@@ -1,0 +1,42 @@
+"""quest_tpu — a TPU-native quantum simulation framework.
+
+A ground-up JAX/XLA re-architecture with the full capability surface of the
+QuEST reference simulator (state-vectors and density matrices; the complete
+unitary/controlled/multi-qubit gate set; measurement and collapse; decoherence
+channels via Kraus maps; Pauli-sum expectations; QASM logging; golden-file
+cross-backend testing) — designed TPU-first rather than ported:
+
+- amplitudes live in one (shardable) flat complex ``jax.Array``;
+- gates are axis contractions fused by XLA; diagonal gates are broadcast
+  multiplies; k-qubit gates are MXU matmuls;
+- distribution shards the high-qubit axis over a ``jax.sharding.Mesh``
+  (the reference's MPI chunk layout), with pair exchanges lowering to
+  ``ppermute`` over ICI and reductions to ``psum``;
+- whole circuits jit into single XLA programs (``quest_tpu.circuits``),
+  eliminating the per-gate dispatch the reference pays.
+
+The public API mirrors the reference's function names and argument orders
+(``QuEST.h``); C count-parameters are inferred from Python sequence lengths.
+"""
+
+from .config import Precision, SINGLE, DOUBLE, default_precision
+from .types import (
+    PauliOpType, PAULI_I, PAULI_X, PAULI_Y, PAULI_Z,
+    QuESTError, invalid_quest_input_error, set_input_error_handler,
+)
+from .env import QuESTEnv, create_quest_env, destroy_quest_env
+from .qureg import Qureg
+from .api import *  # noqa: F401,F403  (the QuEST-compatible surface)
+from .api import __all__ as _api_all
+
+__version__ = "0.1.0"
+
+__all__ = (
+    [
+        "Precision", "SINGLE", "DOUBLE", "default_precision",
+        "PauliOpType", "PAULI_I", "PAULI_X", "PAULI_Y", "PAULI_Z",
+        "QuESTError", "invalid_quest_input_error", "set_input_error_handler",
+        "QuESTEnv", "create_quest_env", "destroy_quest_env", "Qureg",
+    ]
+    + list(_api_all)
+)

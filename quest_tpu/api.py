@@ -1,0 +1,1356 @@
+"""The public QuEST-compatible API surface.
+
+Implements every user-facing function of the reference's public header
+(``QuEST.h``; inventory in SURVEY.md §2.6) with the same names, argument
+orders, and numerical conventions, dispatching to the pure-functional TPU ops.
+Each function follows the reference's 3-step shape (``QuEST.c``):
+validate -> apply -> record QASM.
+
+Density-matrix handling improves on the reference: where ``QuEST.c:175-658``
+issues *two* sequential statevector calls per gate (U on targets, conj(U) on
+targets+n), we apply the single combined operator ``conj(U) (x) U`` on
+``(targets, targets+n)`` — one fused pass over the 4^n amplitudes instead of
+two.
+
+Scalars returned by calc* functions are Python floats/complex (device sync);
+gate application stays asynchronous on device.
+"""
+
+from __future__ import annotations
+
+import functools
+import numbers
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import validation as val
+from .config import Precision
+from .core import matrices as mats
+from .core.apply import apply_diagonal, apply_unitary
+from .env import QuESTEnv, create_quest_env, destroy_quest_env
+from .ops import channels as chan
+from .ops import densmatr as dm
+from .ops import statevec as sv
+from .qureg import Qureg
+from .types import PauliOpType, QuESTError
+
+__all__ = [
+    # env
+    "createQuESTEnv", "destroyQuESTEnv", "syncQuESTEnv", "syncQuESTSuccess",
+    "reportQuESTEnv", "getEnvironmentString", "seedQuEST", "seedQuESTDefault",
+    # registers
+    "createQureg", "createDensityQureg", "createCloneQureg", "destroyQureg",
+    "createComplexMatrixN", "destroyComplexMatrixN", "initComplexMatrixN",
+    "copyStateToGPU", "copyStateFromGPU",
+    # init
+    "initBlankState", "initZeroState", "initPlusState", "initClassicalState",
+    "initPureState", "initDebugState", "initStateFromAmps", "setAmps",
+    "setDensityAmps", "cloneQureg", "setWeightedQureg",
+    "initStateOfSingleQubit",
+    # 1q gates
+    "phaseShift", "sGate", "tGate", "pauliX", "pauliY", "pauliZ", "hadamard",
+    "compactUnitary", "unitary", "rotateX", "rotateY", "rotateZ",
+    "rotateAroundAxis",
+    # controlled / multi-qubit
+    "controlledPhaseShift", "multiControlledPhaseShift", "controlledPhaseFlip",
+    "multiControlledPhaseFlip", "controlledNot", "controlledPauliY",
+    "controlledRotateX", "controlledRotateY", "controlledRotateZ",
+    "controlledRotateAroundAxis", "controlledCompactUnitary",
+    "controlledUnitary", "multiControlledUnitary", "multiStateControlledUnitary",
+    "swapGate", "sqrtSwapGate", "multiRotateZ", "multiRotatePauli",
+    "twoQubitUnitary", "controlledTwoQubitUnitary",
+    "multiControlledTwoQubitUnitary", "multiQubitUnitary",
+    "controlledMultiQubitUnitary", "multiControlledMultiQubitUnitary",
+    "applyPauliSum",
+    # measurement
+    "calcProbOfOutcome", "collapseToOutcome", "measure", "measureWithStats",
+    # calculations
+    "getNumQubits", "getNumAmps", "getAmp", "getRealAmp", "getImagAmp",
+    "getProbAmp", "getDensityAmp", "calcTotalProb", "calcInnerProduct",
+    "calcDensityInnerProduct", "calcPurity", "calcFidelity",
+    "calcExpecPauliProd", "calcExpecPauliSum", "calcHilbertSchmidtDistance",
+    # decoherence
+    "mixDephasing", "mixTwoQubitDephasing", "mixDepolarising", "mixDamping",
+    "mixTwoQubitDepolarising", "mixPauli", "mixDensityMatrix", "mixKrausMap",
+    "mixTwoQubitKrausMap", "mixMultiQubitKrausMap",
+    # QASM
+    "startRecordingQASM", "stopRecordingQASM", "clearRecordedQASM",
+    "printRecordedQASM", "writeRecordedQASMToFile",
+    # debug / report
+    "reportState", "reportStateToScreen", "reportQuregParams", "compareStates",
+    "initStateFromSingleFile", "getQuEST_PREC",
+]
+
+
+# ---------------------------------------------------------------------------
+# jitted dispatch kernels (cached per static signature)
+#
+# All state and matrix arguments cross the jit boundary as packed (2, ...)
+# float planes (core/packing.py): the TPU backend forbids complex buffers
+# between executables, so complex exists only inside the compiled programs.
+# ---------------------------------------------------------------------------
+
+from .core.packing import pack, unpack, pack_host, unpack_host  # noqa: E402
+
+
+def _state_kernel(static_argnums=(), donate=True):
+    """jit a packed-state kernel, appending a trailing static ``sharding``
+    argument: the output keeps the amplitude sharding so GSPMD never decays a
+    cross-shard gate into full replication (the pair-exchange stays a
+    collective, as the reference's ``exchangeStateVectors`` does)."""
+    def deco(fn):
+        def with_constraint(*args):
+            *real, sharding = args
+            out = fn(*real)
+            if sharding is not None:
+                out = jax.lax.with_sharding_constraint(out, sharding)
+            return out
+
+        n_args = fn.__code__.co_argcount
+        return jax.jit(with_constraint,
+                       static_argnums=tuple(static_argnums) + (n_args,),
+                       donate_argnums=(0,) if donate else ())
+    return deco
+
+
+@_state_kernel(static_argnums=(1, 3, 4, 5))
+def _jit_unitary(state_f, num_qubits, u_f, targets, ctrl_mask, flip_mask):
+    out = apply_unitary(unpack(state_f), num_qubits, unpack(u_f),
+                        targets, ctrl_mask, flip_mask)
+    return pack(out)
+
+
+@_state_kernel(static_argnums=(1, 3))
+def _jit_diag(state_f, num_qubits, tensor_f, qubits_desc):
+    out = apply_diagonal(unpack(state_f), num_qubits, qubits_desc,
+                         unpack(tensor_f))
+    return pack(out)
+
+
+@_state_kernel(static_argnums=(1, 2, 3))
+def _jit_swap(state_f, num_qubits, q1, q2):
+    return pack(sv.swap_amps(unpack(state_f), num_qubits, q1, q2))
+
+
+@_state_kernel(donate=False)
+def _jit_outer(pure_f):
+    """rho = |psi><psi| as a packed flat vector."""
+    return pack(dm.init_pure_state(unpack(pure_f)))
+
+
+@_state_kernel(donate=False)
+def _jit_weighted(f1_f, s1_f, f2_f, s2_f, fo_f, out_f):
+    out = sv.set_weighted(unpack(f1_f), unpack(s1_f), unpack(f2_f),
+                          unpack(s2_f), unpack(fo_f), unpack(out_f))
+    return pack(out)
+
+
+@_state_kernel(donate=False)
+def _jit_mix_linear(p, a_f, b_f):
+    """(1-p)*a + p*b on packed states (real p)."""
+    return pack(dm.mix_density_matrix(unpack(a_f), p, unpack(b_f)))
+
+
+@_state_kernel(static_argnums=(1, 2, 3))
+def _jit_mix_dephasing(state_f, num_qubits, target, prob):
+    return pack(dm.mix_dephasing(unpack(state_f), num_qubits, target, prob))
+
+
+@_state_kernel(static_argnums=(1, 2, 3, 4))
+def _jit_mix_two_qubit_dephasing(state_f, num_qubits, q1, q2, prob):
+    return pack(dm.mix_two_qubit_dephasing(unpack(state_f), num_qubits,
+                                           q1, q2, prob))
+
+
+@_state_kernel(static_argnums=(1, 2))
+def _jit_kraus_superop(state_f, num_qubits, targets, superop_f):
+    return pack(dm.apply_kraus_superoperator(
+        unpack(state_f), num_qubits, targets, unpack(superop_f)))
+
+
+@jax.jit
+def _jit_total_prob_sv(state_f):
+    return jnp.sum(state_f * state_f)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _jit_total_prob_dm(state_f, num_qubits):
+    return dm.calc_total_prob(unpack(state_f), num_qubits)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _jit_prob_outcome_sv(state_f, num_qubits, qubit, outcome):
+    return sv.calc_prob_of_outcome(unpack(state_f), num_qubits, qubit, outcome)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _jit_prob_outcome_dm(state_f, num_qubits, qubit, outcome):
+    return dm.calc_prob_of_outcome(unpack(state_f), num_qubits, qubit, outcome)
+
+
+@_state_kernel(static_argnums=(1, 2, 3))
+def _jit_collapse_sv(state_f, num_qubits, qubit, outcome, prob):
+    return pack(sv.collapse_to_known_prob_outcome(
+        unpack(state_f), num_qubits, qubit, outcome, prob))
+
+
+@_state_kernel(static_argnums=(1, 2, 3))
+def _jit_collapse_dm(state_f, num_qubits, qubit, outcome, prob):
+    return pack(dm.collapse_to_known_prob_outcome(
+        unpack(state_f), num_qubits, qubit, outcome, prob))
+
+
+@jax.jit
+def _jit_inner_product(bra_f, ket_f):
+    ip = sv.calc_inner_product(unpack(bra_f), unpack(ket_f))
+    return jnp.real(ip), jnp.imag(ip)
+
+
+@jax.jit
+def _jit_purity(state_f):
+    return jnp.sum(state_f * state_f)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _jit_fidelity_dm(state_f, num_qubits, pure_f):
+    return dm.calc_fidelity(unpack(state_f), num_qubits, unpack(pure_f))
+
+
+@jax.jit
+def _jit_dm_inner(a_f, b_f):
+    return dm.calc_inner_product(unpack(a_f), unpack(b_f))
+
+
+@jax.jit
+def _jit_hs_dist(a_f, b_f):
+    return dm.calc_hilbert_schmidt_distance(unpack(a_f), unpack(b_f))
+
+
+def _bitmask(qubits: Sequence[int]) -> int:
+    m = 0
+    for q in qubits:
+        m |= 1 << int(q)
+    return m
+
+
+def _packed(qureg: Qureg, mat: np.ndarray) -> jnp.ndarray:
+    return jnp.asarray(pack_host(mat, qureg.real_dtype))
+
+
+def _shard(qureg: Qureg):
+    """Amplitude sharding for this register's env (None on single device)."""
+    return qureg.env.sharding()
+
+
+def _apply_gate(qureg: Qureg, u: np.ndarray, targets: Sequence[int],
+                controls: Sequence[int] = (), flips: Sequence[int] = ()) -> None:
+    """Apply u (with controls) to a register; density registers get the
+    combined conj(u) (x) u on (targets, targets+n) in one pass."""
+    n = qureg.num_qubits_represented
+    targets = tuple(int(t) for t in targets)
+    ctrl_mask, flip_mask = _bitmask(controls), _bitmask(flips)
+    if qureg.is_density_matrix and not ctrl_mask:
+        # fused single pass: conj(U) (x) U on (targets, targets+n)
+        u2 = np.kron(np.conj(u), u)
+        targets2 = targets + tuple(t + n for t in targets)
+        qureg.state = _jit_unitary(qureg.state, 2 * n, _packed(qureg, u2),
+                                   targets2, 0, 0, _shard(qureg))
+    elif qureg.is_density_matrix:
+        # row- and column-side controls condition independently, so a
+        # controlled gate needs the reference's two-pass form
+        # (``QuEST.c:352-357``): U on (targets | controls), then conj(U) on
+        # the shifted copies
+        qureg.state = _jit_unitary(qureg.state, 2 * n, _packed(qureg, u),
+                                   targets, ctrl_mask, flip_mask,
+                                   _shard(qureg))
+        qureg.state = _jit_unitary(qureg.state, 2 * n,
+                                   _packed(qureg, np.conj(u)),
+                                   tuple(t + n for t in targets),
+                                   ctrl_mask << n, flip_mask << n,
+                                   _shard(qureg))
+    else:
+        qureg.state = _jit_unitary(qureg.state, n, _packed(qureg, u),
+                                   targets, ctrl_mask, flip_mask,
+                                   _shard(qureg))
+
+
+def _apply_diag_gate(qureg: Qureg, tensor: np.ndarray,
+                     qubits: Sequence[int]) -> None:
+    """Apply a diagonal factor tensor (axis i = i-th qubit of ``qubits``
+    sorted descending); density registers get conj on the column side."""
+    n = qureg.num_qubits_represented
+    qs = tuple(sorted((int(q) for q in qubits), reverse=True))
+    tensor = np.asarray(tensor, dtype=np.complex128)
+    if qureg.is_density_matrix:
+        tensor = np.multiply.outer(np.conj(tensor), tensor)
+        qs = tuple(q + n for q in qs) + qs
+    qureg.state = _jit_diag(qureg.state, qureg.num_qubits_in_state_vec,
+                            _packed(qureg, tensor), qs, _shard(qureg))
+
+
+# ---------------------------------------------------------------------------
+# environment (QuEST.h:785-832)
+# ---------------------------------------------------------------------------
+
+def createQuESTEnv(num_devices: Optional[int] = None,
+                   precision: Optional[Precision] = None,
+                   seed: Optional[Sequence[int]] = None) -> QuESTEnv:
+    return create_quest_env(num_devices=num_devices, precision=precision, seed=seed)
+
+
+def destroyQuESTEnv(env: QuESTEnv) -> None:
+    destroy_quest_env(env)
+
+
+def syncQuESTEnv(env: QuESTEnv) -> None:
+    env.sync()
+
+
+def syncQuESTSuccess(success_code: int) -> int:
+    """Logical-AND agreement across ranks (``QuEST_cpu_distributed.c:163``);
+    SPMD programs agree by construction."""
+    return int(bool(success_code))
+
+
+def reportQuESTEnv(env: QuESTEnv) -> None:
+    print(env.report())
+
+
+def getEnvironmentString(env: QuESTEnv) -> str:
+    mode = "mesh" if env.mesh is not None else "local"
+    return (f"CUDA=0 OpenMP=0 MPI=0 TPU=1 mode={mode} "
+            f"threads=1 ranks={env.num_ranks}")
+
+
+def seedQuEST(env: QuESTEnv, seeds: Sequence[int]) -> None:
+    env.seed(seeds)
+
+
+def seedQuESTDefault(env: QuESTEnv) -> None:
+    env.seed_default()
+
+
+# ---------------------------------------------------------------------------
+# register management (QuEST.h:224-292)
+# ---------------------------------------------------------------------------
+
+def createQureg(num_qubits: int, env: QuESTEnv) -> Qureg:
+    val.validate_num_qubits(num_qubits, "createQureg")
+    q = Qureg(num_qubits, env, is_density=False)
+    initZeroState(q)
+    return q
+
+
+def createDensityQureg(num_qubits: int, env: QuESTEnv) -> Qureg:
+    val.validate_num_qubits(num_qubits, "createDensityQureg")
+    q = Qureg(num_qubits, env, is_density=True)
+    initZeroState(q)
+    return q
+
+
+def createCloneQureg(qureg: Qureg, env: QuESTEnv) -> Qureg:
+    new = Qureg(qureg.num_qubits_represented, env,
+                is_density=qureg.is_density_matrix)
+    # deep copy: gate kernels donate their input buffer, so clones must not
+    # alias the source register's storage
+    new.state = jnp.array(qureg.state, copy=True)
+    return new
+
+
+def destroyQureg(qureg: Qureg, env: QuESTEnv = None) -> None:
+    qureg.state = None
+
+
+def createComplexMatrixN(num_qubits: int) -> np.ndarray:
+    val.validate_num_qubits(num_qubits, "createComplexMatrixN")
+    d = 1 << num_qubits
+    return np.zeros((d, d), dtype=np.complex128)
+
+
+def destroyComplexMatrixN(m: np.ndarray) -> None:
+    pass  # numpy arrays are GC-managed; kept for API parity
+
+
+def initComplexMatrixN(m: np.ndarray, re, im) -> None:
+    m[...] = np.asarray(re, dtype=np.float64) + 1j * np.asarray(im, dtype=np.float64)
+
+
+def copyStateToGPU(qureg: Qureg) -> None:
+    """No-op: amplitudes already live on device (``copyStateToGPU``
+    ``QuEST.h:855`` exists because the reference mirrors host/device copies)."""
+    jax.block_until_ready(qureg.state)
+
+
+def copyStateFromGPU(qureg: Qureg) -> None:
+    jax.block_until_ready(qureg.state)
+
+
+# ---------------------------------------------------------------------------
+# state initialisation (QuEST.h:383-506)
+# ---------------------------------------------------------------------------
+
+def initBlankState(qureg: Qureg) -> None:
+    qureg.device_put(np.zeros(qureg.num_amps_total, dtype=np.complex128))
+    qureg.qasm_log.record_comment(
+        "the register was set to the unphysical all-zero-amplitudes state")
+
+
+def initZeroState(qureg: Qureg) -> None:
+    arr = np.zeros(qureg.num_amps_total, dtype=np.complex128)
+    arr[0] = 1.0
+    qureg.device_put(arr)
+    qureg.qasm_log.record_init_zero()
+
+
+def initPlusState(qureg: Qureg) -> None:
+    n = qureg.num_qubits_represented
+    if qureg.is_density_matrix:
+        arr = np.full(qureg.num_amps_total, 1.0 / (1 << n), dtype=np.complex128)
+    else:
+        arr = np.full(qureg.num_amps_total, 1.0 / np.sqrt(1 << n),
+                      dtype=np.complex128)
+    qureg.device_put(arr)
+    qureg.qasm_log.record_init_plus()
+
+
+def initClassicalState(qureg: Qureg, state_ind: int) -> None:
+    val.validate_state_index(qureg.num_qubits_represented, state_ind,
+                             "initClassicalState")
+    arr = np.zeros(qureg.num_amps_total, dtype=np.complex128)
+    if qureg.is_density_matrix:
+        arr[state_ind * ((1 << qureg.num_qubits_represented) + 1)] = 1.0
+    else:
+        arr[state_ind] = 1.0
+    qureg.device_put(arr)
+    qureg.qasm_log.record_init_classical(state_ind)
+
+
+def initPureState(qureg: Qureg, pure: Qureg) -> None:
+    val.validate_state_vec(pure.is_density_matrix, "initPureState")
+    val.validate_matching_dims(qureg.num_qubits_represented,
+                               pure.num_qubits_represented, "initPureState")
+    if qureg.is_density_matrix:
+        qureg.state = _jit_outer(pure.state, _shard(qureg))
+    else:
+        qureg.state = jnp.array(pure.state, copy=True)
+    qureg.qasm_log.record_comment(
+        "the register was initialised to an undisclosed pure state")
+
+
+def initDebugState(qureg: Qureg) -> None:
+    idx = np.arange(qureg.num_amps_total, dtype=np.float64)
+    qureg.device_put((2.0 * idx + 1j * (2.0 * idx + 1.0)) / 10.0)
+
+
+def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
+    val.validate_state_vec(qureg.is_density_matrix, "initStateFromAmps")
+    arr = np.asarray(reals, dtype=np.float64) + 1j * np.asarray(imags, np.float64)
+    val.validate_num_amps(qureg.num_amps_total, 0, arr.size, "initStateFromAmps")
+    if arr.size != qureg.num_amps_total:
+        val._fail("the amplitude arrays must cover the full register",
+                  "initStateFromAmps")
+    qureg.device_put(arr)
+    qureg.qasm_log.record_comment(
+        "the register was initialised to an undisclosed pure state")
+
+
+def setAmps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
+    val.validate_state_vec(qureg.is_density_matrix, "setAmps")
+    val.validate_num_amps(qureg.num_amps_total, start_ind, num_amps, "setAmps")
+    vals = np.stack([np.asarray(reals, np.float64)[:num_amps],
+                     np.asarray(imags, np.float64)[:num_amps]])
+    qureg.state = qureg.state.at[:, start_ind:start_ind + num_amps].set(
+        jnp.asarray(vals, qureg.real_dtype))
+    qureg.qasm_log.record_comment("amplitudes were manually edited")
+
+
+def setDensityAmps(qureg: Qureg, reals, imags) -> None:
+    arr = np.asarray(reals, np.float64).reshape(-1) \
+        + 1j * np.asarray(imags, np.float64).reshape(-1)
+    if arr.size != qureg.num_amps_total:
+        val._fail("the amplitude arrays must cover the full density matrix",
+                  "setDensityAmps")
+    qureg.device_put(arr)
+    qureg.qasm_log.record_comment("density-matrix amplitudes were manually edited")
+
+
+def cloneQureg(target: Qureg, copy: Qureg) -> None:
+    val.validate_matching_types(target.is_density_matrix,
+                                copy.is_density_matrix, "cloneQureg")
+    val.validate_matching_dims(target.num_qubits_represented,
+                               copy.num_qubits_represented, "cloneQureg")
+    target.state = jnp.array(copy.state, copy=True)
+
+
+def setWeightedQureg(fac1, qureg1: Qureg, fac2, qureg2: Qureg,
+                     fac_out, out: Qureg) -> None:
+    val.validate_matching_types(qureg1.is_density_matrix,
+                                qureg2.is_density_matrix, "setWeightedQureg")
+    val.validate_matching_types(qureg1.is_density_matrix,
+                                out.is_density_matrix, "setWeightedQureg")
+    val.validate_matching_dims(qureg1.num_qubits_represented,
+                               qureg2.num_qubits_represented, "setWeightedQureg")
+    val.validate_matching_dims(qureg1.num_qubits_represented,
+                               out.num_qubits_represented, "setWeightedQureg")
+    rd = out.real_dtype
+    out.state = _jit_weighted(
+        jnp.asarray(pack_host(np.asarray(fac1, np.complex128), rd)),
+        qureg1.state,
+        jnp.asarray(pack_host(np.asarray(fac2, np.complex128), rd)),
+        qureg2.state,
+        jnp.asarray(pack_host(np.asarray(fac_out, np.complex128), rd)),
+        out.state, _shard(out))
+    out.qasm_log.record_comment(
+        "the register was set to a weighted combination (possibly unphysical)")
+
+
+def initStateOfSingleQubit(qureg: Qureg, qubit: int, outcome: int) -> None:
+    val.validate_state_vec(qureg.is_density_matrix, "initStateOfSingleQubit")
+    val.validate_target(qureg.num_qubits_represented, qubit,
+                        "initStateOfSingleQubit")
+    val.validate_outcome(outcome, "initStateOfSingleQubit")
+    idx = np.arange(qureg.num_amps_total)
+    amp = np.where(((idx >> qubit) & 1) == outcome,
+                   1.0 / np.sqrt(qureg.num_amps_total // 2), 0.0)
+    qureg.device_put(amp.astype(np.complex128))
+
+
+# ---------------------------------------------------------------------------
+# single-qubit gates (QuEST.h:540-1583)
+# ---------------------------------------------------------------------------
+
+def hadamard(qureg: Qureg, target: int) -> None:
+    val.validate_target(qureg.num_qubits_represented, target, "hadamard")
+    _apply_gate(qureg, mats.hadamard(), (target,))
+    qureg.qasm_log.record_gate("hadamard", target)
+
+
+def pauliX(qureg: Qureg, target: int) -> None:
+    val.validate_target(qureg.num_qubits_represented, target, "pauliX")
+    _apply_gate(qureg, mats.pauli_x(), (target,))
+    qureg.qasm_log.record_gate("sigma_x", target)
+
+
+def pauliY(qureg: Qureg, target: int) -> None:
+    val.validate_target(qureg.num_qubits_represented, target, "pauliY")
+    _apply_gate(qureg, mats.pauli_y(), (target,))
+    qureg.qasm_log.record_gate("sigma_y", target)
+
+
+def pauliZ(qureg: Qureg, target: int) -> None:
+    val.validate_target(qureg.num_qubits_represented, target, "pauliZ")
+    _apply_diag_gate(qureg, np.array([1.0, -1.0]), (target,))
+    qureg.qasm_log.record_gate("sigma_z", target)
+
+
+def sGate(qureg: Qureg, target: int) -> None:
+    val.validate_target(qureg.num_qubits_represented, target, "sGate")
+    _apply_diag_gate(qureg, np.array([1.0, 1j]), (target,))
+    qureg.qasm_log.record_gate("s", target)
+
+
+def tGate(qureg: Qureg, target: int) -> None:
+    val.validate_target(qureg.num_qubits_represented, target, "tGate")
+    _apply_diag_gate(qureg, np.array([1.0, np.exp(1j * np.pi / 4)]), (target,))
+    qureg.qasm_log.record_gate("t", target)
+
+
+def phaseShift(qureg: Qureg, target: int, angle: float) -> None:
+    val.validate_target(qureg.num_qubits_represented, target, "phaseShift")
+    _apply_diag_gate(qureg, np.array([1.0, np.exp(1j * angle)]), (target,))
+    qureg.qasm_log.record_param_gate("phase_shift", target, angle)
+
+
+def compactUnitary(qureg: Qureg, target: int, alpha, beta) -> None:
+    val.validate_target(qureg.num_qubits_represented, target, "compactUnitary")
+    val.validate_unitary_complex_pair(alpha, beta, "compactUnitary",
+                                      qureg.env.precision.eps)
+    _apply_gate(qureg, mats.compact_unitary(alpha, beta), (target,))
+    qureg.qasm_log.record_compact_unitary(alpha, beta, target)
+
+
+def unitary(qureg: Qureg, target: int, u) -> None:
+    val.validate_target(qureg.num_qubits_represented, target, "unitary")
+    u = mats.matrix2(u)
+    val.validate_unitary(u, "unitary", qureg.env.precision.eps)
+    _apply_gate(qureg, u, (target,))
+    qureg.qasm_log.record_unitary(u, target)
+
+
+def rotateX(qureg: Qureg, target: int, angle: float) -> None:
+    rotateAroundAxis(qureg, target, angle, (1.0, 0.0, 0.0), _label="rotate_x",
+                     _angle=angle)
+
+
+def rotateY(qureg: Qureg, target: int, angle: float) -> None:
+    rotateAroundAxis(qureg, target, angle, (0.0, 1.0, 0.0), _label="rotate_y",
+                     _angle=angle)
+
+
+def rotateZ(qureg: Qureg, target: int, angle: float) -> None:
+    rotateAroundAxis(qureg, target, angle, (0.0, 0.0, 1.0), _label="rotate_z",
+                     _angle=angle)
+
+
+def rotateAroundAxis(qureg: Qureg, target: int, angle: float, axis,
+                     _label: Optional[str] = None,
+                     _angle: Optional[float] = None) -> None:
+    val.validate_target(qureg.num_qubits_represented, target, "rotateAroundAxis")
+    val.validate_vector(axis, "rotateAroundAxis")
+    _apply_gate(qureg, mats.rotation(angle, axis), (target,))
+    if _label is not None:
+        qureg.qasm_log.record_param_gate(_label, target, _angle)
+    else:
+        qureg.qasm_log.record_axis_rotation(angle, axis, target)
+
+
+# ---------------------------------------------------------------------------
+# controlled gates (QuEST.h:583-1669)
+# ---------------------------------------------------------------------------
+
+def controlledNot(qureg: Qureg, control: int, target: int) -> None:
+    val.validate_control_target(qureg.num_qubits_represented, control, target,
+                                "controlledNot")
+    _apply_gate(qureg, mats.pauli_x(), (target,), (control,))
+    qureg.qasm_log.record_gate("sigma_x", target, (control,))
+
+
+def controlledPauliY(qureg: Qureg, control: int, target: int) -> None:
+    val.validate_control_target(qureg.num_qubits_represented, control, target,
+                                "controlledPauliY")
+    _apply_gate(qureg, mats.pauli_y(), (target,), (control,))
+    qureg.qasm_log.record_gate("sigma_y", target, (control,))
+
+
+def controlledPhaseShift(qureg: Qureg, q1: int, q2: int, angle: float) -> None:
+    val.validate_control_target(qureg.num_qubits_represented, q1, q2,
+                                "controlledPhaseShift")
+    tensor = np.ones((2, 2), dtype=np.complex128)
+    tensor[1, 1] = np.exp(1j * angle)
+    _apply_diag_gate(qureg, tensor, (q1, q2))
+    qureg.qasm_log.record_param_gate("phase_shift", q2, angle, (q1,))
+
+
+def multiControlledPhaseShift(qureg: Qureg, qubits: Sequence[int],
+                              angle: float) -> None:
+    val.validate_multi_targets(qureg.num_qubits_represented, qubits,
+                               "multiControlledPhaseShift")
+    k = len(qubits)
+    tensor = np.ones((2,) * k, dtype=np.complex128)
+    tensor[(1,) * k] = np.exp(1j * angle)
+    _apply_diag_gate(qureg, tensor, qubits)
+    qureg.qasm_log.record_param_gate("phase_shift", qubits[-1], angle,
+                                     tuple(qubits[:-1]))
+
+
+def controlledPhaseFlip(qureg: Qureg, q1: int, q2: int) -> None:
+    val.validate_control_target(qureg.num_qubits_represented, q1, q2,
+                                "controlledPhaseFlip")
+    tensor = np.ones((2, 2), dtype=np.complex128)
+    tensor[1, 1] = -1.0
+    _apply_diag_gate(qureg, tensor, (q1, q2))
+    qureg.qasm_log.record_gate("sigma_z", q2, (q1,))
+
+
+def multiControlledPhaseFlip(qureg: Qureg, qubits: Sequence[int]) -> None:
+    val.validate_multi_targets(qureg.num_qubits_represented, qubits,
+                               "multiControlledPhaseFlip")
+    k = len(qubits)
+    tensor = np.ones((2,) * k, dtype=np.complex128)
+    tensor[(1,) * k] = -1.0
+    _apply_diag_gate(qureg, tensor, qubits)
+    qureg.qasm_log.record_gate("sigma_z", qubits[-1], tuple(qubits[:-1]))
+
+
+def controlledRotateX(qureg, control, target, angle):
+    controlledRotateAroundAxis(qureg, control, target, angle, (1, 0, 0),
+                               _label="rotate_x", _angle=angle)
+
+
+def controlledRotateY(qureg, control, target, angle):
+    controlledRotateAroundAxis(qureg, control, target, angle, (0, 1, 0),
+                               _label="rotate_y", _angle=angle)
+
+
+def controlledRotateZ(qureg, control, target, angle):
+    controlledRotateAroundAxis(qureg, control, target, angle, (0, 0, 1),
+                               _label="rotate_z", _angle=angle)
+
+
+def controlledRotateAroundAxis(qureg: Qureg, control: int, target: int,
+                               angle: float, axis,
+                               _label: Optional[str] = None,
+                               _angle: Optional[float] = None) -> None:
+    val.validate_control_target(qureg.num_qubits_represented, control, target,
+                                "controlledRotateAroundAxis")
+    val.validate_vector(axis, "controlledRotateAroundAxis")
+    _apply_gate(qureg, mats.rotation(angle, axis), (target,), (control,))
+    if _label is not None:
+        qureg.qasm_log.record_param_gate(_label, target, _angle, (control,))
+    else:
+        qureg.qasm_log.record_axis_rotation(angle, axis, target, (control,))
+
+
+def controlledCompactUnitary(qureg: Qureg, control: int, target: int,
+                             alpha, beta) -> None:
+    val.validate_control_target(qureg.num_qubits_represented, control, target,
+                                "controlledCompactUnitary")
+    val.validate_unitary_complex_pair(alpha, beta, "controlledCompactUnitary",
+                                      qureg.env.precision.eps)
+    _apply_gate(qureg, mats.compact_unitary(alpha, beta), (target,), (control,))
+    qureg.qasm_log.record_compact_unitary(alpha, beta, target, (control,))
+
+
+def controlledUnitary(qureg: Qureg, control: int, target: int, u) -> None:
+    val.validate_control_target(qureg.num_qubits_represented, control, target,
+                                "controlledUnitary")
+    u = mats.matrix2(u)
+    val.validate_unitary(u, "controlledUnitary", qureg.env.precision.eps)
+    _apply_gate(qureg, u, (target,), (control,))
+    qureg.qasm_log.record_unitary(u, target, (control,))
+
+
+def multiControlledUnitary(qureg: Qureg, controls: Sequence[int],
+                           target: int, u) -> None:
+    val.validate_multi_controls_multi_targets(
+        qureg.num_qubits_represented, controls, (target,),
+        "multiControlledUnitary")
+    u = mats.matrix2(u)
+    val.validate_unitary(u, "multiControlledUnitary", qureg.env.precision.eps)
+    _apply_gate(qureg, u, (target,), tuple(controls))
+    qureg.qasm_log.record_unitary(u, target, tuple(controls))
+
+
+def multiStateControlledUnitary(qureg: Qureg, controls: Sequence[int],
+                                control_state: Sequence[int],
+                                target: int, u) -> None:
+    val.validate_multi_controls_multi_targets(
+        qureg.num_qubits_represented, controls, (target,),
+        "multiStateControlledUnitary")
+    val.validate_control_state(control_state, len(controls),
+                               "multiStateControlledUnitary")
+    u = mats.matrix2(u)
+    val.validate_unitary(u, "multiStateControlledUnitary",
+                         qureg.env.precision.eps)
+    flips = tuple(c for c, s in zip(controls, control_state) if s == 0)
+    _apply_gate(qureg, u, (target,), tuple(controls), flips)
+    qureg.qasm_log.record_multi_state_controlled_unitary(
+        u, tuple(controls), tuple(control_state), target)
+
+
+# ---------------------------------------------------------------------------
+# two-/multi-qubit gates (QuEST.h:2232-3043)
+# ---------------------------------------------------------------------------
+
+def swapGate(qureg: Qureg, q1: int, q2: int) -> None:
+    val.validate_unique_targets(qureg.num_qubits_represented, q1, q2, "swapGate")
+    n = qureg.num_qubits_represented
+    if qureg.is_density_matrix:
+        qureg.state = _jit_swap(qureg.state, 2 * n, q1, q2, _shard(qureg))
+        qureg.state = _jit_swap(qureg.state, 2 * n, q1 + n, q2 + n, _shard(qureg))
+    else:
+        qureg.state = _jit_swap(qureg.state, n, q1, q2, _shard(qureg))
+    qureg.qasm_log.record_gate("swap", q2, (q1,))
+
+
+def sqrtSwapGate(qureg: Qureg, q1: int, q2: int) -> None:
+    val.validate_unique_targets(qureg.num_qubits_represented, q1, q2,
+                                "sqrtSwapGate")
+    _apply_gate(qureg, mats.sqrt_swap(), (q1, q2))
+    qureg.qasm_log.record_gate("sqrt_swap", q2, (q1,))
+
+
+def multiRotateZ(qureg: Qureg, qubits: Sequence[int], angle: float) -> None:
+    val.validate_multi_targets(qureg.num_qubits_represented, qubits,
+                               "multiRotateZ")
+    k = len(qubits)
+    _apply_diag_gate(qureg, sv.multi_rotate_z_diag(k, angle), qubits)
+    qureg.qasm_log.record_comment(
+        f"a {k}-qubit multiRotateZ of angle {angle:g} was applied")
+
+
+def multiRotatePauli(qureg: Qureg, targets: Sequence[int],
+                     paulis: Sequence[int], angle: float) -> None:
+    """exp(-i angle/2 P1 (x) P2 ...) via basis rotation to Z then multiRotateZ
+    (``statevec_multiRotatePauli`` ``QuEST_common.c:410-447``). Composed from
+    density-aware primitives, so the conj side is handled per-gate."""
+    val.validate_multi_targets(qureg.num_qubits_represented, targets,
+                               "multiRotatePauli")
+    val.validate_pauli_codes(paulis, "multiRotatePauli")
+    fac = 1.0 / np.sqrt(2.0)
+    u_rx = mats.compact_unitary(fac, -1j * fac)    # rotates Z -> Y
+    u_ry = mats.compact_unitary(fac, -fac)         # rotates Z -> X
+    z_targets = []
+    for t, p in zip(targets, paulis):
+        p = int(p)
+        if p == PauliOpType.PAULI_X:
+            _apply_gate(qureg, u_ry, (t,))
+        elif p == PauliOpType.PAULI_Y:
+            _apply_gate(qureg, u_rx, (t,))
+        if p != PauliOpType.PAULI_I:
+            z_targets.append(t)
+    if z_targets:
+        _apply_diag_gate(qureg, sv.multi_rotate_z_diag(len(z_targets), angle),
+                         z_targets)
+    for t, p in zip(targets, paulis):
+        p = int(p)
+        if p == PauliOpType.PAULI_X:
+            _apply_gate(qureg, u_ry.conj().T, (t,))
+        elif p == PauliOpType.PAULI_Y:
+            _apply_gate(qureg, u_rx.conj().T, (t,))
+    qureg.qasm_log.record_comment(
+        f"a {len(targets)}-qubit multiRotatePauli of angle {angle:g} was applied")
+
+
+def twoQubitUnitary(qureg: Qureg, t1: int, t2: int, u) -> None:
+    val.validate_multi_targets(qureg.num_qubits_represented, (t1, t2),
+                               "twoQubitUnitary")
+    u = mats.matrix4(u)
+    val.validate_unitary(u, "twoQubitUnitary", qureg.env.precision.eps)
+    _apply_gate(qureg, u, (t1, t2))
+    qureg.qasm_log.record_comment("an undisclosed 2-qubit unitary was applied")
+
+
+def controlledTwoQubitUnitary(qureg: Qureg, control: int, t1: int, t2: int,
+                              u) -> None:
+    val.validate_multi_controls_multi_targets(
+        qureg.num_qubits_represented, (control,), (t1, t2),
+        "controlledTwoQubitUnitary")
+    u = mats.matrix4(u)
+    val.validate_unitary(u, "controlledTwoQubitUnitary",
+                         qureg.env.precision.eps)
+    _apply_gate(qureg, u, (t1, t2), (control,))
+    qureg.qasm_log.record_comment(
+        "an undisclosed controlled 2-qubit unitary was applied")
+
+
+def multiControlledTwoQubitUnitary(qureg: Qureg, controls: Sequence[int],
+                                   t1: int, t2: int, u) -> None:
+    val.validate_multi_controls_multi_targets(
+        qureg.num_qubits_represented, controls, (t1, t2),
+        "multiControlledTwoQubitUnitary")
+    u = mats.matrix4(u)
+    val.validate_unitary(u, "multiControlledTwoQubitUnitary",
+                         qureg.env.precision.eps)
+    _apply_gate(qureg, u, (t1, t2), tuple(controls))
+    qureg.qasm_log.record_comment(
+        "an undisclosed multi-controlled 2-qubit unitary was applied")
+
+
+def multiQubitUnitary(qureg: Qureg, targets: Sequence[int], u) -> None:
+    val.validate_multi_targets(qureg.num_qubits_represented, targets,
+                               "multiQubitUnitary")
+    u = np.asarray(u, dtype=np.complex128)
+    val.validate_matrix_dim(u, len(targets), "multiQubitUnitary")
+    val.validate_unitary(u, "multiQubitUnitary", qureg.env.precision.eps)
+    _apply_gate(qureg, u, tuple(targets))
+    qureg.qasm_log.record_comment(
+        "an undisclosed multi-qubit unitary was applied")
+
+
+def controlledMultiQubitUnitary(qureg: Qureg, control: int,
+                                targets: Sequence[int], u) -> None:
+    multiControlledMultiQubitUnitary(qureg, (control,), targets, u)
+
+
+def multiControlledMultiQubitUnitary(qureg: Qureg, controls: Sequence[int],
+                                     targets: Sequence[int], u) -> None:
+    val.validate_multi_controls_multi_targets(
+        qureg.num_qubits_represented, controls, targets,
+        "multiControlledMultiQubitUnitary")
+    u = np.asarray(u, dtype=np.complex128)
+    val.validate_matrix_dim(u, len(targets), "multiControlledMultiQubitUnitary")
+    val.validate_unitary(u, "multiControlledMultiQubitUnitary",
+                         qureg.env.precision.eps)
+    _apply_gate(qureg, u, tuple(targets), tuple(controls))
+    qureg.qasm_log.record_comment(
+        "an undisclosed multi-controlled multi-qubit unitary was applied")
+
+
+# ---------------------------------------------------------------------------
+# Pauli sums (QuEST.h:2454-3151)
+# ---------------------------------------------------------------------------
+
+def _pauli_prod_state(state, num_qubits_in_vec, targets, codes):
+    """paulis |state> (complex, jit-internal), acting on the raw vector
+    (row side for densities)."""
+    for t, p in zip(targets, codes):
+        p = int(p)
+        if p == PauliOpType.PAULI_I:
+            continue
+        state = apply_unitary(state, num_qubits_in_vec, mats.PAULI_MATS[p],
+                              (int(t),))
+    return state
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _jit_expec_pauli_sv(state_f, num_qubits, targets, codes):
+    z = unpack(state_f)
+    return jnp.real(jnp.vdot(z, _pauli_prod_state(z, num_qubits, targets, codes)))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _jit_expec_pauli_dm(state_f, num_qubits_vec, num_qubits, targets, codes):
+    z = unpack(state_f)
+    return dm.calc_total_prob(
+        _pauli_prod_state(z, num_qubits_vec, targets, codes), num_qubits)
+
+
+@_state_kernel(static_argnums=(1, 2, 3), donate=False)
+def _jit_apply_pauli_sum(state_f, num_qubits_vec, num_qubits, codes_flat,
+                         coeffs_f):
+    z = unpack(state_f)
+    targets = tuple(range(num_qubits))
+    acc = jnp.zeros_like(z)
+    num_terms = len(codes_flat) // num_qubits
+    for t in range(num_terms):
+        codes = codes_flat[t * num_qubits:(t + 1) * num_qubits]
+        acc = acc + coeffs_f[t].astype(z.dtype) * _pauli_prod_state(
+            z, num_qubits_vec, targets, codes)
+    return pack(acc)
+
+
+def calcExpecPauliProd(qureg: Qureg, targets: Sequence[int],
+                       codes: Sequence[int], num_targets: int = None,
+                       workspace: Qureg = None) -> float:
+    """C-signature parity: the 4th positional argument is numTargets
+    (``QuEST.h:2454``); in Python it may be omitted (inferred from lengths)."""
+    if num_targets is not None and not isinstance(num_targets, numbers.Integral):
+        workspace, num_targets = num_targets, None
+    if num_targets is not None:
+        num_targets = int(num_targets)
+    if num_targets is not None:
+        targets = tuple(targets)[:num_targets]
+        codes = tuple(codes)[:num_targets]
+    val.validate_multi_targets(qureg.num_qubits_represented, targets,
+                               "calcExpecPauliProd")
+    val.validate_pauli_codes(codes, "calcExpecPauliProd")
+    targets = tuple(int(t) for t in targets)
+    codes = tuple(int(c) for c in codes)
+    if qureg.is_density_matrix:
+        value = _jit_expec_pauli_dm(qureg.state, qureg.num_qubits_in_state_vec,
+                                    qureg.num_qubits_represented, targets, codes)
+    else:
+        value = _jit_expec_pauli_sv(qureg.state, qureg.num_qubits_in_state_vec,
+                                    targets, codes)
+    return float(value)
+
+
+def calcExpecPauliSum(qureg: Qureg, all_codes: Sequence[int],
+                      coeffs: Sequence[float], num_sum_terms: int = None,
+                      workspace: Qureg = None) -> float:
+    """C-signature parity: the 4th positional argument is numSumTerms
+    (``QuEST.h:2504``); in Python it may be omitted (inferred)."""
+    if num_sum_terms is not None and not isinstance(num_sum_terms, numbers.Integral):
+        workspace, num_sum_terms = num_sum_terms, None
+    n = qureg.num_qubits_represented
+    num_terms = int(num_sum_terms) if num_sum_terms is not None else len(coeffs)
+    val.validate_num_pauli_sum_terms(num_terms, "calcExpecPauliSum")
+    val.validate_pauli_codes(all_codes, "calcExpecPauliSum")
+    targets = tuple(range(n))
+    value = 0.0
+    for t in range(num_terms):
+        codes = tuple(all_codes[t * n:(t + 1) * n])
+        value += float(coeffs[t]) * calcExpecPauliProd(qureg, targets, codes)
+    return value
+
+
+def applyPauliSum(in_qureg: Qureg, all_codes: Sequence[int],
+                  coeffs: Sequence[float], num_terms: int,
+                  out_qureg: Qureg) -> None:
+    """out = sum_t c_t P_t |in> (``statevec_applyPauliSum``
+    ``QuEST_common.c:494-514``)."""
+    val.validate_matching_types(in_qureg.is_density_matrix,
+                                out_qureg.is_density_matrix, "applyPauliSum")
+    val.validate_matching_dims(in_qureg.num_qubits_represented,
+                               out_qureg.num_qubits_represented, "applyPauliSum")
+    val.validate_num_pauli_sum_terms(num_terms, "applyPauliSum")
+    val.validate_pauli_codes(all_codes, "applyPauliSum")
+    n = in_qureg.num_qubits_represented
+    codes_flat = tuple(int(c) for c in all_codes[:num_terms * n])
+    coeffs_f = jnp.asarray(np.asarray(coeffs[:num_terms], np.float64),
+                           in_qureg.real_dtype)
+    out_qureg.state = _jit_apply_pauli_sum(
+        in_qureg.state, in_qureg.num_qubits_in_state_vec, n, codes_flat,
+        coeffs_f, _shard(out_qureg))
+    out_qureg.qasm_log.record_comment(
+        "the register was set to a Pauli-sum image (possibly unphysical)")
+
+
+# ---------------------------------------------------------------------------
+# measurement & collapse (QuEST.h:1694-1753)
+# ---------------------------------------------------------------------------
+
+def calcProbOfOutcome(qureg: Qureg, qubit: int, outcome: int) -> float:
+    val.validate_target(qureg.num_qubits_represented, qubit, "calcProbOfOutcome")
+    val.validate_outcome(outcome, "calcProbOfOutcome")
+    if qureg.is_density_matrix:
+        p = _jit_prob_outcome_dm(qureg.state, qureg.num_qubits_represented,
+                                 qubit, outcome)
+    else:
+        p = _jit_prob_outcome_sv(qureg.state, qureg.num_qubits_in_state_vec,
+                                 qubit, outcome)
+    return float(p)
+
+
+def _collapse(qureg: Qureg, qubit: int, outcome: int, prob: float) -> None:
+    prob = jnp.asarray(prob, qureg.real_dtype)
+    if qureg.is_density_matrix:
+        qureg.state = _jit_collapse_dm(
+            qureg.state, qureg.num_qubits_represented, qubit, outcome, prob,
+            _shard(qureg))
+    else:
+        qureg.state = _jit_collapse_sv(
+            qureg.state, qureg.num_qubits_in_state_vec, qubit, outcome, prob,
+            _shard(qureg))
+
+
+def collapseToOutcome(qureg: Qureg, qubit: int, outcome: int) -> float:
+    val.validate_target(qureg.num_qubits_represented, qubit, "collapseToOutcome")
+    val.validate_outcome(outcome, "collapseToOutcome")
+    prob = calcProbOfOutcome(qureg, qubit, outcome)
+    val.validate_measurement_prob(prob, "collapseToOutcome")
+    _collapse(qureg, qubit, outcome, prob)
+    qureg.qasm_log.record_measurement(qubit)
+    return prob
+
+
+def measureWithStats(qureg: Qureg, qubit: int):
+    """Returns (outcome, outcome_prob). RNG = jax.random key stream held by
+    the env (replacing mt19937, ``generateMeasurementOutcome``
+    ``QuEST_common.c:154-169``)."""
+    val.validate_target(qureg.num_qubits_represented, qubit, "measureWithStats")
+    zero_prob = calcProbOfOutcome(qureg, qubit, 0)
+    eps = qureg.env.precision.eps
+    if zero_prob < eps:
+        outcome = 1
+    elif 1.0 - zero_prob < eps:
+        outcome = 0
+    else:
+        r = float(jax.random.uniform(qureg.env.next_key()))
+        outcome = int(r > zero_prob)
+    prob = zero_prob if outcome == 0 else 1.0 - zero_prob
+    _collapse(qureg, qubit, outcome, prob)
+    qureg.qasm_log.record_measurement(qubit)
+    return outcome, prob
+
+
+def measure(qureg: Qureg, qubit: int) -> int:
+    outcome, _ = measureWithStats(qureg, qubit)
+    return outcome
+
+
+# ---------------------------------------------------------------------------
+# amplitude access & calculations (QuEST.h:366-944, 971-2504, 3071)
+# ---------------------------------------------------------------------------
+
+def getNumQubits(qureg: Qureg) -> int:
+    return qureg.num_qubits_represented
+
+
+def getNumAmps(qureg: Qureg) -> int:
+    val.validate_state_vec(qureg.is_density_matrix, "getNumAmps")
+    return qureg.num_amps_total
+
+
+def getAmp(qureg: Qureg, index: int) -> complex:
+    val.validate_state_vec(qureg.is_density_matrix, "getAmp")
+    val.validate_amp_index(qureg.num_amps_total, index, "getAmp")
+    pair = np.asarray(qureg.state[:, index])
+    return complex(pair[0], pair[1])
+
+
+def getRealAmp(qureg: Qureg, index: int) -> float:
+    return getAmp(qureg, index).real
+
+
+def getImagAmp(qureg: Qureg, index: int) -> float:
+    return getAmp(qureg, index).imag
+
+
+def getProbAmp(qureg: Qureg, index: int) -> float:
+    a = getAmp(qureg, index)
+    return a.real * a.real + a.imag * a.imag
+
+
+def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
+    val.validate_density_matr(qureg.is_density_matrix, "getDensityAmp")
+    dim = 1 << qureg.num_qubits_represented
+    val.validate_amp_index(dim, row, "getDensityAmp")
+    val.validate_amp_index(dim, col, "getDensityAmp")
+    pair = np.asarray(qureg.state[:, row + col * dim])
+    return complex(pair[0], pair[1])
+
+
+def calcTotalProb(qureg: Qureg) -> float:
+    if qureg.is_density_matrix:
+        return float(_jit_total_prob_dm(qureg.state,
+                                        qureg.num_qubits_represented))
+    return float(_jit_total_prob_sv(qureg.state))
+
+
+def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
+    val.validate_state_vec(bra.is_density_matrix, "calcInnerProduct")
+    val.validate_state_vec(ket.is_density_matrix, "calcInnerProduct")
+    val.validate_matching_dims(bra.num_qubits_represented,
+                               ket.num_qubits_represented, "calcInnerProduct")
+    re, im = _jit_inner_product(bra.state, ket.state)
+    return complex(float(re), float(im))
+
+
+def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
+    val.validate_density_matr(rho1.is_density_matrix, "calcDensityInnerProduct")
+    val.validate_density_matr(rho2.is_density_matrix, "calcDensityInnerProduct")
+    val.validate_matching_dims(rho1.num_qubits_represented,
+                               rho2.num_qubits_represented,
+                               "calcDensityInnerProduct")
+    return float(_jit_dm_inner(rho1.state, rho2.state))
+
+
+def calcPurity(qureg: Qureg) -> float:
+    val.validate_density_matr(qureg.is_density_matrix, "calcPurity")
+    return float(_jit_purity(qureg.state))
+
+
+def calcFidelity(qureg: Qureg, pure_state: Qureg) -> float:
+    val.validate_state_vec(pure_state.is_density_matrix, "calcFidelity")
+    val.validate_matching_dims(qureg.num_qubits_represented,
+                               pure_state.num_qubits_represented,
+                               "calcFidelity")
+    if qureg.is_density_matrix:
+        return float(_jit_fidelity_dm(qureg.state,
+                                      qureg.num_qubits_represented,
+                                      pure_state.state))
+    re, im = _jit_inner_product(qureg.state, pure_state.state)
+    return float(re) ** 2 + float(im) ** 2
+
+
+def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
+    val.validate_density_matr(a.is_density_matrix, "calcHilbertSchmidtDistance")
+    val.validate_density_matr(b.is_density_matrix, "calcHilbertSchmidtDistance")
+    val.validate_matching_dims(a.num_qubits_represented,
+                               b.num_qubits_represented,
+                               "calcHilbertSchmidtDistance")
+    return float(_jit_hs_dist(a.state, b.state))
+
+
+# ---------------------------------------------------------------------------
+# decoherence (QuEST.h:1929-3043)
+# ---------------------------------------------------------------------------
+
+def _apply_kraus(qureg: Qureg, targets: Sequence[int], ops) -> None:
+    """Superoperator on (targets, targets+n) of the flat density vector
+    (``densmatr_applyMultiQubitKrausSuperoperator``
+    ``QuEST_common.c:598-604``)."""
+    superop = dm.kraus_superoperator(ops)
+    qureg.state = _jit_kraus_superop(
+        qureg.state, qureg.num_qubits_represented,
+        tuple(int(t) for t in targets), _packed(qureg, superop),
+        _shard(qureg))
+
+
+def mixDephasing(qureg: Qureg, target: int, prob: float) -> None:
+    val.validate_density_matr(qureg.is_density_matrix, "mixDephasing")
+    val.validate_target(qureg.num_qubits_represented, target, "mixDephasing")
+    val.validate_prob(prob, "mixDephasing", 0.5, "dephasing probability")
+    qureg.state = _jit_mix_dephasing(qureg.state, qureg.num_qubits_represented,
+                                     target, float(prob), _shard(qureg))
+    qureg.qasm_log.record_comment(
+        f"a phase (Z) error occurred on qubit {target} with probability {prob:g}")
+
+
+def mixTwoQubitDephasing(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
+    val.validate_density_matr(qureg.is_density_matrix, "mixTwoQubitDephasing")
+    val.validate_unique_targets(qureg.num_qubits_represented, q1, q2,
+                                "mixTwoQubitDephasing")
+    val.validate_prob(prob, "mixTwoQubitDephasing", 0.75,
+                      "two-qubit dephasing probability")
+    qureg.state = _jit_mix_two_qubit_dephasing(
+        qureg.state, qureg.num_qubits_represented, q1, q2, float(prob),
+        _shard(qureg))
+    qureg.qasm_log.record_comment(
+        f"a phase (Z) error occurred on qubits {q1} and/or {q2} "
+        f"with total probability {prob:g}")
+
+
+def mixDepolarising(qureg: Qureg, target: int, prob: float) -> None:
+    val.validate_density_matr(qureg.is_density_matrix, "mixDepolarising")
+    val.validate_target(qureg.num_qubits_represented, target, "mixDepolarising")
+    val.validate_prob(prob, "mixDepolarising", 0.75, "depolarising probability")
+    _apply_kraus(qureg, (target,), chan.depolarising_kraus(prob))
+    qureg.qasm_log.record_comment(
+        f"a depolarising error occurred on qubit {target} "
+        f"with total probability {prob:g}")
+
+
+def mixDamping(qureg: Qureg, target: int, prob: float) -> None:
+    val.validate_density_matr(qureg.is_density_matrix, "mixDamping")
+    val.validate_target(qureg.num_qubits_represented, target, "mixDamping")
+    val.validate_prob(prob, "mixDamping", 1.0, "damping probability")
+    _apply_kraus(qureg, (target,), chan.damping_kraus(prob))
+
+
+def mixTwoQubitDepolarising(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
+    val.validate_density_matr(qureg.is_density_matrix, "mixTwoQubitDepolarising")
+    val.validate_unique_targets(qureg.num_qubits_represented, q1, q2,
+                                "mixTwoQubitDepolarising")
+    val.validate_prob(prob, "mixTwoQubitDepolarising", 15.0 / 16.0,
+                      "two-qubit depolarising probability")
+    _apply_kraus(qureg, (q1, q2), chan.two_qubit_depolarising_kraus(prob))
+    qureg.qasm_log.record_comment(
+        f"a depolarising error occurred on qubits {q1} and {q2} "
+        f"with total probability {prob:g}")
+
+
+def mixPauli(qureg: Qureg, qubit: int, prob_x: float, prob_y: float,
+             prob_z: float) -> None:
+    val.validate_density_matr(qureg.is_density_matrix, "mixPauli")
+    val.validate_target(qureg.num_qubits_represented, qubit, "mixPauli")
+    val.validate_one_qubit_pauli_probs(prob_x, prob_y, prob_z, "mixPauli")
+    _apply_kraus(qureg, (qubit,), chan.pauli_kraus(prob_x, prob_y, prob_z))
+    qureg.qasm_log.record_comment(
+        f"X, Y and Z errors occurred on qubit {qubit} with probabilities "
+        f"{prob_x:g}, {prob_y:g} and {prob_z:g} respectively")
+
+
+def mixDensityMatrix(qureg: Qureg, other_prob: float, other: Qureg) -> None:
+    val.validate_density_matr(qureg.is_density_matrix, "mixDensityMatrix")
+    val.validate_density_matr(other.is_density_matrix, "mixDensityMatrix")
+    val.validate_matching_dims(qureg.num_qubits_represented,
+                               other.num_qubits_represented,
+                               "mixDensityMatrix")
+    val.validate_prob(other_prob, "mixDensityMatrix")
+    qureg.state = _jit_mix_linear(
+        jnp.asarray(other_prob, qureg.real_dtype), qureg.state, other.state,
+        _shard(qureg))
+
+
+def mixKrausMap(qureg: Qureg, target: int, ops, num_ops: int = None) -> None:
+    val.validate_density_matr(qureg.is_density_matrix, "mixKrausMap")
+    val.validate_target(qureg.num_qubits_represented, target, "mixKrausMap")
+    ops = list(ops)[:num_ops] if num_ops is not None else list(ops)
+    val.validate_kraus_ops(ops, 1, "mixKrausMap", qureg.env.precision.eps)
+    _apply_kraus(qureg, (target,), ops)
+    qureg.qasm_log.record_comment(
+        f"an undisclosed Kraus map was applied to qubit {target}")
+
+
+def mixTwoQubitKrausMap(qureg: Qureg, t1: int, t2: int, ops,
+                        num_ops: int = None) -> None:
+    val.validate_density_matr(qureg.is_density_matrix, "mixTwoQubitKrausMap")
+    val.validate_multi_targets(qureg.num_qubits_represented, (t1, t2),
+                               "mixTwoQubitKrausMap")
+    ops = list(ops)[:num_ops] if num_ops is not None else list(ops)
+    val.validate_kraus_ops(ops, 2, "mixTwoQubitKrausMap",
+                           qureg.env.precision.eps)
+    _apply_kraus(qureg, (t1, t2), ops)
+    qureg.qasm_log.record_comment(
+        f"an undisclosed two-qubit Kraus map was applied to qubits {t1}, {t2}")
+
+
+def mixMultiQubitKrausMap(qureg: Qureg, targets: Sequence[int], ops,
+                          num_ops: int = None) -> None:
+    val.validate_density_matr(qureg.is_density_matrix, "mixMultiQubitKrausMap")
+    val.validate_multi_targets(qureg.num_qubits_represented, targets,
+                               "mixMultiQubitKrausMap")
+    ops = list(ops)[:num_ops] if num_ops is not None else list(ops)
+    val.validate_kraus_ops(ops, len(targets), "mixMultiQubitKrausMap",
+                           qureg.env.precision.eps)
+    _apply_kraus(qureg, tuple(targets), ops)
+    qureg.qasm_log.record_comment(
+        f"an undisclosed {len(targets)}-qubit Kraus map was applied")
+
+
+# ---------------------------------------------------------------------------
+# QASM recording (QuEST.h:1868-1906)
+# ---------------------------------------------------------------------------
+
+def startRecordingQASM(qureg: Qureg) -> None:
+    qureg.qasm_log.is_logging = True
+
+
+def stopRecordingQASM(qureg: Qureg) -> None:
+    qureg.qasm_log.is_logging = False
+
+
+def clearRecordedQASM(qureg: Qureg) -> None:
+    qureg.qasm_log.clear()
+
+
+def printRecordedQASM(qureg: Qureg) -> None:
+    print(qureg.qasm_log.text(), end="")
+
+
+def writeRecordedQASMToFile(qureg: Qureg, filename: str) -> None:
+    try:
+        qureg.qasm_log.write_to_file(filename)
+    except OSError:
+        val._fail("could not open the output file for writing",
+                  "writeRecordedQASMToFile")
+
+
+# ---------------------------------------------------------------------------
+# debug / reporting (QuEST.h:319-359, QuEST_debug.h)
+# ---------------------------------------------------------------------------
+
+def reportState(qureg: Qureg, filename: str = "state_rank_0.csv") -> None:
+    """Dump amplitudes as 'real, imag' CSV (``reportState``
+    ``QuEST_common.c:215-231``)."""
+    amps = qureg.to_numpy()
+    with open(filename, "w") as f:
+        f.write("real, imag\n")
+        for a in amps:
+            f.write(f"{a.real:.12e}, {a.imag:.12e}\n")
+
+
+def reportStateToScreen(qureg: Qureg, env: QuESTEnv = None,
+                        report_rank: int = 0) -> None:
+    amps = qureg.to_numpy()
+    print("Reporting state from rank 0 of 1")
+    for a in amps:
+        print(f"{a.real:.12f}, {a.imag:.12f}")
+
+
+def reportQuregParams(qureg: Qureg) -> None:
+    print(f"QUBITS: {qureg.num_qubits_represented}")
+    print(f"TOTAL AMPS: {qureg.num_amps_total}")
+    print(f"AMPS PER DEVICE: {qureg.num_amps_per_chunk}")
+    mem = qureg.num_amps_total * np.dtype(qureg.dtype).itemsize
+    print(f"DEVICE MEMORY: {mem / 2**20:.1f} MiB")
+
+
+def compareStates(q1: Qureg, q2: Qureg, precision: float) -> bool:
+    val.validate_matching_dims(q1.num_qubits_represented,
+                               q2.num_qubits_represented, "compareStates")
+    a, b = q1.to_numpy(), q2.to_numpy()
+    return bool(np.all(np.abs(a.real - b.real) < precision)
+                and np.all(np.abs(a.imag - b.imag) < precision))
+
+
+def initStateFromSingleFile(qureg: Qureg, filename: str,
+                            env: QuESTEnv = None) -> None:
+    """Load a state previously written by :func:`reportState`."""
+    rows = []
+    try:
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("real"):
+                    continue
+                re_s, im_s = line.split(",")
+                rows.append(complex(float(re_s), float(im_s)))
+    except OSError:
+        val._fail("could not open the state file for reading",
+                  "initStateFromSingleFile")
+    if len(rows) != qureg.num_amps_total:
+        val._fail("the state file does not match the register dimension",
+                  "initStateFromSingleFile")
+    qureg.device_put(np.asarray(rows, dtype=np.complex128))
+
+
+def getQuEST_PREC() -> int:
+    from .config import default_precision
+    return default_precision().quest_prec

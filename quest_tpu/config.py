@@ -1,0 +1,43 @@
+"""Precision configuration.
+
+TPU-native analogue of the reference's compile-time precision switch
+(``QuEST_precision.h:28-65``, macro ``QuEST_PREC``): instead of rebuilding the
+library per precision, precision is a runtime property of the environment.
+
+On TPU the natural dtype is complex64 (pairs of f32 riding the VPU/MXU);
+complex128 is available on CPU (and via slow emulation elsewhere) for
+golden-accuracy parity testing against the reference's 1e-10 tolerance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Precision", "SINGLE", "DOUBLE", "default_precision"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    """Numeric precision bundle (mirrors qreal/REAL_EPS of the reference)."""
+
+    quest_prec: int  # 1 = single, 2 = double (reference QuEST_PREC values)
+    real_dtype: jnp.dtype
+    complex_dtype: jnp.dtype
+    # REAL_EPS analogue (QuEST_precision.h: 1e-5 single / 1e-13 double)
+    eps: float
+
+    @property
+    def name(self) -> str:
+        return {1: "single", 2: "double"}[self.quest_prec]
+
+
+SINGLE = Precision(1, jnp.dtype("float32"), jnp.dtype("complex64"), 1e-5)
+DOUBLE = Precision(2, jnp.dtype("float64"), jnp.dtype("complex128"), 1e-13)
+
+
+def default_precision() -> Precision:
+    """DOUBLE when x64 is enabled (CPU test rigs), else SINGLE (TPU)."""
+    return DOUBLE if jax.config.jax_enable_x64 else SINGLE

@@ -1,0 +1,1 @@
+from . import apply, matrices  # noqa: F401

@@ -1,0 +1,128 @@
+"""Execution environment: device mesh, precision, randomness.
+
+TPU-native replacement for ``QuESTEnv`` (``QuEST.h:200-204``) and the
+per-backend ``createQuESTEnv`` implementations (MPI init
+``QuEST_cpu_distributed.c:128-157``, GPU probe ``QuEST_gpu.cu:353-367``):
+there is no build-time backend fork — one environment object carries
+
+- a :class:`jax.sharding.Mesh` over the amplitude axis (``None`` = single
+  device), replacing rank/numRanks bookkeeping;
+- the numeric :class:`~quest_tpu.config.Precision` (runtime, not compile-time);
+- a single ``jax.random`` key, split per draw — the analogue of the
+  rank-0-seeded, broadcast mt19937 stream (``QuEST_cpu_distributed.c:1318-1329``):
+  in SPMD there is one logical program, so agreement is automatic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .config import Precision, default_precision
+
+__all__ = ["QuESTEnv", "create_quest_env", "destroy_quest_env"]
+
+AMP_AXIS = "amps"
+
+
+@dataclasses.dataclass
+class QuESTEnv:
+    """Runtime environment handle (mesh + precision + RNG)."""
+
+    precision: Precision
+    mesh: Optional[Mesh] = None
+    key: jax.Array = None  # type: ignore[assignment]
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.mesh.devices.shape)) if self.mesh is not None else 1
+
+    @property
+    def rank(self) -> int:
+        """Process index (0 on single-host; mirrors QuESTEnv.rank)."""
+        return jax.process_index()
+
+    @property
+    def num_ranks(self) -> int:
+        return self.num_devices
+
+    def sharding(self, sharded: bool = True) -> Optional[NamedSharding]:
+        """NamedSharding for a packed (2, 2^N) state array: the amplitude
+        axis is split on its leading (high-qubit) bits — the chunkId-prefix
+        layout of ``QuEST.h:169-177`` — and the re/im plane axis is
+        replicated."""
+        if self.mesh is None:
+            return None
+        spec = PartitionSpec(None, AMP_AXIS) if sharded else PartitionSpec()
+        return NamedSharding(self.mesh, spec)
+
+    def seed(self, seeds: Sequence[int]) -> None:
+        """Re-seed the measurement RNG (``seedQuEST`` ``QuEST.h:1858``)."""
+        key = jax.random.key(int(seeds[0]) & 0xFFFFFFFF)
+        for s in seeds[1:]:
+            key = jax.random.fold_in(key, int(s) & 0xFFFFFFFF)
+        self.key = key
+
+    def seed_default(self) -> None:
+        """Seed from time and pid (``seedQuESTDefault``
+        ``QuEST_common.c:181-213``)."""
+        self.seed([int(time.time() * 1e6) & 0xFFFFFFFF, os.getpid()])
+
+    def next_key(self) -> jax.Array:
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def sync(self) -> None:
+        """Barrier analogue (``syncQuESTEnv``): SPMD programs need no explicit
+        barrier; block until async dispatch drains instead."""
+        jax.effects_barrier()
+
+    def report(self) -> str:
+        plats = {d.platform for d in jax.devices()}
+        lines = [
+            "QuEST-TPU execution environment:",
+            f"  backend devices: {len(jax.devices())} ({', '.join(sorted(plats))})",
+            f"  mesh: {'none (single device)' if self.mesh is None else str(self.mesh.shape)}",
+            f"  precision: {self.precision.name} ({self.precision.complex_dtype})",
+        ]
+        return "\n".join(lines)
+
+
+def create_quest_env(
+    num_devices: Optional[int] = None,
+    precision: Optional[Precision] = None,
+    seed: Optional[Sequence[int]] = None,
+) -> QuESTEnv:
+    """Create the execution environment (``createQuESTEnv`` ``QuEST.h:785``).
+
+    ``num_devices=None`` uses all local devices when more than one is present
+    (as the reference's MPI build uses all ranks), else single-device.
+    """
+    precision = precision or default_precision()
+    devices = jax.devices()
+    n = len(devices) if num_devices is None else num_devices
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices but only {len(devices)} available")
+    mesh = None
+    if n > 1:
+        if n & (n - 1):
+            raise ValueError("the device count must be a power of 2 "
+                             "(amplitude sharding halves per device)")
+        mesh = Mesh(np.asarray(devices[:n]), (AMP_AXIS,))
+    env = QuESTEnv(precision=precision, mesh=mesh)
+    if seed is not None:
+        env.seed(seed)
+    else:
+        env.seed_default()
+    return env
+
+
+def destroy_quest_env(env: QuESTEnv) -> None:
+    """No-op (buffers are GC-managed); kept for API parity
+    (``destroyQuESTEnv`` ``QuEST.h:795``)."""

@@ -1,0 +1,1 @@
+from . import statevec, densmatr, channels  # noqa: F401

@@ -1,0 +1,55 @@
+"""Kraus-operator sets for the built-in decoherence channels.
+
+Every channel in the reference is (or is equivalent to) a Kraus map applied
+through the superoperator path (``QuEST_common.c:540-604``, ``densmatr_mixPauli``
+``QuEST_common.c:675-695``). These builders produce the Kraus sets; the
+dephasing channels additionally have diagonal fast paths in
+``ops.densmatr``.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from ..core.matrices import PAULI_MATS
+
+__all__ = [
+    "damping_kraus",
+    "depolarising_kraus",
+    "pauli_kraus",
+    "two_qubit_depolarising_kraus",
+]
+
+
+def damping_kraus(prob: float) -> list[np.ndarray]:
+    """Amplitude damping: K0 = diag(1, sqrt(1-p)), K1 = sqrt(p)|0><1|."""
+    k0 = np.array([[1.0, 0.0], [0.0, np.sqrt(1.0 - prob)]], dtype=np.complex128)
+    k1 = np.array([[0.0, np.sqrt(prob)], [0.0, 0.0]], dtype=np.complex128)
+    return [k0, k1]
+
+
+def pauli_kraus(prob_x: float, prob_y: float, prob_z: float) -> list[np.ndarray]:
+    """rho -> (1-px-py-pz) rho + px X rho X + py Y rho Y + pz Z rho Z."""
+    probs = (1.0 - prob_x - prob_y - prob_z, prob_x, prob_y, prob_z)
+    return [np.sqrt(p) * m for p, m in zip(probs, PAULI_MATS)]
+
+
+def depolarising_kraus(prob: float) -> list[np.ndarray]:
+    """Homogeneous single-qubit depolarising: px=py=pz=p/3."""
+    return pauli_kraus(prob / 3.0, prob / 3.0, prob / 3.0)
+
+
+def two_qubit_depolarising_kraus(prob: float) -> list[np.ndarray]:
+    """rho -> (1-p) rho + p/15 sum over the 15 non-identity two-qubit Paulis.
+
+    Kraus index bit 0 addresses the first target (matrix convention of
+    ``densmatr_applyTwoQubitKrausSuperoperator``), so the kron order is
+    (second (x) first).
+    """
+    ops = []
+    for i, j in itertools.product(range(4), range(4)):
+        w = (1.0 - prob) if (i == 0 and j == 0) else prob / 15.0
+        ops.append(np.sqrt(w) * np.kron(PAULI_MATS[j], PAULI_MATS[i]))
+    return ops

@@ -1,0 +1,178 @@
+"""Pure-functional density-matrix operations on the flat 2n-qubit vector.
+
+The reference flattens an n-qubit density matrix into a 2n-qubit vector with
+``flat[r + c*2^n] = rho[r, c]`` and reuses the statevector kernels on it
+(``QuEST.c:8-10``). We keep that layout: unitaries act as ``U`` on the row
+qubits then ``conj(U)`` on the column qubits ``q+n`` (handled in the api
+layer), while the ops here are the genuinely density-specific ones
+(``QuEST_internal.h:57-101``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.apply import apply_diagonal, apply_unitary, split_shape
+from . import statevec as sv
+
+__all__ = [
+    "init_plus_state",
+    "init_classical_state",
+    "init_pure_state",
+    "calc_total_prob",
+    "calc_prob_of_outcome",
+    "collapse_to_known_prob_outcome",
+    "calc_purity",
+    "calc_fidelity",
+    "calc_inner_product",
+    "calc_hilbert_schmidt_distance",
+    "mix_density_matrix",
+    "mix_dephasing",
+    "mix_two_qubit_dephasing",
+    "apply_kraus_superoperator",
+    "kraus_superoperator",
+]
+
+
+def _as_matrix(flat, num_qubits):
+    """View flat density vector as mat[c, r] = rho[r, c] (column axis leads
+    because columns occupy the high index bits)."""
+    dim = 1 << num_qubits
+    return flat.reshape(dim, dim)
+
+
+def init_plus_state(num_qubits: int, dtype) -> jnp.ndarray:
+    """|+><+|: every element 1/2^n (``QuEST_cpu.c:1159``)."""
+    dim = 1 << (2 * num_qubits)
+    return jnp.full(dim, 1.0 / (1 << num_qubits), dtype=dtype)
+
+
+def init_classical_state(num_qubits: int, state_ind: int, dtype) -> jnp.ndarray:
+    """|s><s|: single 1 on the diagonal (``QuEST_cpu.c:1120``)."""
+    dim = 1 << (2 * num_qubits)
+    ind = state_ind * ((1 << num_qubits) + 1)
+    return jnp.zeros(dim, dtype=dtype).at[ind].set(1.0)
+
+
+def init_pure_state(pure_state) -> jnp.ndarray:
+    """rho = |psi><psi|: flat[r + c*2^n] = psi_r * conj(psi_c)
+    (``QuEST_cpu.c:1189``)."""
+    return jnp.outer(jnp.conj(pure_state), pure_state).reshape(-1)
+
+
+def calc_total_prob(flat, num_qubits: int) -> jnp.ndarray:
+    """Trace: sum of real diagonal entries (``densmatr_calcTotalProb``)."""
+    return jnp.sum(jnp.real(jnp.diagonal(_as_matrix(flat, num_qubits))))
+
+
+def calc_prob_of_outcome(flat, num_qubits: int, qubit: int, outcome: int) -> jnp.ndarray:
+    """Sum diagonal entries whose basis state has ``qubit``==0, complemented
+    for outcome 1 (``densmatr_findProbabilityOfZeroLocal``
+    ``QuEST_cpu.c:3117``)."""
+    diag = jnp.real(jnp.diagonal(_as_matrix(flat, num_qubits)))
+    shape = split_shape(num_qubits, (qubit,))
+    zero_prob = jnp.sum(diag.reshape(shape)[:, 0, :])
+    return zero_prob if outcome == 0 else 1.0 - zero_prob
+
+
+def collapse_to_known_prob_outcome(flat, num_qubits, qubit, outcome, prob):
+    """Keep only elements with row *and* column qubit == outcome, scaled 1/prob
+    (``QuEST_cpu.c:790``)."""
+    fac = jnp.zeros((2, 2), dtype=flat.dtype).at[outcome, outcome].set(
+        (1.0 / prob).astype(flat.dtype) if hasattr(prob, "dtype") else 1.0 / prob
+    )
+    # qubit in rows is bit `qubit`; in columns bit `qubit + n`
+    return apply_diagonal(flat, 2 * num_qubits, (qubit + num_qubits, qubit), fac)
+
+
+def calc_purity(flat) -> jnp.ndarray:
+    """Tr(rho^2) = sum |rho_ij|^2 (``densmatr_calcPurityLocal``)."""
+    return jnp.sum(jnp.real(flat) ** 2 + jnp.imag(flat) ** 2)
+
+
+def calc_fidelity(flat, num_qubits: int, pure_state) -> jnp.ndarray:
+    """<psi|rho|psi> (``densmatr_calcFidelityLocal`` ``QuEST_cpu.c:995``)."""
+    mat = _as_matrix(flat, num_qubits)  # mat[c, r] = rho[r, c]
+    val = jnp.einsum("cr,r,c->", mat, jnp.conj(pure_state), pure_state,
+                     precision=jax.lax.Precision.HIGHEST)
+    return jnp.real(val)
+
+
+def calc_inner_product(flat_a, flat_b) -> jnp.ndarray:
+    """real(Tr(a^dag b)) (``densmatr_calcInnerProductLocal``
+    ``QuEST_cpu.c:963``)."""
+    return jnp.real(jnp.vdot(flat_a, flat_b))
+
+
+def calc_hilbert_schmidt_distance(flat_a, flat_b) -> jnp.ndarray:
+    """sqrt(sum |a-b|^2) (``QuEST_cpu.c:928``)."""
+    d = flat_a - flat_b
+    return jnp.sqrt(jnp.sum(jnp.real(d) ** 2 + jnp.imag(d) ** 2))
+
+
+def mix_density_matrix(flat_combine, other_prob, flat_other):
+    """combine = (1-p)*combine + p*other (``QuEST_cpu.c:895``)."""
+    p = jnp.asarray(other_prob, dtype=flat_combine.dtype)
+    return (1.0 - p) * flat_combine + p * flat_other
+
+
+# ---------------------------------------------------------------------------
+# decoherence channels
+# ---------------------------------------------------------------------------
+#
+# All channels are Kraus maps. The reference builds a superoperator
+# S[(i,k),(j,l)] = sum_n conj(K_n[i,j]) K_n[k,l] and applies it as a 2k-qubit
+# "unitary" on targets (t, t+n) of the flat vector
+# (``QuEST_common.c:540-604``). We keep that single code path, with the
+# dephasing channels special-cased to diagonal multiplies (the reference's
+# ``densmatr_oneQubitDegradeOffDiagonal`` fast path, ``QuEST_cpu.c:48``).
+
+
+def kraus_superoperator(ops) -> np.ndarray:
+    """S = sum_n conj(K_n) (x) K_n with row (i,k), col (j,l); i,j the column-
+    (bra-)side indices (``macro_populateKrausOperator``
+    ``QuEST_common.c:543-563``)."""
+    ops = [np.asarray(op, dtype=np.complex128) for op in ops]
+    d = ops[0].shape[0]
+    s = np.zeros((d * d, d * d), dtype=np.complex128)
+    for op in ops:
+        s += np.kron(np.conj(op), op)
+    return s
+
+
+def apply_kraus_superoperator(flat, num_qubits, targets, superop):
+    """Apply a superoperator to targets of the flat density vector.
+
+    Matrix bit order: targets (row side, low bits) then targets+n (column
+    side, high bits) — ``densmatr_applyMultiQubitKrausSuperoperator``
+    (``QuEST_common.c:598-604``)."""
+    all_targets = tuple(targets) + tuple(t + num_qubits for t in targets)
+    return apply_unitary(flat, 2 * num_qubits, superop, all_targets)
+
+
+def mix_dephasing(flat, num_qubits, target, prob):
+    """rho -> (1-p) rho + p Z rho Z: off-diagonals (in ``target``) scaled by
+    1-2p (``densmatr_mixDephasing`` with dephase=2p, ``QuEST.c:907``)."""
+    retain = 1.0 - 2.0 * prob
+    fac = np.array([[1.0, retain], [retain, 1.0]], dtype=np.complex128)
+    return apply_diagonal(flat, 2 * num_qubits, (target + num_qubits, target), fac)
+
+
+def mix_two_qubit_dephasing(flat, num_qubits, q1, q2, prob):
+    """Z error on either/both qubits, total prob p: any row/col mismatch in
+    q1 or q2 scales by 1-4p/3 (``densmatr_mixTwoQubitDephasing``)."""
+    retain = 1.0 - (4.0 * prob) / 3.0
+    qs = sorted((q1 + num_qubits, q2 + num_qubits, q2, q1), reverse=True)
+    # tensor indexed by bits of sorted-desc positions: (c2, c1, r2, r1) when
+    # q2 > q1; mismatch on either qubit -> retain
+    fac = np.ones((2, 2, 2, 2), dtype=np.complex128)
+    hi, lo = max(q1, q2), min(q1, q2)
+    for chi in range(2):
+        for clo in range(2):
+            for rhi in range(2):
+                for rlo in range(2):
+                    if chi != rhi or clo != rlo:
+                        fac[chi, clo, rhi, rlo] = retain
+    return apply_diagonal(flat, 2 * num_qubits, qs, fac)

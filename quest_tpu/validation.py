@@ -1,0 +1,207 @@
+"""Input validation layer.
+
+Python-native port of the reference's validation taxonomy
+(``QuEST_validation.c:25-124``): each check raises through
+:func:`quest_tpu.types.invalid_quest_input_error`, which by default throws a
+catchable :class:`~quest_tpu.types.QuESTError` (replacing the reference's
+fatal ``exitWithError``; the overridable handler plays the role of the weak
+``invalidQuESTInputError`` symbol).
+
+Numerical checks (unitarity, CPTP, norms) run host-side on numpy inputs; they
+guard user-supplied matrices, not traced arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .types import invalid_quest_input_error, PauliOpType
+
+# tolerance for unitarity/CPTP/norm checks, per precision eps at call sites
+_DEFAULT_EPS = 1e-10
+
+
+def _fail(msg: str, func: str) -> None:
+    invalid_quest_input_error(msg, func)
+
+
+def validate_num_qubits(num_qubits: int, func: str) -> None:
+    if num_qubits < 1:
+        _fail("the register must contain at least one qubit", func)
+    if num_qubits > 62:
+        _fail("the number of qubits exceeds the indexable amplitude range", func)
+
+
+def validate_target(num_qubits: int, target: int, func: str) -> None:
+    if not 0 <= target < num_qubits:
+        _fail(f"qubit index {target} is outside [0, {num_qubits})", func)
+
+
+def validate_control_target(num_qubits: int, control: int, target: int, func: str) -> None:
+    validate_target(num_qubits, target, func)
+    validate_target(num_qubits, control, func)
+    if control == target:
+        _fail("the control qubit must differ from the target qubit", func)
+
+
+def validate_unique_targets(num_qubits: int, q1: int, q2: int, func: str) -> None:
+    validate_target(num_qubits, q1, func)
+    validate_target(num_qubits, q2, func)
+    if q1 == q2:
+        _fail("the two target qubits must be distinct", func)
+
+
+def validate_multi_targets(num_qubits: int, targets, func: str) -> None:
+    if len(targets) < 1:
+        _fail("at least one target qubit is required", func)
+    if len(targets) > num_qubits:
+        _fail("the number of targets exceeds the register size", func)
+    for t in targets:
+        validate_target(num_qubits, t, func)
+    if len(set(targets)) != len(targets):
+        _fail("target qubits must be unique", func)
+
+
+def validate_multi_controls_multi_targets(num_qubits: int, controls, targets, func: str) -> None:
+    validate_multi_targets(num_qubits, targets, func)
+    for c in controls:
+        validate_target(num_qubits, c, func)
+    if len(set(controls)) != len(controls):
+        _fail("control qubits must be unique", func)
+    if set(controls) & set(targets):
+        _fail("control qubits may not also be targets", func)
+
+
+def validate_control_state(control_state, num_controls: int, func: str) -> None:
+    if len(control_state) != num_controls:
+        _fail("one control-state bit is required per control qubit", func)
+    for b in control_state:
+        if b not in (0, 1):
+            _fail("control-state bits must be 0 or 1", func)
+
+
+def validate_outcome(outcome: int, func: str) -> None:
+    if outcome not in (0, 1):
+        _fail("the measurement outcome must be 0 or 1", func)
+
+
+def validate_measurement_prob(prob: float, func: str) -> None:
+    if prob <= 0:
+        _fail("the probability of the chosen outcome is zero; collapse is impossible", func)
+
+
+def validate_state_index(num_qubits: int, state_ind: int, func: str) -> None:
+    if not 0 <= state_ind < (1 << num_qubits):
+        _fail(f"basis-state index {state_ind} is outside the register dimension", func)
+
+
+def validate_amp_index(num_amps: int, index: int, func: str) -> None:
+    if not 0 <= index < num_amps:
+        _fail(f"amplitude index {index} is outside [0, {num_amps})", func)
+
+
+def validate_num_amps(num_amps_total: int, start: int, num: int, func: str) -> None:
+    if start < 0 or num < 0 or start + num > num_amps_total:
+        _fail("the amplitude range exceeds the register dimension", func)
+
+
+def validate_prob(prob: float, func: str, max_prob: float = 1.0, name: str = "probability") -> None:
+    if prob < 0:
+        _fail(f"the {name} must be non-negative", func)
+    if prob > max_prob:
+        _fail(f"the {name} exceeds its physical maximum of {max_prob}", func)
+
+
+def _num_tol(eps: float, dim: int) -> float:
+    """Absolute tolerance for matrix checks: the precision eps (REAL_EPS
+    analogue) with headroom for accumulation over the matrix dimension."""
+    return eps * dim * 10.0
+
+
+def validate_unitary(u: np.ndarray, func: str, eps: float = _DEFAULT_EPS) -> None:
+    u = np.asarray(u)
+    d = u.shape[0]
+    if u.shape != (d, d):
+        _fail("the matrix is not square", func)
+    if not np.allclose(u.conj().T @ u, np.eye(d), atol=_num_tol(eps, d)):
+        _fail("the matrix is not unitary", func)
+
+
+def validate_matrix_dim(u: np.ndarray, num_targets: int, func: str) -> None:
+    d = 1 << num_targets
+    u = np.asarray(u)
+    if u.shape != (d, d):
+        _fail(f"the matrix dimension {u.shape} does not match {num_targets} target qubits", func)
+
+
+def validate_unitary_complex_pair(alpha: complex, beta: complex, func: str,
+                                  eps: float = _DEFAULT_EPS) -> None:
+    norm = abs(alpha) ** 2 + abs(beta) ** 2
+    if abs(norm - 1.0) > _num_tol(eps, 2):
+        _fail("|alpha|^2 + |beta|^2 must equal 1 for a unitary", func)
+
+
+def validate_vector(v, func: str) -> None:
+    if np.linalg.norm(np.asarray(v, dtype=np.float64)) < 1e-15:
+        _fail("the rotation axis vector must not be the zero vector", func)
+
+
+def validate_kraus_ops(ops, num_targets: int, func: str, eps: float = _DEFAULT_EPS) -> None:
+    d = 1 << num_targets
+    if len(ops) < 1:
+        _fail("at least one Kraus operator is required", func)
+    if len(ops) > d * d:
+        _fail(f"a {num_targets}-qubit channel admits at most {d*d} Kraus operators", func)
+    acc = np.zeros((d, d), dtype=np.complex128)
+    for op in ops:
+        op = np.asarray(op, dtype=np.complex128)
+        if op.shape != (d, d):
+            _fail("each Kraus operator must match the target dimension", func)
+        acc += op.conj().T @ op
+    if not np.allclose(acc, np.eye(d), atol=_num_tol(eps, d)):
+        _fail("the Kraus operators do not form a completely positive "
+              "trace-preserving map", func)
+
+
+def validate_one_qubit_pauli_probs(prob_x: float, prob_y: float, prob_z: float,
+                                   func: str) -> None:
+    """Each Pauli error must be no likelier than no-error — the channel-mixing
+    bound of ``validateOneQubitPauliProbs`` (``QuEST_validation.c:447-456``)."""
+    for p in (prob_x, prob_y, prob_z):
+        validate_prob(p, func, 1.0, "Pauli error probability")
+    no_error = 1.0 - prob_x - prob_y - prob_z
+    if prob_x > no_error or prob_y > no_error or prob_z > no_error:
+        _fail("each Pauli error probability may not exceed the "
+              "no-error probability 1-px-py-pz", func)
+
+
+def validate_pauli_codes(codes, func: str) -> None:
+    for c in codes:
+        if int(c) not in (0, 1, 2, 3):
+            _fail("Pauli codes must be 0 (I), 1 (X), 2 (Y) or 3 (Z)", func)
+    _ = PauliOpType  # codes are value-compatible with the enum
+
+
+def validate_num_pauli_sum_terms(n: int, func: str) -> None:
+    if n < 1:
+        _fail("the Pauli sum must contain at least one term", func)
+
+
+def validate_density_matr(is_density: bool, func: str) -> None:
+    if not is_density:
+        _fail("this operation is defined only for density matrices", func)
+
+
+def validate_state_vec(is_density: bool, func: str) -> None:
+    if is_density:
+        _fail("this operation is defined only for state-vectors", func)
+
+
+def validate_matching_types(a_density: bool, b_density: bool, func: str) -> None:
+    if a_density != b_density:
+        _fail("the registers must both be state-vectors or both be density matrices", func)
+
+
+def validate_matching_dims(a_qubits: int, b_qubits: int, func: str) -> None:
+    if a_qubits != b_qubits:
+        _fail("the registers must represent equal numbers of qubits", func)

@@ -1,0 +1,41 @@
+"""Test configuration: CPU backend with 8 virtual devices, float64.
+
+The suite runs on a virtual 8-device CPU mesh (the reference tests the MPI
+build by launching the same suite under mpiexec; we test the sharded path by
+forcing ``xla_force_host_platform_device_count=8`` — SURVEY.md §4) and in
+double precision so golden comparisons can use the reference's 1e-10
+tolerance.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize force-registers the TPU plugin; an in-process
+# config update (not the env var) is what reliably selects CPU for tests.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def env():
+    import quest_tpu as qt
+    return qt.createQuESTEnv(num_devices=1, seed=[12345])
+
+
+@pytest.fixture
+def mesh_env():
+    import quest_tpu as qt
+    return qt.createQuESTEnv(num_devices=8, seed=[12345])
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260729)

@@ -1,0 +1,172 @@
+"""Density-matrix gate tests: every gate class applied to a random mixed
+state, checked against the dense oracle's U rho U^dag (the reference's
+density_matrix/gates unit tier, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.core import matrices as mats
+
+import oracle
+
+N = 2
+TOL = 1e-10
+ANGLE = 0.9
+
+
+def make(env, rho):
+    q = qt.createDensityQureg(N, env)
+    oracle.set_dm(q, rho)
+    return q
+
+
+def check(q, expected):
+    np.testing.assert_allclose(oracle.get_dm(q), expected, atol=TOL)
+
+
+GATES_1Q = [
+    ("hadamard", lambda q, t: qt.hadamard(q, t), mats.hadamard()),
+    ("pauliX", lambda q, t: qt.pauliX(q, t), mats.pauli_x()),
+    ("pauliY", lambda q, t: qt.pauliY(q, t), mats.pauli_y()),
+    ("pauliZ", lambda q, t: qt.pauliZ(q, t), mats.pauli_z()),
+    ("sGate", lambda q, t: qt.sGate(q, t), mats.s_gate()),
+    ("tGate", lambda q, t: qt.tGate(q, t), mats.t_gate()),
+    ("phaseShift", lambda q, t: qt.phaseShift(q, t, ANGLE),
+     np.diag([1, np.exp(1j * ANGLE)])),
+    ("rotateX", lambda q, t: qt.rotateX(q, t, ANGLE), mats.rotation(ANGLE, (1, 0, 0))),
+    ("rotateY", lambda q, t: qt.rotateY(q, t, ANGLE), mats.rotation(ANGLE, (0, 1, 0))),
+    ("rotateZ", lambda q, t: qt.rotateZ(q, t, ANGLE), mats.rotation(ANGLE, (0, 0, 1))),
+    ("rotateAroundAxis",
+     lambda q, t: qt.rotateAroundAxis(q, t, ANGLE, (0.2, 1.0, -1.0)),
+     mats.rotation(ANGLE, (0.2, 1.0, -1.0))),
+    ("compactUnitary",
+     lambda q, t: qt.compactUnitary(q, t, 0.6 + 0.48j, 0.64j),
+     mats.compact_unitary(0.6 + 0.48j, 0.64j)),
+]
+
+
+@pytest.mark.parametrize("name,fn,u", GATES_1Q, ids=[g[0] for g in GATES_1Q])
+@pytest.mark.parametrize("target", range(N))
+def test_1q_gate_density(env, rng, name, fn, u, target):
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    fn(q, target)
+    check(q, oracle.apply_dm(rho, N, u, (target,)))
+
+
+GATES_CTRL = [
+    ("controlledNot", lambda q, c, t: qt.controlledNot(q, c, t), mats.pauli_x()),
+    ("controlledPauliY", lambda q, c, t: qt.controlledPauliY(q, c, t), mats.pauli_y()),
+    ("controlledPhaseShift",
+     lambda q, c, t: qt.controlledPhaseShift(q, c, t, ANGLE),
+     np.diag([1, np.exp(1j * ANGLE)])),
+    ("controlledPhaseFlip",
+     lambda q, c, t: qt.controlledPhaseFlip(q, c, t), mats.pauli_z()),
+    ("controlledRotateX",
+     lambda q, c, t: qt.controlledRotateX(q, c, t, ANGLE),
+     mats.rotation(ANGLE, (1, 0, 0))),
+    ("controlledCompactUnitary",
+     lambda q, c, t: qt.controlledCompactUnitary(q, c, t, 0.28 + 0.96j, 0.0),
+     mats.compact_unitary(0.28 + 0.96j, 0.0)),
+]
+
+
+@pytest.mark.parametrize("name,fn,u", GATES_CTRL, ids=[g[0] for g in GATES_CTRL])
+def test_controlled_gate_density(env, rng, name, fn, u):
+    for control, target in [(0, 1), (1, 0)]:
+        rho = oracle.random_density(N, rng)
+        q = make(env, rho)
+        fn(q, control, target)
+        check(q, oracle.apply_dm(rho, N, u, (target,), (control,)))
+
+
+def test_unitary_density(env, rng):
+    u = oracle.random_unitary(1, rng)
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.unitary(q, 1, u)
+    check(q, oracle.apply_dm(rho, N, u, (1,)))
+
+
+def test_controlled_unitary_density(env, rng):
+    u = oracle.random_unitary(1, rng)
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.controlledUnitary(q, 0, 1, u)
+    check(q, oracle.apply_dm(rho, N, u, (1,), (0,)))
+
+
+def test_two_qubit_unitary_density(env, rng):
+    u = oracle.random_unitary(2, rng)
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.twoQubitUnitary(q, 0, 1, u)
+    check(q, oracle.apply_dm(rho, N, u, (0, 1)))
+
+
+def test_multi_qubit_unitary_density(env, rng):
+    n = 3
+    u = oracle.random_unitary(2, rng)
+    rho = oracle.random_density(n, rng)
+    q = qt.createDensityQureg(n, env)
+    oracle.set_dm(q, rho)
+    qt.multiQubitUnitary(q, (2, 0), u)
+    np.testing.assert_allclose(
+        oracle.get_dm(q), oracle.apply_dm(rho, n, u, (2, 0)), atol=TOL)
+
+
+def test_multi_controlled_multi_qubit_unitary_density(env, rng):
+    n = 3
+    u = oracle.random_unitary(1, rng)
+    rho = oracle.random_density(n, rng)
+    q = qt.createDensityQureg(n, env)
+    oracle.set_dm(q, rho)
+    qt.multiControlledMultiQubitUnitary(q, [0, 2], (1,), u)
+    np.testing.assert_allclose(
+        oracle.get_dm(q), oracle.apply_dm(rho, n, u, (1,), (0, 2)), atol=TOL)
+
+
+def test_swap_density(env, rng):
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.swapGate(q, 0, 1)
+    check(q, oracle.apply_dm(rho, N, mats.swap(), (0, 1)))
+
+
+def test_sqrt_swap_density(env, rng):
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.sqrtSwapGate(q, 0, 1)
+    check(q, oracle.apply_dm(rho, N, mats.sqrt_swap(), (0, 1)))
+
+
+def test_multi_rotate_z_density(env, rng):
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.multiRotateZ(q, [0, 1], ANGLE)
+    P = np.kron(mats.pauli_z(), mats.pauli_z())
+    w, v = np.linalg.eigh(P)
+    U = (v * np.exp(-0.5j * ANGLE * w)) @ v.conj().T
+    check(q, U @ rho @ U.conj().T)
+
+
+def test_multi_rotate_pauli_density(env, rng):
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.multiRotatePauli(q, [0, 1], [qt.PAULI_Y, qt.PAULI_X], ANGLE)
+    P = np.kron(mats.pauli_x(), mats.pauli_y())
+    w, v = np.linalg.eigh(P)
+    U = (v * np.exp(-0.5j * ANGLE * w)) @ v.conj().T
+    check(q, U @ rho @ U.conj().T)
+
+
+def test_trace_preserved_through_circuit(env, rng):
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.hadamard(q, 0)
+    qt.controlledNot(q, 0, 1)
+    qt.tGate(q, 1)
+    qt.rotateY(q, 0, ANGLE)
+    assert abs(qt.calcTotalProb(q) - 1.0) < TOL
+    assert abs(qt.calcPurity(q) - np.real(np.trace(rho @ rho))) < TOL

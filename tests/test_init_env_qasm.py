@@ -1,0 +1,219 @@
+"""Init-state, environment, QASM, validation, and IO tests (the reference's
+essential tier plus the L2 shell, SURVEY.md §4/§5)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+import oracle
+
+N = 3
+TOL = 1e-10
+
+
+# -- essential: allocation & initialisation ---------------------------------
+
+def test_create_qureg_zero_state(env):
+    q = qt.createQureg(N, env)
+    expected = np.zeros(1 << N, complex)
+    expected[0] = 1
+    np.testing.assert_allclose(oracle.get_sv(q), expected, atol=TOL)
+    assert qt.getNumQubits(q) == N
+    assert qt.getNumAmps(q) == 1 << N
+
+
+def test_init_blank_and_zero(env):
+    q = qt.createQureg(N, env)
+    qt.initBlankState(q)
+    assert qt.calcTotalProb(q) == 0.0
+    qt.initZeroState(q)
+    assert abs(qt.calcTotalProb(q) - 1.0) < TOL
+
+
+def test_init_plus(env):
+    q = qt.createQureg(N, env)
+    qt.initPlusState(q)
+    np.testing.assert_allclose(
+        oracle.get_sv(q), np.full(1 << N, (1 << N) ** -0.5), atol=TOL)
+    d = qt.createDensityQureg(N, env)
+    qt.initPlusState(d)
+    np.testing.assert_allclose(
+        oracle.get_dm(d), np.full((1 << N, 1 << N), 1.0 / (1 << N)), atol=TOL)
+
+
+def test_init_classical(env):
+    for ind in (0, 3, 7):
+        q = qt.createQureg(N, env)
+        qt.initClassicalState(q, ind)
+        assert abs(qt.getProbAmp(q, ind) - 1.0) < TOL
+        d = qt.createDensityQureg(N, env)
+        qt.initClassicalState(d, ind)
+        assert abs(qt.getDensityAmp(d, ind, ind).real - 1.0) < TOL
+
+
+def test_init_debug_state(env):
+    q = qt.createQureg(N, env)
+    qt.initDebugState(q)
+    np.testing.assert_allclose(oracle.get_sv(q), oracle.debug_state(N), atol=TOL)
+
+
+def test_init_pure_state_density(env, rng):
+    psi = oracle.random_state(N, rng)
+    p = qt.createQureg(N, env)
+    oracle.set_sv(p, psi)
+    d = qt.createDensityQureg(N, env)
+    qt.initPureState(d, p)
+    np.testing.assert_allclose(oracle.get_dm(d), np.outer(psi, psi.conj()),
+                               atol=TOL)
+    assert abs(qt.calcPurity(d) - 1.0) < TOL
+
+
+def test_init_state_of_single_qubit(env):
+    q = qt.createQureg(N, env)
+    qt.initStateOfSingleQubit(q, 1, 1)
+    psi = oracle.get_sv(q)
+    idx = np.arange(1 << N)
+    expected = np.where(((idx >> 1) & 1) == 1, 0.5, 0.0)
+    np.testing.assert_allclose(psi, expected, atol=TOL)
+
+
+def test_set_amps_and_getters(env, rng):
+    psi = oracle.random_state(N, rng)
+    q = qt.createQureg(N, env)
+    qt.setAmps(q, 2, np.real(psi[2:5]), np.imag(psi[2:5]), 3)
+    for i in (2, 3, 4):
+        amp = qt.getAmp(q, i)
+        assert abs(amp - psi[i]) < TOL
+        assert abs(qt.getRealAmp(q, i) - psi[i].real) < TOL
+        assert abs(qt.getImagAmp(q, i) - psi[i].imag) < TOL
+        assert abs(qt.getProbAmp(q, i) - abs(psi[i]) ** 2) < TOL
+    assert abs(qt.getAmp(q, 0) - 1.0) < TOL  # untouched
+
+
+def test_clone_independent(env, rng):
+    psi = oracle.random_state(N, rng)
+    q = qt.createQureg(N, env)
+    oracle.set_sv(q, psi)
+    c = qt.createCloneQureg(q, env)
+    qt.pauliX(q, 0)  # must not affect clone
+    np.testing.assert_allclose(oracle.get_sv(c), psi, atol=TOL)
+    qt.cloneQureg(c, q)
+    np.testing.assert_allclose(oracle.get_sv(c), oracle.get_sv(q), atol=TOL)
+
+
+def test_compare_states(env, rng):
+    psi = oracle.random_state(N, rng)
+    q1, q2 = qt.createQureg(N, env), qt.createQureg(N, env)
+    oracle.set_sv(q1, psi)
+    oracle.set_sv(q2, psi)
+    assert qt.compareStates(q1, q2, 1e-12)
+    qt.rotateX(q2, 0, 1e-3)
+    assert not qt.compareStates(q1, q2, 1e-12)
+
+
+def test_report_and_load_roundtrip(env, rng, tmp_path):
+    psi = oracle.random_state(N, rng)
+    q = qt.createQureg(N, env)
+    oracle.set_sv(q, psi)
+    path = str(tmp_path / "state.csv")
+    qt.reportState(q, path)
+    q2 = qt.createQureg(N, env)
+    qt.initStateFromSingleFile(q2, path)
+    np.testing.assert_allclose(oracle.get_sv(q2), psi, atol=1e-9)
+
+
+# -- environment ------------------------------------------------------------
+
+def test_env_report_and_string(env):
+    s = qt.getEnvironmentString(env)
+    assert "TPU=1" in s
+    qt.reportQuESTEnv(env)
+    qt.reportQuregParams(qt.createQureg(2, env))
+    qt.syncQuESTEnv(env)
+    assert qt.syncQuESTSuccess(1) == 1
+
+
+def test_seeding(env):
+    import jax
+    qt.seedQuEST(env, [1, 2, 3])
+    k1 = jax.random.key_data(env.key)
+    qt.seedQuEST(env, [1, 2, 3])
+    assert (np.asarray(k1) == np.asarray(jax.random.key_data(env.key))).all()
+    qt.seedQuESTDefault(env)
+
+
+# -- validation -------------------------------------------------------------
+
+def test_validation_errors(env):
+    q = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError):
+        qt.hadamard(q, N)  # target out of range
+    with pytest.raises(qt.QuESTError):
+        qt.controlledNot(q, 1, 1)  # control == target
+    with pytest.raises(qt.QuESTError):
+        qt.unitary(q, 0, np.array([[1, 1], [0, 1]]))  # not unitary
+    with pytest.raises(qt.QuESTError):
+        qt.compactUnitary(q, 0, 1.0, 1.0)  # |a|^2+|b|^2 != 1
+    with pytest.raises(qt.QuESTError):
+        qt.createQureg(0, env)
+    with pytest.raises(qt.QuESTError):
+        qt.initClassicalState(q, 1 << N)
+    with pytest.raises(qt.QuESTError):
+        qt.calcPurity(q)  # statevec-only register
+    with pytest.raises(qt.QuESTError):
+        qt.getAmp(q, 1 << N)
+    with pytest.raises(qt.QuESTError):
+        qt.multiQubitUnitary(q, (0, 0), np.eye(4))  # duplicate targets
+    with pytest.raises(qt.QuESTError):
+        qt.measure(q, -1)
+
+
+def test_error_handler_hook(env):
+    seen = []
+    qt.set_input_error_handler(lambda msg, fn: seen.append((msg, fn)))
+    try:
+        q = qt.createQureg(N, env)
+        # the hook observes the failure; the call still raises so invalid
+        # inputs can never reach the kernels
+        with pytest.raises(qt.QuESTError):
+            qt.hadamard(q, 99)
+        assert seen and seen[0][1] == "hadamard"
+    finally:
+        qt.set_input_error_handler(None)
+
+
+# -- QASM -------------------------------------------------------------------
+
+def test_qasm_recording(env, tmp_path):
+    q = qt.createQureg(2, env)
+    qt.startRecordingQASM(q)
+    qt.hadamard(q, 0)
+    qt.controlledNot(q, 0, 1)
+    qt.rotateZ(q, 1, 0.5)
+    qt.tGate(q, 0)
+    qt.measure(q, 0)
+    qt.stopRecordingQASM(q)
+    qt.pauliX(q, 1)  # not recorded
+    text = q.qasm_log.text()
+    assert "OPENQASM 2.0;" in text
+    assert "qreg q[2];" in text
+    assert "h q[0];" in text
+    assert "cx q[0],q[1];" in text
+    assert "Rz(0.5) q[1];" in text
+    assert "t q[0];" in text
+    assert "measure q[0] -> c[0];" in text
+    assert text.count("x q[1]") == 0
+    path = str(tmp_path / "out.qasm")
+    qt.writeRecordedQASMToFile(q, path)
+    assert open(path).read() == text
+    qt.clearRecordedQASM(q)
+    assert "h q[0];" not in q.qasm_log.text()
+
+
+def test_qasm_compact_unitary_zyz(env):
+    q = qt.createQureg(1, env)
+    qt.startRecordingQASM(q)
+    qt.compactUnitary(q, 0, 0.6 + 0.48j, 0.64j)
+    text = q.qasm_log.text()
+    assert "U(" in text

@@ -1,0 +1,220 @@
+"""Measurement, collapse, and calculation tests (the reference's maths tier
+plus the measurement path of ``QuEST_common.c:360-374``)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.core import matrices as mats
+
+import oracle
+
+N = 3
+TOL = 1e-10
+
+
+def sv(env, psi):
+    q = qt.createQureg(N, env)
+    oracle.set_sv(q, psi)
+    return q
+
+
+# -- probabilities ----------------------------------------------------------
+
+def test_calc_prob_of_outcome_sv(env, rng):
+    psi = oracle.random_state(N, rng)
+    q = sv(env, psi)
+    for qubit in range(N):
+        for outcome in (0, 1):
+            assert abs(qt.calcProbOfOutcome(q, qubit, outcome)
+                       - oracle.prob_of_outcome_sv(psi, qubit, outcome)) < TOL
+
+
+def test_calc_prob_of_outcome_dm(env, rng):
+    rho = oracle.random_density(N, rng)
+    q = qt.createDensityQureg(N, env)
+    oracle.set_dm(q, rho)
+    for qubit in range(N):
+        for outcome in (0, 1):
+            assert abs(qt.calcProbOfOutcome(q, qubit, outcome)
+                       - oracle.prob_of_outcome_dm(rho, qubit, outcome)) < TOL
+
+
+def test_calc_total_prob(env, rng):
+    psi = oracle.random_state(N, rng)
+    q = sv(env, psi)
+    assert abs(qt.calcTotalProb(q) - 1.0) < TOL
+    qt.initDebugState(q)
+    expected = float(np.sum(np.abs(oracle.debug_state(N)) ** 2))
+    assert abs(qt.calcTotalProb(q) - expected) < 1e-9
+
+
+# -- collapse ---------------------------------------------------------------
+
+def test_collapse_to_outcome_sv(env, rng):
+    for qubit in range(N):
+        for outcome in (0, 1):
+            psi = oracle.random_state(N, rng)
+            q = sv(env, psi)
+            p = qt.collapseToOutcome(q, qubit, outcome)
+            idx = np.arange(1 << N)
+            keep = ((idx >> qubit) & 1) == outcome
+            expected = np.where(keep, psi, 0) / np.sqrt(p)
+            np.testing.assert_allclose(oracle.get_sv(q), expected, atol=TOL)
+            assert abs(qt.calcTotalProb(q) - 1.0) < TOL
+
+
+def test_collapse_to_outcome_dm(env, rng):
+    rho = oracle.random_density(N, rng)
+    q = qt.createDensityQureg(N, env)
+    oracle.set_dm(q, rho)
+    p = qt.collapseToOutcome(q, 1, 0)
+    idx = np.arange(1 << N)
+    keep = ((idx >> 1) & 1) == 0
+    proj = np.diag(keep.astype(float))
+    expected = proj @ rho @ proj / p
+    np.testing.assert_allclose(oracle.get_dm(q), expected, atol=TOL)
+    assert abs(qt.calcTotalProb(q) - 1.0) < TOL
+
+
+def test_collapse_impossible_outcome_raises(env):
+    q = qt.createQureg(N, env)  # |000>
+    with pytest.raises(qt.QuESTError):
+        qt.collapseToOutcome(q, 0, 1)
+
+
+def test_measure_deterministic(env):
+    q = qt.createQureg(N, env)
+    qt.pauliX(q, 1)  # |010>
+    for qubit, expected in [(0, 0), (1, 1), (2, 0)]:
+        outcome, prob = qt.measureWithStats(q, qubit)
+        assert outcome == expected
+        assert abs(prob - 1.0) < TOL
+
+
+def test_measure_statistics(env):
+    """~50/50 statistics on |+> with the seeded RNG stream."""
+    counts = [0, 0]
+    trials = 200
+    for _ in range(trials):
+        q = qt.createQureg(1, env)
+        qt.hadamard(q, 0)
+        counts[qt.measure(q, 0)] += 1
+    assert 60 < counts[0] < 140  # ~6 sigma window around 100
+
+
+def test_measure_reproducible_with_seed(env):
+    def run(seed):
+        e = qt.createQuESTEnv(num_devices=1, seed=[seed])
+        outcomes = []
+        for _ in range(20):
+            q = qt.createQureg(1, e)
+            qt.hadamard(q, 0)
+            outcomes.append(qt.measure(q, 0))
+        return outcomes
+
+    assert run(99) == run(99)
+
+
+# -- inner products & distances --------------------------------------------
+
+def test_inner_product(env, rng):
+    a, b = oracle.random_state(N, rng), oracle.random_state(N, rng)
+    qa, qb = sv(env, a), sv(env, b)
+    assert abs(qt.calcInnerProduct(qa, qb) - np.vdot(a, b)) < TOL
+
+
+def test_fidelity_sv(env, rng):
+    a, b = oracle.random_state(N, rng), oracle.random_state(N, rng)
+    qa, qb = sv(env, a), sv(env, b)
+    assert abs(qt.calcFidelity(qa, qb) - abs(np.vdot(a, b)) ** 2) < TOL
+
+
+def test_fidelity_dm(env, rng):
+    rho = oracle.random_density(N, rng)
+    psi = oracle.random_state(N, rng)
+    qd = qt.createDensityQureg(N, env)
+    oracle.set_dm(qd, rho)
+    qp = sv(env, psi)
+    expected = float(np.real(psi.conj() @ rho @ psi))
+    assert abs(qt.calcFidelity(qd, qp) - expected) < TOL
+
+
+def test_purity_and_hs_distance(env, rng):
+    rho1, rho2 = oracle.random_density(N, rng), oracle.random_density(N, rng)
+    q1 = qt.createDensityQureg(N, env)
+    q2 = qt.createDensityQureg(N, env)
+    oracle.set_dm(q1, rho1)
+    oracle.set_dm(q2, rho2)
+    assert abs(qt.calcPurity(q1) - np.real(np.trace(rho1 @ rho1))) < TOL
+    expected_hs = np.sqrt(np.sum(np.abs(rho1 - rho2) ** 2))
+    assert abs(qt.calcHilbertSchmidtDistance(q1, q2) - expected_hs) < TOL
+    expected_ip = np.real(np.trace(rho1.conj().T @ rho2))
+    assert abs(qt.calcDensityInnerProduct(q1, q2) - expected_ip) < TOL
+
+
+# -- Pauli expectation values ----------------------------------------------
+
+def _pauli_sum_matrix(codes, coeffs, n):
+    total = np.zeros((1 << n, 1 << n), dtype=np.complex128)
+    for t, c in enumerate(coeffs):
+        term = np.eye(1)
+        for qb in range(n):
+            term = np.kron(mats.PAULI_MATS[int(codes[t * n + qb])], term)
+        total += c * term
+    return total
+
+
+def test_expec_pauli_prod_sv(env, rng):
+    psi = oracle.random_state(N, rng)
+    q = sv(env, psi)
+    P = _pauli_sum_matrix([qt.PAULI_X, qt.PAULI_Y, qt.PAULI_Z], [1.0], N)
+    expected = float(np.real(psi.conj() @ P @ psi))
+    got = qt.calcExpecPauliProd(q, [0, 1, 2],
+                                [qt.PAULI_X, qt.PAULI_Y, qt.PAULI_Z])
+    assert abs(got - expected) < TOL
+
+
+def test_expec_pauli_prod_dm(env, rng):
+    rho = oracle.random_density(N, rng)
+    q = qt.createDensityQureg(N, env)
+    oracle.set_dm(q, rho)
+    P = _pauli_sum_matrix([qt.PAULI_Z, qt.PAULI_I, qt.PAULI_X], [1.0], N)
+    expected = float(np.real(np.trace(P @ rho)))
+    got = qt.calcExpecPauliProd(q, [0, 1, 2],
+                                [qt.PAULI_Z, qt.PAULI_I, qt.PAULI_X])
+    assert abs(got - expected) < TOL
+
+
+def test_expec_pauli_sum_sv(env, rng):
+    psi = oracle.random_state(N, rng)
+    q = sv(env, psi)
+    codes = [qt.PAULI_X, qt.PAULI_I, qt.PAULI_Z,
+             qt.PAULI_Y, qt.PAULI_Y, qt.PAULI_I]
+    coeffs = [0.7, -1.3]
+    H = _pauli_sum_matrix(codes, coeffs, N)
+    expected = float(np.real(psi.conj() @ H @ psi))
+    assert abs(qt.calcExpecPauliSum(q, codes, coeffs) - expected) < TOL
+
+
+def test_apply_pauli_sum(env, rng):
+    psi = oracle.random_state(N, rng)
+    q_in = sv(env, psi)
+    q_out = qt.createQureg(N, env)
+    codes = [qt.PAULI_X, qt.PAULI_I, qt.PAULI_Z,
+             qt.PAULI_I, qt.PAULI_Y, qt.PAULI_I]
+    coeffs = [0.5, 2.0]
+    qt.applyPauliSum(q_in, codes, coeffs, 2, q_out)
+    H = _pauli_sum_matrix(codes, coeffs, N)
+    np.testing.assert_allclose(oracle.get_sv(q_out), H @ psi, atol=TOL)
+    # input register must be unchanged
+    np.testing.assert_allclose(oracle.get_sv(q_in), psi, atol=TOL)
+
+
+def test_set_weighted_qureg(env, rng):
+    a, b = oracle.random_state(N, rng), oracle.random_state(N, rng)
+    qa, qb = sv(env, a), sv(env, b)
+    out = qt.createQureg(N, env)
+    qt.setWeightedQureg(0.3 + 0.1j, qa, -0.2j, qb, 0.5, out)
+    expected = (0.3 + 0.1j) * a + (-0.2j) * b + 0.5 * np.eye(1 << N)[0]  # out was |0..0>
+    np.testing.assert_allclose(oracle.get_sv(out), expected, atol=TOL)

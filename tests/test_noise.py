@@ -1,0 +1,159 @@
+"""Decoherence channel tests (the reference's density_matrix/noise tier):
+every mix* channel against the dense Kraus oracle, plus CPTP validation."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.ops import channels as chan
+from quest_tpu.core import matrices as mats
+
+import oracle
+
+N = 2
+TOL = 1e-10
+
+
+def make(env, rho):
+    q = qt.createDensityQureg(N, env)
+    oracle.set_dm(q, rho)
+    return q
+
+
+def check(q, expected):
+    np.testing.assert_allclose(oracle.get_dm(q), expected, atol=TOL)
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mix_dephasing(env, rng, target):
+    p = 0.23
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.mixDephasing(q, target, p)
+    Z = mats.pauli_z()
+    kraus = [np.sqrt(1 - p) * np.eye(2), np.sqrt(p) * Z]
+    check(q, oracle.apply_channel(rho, N, kraus, (target,)))
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mix_depolarising(env, rng, target):
+    p = 0.31
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.mixDepolarising(q, target, p)
+    check(q, oracle.apply_channel(rho, N, chan.depolarising_kraus(p), (target,)))
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mix_damping(env, rng, target):
+    p = 0.4
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.mixDamping(q, target, p)
+    check(q, oracle.apply_channel(rho, N, chan.damping_kraus(p), (target,)))
+
+
+def test_damping_ground_state_fixture(env):
+    """|1><1| damped with p decays to (1-p)|1><1| + p|0><0|
+    (the reference's damping_example.c behaviour)."""
+    p = 0.35
+    q = qt.createDensityQureg(1, env)
+    qt.initClassicalState(q, 1)
+    qt.mixDamping(q, 0, p)
+    rho = oracle.get_dm(q)
+    np.testing.assert_allclose(rho, np.diag([p, 1 - p]), atol=TOL)
+
+
+def test_mix_pauli(env, rng):
+    px, py, pz = 0.1, 0.15, 0.2
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.mixPauli(q, 1, px, py, pz)
+    check(q, oracle.apply_channel(rho, N, chan.pauli_kraus(px, py, pz), (1,)))
+
+
+def test_mix_two_qubit_dephasing(env, rng):
+    p = 0.3
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.mixTwoQubitDephasing(q, 0, 1, p)
+    Z, I = mats.pauli_z(), np.eye(2)
+    kraus = [np.sqrt(1 - p) * np.kron(I, I),
+             np.sqrt(p / 3) * np.kron(I, Z),
+             np.sqrt(p / 3) * np.kron(Z, I),
+             np.sqrt(p / 3) * np.kron(Z, Z)]
+    check(q, oracle.apply_channel(rho, N, kraus, (0, 1)))
+
+
+def test_mix_two_qubit_depolarising(env, rng):
+    p = 0.5
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.mixTwoQubitDepolarising(q, 0, 1, p)
+    check(q, oracle.apply_channel(
+        rho, N, chan.two_qubit_depolarising_kraus(p), (0, 1)))
+
+
+def test_mix_kraus_map_random(env, rng):
+    ops = oracle.random_kraus(1, 3, rng)
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.mixKrausMap(q, 1, ops)
+    check(q, oracle.apply_channel(rho, N, ops, (1,)))
+
+
+def test_mix_two_qubit_kraus_map_random(env, rng):
+    ops = oracle.random_kraus(2, 4, rng)
+    rho = oracle.random_density(N, rng)
+    q = make(env, rho)
+    qt.mixTwoQubitKrausMap(q, 0, 1, ops)
+    check(q, oracle.apply_channel(rho, N, ops, (0, 1)))
+
+
+def test_mix_multi_qubit_kraus_map_random(env, rng):
+    n = 3
+    ops = oracle.random_kraus(2, 2, rng)
+    rho = oracle.random_density(n, rng)
+    q = qt.createDensityQureg(n, env)
+    oracle.set_dm(q, rho)
+    qt.mixMultiQubitKrausMap(q, (2, 0), ops)
+    np.testing.assert_allclose(
+        oracle.get_dm(q), oracle.apply_channel(rho, n, ops, (2, 0)), atol=TOL)
+
+
+def test_mix_density_matrix(env, rng):
+    rho1 = oracle.random_density(N, rng)
+    rho2 = oracle.random_density(N, rng)
+    q1, q2 = make(env, rho1), make(env, rho2)
+    qt.mixDensityMatrix(q1, 0.3, q2)
+    check(q1, 0.7 * rho1 + 0.3 * rho2)
+
+
+def test_channels_preserve_trace(env, rng):
+    q = make(env, oracle.random_density(N, rng))
+    qt.mixDephasing(q, 0, 0.2)
+    qt.mixDepolarising(q, 1, 0.3)
+    qt.mixDamping(q, 0, 0.15)
+    qt.mixTwoQubitDepolarising(q, 0, 1, 0.4)
+    assert abs(qt.calcTotalProb(q) - 1.0) < TOL
+
+
+def test_non_cptp_kraus_rejected(env):
+    q = qt.createDensityQureg(N, env)
+    bad = [np.eye(2) * 0.5]
+    with pytest.raises(qt.QuESTError):
+        qt.mixKrausMap(q, 0, bad)
+
+
+def test_prob_limits_enforced(env):
+    q = qt.createDensityQureg(N, env)
+    with pytest.raises(qt.QuESTError):
+        qt.mixDephasing(q, 0, 0.6)          # max 1/2
+    with pytest.raises(qt.QuESTError):
+        qt.mixDepolarising(q, 0, 0.8)       # max 3/4
+    with pytest.raises(qt.QuESTError):
+        qt.mixTwoQubitDephasing(q, 0, 1, 0.8)   # max 3/4
+    with pytest.raises(qt.QuESTError):
+        qt.mixTwoQubitDepolarising(q, 0, 1, 0.95)  # max 15/16
+    with pytest.raises(qt.QuESTError):
+        qt.mixDamping(q, 0, 1.2)            # max 1

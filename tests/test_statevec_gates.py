@@ -1,0 +1,274 @@
+"""Statevector gate tests vs the dense oracle.
+
+Mirrors the reference's unit tier (SURVEY.md §4): every gate exercised on
+every valid target (and control) of a small register, across several initial
+states, compared with S (full state) and P (total probability) checks at the
+1e-10 golden tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.core import matrices as mats
+
+import oracle
+
+N = 3
+TOL = 1e-10
+ANGLE = 0.7853981633974483  # pi/4, arbitrary non-trivial
+
+
+def states(rng):
+    yield "plus", np.full(1 << N, (1 << N) ** -0.5, dtype=np.complex128)
+    yield "debug", oracle.debug_state(N)
+    yield "random", oracle.random_state(N, rng)
+
+
+def make_qureg(env, psi):
+    q = qt.createQureg(N, env)
+    oracle.set_sv(q, psi)
+    return q
+
+
+def check(q, expected):
+    np.testing.assert_allclose(oracle.get_sv(q), expected, atol=TOL)
+
+
+# ---------------------------------------------------------------------------
+# single-qubit gates, all targets x all init states
+# ---------------------------------------------------------------------------
+
+GATES_1Q = [
+    ("hadamard", lambda q, t: qt.hadamard(q, t), mats.hadamard()),
+    ("pauliX", lambda q, t: qt.pauliX(q, t), mats.pauli_x()),
+    ("pauliY", lambda q, t: qt.pauliY(q, t), mats.pauli_y()),
+    ("pauliZ", lambda q, t: qt.pauliZ(q, t), mats.pauli_z()),
+    ("sGate", lambda q, t: qt.sGate(q, t), mats.s_gate()),
+    ("tGate", lambda q, t: qt.tGate(q, t), mats.t_gate()),
+    ("phaseShift", lambda q, t: qt.phaseShift(q, t, ANGLE),
+     np.diag([1, np.exp(1j * ANGLE)])),
+    ("rotateX", lambda q, t: qt.rotateX(q, t, ANGLE), mats.rotation(ANGLE, (1, 0, 0))),
+    ("rotateY", lambda q, t: qt.rotateY(q, t, ANGLE), mats.rotation(ANGLE, (0, 1, 0))),
+    ("rotateZ", lambda q, t: qt.rotateZ(q, t, ANGLE), mats.rotation(ANGLE, (0, 0, 1))),
+    ("rotateAroundAxis",
+     lambda q, t: qt.rotateAroundAxis(q, t, ANGLE, (1.0, 2.0, -0.5)),
+     mats.rotation(ANGLE, (1.0, 2.0, -0.5))),
+    ("compactUnitary",
+     lambda q, t: qt.compactUnitary(q, t, 0.6 + 0.48j, 0.64j),
+     mats.compact_unitary(0.6 + 0.48j, 0.64j)),
+]
+
+
+@pytest.mark.parametrize("name,fn,u", GATES_1Q, ids=[g[0] for g in GATES_1Q])
+@pytest.mark.parametrize("target", range(N))
+def test_1q_gate(env, rng, name, fn, u, target):
+    for _, psi in states(rng):
+        q = make_qureg(env, psi)
+        fn(q, target)
+        check(q, oracle.apply_sv(psi, N, u, (target,)))
+
+
+def test_unitary_random(env, rng):
+    for target in range(N):
+        u = oracle.random_unitary(1, rng)
+        psi = oracle.random_state(N, rng)
+        q = make_qureg(env, psi)
+        qt.unitary(q, target, u)
+        check(q, oracle.apply_sv(psi, N, u, (target,)))
+
+
+# ---------------------------------------------------------------------------
+# controlled gates, all (control, target) pairs
+# ---------------------------------------------------------------------------
+
+GATES_CTRL = [
+    ("controlledNot", lambda q, c, t: qt.controlledNot(q, c, t), mats.pauli_x()),
+    ("controlledPauliY", lambda q, c, t: qt.controlledPauliY(q, c, t), mats.pauli_y()),
+    ("controlledPhaseShift",
+     lambda q, c, t: qt.controlledPhaseShift(q, c, t, ANGLE),
+     np.diag([1, np.exp(1j * ANGLE)])),
+    ("controlledPhaseFlip",
+     lambda q, c, t: qt.controlledPhaseFlip(q, c, t), mats.pauli_z()),
+    ("controlledRotateX",
+     lambda q, c, t: qt.controlledRotateX(q, c, t, ANGLE),
+     mats.rotation(ANGLE, (1, 0, 0))),
+    ("controlledRotateY",
+     lambda q, c, t: qt.controlledRotateY(q, c, t, ANGLE),
+     mats.rotation(ANGLE, (0, 1, 0))),
+    ("controlledRotateZ",
+     lambda q, c, t: qt.controlledRotateZ(q, c, t, ANGLE),
+     mats.rotation(ANGLE, (0, 0, 1))),
+    ("controlledRotateAroundAxis",
+     lambda q, c, t: qt.controlledRotateAroundAxis(q, c, t, ANGLE, (0.3, -1.0, 2.0)),
+     mats.rotation(ANGLE, (0.3, -1.0, 2.0))),
+    ("controlledCompactUnitary",
+     lambda q, c, t: qt.controlledCompactUnitary(q, c, t, 0.28 + 0.96j, 0.0),
+     mats.compact_unitary(0.28 + 0.96j, 0.0)),
+]
+
+
+@pytest.mark.parametrize("name,fn,u", GATES_CTRL, ids=[g[0] for g in GATES_CTRL])
+def test_controlled_gate(env, rng, name, fn, u):
+    for control in range(N):
+        for target in range(N):
+            if control == target:
+                continue
+            psi = oracle.random_state(N, rng)
+            q = make_qureg(env, psi)
+            fn(q, control, target)
+            check(q, oracle.apply_sv(psi, N, u, (target,), (control,)))
+
+
+def test_controlled_unitary_random(env, rng):
+    u = oracle.random_unitary(1, rng)
+    psi = oracle.random_state(N, rng)
+    q = make_qureg(env, psi)
+    qt.controlledUnitary(q, 2, 0, u)
+    check(q, oracle.apply_sv(psi, N, u, (0,), (2,)))
+
+
+def test_multi_controlled_unitary(env, rng):
+    u = oracle.random_unitary(1, rng)
+    psi = oracle.random_state(N, rng)
+    q = make_qureg(env, psi)
+    qt.multiControlledUnitary(q, [1, 2], 0, u)
+    check(q, oracle.apply_sv(psi, N, u, (0,), (1, 2)))
+
+
+def test_multi_state_controlled_unitary(env, rng):
+    u = oracle.random_unitary(1, rng)
+    psi = oracle.random_state(N, rng)
+    q = make_qureg(env, psi)
+    qt.multiStateControlledUnitary(q, [1, 2], [0, 1], 0, u)
+    check(q, oracle.apply_sv(psi, N, u, (0,), (1, 2), [0, 1]))
+
+
+# ---------------------------------------------------------------------------
+# multi-qubit gates
+# ---------------------------------------------------------------------------
+
+def test_swap_all_pairs(env, rng):
+    for q1 in range(N):
+        for q2 in range(N):
+            if q1 == q2:
+                continue
+            psi = oracle.random_state(N, rng)
+            q = make_qureg(env, psi)
+            qt.swapGate(q, q1, q2)
+            check(q, oracle.apply_sv(psi, N, mats.swap(), (q1, q2)))
+
+
+def test_sqrt_swap(env, rng):
+    for q1, q2 in [(0, 1), (1, 0), (0, 2), (2, 1)]:
+        psi = oracle.random_state(N, rng)
+        q = make_qureg(env, psi)
+        qt.sqrtSwapGate(q, q1, q2)
+        check(q, oracle.apply_sv(psi, N, mats.sqrt_swap(), (q1, q2)))
+    # sqrtSwap . sqrtSwap == swap
+    psi = oracle.random_state(N, rng)
+    q = make_qureg(env, psi)
+    qt.sqrtSwapGate(q, 0, 2)
+    qt.sqrtSwapGate(q, 0, 2)
+    check(q, oracle.apply_sv(psi, N, mats.swap(), (0, 2)))
+
+
+def test_two_qubit_unitary(env, rng):
+    for t1, t2 in [(0, 1), (1, 0), (0, 2), (2, 0), (1, 2), (2, 1)]:
+        u = oracle.random_unitary(2, rng)
+        psi = oracle.random_state(N, rng)
+        q = make_qureg(env, psi)
+        qt.twoQubitUnitary(q, t1, t2, u)
+        check(q, oracle.apply_sv(psi, N, u, (t1, t2)))
+
+
+def test_controlled_two_qubit_unitary(env, rng):
+    u = oracle.random_unitary(2, rng)
+    psi = oracle.random_state(N, rng)
+    q = make_qureg(env, psi)
+    qt.controlledTwoQubitUnitary(q, 1, 0, 2, u)
+    check(q, oracle.apply_sv(psi, N, u, (0, 2), (1,)))
+
+
+def test_multi_qubit_unitary(env, rng):
+    # 2- and 3-qubit dense unitaries, scrambled target orders
+    for targets in [(0, 1), (2, 1), (0, 1, 2), (2, 0, 1)]:
+        u = oracle.random_unitary(len(targets), rng)
+        psi = oracle.random_state(N, rng)
+        q = make_qureg(env, psi)
+        qt.multiQubitUnitary(q, targets, u)
+        check(q, oracle.apply_sv(psi, N, u, targets))
+
+
+def test_multi_controlled_multi_qubit_unitary(env, rng):
+    n = 4
+    u = oracle.random_unitary(2, rng)
+    psi = oracle.random_state(n, rng)
+    q = qt.createQureg(n, env)
+    oracle.set_sv(q, psi)
+    qt.multiControlledMultiQubitUnitary(q, [1, 3], (0, 2), u)
+    np.testing.assert_allclose(
+        oracle.get_sv(q), oracle.apply_sv(psi, n, u, (0, 2), (1, 3)), atol=TOL)
+
+
+def test_multi_controlled_phase_gates(env, rng):
+    psi = oracle.random_state(N, rng)
+    q = make_qureg(env, psi)
+    qt.multiControlledPhaseShift(q, [0, 1, 2], ANGLE)
+    expected = psi.copy()
+    expected[7] *= np.exp(1j * ANGLE)
+    check(q, expected)
+
+    q = make_qureg(env, psi)
+    qt.multiControlledPhaseFlip(q, [0, 2])
+    idx = np.arange(1 << N)
+    expected = np.where((idx & 0b101) == 0b101, -psi, psi)
+    check(q, expected)
+
+
+def test_multi_rotate_z(env, rng):
+    psi = oracle.random_state(N, rng)
+    q = make_qureg(env, psi)
+    qt.multiRotateZ(q, [0, 2], ANGLE)
+    idx = np.arange(1 << N)
+    parity = ((idx & 1) ^ ((idx >> 2) & 1)).astype(bool)
+    fac = np.where(parity, np.exp(0.5j * ANGLE), np.exp(-0.5j * ANGLE))
+    check(q, psi * fac)
+
+
+def test_multi_rotate_pauli(env, rng):
+    # exp(-i a/2 X0 Y1 Z2) vs dense expm via eigendecomposition
+    psi = oracle.random_state(N, rng)
+    q = make_qureg(env, psi)
+    qt.multiRotatePauli(q, [0, 1, 2],
+                        [qt.PAULI_X, qt.PAULI_Y, qt.PAULI_Z], ANGLE)
+    P = np.kron(mats.pauli_z(), np.kron(mats.pauli_y(), mats.pauli_x()))
+    w, v = np.linalg.eigh(P)
+    U = (v * np.exp(-0.5j * ANGLE * w)) @ v.conj().T
+    check(q, U @ psi)
+    # identity codes leave those qubits untouched
+    q = make_qureg(env, psi)
+    qt.multiRotatePauli(q, [0, 1], [qt.PAULI_I, qt.PAULI_Z], ANGLE)
+    Pz = oracle.full_operator(N, mats.pauli_z(), (1,))
+    w, v = np.linalg.eigh(Pz)
+    U = (v * np.exp(-0.5j * ANGLE * w)) @ v.conj().T
+    check(q, U @ psi)
+
+
+def test_gate_composition_qft3(env):
+    """3-qubit QFT built from H + controlled phase shifts matches the DFT
+    matrix (the reference's algor tier, ``tests/algor/QFT.test``)."""
+    rng = np.random.default_rng(7)
+    psi = oracle.random_state(3, rng)
+    q = qt.createQureg(3, env)
+    oracle.set_sv(q, psi)
+    # standard QFT circuit (qubit 0 = least significant)
+    qt.hadamard(q, 2)
+    qt.controlledPhaseShift(q, 1, 2, np.pi / 2)
+    qt.controlledPhaseShift(q, 0, 2, np.pi / 4)
+    qt.hadamard(q, 1)
+    qt.controlledPhaseShift(q, 0, 1, np.pi / 2)
+    qt.hadamard(q, 0)
+    qt.swapGate(q, 0, 2)
+    dft = np.exp(2j * np.pi * np.outer(np.arange(8), np.arange(8)) / 8) / np.sqrt(8)
+    np.testing.assert_allclose(oracle.get_sv(q), dft @ psi, atol=TOL)
