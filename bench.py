@@ -1,0 +1,92 @@
+"""Headline benchmark: single-qubit + CNOT gate throughput per chip.
+
+Mirrors the reference's `tests/benchmarks/rotate_benchmark.test` (29-qubit
+register, repeated `compactUnitary` probes per target qubit) recast the
+TPU-native way: the gate sequence is compiled into ONE XLA executable
+(rotation layer over every qubit + CNOT brickwork, repeated), so the measured
+number is sustained HBM-roofline throughput rather than per-launch latency.
+
+Prints one JSON line:
+  {"metric": ..., "value": gates/sec, "unit": "gates/sec", "vs_baseline": r}
+
+`vs_baseline` compares against the reference's GPU backend modeled at its
+HBM roofline on an A100-80GB (2.0e12 B/s): each 1q/CNOT gate streams the
+full state once (read + write, 8 B/amp in the complex64 planes used here) —
+the same memory-bound model that governs `QuEST_gpu.cu`'s per-amplitude
+kernels (`statevec_compactUnitaryKernel`, QuEST_gpu.cu:667-720). No in-repo
+published numbers exist (BASELINE.md), so the roofline is the baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_bench_circuit(num_qubits: int, layers: int):
+    from quest_tpu.circuits import Circuit
+    rng = np.random.default_rng(2026)
+    c = Circuit(num_qubits)
+    n_gates = 0
+    for layer in range(layers):
+        for q in range(num_qubits):
+            c.rotate(q, float(rng.uniform(0, 2 * np.pi)), rng.normal(size=3))
+            n_gates += 1
+        off = layer % 2
+        for q in range(off, num_qubits - 1, 2):
+            c.cnot(q, q + 1)
+            n_gates += 1
+    return c, n_gates
+
+
+def main() -> None:
+    import os
+    import jax
+    import quest_tpu as qt
+
+    platform = jax.devices()[0].platform
+    # state sized to the device: 2^n amps * 8 B (f32 planes). The compiled
+    # program is kept to 2 layers (re-run `trials` times) so the first-call
+    # XLA compile stays fast on the remote-compile tunnel.
+    num_qubits = int(os.environ.get(
+        "QUEST_BENCH_QUBITS", "26" if platform == "tpu" else "20"))
+    layers = int(os.environ.get("QUEST_BENCH_LAYERS", "2"))
+    trials = int(os.environ.get("QUEST_BENCH_TRIALS", "10"))
+
+    env = qt.createQuESTEnv(num_devices=1, seed=[2026])
+    q = qt.createQureg(num_qubits, env)
+    qt.initZeroState(q)
+
+    circ, n_gates = build_bench_circuit(num_qubits, layers)
+    compiled = circ.compile(env)
+
+    compiled.run(q)                      # compile + warm-up
+    q.state.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        compiled.run(q)
+    q.state.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    gates_per_sec = n_gates * trials / dt
+
+    # A100 HBM-roofline baseline at the same width/precision
+    bytes_per_amp_pass = 16.0            # 8 B/amp complex64: read + write
+    a100_bw = 2.0e12
+    baseline = a100_bw / (bytes_per_amp_pass * (1 << num_qubits))
+
+    print(json.dumps({
+        "metric": f"1q+CNOT gate throughput, {num_qubits}-qubit statevector, "
+                  f"complex64, single {platform} chip",
+        "value": round(gates_per_sec, 2),
+        "unit": "gates/sec",
+        "vs_baseline": round(gates_per_sec / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

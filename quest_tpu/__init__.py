@@ -26,6 +26,7 @@ from .types import (
 )
 from .env import QuESTEnv, create_quest_env, destroy_quest_env
 from .qureg import Qureg
+from .circuits import Circuit, CompiledCircuit, Param
 from .api import *  # noqa: F401,F403  (the QuEST-compatible surface)
 from .api import __all__ as _api_all
 
@@ -37,6 +38,7 @@ __all__ = (
         "PauliOpType", "PAULI_I", "PAULI_X", "PAULI_Y", "PAULI_Z",
         "QuESTError", "invalid_quest_input_error", "set_input_error_handler",
         "QuESTEnv", "create_quest_env", "destroy_quest_env", "Qureg",
+        "Circuit", "CompiledCircuit", "Param",
     ]
     + list(_api_all)
 )

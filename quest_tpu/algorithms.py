@@ -1,0 +1,129 @@
+"""Standard quantum algorithms as :class:`~quest_tpu.circuits.Circuit` builders.
+
+The reference ships these as user programs (`examples/tutorial_example.c`,
+`examples/bernstein_vazirani_circuit.c`, `examples/damping_example.c`) and as
+algorithm-level tests (`tests/algor/QFT.test`). Here they are library
+functions producing whole-circuit programs that compile to single XLA
+executables — also the workloads of the BASELINE.json benchmark configs
+(QFT-30, Grover-30, random Clifford+T circuits).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .circuits import Circuit
+
+__all__ = [
+    "qft",
+    "inverse_qft",
+    "grover",
+    "bernstein_vazirani",
+    "ghz",
+    "random_circuit",
+]
+
+
+def qft(num_qubits: int, swap_order: bool = True) -> Circuit:
+    """Quantum Fourier transform (the reference's `tests/algor/QFT.test`
+    workload): H + controlled phase ladder, optional bit-reversal swaps."""
+    c = Circuit(num_qubits)
+    for q in range(num_qubits - 1, -1, -1):
+        c.h(q)
+        for k, ctrl in enumerate(range(q - 1, -1, -1), start=2):
+            c.cphase(ctrl, q, 2.0 * np.pi / (1 << k))
+    if swap_order:
+        for q in range(num_qubits // 2):
+            c.swap(q, num_qubits - 1 - q)
+    return c
+
+
+def inverse_qft(num_qubits: int, swap_order: bool = True) -> Circuit:
+    return qft(num_qubits, swap_order).inverse()
+
+
+def grover(num_qubits: int, marked: int, num_iterations: int | None = None) -> Circuit:
+    """Grover search for basis state ``marked``: uniform superposition, then
+    round(pi/4 sqrt(2^n)) iterations of oracle + diffusion. The oracle is a
+    multi-controlled phase flip with flipped controls on the 0-bits of
+    ``marked``; diffusion is H^n · (2|0><0| - 1) · H^n."""
+    n = num_qubits
+    if not 0 <= marked < (1 << n):
+        raise ValueError(f"marked state {marked} out of range [0, {1 << n})")
+    if num_iterations is None:
+        num_iterations = max(1, int(round(np.pi / 4.0 * np.sqrt(1 << n))))
+    c = Circuit(n)
+    for q in range(n):
+        c.h(q)
+
+    def phase_on(index: int):
+        """-1 phase on exactly |index>: a 1-qubit phase conditioned on every
+        other qubit being at its bit of ``index`` — O(1) memory at any n
+        (the reference's multiControlledPhaseFlip with flipped controls)."""
+        target_diag = np.array([1.0, -1.0]) if (index >> (n - 1)) & 1 \
+            else np.array([-1.0, 1.0])
+        controls = tuple(range(n - 1))
+        states = tuple((index >> q) & 1 for q in controls)
+        c.gate(np.diag(target_diag), (n - 1,), controls, states)
+
+    for _ in range(num_iterations):
+        phase_on(marked)
+        for q in range(n):
+            c.h(q)
+        phase_on(0)
+        for q in range(n):
+            c.h(q)
+    return c
+
+
+def bernstein_vazirani(num_qubits: int, secret: int) -> Circuit:
+    """Phase-oracle Bernstein–Vazirani (one query recovers ``secret``), the
+    workload of `examples/bernstein_vazirani_circuit.c`: H^n, Z on secret
+    bits, H^n — final state = |secret>."""
+    c = Circuit(num_qubits)
+    for q in range(num_qubits):
+        c.h(q)
+    for q in range(num_qubits):
+        if (secret >> q) & 1:
+            c.z(q)
+    for q in range(num_qubits):
+        c.h(q)
+    return c
+
+
+def ghz(num_qubits: int) -> Circuit:
+    c = Circuit(num_qubits)
+    c.h(0)
+    for q in range(1, num_qubits):
+        c.cnot(q - 1, q)
+    return c
+
+
+def random_circuit(num_qubits: int, depth: int, seed: int = 0,
+                   gate_set: str = "clifford+t") -> Circuit:
+    """Layered random circuit (the BASELINE.json "20-qubit random Clifford+T"
+    / "34–38 qubit random circuit" configs): each layer applies a random
+    1-qubit gate to every qubit then entangles a random brickwork pairing."""
+    rng = np.random.default_rng(seed)
+    c = Circuit(num_qubits)
+    if gate_set == "clifford+t":
+        one_q = ("h", "s", "t", "x", "y", "z")
+    elif gate_set == "haar":
+        one_q = ("rot",)
+    else:
+        raise ValueError(f"unknown gate_set {gate_set!r}")
+    for _ in range(depth):
+        for q in range(num_qubits):
+            g = one_q[rng.integers(len(one_q))]
+            if g == "rot":
+                axis = rng.normal(size=3)
+                c.rotate(q, float(rng.uniform(0, 2 * np.pi)), axis)
+            else:
+                getattr(c, g)(q)
+        offset = int(rng.integers(2))
+        for q in range(offset, num_qubits - 1, 2):
+            if rng.uniform() < 0.5:
+                c.cnot(q, q + 1)
+            else:
+                c.cz(q, q + 1)
+    return c

@@ -229,11 +229,7 @@ def _jit_hs_dist(a_f, b_f):
     return dm.calc_hilbert_schmidt_distance(unpack(a_f), unpack(b_f))
 
 
-def _bitmask(qubits: Sequence[int]) -> int:
-    m = 0
-    for q in qubits:
-        m |= 1 << int(q)
-    return m
+from .core.apply import bitmask as _bitmask  # noqa: E402
 
 
 def _packed(qureg: Qureg, mat: np.ndarray) -> jnp.ndarray:

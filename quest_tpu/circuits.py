@@ -1,0 +1,493 @@
+"""Whole-circuit compilation: a gate program -> ONE XLA executable.
+
+The reference pays per-gate dispatch: every API call crosses the user/library
+boundary, validates, and launches a kernel (CUDA: one ``__global__`` launch per
+gate, ``QuEST_gpu.cu:722-728``; MPI: one exchange round per cross-chunk gate,
+``QuEST_cpu_distributed.c:843-878``). On TPU, launch latency dwarfs per-gate
+math, so the idiomatic design is to trace the *entire circuit* into a single
+jitted program: XLA fuses adjacent gates into shared memory passes, schedules
+cross-shard ``ppermute`` exchanges itself, and the donated state buffer is
+updated in place. This module is that fast path (SURVEY.md §7, build stage 5's
+"circuit-level jit").
+
+Beyond the reference's capabilities, compiled circuits are:
+
+- **parameterized** — angles may be :class:`Param` placeholders bound at call
+  time, so one executable serves every rotation angle (no recompiles);
+- **differentiable** — :meth:`CompiledCircuit.expectation` is a pure function
+  of the parameter vector, so ``jax.grad`` gives exact gradients for
+  variational algorithms (impossible in the reference);
+- **pre-fused** — runs of static gates on the same target set are multiplied
+  host-side into one matrix, and consecutive static diagonal gates merge into
+  one elementwise pass, before XLA ever sees the program.
+
+Usage::
+
+    c = Circuit(20)
+    theta = c.parameter("theta")
+    for q in range(20):
+        c.h(q)
+    c.rz(0, theta)
+    c.cnot(0, 1)
+    f = c.compile(env)
+    f.run(qureg, params={"theta": 0.3})      # one executable, donated buffer
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.apply import apply_unitary, apply_diagonal, bitmask
+from .core import matrices as mats
+from .core.packing import pack, unpack
+from .env import QuESTEnv
+from .qureg import Qureg
+from .types import PauliOpType
+
+__all__ = ["Circuit", "CompiledCircuit", "Param"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Param:
+    """A named angle placeholder, bound at run time."""
+    name: str
+
+
+Angle = Union[float, Param]
+
+
+@dataclasses.dataclass
+class _Op:
+    """One recorded gate. ``mat`` is a static numpy matrix (fusable) or a
+    traceable ``params -> jnp matrix`` builder; likewise ``diag`` for
+    elementwise (phase-family) factors of shape ``(2,)*k``."""
+    kind: str                      # "u" | "diag"
+    targets: tuple[int, ...]       # user bit order ("u") / sorted desc ("diag")
+    ctrl_mask: int = 0
+    flip_mask: int = 0
+    mat: Optional[np.ndarray] = None
+    mat_fn: Optional[Callable] = None
+    diag: Optional[np.ndarray] = None
+    diag_fn: Optional[Callable] = None
+
+    @property
+    def is_static(self) -> bool:
+        return self.mat_fn is None and self.diag_fn is None
+
+
+def _angle(params: dict, a: Angle):
+    return params[a.name] if isinstance(a, Param) else a
+
+
+def _rot_matrix(angle, axis) -> jnp.ndarray:
+    """Traceable exp(-i angle/2 n.sigma) (getComplexPairFromRotation,
+    ``QuEST_common.c:113-120``) — jnp so ``angle`` may be a tracer."""
+    n = mats.unit_vector(axis)
+    c = jnp.cos(angle / 2.0)
+    s = jnp.sin(angle / 2.0)
+    alpha = jax.lax.complex(c, -s * n[2])
+    beta = jax.lax.complex(s * n[1], -s * n[0])
+    return jnp.array([[1.0, 0.0], [0.0, 0.0]]) * alpha \
+        + jnp.array([[0.0, -1.0], [0.0, 0.0]]) * jnp.conj(beta) \
+        + jnp.array([[0.0, 0.0], [1.0, 0.0]]) * beta \
+        + jnp.array([[0.0, 0.0], [0.0, 1.0]]) * jnp.conj(alpha)
+
+
+def _phase_diag(angle) -> jnp.ndarray:
+    return jnp.stack([jnp.ones_like(angle) + 0j, jnp.exp(1j * angle)])
+
+
+def _apply_ops(state: jnp.ndarray, num_qubits: int, ops: Sequence["_Op"],
+               params: dict) -> jnp.ndarray:
+    """Trace a recorded op sequence onto a complex state (the one dispatch
+    loop shared by run/apply and expectation_fn)."""
+    for op in ops:
+        if op.kind == "u":
+            u = op.mat_fn(params) if op.mat_fn is not None else op.mat
+            state = apply_unitary(state, num_qubits, u, op.targets,
+                                  op.ctrl_mask, op.flip_mask)
+        else:
+            d = op.diag_fn(params) if op.diag_fn is not None else op.diag
+            state = apply_diagonal(state, num_qubits, op.targets, d)
+    return state
+
+
+class Circuit:
+    """A recorded gate program over ``num_qubits`` qubits.
+
+    Builder methods append gates; nothing touches a device until
+    :meth:`compile`. Qubit/control indices follow the reference's conventions
+    (bit ``j`` of a multi-qubit matrix row indexes ``targets[j]``).
+    """
+
+    def __init__(self, num_qubits: int):
+        if num_qubits < 1:
+            raise ValueError("circuit needs at least one qubit")
+        self.num_qubits = num_qubits
+        self.ops: list[_Op] = []
+        self._params: list[str] = []
+
+    # -- parameters --------------------------------------------------------
+
+    def parameter(self, name: str) -> Param:
+        if name not in self._params:
+            self._params.append(name)
+        return Param(name)
+
+    @property
+    def param_names(self) -> tuple[str, ...]:
+        return tuple(self._params)
+
+    # -- recording helpers -------------------------------------------------
+
+    def _check(self, qubits: Sequence[int]) -> None:
+        for q in qubits:
+            if not 0 <= q < self.num_qubits:
+                raise ValueError(f"qubit {q} out of range [0, {self.num_qubits})")
+        if len(set(qubits)) != len(tuple(qubits)):
+            raise ValueError(f"repeated qubit in {tuple(qubits)}")
+
+    def _register_angle(self, a: Angle) -> Angle:
+        """Auto-register Param placeholders used in builder calls so directly
+        constructed ``Param("x")`` objects work like ``circuit.parameter``."""
+        if isinstance(a, Param):
+            return self.parameter(a.name)
+        return a
+
+    def gate(self, u, targets: Sequence[int], controls: Sequence[int] = (),
+             control_states: Optional[Sequence[int]] = None) -> "Circuit":
+        """Record an arbitrary k-qubit (controlled) unitary.
+
+        ``u``: a ``(2^k, 2^k)`` matrix, or a callable ``params_dict -> matrix``
+        for parameterized gates. ``control_states`` (default all-1) gives the
+        conditioning bit per control (multiStateControlledUnitary semantics).
+        """
+        targets = tuple(int(t) for t in targets)
+        controls = tuple(int(c) for c in controls)
+        self._check(targets + controls)
+        flip = 0
+        if control_states is not None:
+            if len(control_states) != len(controls):
+                raise ValueError(
+                    f"{len(controls)} controls but "
+                    f"{len(control_states)} control states")
+            for c, s in zip(controls, control_states):
+                if not s:
+                    flip |= 1 << c
+        if callable(u):
+            op = _Op("u", targets, bitmask(controls), flip, mat_fn=u)
+        else:
+            u = np.asarray(u, dtype=np.complex128)
+            dim = 1 << len(targets)
+            if u.shape != (dim, dim):
+                raise ValueError(f"matrix shape {u.shape} != {(dim, dim)}")
+            op = _Op("u", targets, bitmask(controls), flip, mat=u)
+        self.ops.append(op)
+        return self
+
+    def diagonal(self, factors, qubits: Sequence[int]) -> "Circuit":
+        """Record an elementwise phase factor: ``factors`` has shape
+        ``(2,)*k`` with axis ``i`` indexed by the bit of ``qubits[i]``, or is
+        a callable ``params -> tensor`` (same axis order). Axes are
+        re-ordered internally to the engine's sorted-descending layout."""
+        qubits = tuple(int(q) for q in qubits)
+        self._check(qubits)
+        desc = tuple(sorted(qubits, reverse=True))
+        axes = tuple(qubits.index(q) for q in desc)
+        identity = axes == tuple(range(len(qubits)))
+        if callable(factors):
+            fn = factors if identity else \
+                (lambda p, f=factors, a=axes: jnp.transpose(f(p), a))
+            op = _Op("diag", desc, diag_fn=fn)
+        else:
+            t = np.asarray(factors, dtype=np.complex128)
+            if t.shape != (2,) * len(qubits):
+                raise ValueError(f"diagonal tensor shape {t.shape} != "
+                                 f"{(2,) * len(qubits)}")
+            op = _Op("diag", desc, diag=t if identity else t.transpose(axes))
+        self.ops.append(op)
+        return self
+
+    # -- named gates (reference API surface) -------------------------------
+
+    def h(self, q: int) -> "Circuit":
+        return self.gate(mats.hadamard(), (q,))
+
+    def x(self, q: int) -> "Circuit":
+        return self.gate(mats.pauli_x(), (q,))
+
+    def y(self, q: int) -> "Circuit":
+        return self.gate(mats.pauli_y(), (q,))
+
+    def z(self, q: int) -> "Circuit":
+        return self.diagonal(np.array([1.0, -1.0]), (q,))
+
+    def s(self, q: int) -> "Circuit":
+        return self.diagonal(np.array([1.0, 1j]), (q,))
+
+    def t(self, q: int) -> "Circuit":
+        return self.diagonal(np.array([1.0, np.exp(1j * np.pi / 4)]), (q,))
+
+    def phase(self, q: int, angle: Angle) -> "Circuit":
+        angle = self._register_angle(angle)
+        if isinstance(angle, Param):
+            return self.diagonal(lambda p, a=angle: _phase_diag(_angle(p, a)), (q,))
+        return self.diagonal(np.array([1.0, np.exp(1j * angle)]), (q,))
+
+    def _rot(self, q: int, angle: Angle, axis, controls=()) -> "Circuit":
+        angle = self._register_angle(angle)
+        if isinstance(angle, Param):
+            return self.gate(lambda p, a=angle: _rot_matrix(_angle(p, a), axis),
+                             (q,), controls)
+        return self.gate(mats.rotation(float(angle), axis), (q,), controls)
+
+    def rx(self, q: int, angle: Angle) -> "Circuit":
+        return self._rot(q, angle, (1, 0, 0))
+
+    def ry(self, q: int, angle: Angle) -> "Circuit":
+        return self._rot(q, angle, (0, 1, 0))
+
+    def rz(self, q: int, angle: Angle) -> "Circuit":
+        angle = self._register_angle(angle)
+        # diagonal fast path: exp(∓i angle/2)
+        if isinstance(angle, Param):
+            def f(p, a=angle):
+                half = _angle(p, a) / 2.0
+                return jnp.stack([jnp.exp(-1j * half), jnp.exp(1j * half)])
+            return self.diagonal(f, (q,))
+        half = float(angle) / 2.0
+        return self.diagonal(np.array([np.exp(-1j * half), np.exp(1j * half)]),
+                             (q,))
+
+    def rotate(self, q: int, angle: Angle, axis) -> "Circuit":
+        return self._rot(q, angle, axis)
+
+    def cnot(self, control: int, target: int) -> "Circuit":
+        return self.gate(mats.pauli_x(), (target,), (control,))
+
+    def cy(self, control: int, target: int) -> "Circuit":
+        return self.gate(mats.pauli_y(), (target,), (control,))
+
+    def cz(self, q1: int, q2: int) -> "Circuit":
+        return self.diagonal(np.array([[1.0, 1.0], [1.0, -1.0]]), (q1, q2))
+
+    def cphase(self, control: int, target: int, angle: Angle) -> "Circuit":
+        angle = self._register_angle(angle)
+        """Controlled phase shift (diag(1,1,1,e^{i angle}))."""
+        if isinstance(angle, Param):
+            def f(p, a=angle):
+                ph = jnp.exp(1j * _angle(p, a))
+                return jnp.stack([jnp.ones((2,), ph.dtype),
+                                  jnp.stack([jnp.ones((), ph.dtype), ph])])
+            return self.diagonal(f, (control, target))
+        d = np.ones((2, 2), dtype=np.complex128)
+        d[1, 1] = np.exp(1j * angle)
+        return self.diagonal(d, (control, target))
+
+    def crz(self, control: int, target: int, angle: Angle) -> "Circuit":
+        angle = self._register_angle(angle)
+        if isinstance(angle, Param):
+            def f(p, a=angle):
+                half = _angle(p, a) / 2.0
+                lo, hi = jnp.exp(-1j * half), jnp.exp(1j * half)
+                return jnp.stack([jnp.ones((2,), lo.dtype), jnp.stack([lo, hi])])
+            return self.diagonal(f, (control, target))
+        half = float(angle) / 2.0
+        d = np.ones((2, 2), dtype=np.complex128)
+        d[1, 0], d[1, 1] = np.exp(-1j * half), np.exp(1j * half)
+        return self.diagonal(d, (control, target))
+
+    def swap(self, q1: int, q2: int) -> "Circuit":
+        return self.gate(mats.swap(), (q1, q2))
+
+    def sqrt_swap(self, q1: int, q2: int) -> "Circuit":
+        return self.gate(mats.sqrt_swap(), (q1, q2))
+
+    def multi_rotate_z(self, qubits: Sequence[int], angle: Angle) -> "Circuit":
+        angle = self._register_angle(angle)
+        """exp(-i angle/2 Z⊗…⊗Z): phase by mask-parity
+        (``QuEST_cpu.c:3075-3114``)."""
+        qubits = tuple(qubits)
+        k = len(qubits)
+        idx = np.indices((2,) * k).sum(axis=0) % 2  # parity tensor
+        if isinstance(angle, Param):
+            def f(p, a=angle, parity=idx):
+                half = _angle(p, a) / 2.0
+                return jnp.exp(1j * half * (2.0 * parity - 1.0))
+            return self.diagonal(f, qubits)
+        half = float(angle) / 2.0
+        return self.diagonal(np.exp(-1j * half * (1.0 - 2.0 * idx)), qubits)
+
+    def pauli_string(self, paulis: Sequence[tuple[int, int]]) -> "Circuit":
+        """Apply a product of Pauli operators [(qubit, code)] (code: 1=X,2=Y,3=Z)."""
+        for q, code in paulis:
+            code = int(code)
+            if code == int(PauliOpType.PAULI_X):
+                self.x(q)
+            elif code == int(PauliOpType.PAULI_Y):
+                self.y(q)
+            elif code == int(PauliOpType.PAULI_Z):
+                self.z(q)
+        return self
+
+    # -- composition -------------------------------------------------------
+
+    def extend(self, other: "Circuit") -> "Circuit":
+        if other.num_qubits != self.num_qubits:
+            raise ValueError("qubit count mismatch")
+        self.ops.extend(other.ops)
+        for n in other._params:
+            if n not in self._params:
+                self._params.append(n)
+        return self
+
+    def inverse(self) -> "Circuit":
+        """Dagger of a *static* circuit (parameterized ops unsupported)."""
+        inv = Circuit(self.num_qubits)
+        for op in reversed(self.ops):
+            if not op.is_static:
+                raise ValueError("cannot invert a parameterized circuit")
+            if op.kind == "u":
+                inv.ops.append(dataclasses.replace(op, mat=op.mat.conj().T))
+            else:
+                inv.ops.append(dataclasses.replace(op, diag=op.diag.conj()))
+        return inv
+
+    @property
+    def depth(self) -> int:
+        return len(self.ops)
+
+    # -- compilation -------------------------------------------------------
+
+    def _fused_ops(self) -> list[_Op]:
+        """Host-side peephole fusion over static gates.
+
+        1. consecutive static diagonal ops on any qubits merge (union of qubit
+           sets, outer-broadcast product) while the union stays small;
+        2. consecutive static unitaries with identical (targets, controls)
+           merge by matrix product.
+        XLA would fuse the arithmetic anyway, but merging *before* tracing
+        shrinks the program and halves memory passes.
+        """
+        fused: list[_Op] = []
+        for op in self.ops:
+            if fused and op.is_static and fused[-1].is_static:
+                prev = fused[-1]
+                if (op.kind == "u" and prev.kind == "u"
+                        and op.targets == prev.targets
+                        and op.ctrl_mask == prev.ctrl_mask
+                        and op.flip_mask == prev.flip_mask):
+                    fused[-1] = dataclasses.replace(prev, mat=op.mat @ prev.mat)
+                    continue
+                if op.kind == "diag" and prev.kind == "diag":
+                    union = tuple(sorted(set(op.targets) | set(prev.targets),
+                                         reverse=True))
+                    if len(union) <= 6:
+                        def expand(o):
+                            shape = tuple(2 if q in o.targets else 1
+                                          for q in union)
+                            return o.diag.reshape(shape)
+                        fused[-1] = _Op("diag", union,
+                                        diag=expand(prev) * expand(op))
+                        continue
+            fused.append(op)
+        return fused
+
+    def compile(self, env: QuESTEnv, donate: bool = True,
+                fuse: bool = True) -> "CompiledCircuit":
+        return CompiledCircuit(self, env, donate=donate, fuse=fuse)
+
+
+class CompiledCircuit:
+    """One jitted XLA program for a whole :class:`Circuit`.
+
+    The program maps packed float planes ``(2, 2^N)`` -> same (donated), with
+    the amplitude sharding pinned so cross-shard gates lower to ppermute
+    rather than re-replication.
+    """
+
+    def __init__(self, circuit: Circuit, env: QuESTEnv,
+                 donate: bool = True, fuse: bool = True):
+        self.circuit = circuit
+        self.env = env
+        self.num_qubits = circuit.num_qubits
+        self.param_names = circuit.param_names
+        ops = circuit._fused_ops() if fuse else list(circuit.ops)
+        self._ops = ops
+        n = circuit.num_qubits
+        sharding = env.sharding()
+
+        def apply_fn(state_f, param_vec):
+            params = {name: param_vec[i]
+                      for i, name in enumerate(self.param_names)}
+            out = pack(_apply_ops(unpack(state_f), n, ops, params))
+            if sharding is not None:
+                out = jax.lax.with_sharding_constraint(out, sharding)
+            return out
+
+        self._apply_fn = apply_fn
+        self._jitted = jax.jit(apply_fn, donate_argnums=(0,) if donate else ())
+        self._donate = donate
+
+    def _param_vec(self, params: Optional[dict]) -> jnp.ndarray:
+        params = params or {}
+        missing = [p for p in self.param_names if p not in params]
+        if missing:
+            raise ValueError(f"missing circuit parameters: {missing}")
+        vals = [params[nm] for nm in self.param_names]
+        return jnp.asarray(vals, dtype=self.env.precision.real_dtype) \
+            if vals else jnp.zeros((0,), dtype=self.env.precision.real_dtype)
+
+    # -- execution ---------------------------------------------------------
+
+    def run(self, qureg: Qureg, params: Optional[dict] = None) -> None:
+        """Apply in place (the donated buffer is reused by XLA)."""
+        if qureg.num_qubits_in_state_vec != self.num_qubits:
+            raise ValueError(
+                f"circuit has {self.num_qubits} qubits; register state vector "
+                f"has {qureg.num_qubits_in_state_vec}")
+        qureg.state = self._jitted(qureg.state, self._param_vec(params))
+
+    def apply(self, state_f: jnp.ndarray, params: Optional[dict] = None):
+        """Pure form: packed planes in -> packed planes out."""
+        return self._jitted(state_f, self._param_vec(params))
+
+    # -- analysis / autodiff ----------------------------------------------
+
+    def expectation_fn(self, pauli_terms: Sequence[Sequence[tuple[int, int]]],
+                       coeffs: Sequence[float]) -> Callable:
+        """Return jitted ``param_vec -> <psi(params)| H |psi(params)>`` where
+        ``H = sum_j coeffs[j] * prod Pauli`` and ``psi`` starts from |0…0>.
+
+        A pure real-valued function of the parameter vector — feed it to
+        ``jax.grad`` / ``jax.value_and_grad`` for variational optimisation.
+        """
+        n = self.num_qubits
+        cdtype = self.env.precision.complex_dtype
+        terms = [tuple((int(q), int(c)) for q, c in t) for t in pauli_terms]
+        coeffs = np.asarray(coeffs, dtype=np.float64)
+
+        def energy(param_vec):
+            params = {nm: param_vec[i] for i, nm in enumerate(self.param_names)}
+            state = _apply_ops(jnp.zeros(1 << n, dtype=cdtype).at[0].set(1.0),
+                               n, self._ops, params)
+            total = jnp.zeros((), dtype=jnp.float64)
+            for term, c in zip(terms, coeffs):
+                phi = state
+                for q, code in term:
+                    phi = apply_unitary(phi, n, mats.PAULI_MATS[code], (q,))
+                total = total + c * jnp.real(jnp.vdot(state, phi))
+            return total
+
+        return jax.jit(energy)
+
+    def __repr__(self) -> str:
+        return (f"CompiledCircuit(qubits={self.num_qubits}, "
+                f"gates={len(self._ops)} (recorded {self.circuit.depth}), "
+                f"params={list(self.param_names)}, "
+                f"devices={self.env.num_devices})")

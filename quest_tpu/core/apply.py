@@ -47,9 +47,19 @@ import jax.numpy as jnp
 __all__ = [
     "apply_unitary",
     "apply_diagonal",
+    "bitmask",
     "permutation_to_sorted_desc",
     "split_shape",
 ]
+
+
+def bitmask(qubits: Sequence[int]) -> int:
+    """OR of ``1 << q`` (the reference's ``getQubitBitMask``,
+    ``QuEST_common.c:43-51``)."""
+    m = 0
+    for q in qubits:
+        m |= 1 << int(q)
+    return m
 
 
 def split_shape(num_qubits: int, positions_desc: Sequence[int]) -> tuple[int, ...]:

@@ -1,0 +1,219 @@
+"""Whole-circuit compilation tests: the one-executable fast path must agree
+with the per-gate API path (itself golden-tested against the analytic oracle),
+and the algorithm library must match analytic results.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import algorithms as alg
+from quest_tpu.circuits import Circuit
+
+
+def run_api_reference(env, n, build):
+    """Apply gates through the per-gate public API and return the state."""
+    q = qt.createQureg(n, env)
+    build(q)
+    out = q.to_numpy()
+    qt.destroyQureg(q, env)
+    return out
+
+
+def run_circuit(env, circ, params=None):
+    q = qt.createQureg(circ.num_qubits, env)
+    circ.compile(env).run(q, params=params)
+    out = q.to_numpy()
+    qt.destroyQureg(q, env)
+    return out
+
+
+class TestCircuitVsApi:
+    def test_mixed_gate_program(self, env):
+        n = 5
+        c = Circuit(n)
+        c.h(0).h(1).h(2).h(3).h(4)
+        c.cnot(0, 1).cz(2, 3).t(4).s(0)
+        c.rx(1, 0.3).ry(2, -0.7).rz(3, 1.1)
+        c.phase(4, 0.25).cphase(0, 4, 0.5).crz(1, 3, -0.4)
+        c.swap(0, 2).sqrt_swap(1, 4)
+        c.multi_rotate_z((0, 2, 3), 0.9)
+        c.x(1).y(2).z(3)
+        c.rotate(0, 0.6, (1.0, 2.0, -1.0))
+
+        def api(q):
+            for i in range(5):
+                qt.hadamard(q, i)
+            qt.controlledNot(q, 0, 1)
+            qt.controlledPhaseFlip(q, 2, 3)
+            qt.tGate(q, 4)
+            qt.sGate(q, 0)
+            qt.rotateX(q, 1, 0.3)
+            qt.rotateY(q, 2, -0.7)
+            qt.rotateZ(q, 3, 1.1)
+            qt.phaseShift(q, 4, 0.25)
+            qt.controlledPhaseShift(q, 0, 4, 0.5)
+            qt.controlledRotateZ(q, 1, 3, -0.4)
+            qt.swapGate(q, 0, 2)
+            qt.sqrtSwapGate(q, 1, 4)
+            qt.multiRotateZ(q, [0, 2, 3], 0.9)
+            qt.pauliX(q, 1)
+            qt.pauliY(q, 2)
+            qt.pauliZ(q, 3)
+            qt.rotateAroundAxis(q, 0, 0.6, (1.0, 2.0, -1.0))
+
+        got = run_circuit(env, c)
+        want = run_api_reference(env, 5, api)
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_controlled_arbitrary_and_control_states(self, env):
+        rng = np.random.default_rng(7)
+        m = rng.normal(size=(2, 2)) + 1j * rng.normal(size=(2, 2))
+        u, _ = np.linalg.qr(m)
+        c = Circuit(4)
+        for i in range(4):
+            c.h(i)
+        c.gate(u, (2,), controls=(0, 3))
+        c.gate(u, (1,), controls=(0, 3), control_states=(0, 1))
+
+        def api(q):
+            for i in range(4):
+                qt.hadamard(q, i)
+            qt.multiControlledUnitary(q, [0, 3], 2, u)
+            qt.multiStateControlledUnitary(q, [0, 3], [0, 1], 1, u)
+
+        np.testing.assert_allclose(run_circuit(env, c),
+                                   run_api_reference(env, 4, api), atol=1e-12)
+
+    def test_fusion_preserves_semantics(self, env):
+        c = Circuit(3)
+        # long run of same-qubit static gates (fused into one matmul)
+        c.h(0).t(0).s(0).x(0).h(0)
+        # consecutive diagonals on different qubits (fused into one pass)
+        c.z(1).s(2).t(1).phase(2, 0.3)
+        c.cnot(0, 1)
+        fused = c.compile(env, fuse=True)
+        plain = c.compile(env, fuse=False)
+        assert len(fused._ops) < len(plain._ops)
+        q1 = qt.createQureg(3, env)
+        q2 = qt.createQureg(3, env)
+        qt.initPlusState(q1)
+        qt.initPlusState(q2)
+        fused.run(q1)
+        plain.run(q2)
+        np.testing.assert_allclose(q1.to_numpy(), q2.to_numpy(), atol=1e-12)
+
+    def test_parameterized_no_recompile(self, env):
+        c = Circuit(2)
+        th = c.parameter("theta")
+        ph = c.parameter("phi")
+        c.h(0).ry(0, th).rz(1, ph).crz(0, 1, th).cphase(0, 1, ph)
+        f = c.compile(env)
+        for theta, phi in [(0.2, -0.5), (1.3, 2.2)]:
+            def api(q):
+                qt.hadamard(q, 0)
+                qt.rotateY(q, 0, theta)
+                qt.rotateZ(q, 1, phi)
+                qt.controlledRotateZ(q, 0, 1, theta)
+                qt.controlledPhaseShift(q, 0, 1, phi)
+            got = run_circuit(env, c, params={"theta": theta, "phi": phi})
+            np.testing.assert_allclose(got, run_api_reference(env, 2, api),
+                                       atol=1e-12)
+        with pytest.raises(ValueError, match="missing circuit parameters"):
+            f.run(qt.createQureg(2, env), params={"theta": 0.1})
+
+    def test_direct_param_construction(self, env):
+        # Param built directly (not via circuit.parameter) must register
+        from quest_tpu import Param
+        c = Circuit(1)
+        c.ry(0, Param("t"))
+        assert c.param_names == ("t",)
+        q = qt.createQureg(1, env)
+        c.compile(env).run(q, params={"t": 0.5})
+        np.testing.assert_allclose(abs(q.to_numpy()[0]), np.cos(0.25),
+                                   atol=1e-12)
+
+    def test_control_states_length_mismatch(self, env):
+        c = Circuit(3)
+        with pytest.raises(ValueError, match="control states"):
+            c.gate(np.eye(2), (0,), controls=(1, 2), control_states=(0,))
+
+    def test_inverse_roundtrip(self, env):
+        c = alg.random_circuit(4, depth=6, seed=3)
+        q = qt.createQureg(4, env)
+        qt.initDebugState(q)
+        start = q.to_numpy()
+        c.compile(env).run(q)
+        c.inverse().compile(env).run(q)
+        np.testing.assert_allclose(q.to_numpy(), start, atol=1e-10)
+
+    def test_sharded_matches_single_device(self, env, mesh_env):
+        c = alg.random_circuit(6, depth=8, seed=11)
+        np.testing.assert_allclose(run_circuit(mesh_env, c),
+                                   run_circuit(env, c), atol=1e-10)
+
+
+class TestAlgorithms:
+    def test_qft_is_dft(self, env):
+        n = 5
+        dim = 1 << n
+        q = qt.createQureg(n, env)
+        qt.initDebugState(q)
+        x = q.to_numpy()
+        alg.qft(n).compile(env).run(q)
+        # QFT |j> = 1/sqrt(d) sum_k e^{2πi jk/d} |k>  == inverse-normalised DFT
+        want = np.fft.ifft(x) * np.sqrt(dim)
+        np.testing.assert_allclose(q.to_numpy(), want, atol=1e-10)
+
+    def test_qft_inverse_identity(self, env):
+        n = 4
+        q = qt.createQureg(n, env)
+        qt.initDebugState(q)
+        start = q.to_numpy()
+        alg.qft(n).compile(env).run(q)
+        alg.inverse_qft(n).compile(env).run(q)
+        np.testing.assert_allclose(q.to_numpy(), start, atol=1e-10)
+
+    def test_grover_finds_marked(self, env):
+        n, marked = 6, 0b101101
+        q = qt.createQureg(n, env)
+        alg.grover(n, marked).compile(env).run(q)
+        probs = np.abs(q.to_numpy()) ** 2
+        assert probs[marked] > 0.99
+        assert np.argmax(probs) == marked
+
+    def test_grover_marked_out_of_range(self):
+        with pytest.raises(ValueError, match="out of range"):
+            alg.grover(4, marked=20)
+
+    def test_bernstein_vazirani_exact(self, env):
+        n, secret = 7, 0b1011001
+        q = qt.createQureg(n, env)
+        alg.bernstein_vazirani(n, secret).compile(env).run(q)
+        amps = q.to_numpy()
+        assert abs(abs(amps[secret]) - 1.0) < 1e-12
+
+    def test_ghz_state(self, env):
+        n = 5
+        q = qt.createQureg(n, env)
+        alg.ghz(n).compile(env).run(q)
+        amps = q.to_numpy()
+        np.testing.assert_allclose(abs(amps[0]), 1 / np.sqrt(2), atol=1e-12)
+        np.testing.assert_allclose(abs(amps[-1]), 1 / np.sqrt(2), atol=1e-12)
+        assert np.sum(np.abs(amps) ** 2) == pytest.approx(1.0, abs=1e-12)
+
+
+class TestVariational:
+    def test_expectation_gradient(self, env):
+        # <psi(t)| Z_0 |psi(t)> with psi = RY(t)|0> -> cos(t); d/dt = -sin(t)
+        import jax
+        c = Circuit(1)
+        t = c.parameter("t")
+        c.ry(0, t)
+        f = c.compile(env)
+        energy = f.expectation_fn([[(0, int(qt.PAULI_Z))]], [1.0])
+        for theta in (0.0, 0.4, 2.0):
+            v = float(energy(np.array([theta])))
+            g = float(jax.grad(lambda p: energy(p))(np.array([theta]))[0])
+            assert v == pytest.approx(np.cos(theta), abs=1e-10)
+            assert g == pytest.approx(-np.sin(theta), abs=1e-10)
