@@ -102,21 +102,6 @@ def _phase_diag(angle) -> jnp.ndarray:
     return jnp.stack([jnp.ones_like(angle) + 0j, jnp.exp(1j * angle)])
 
 
-def _apply_ops(state: jnp.ndarray, num_qubits: int, ops: Sequence["_Op"],
-               params: dict) -> jnp.ndarray:
-    """Trace a recorded op sequence onto a complex state (the one dispatch
-    loop shared by run/apply and expectation_fn)."""
-    for op in ops:
-        if op.kind == "u":
-            u = op.mat_fn(params) if op.mat_fn is not None else op.mat
-            state = apply_unitary(state, num_qubits, u, op.targets,
-                                  op.ctrl_mask, op.flip_mask)
-        else:
-            d = op.diag_fn(params) if op.diag_fn is not None else op.diag
-            state = apply_diagonal(state, num_qubits, op.targets, d)
-    return state
-
-
 class Circuit:
     """A recorded gate program over ``num_qubits`` qubits.
 
@@ -398,9 +383,12 @@ class Circuit:
             fused.append(op)
         return fused
 
-    def compile(self, env: QuESTEnv, donate: bool = True,
-                fuse: bool = True) -> "CompiledCircuit":
-        return CompiledCircuit(self, env, donate=donate, fuse=fuse)
+    def compile(self, env: QuESTEnv, donate: bool = True, fuse: bool = True,
+                lookahead: int = 32) -> "CompiledCircuit":
+        """Compile to one XLA program; ``lookahead`` is the layout planner's
+        relayout-batching window (quest_tpu.parallel.layout)."""
+        return CompiledCircuit(self, env, donate=donate, fuse=fuse,
+                               lookahead=lookahead)
 
 
 class CompiledCircuit:
@@ -412,7 +400,8 @@ class CompiledCircuit:
     """
 
     def __init__(self, circuit: Circuit, env: QuESTEnv,
-                 donate: bool = True, fuse: bool = True):
+                 donate: bool = True, fuse: bool = True,
+                 lookahead: int = 32):
         self.circuit = circuit
         self.env = env
         self.num_qubits = circuit.num_qubits
@@ -421,11 +410,41 @@ class CompiledCircuit:
         self._ops = ops
         n = circuit.num_qubits
         sharding = env.sharding()
+        shard_bits = env.num_devices.bit_length() - 1
+
+        # schedule gate positions over the mesh: lazy logical->physical
+        # permutation with batched relayouts (quest_tpu.parallel.layout)
+        from .parallel import plan_layout, apply_relayout
+        self.plan = plan_layout(ops, n, shard_bits, lookahead=lookahead)
+        plan_items = self.plan.items
+        flat_sharding = env.sharding_flat()
+
+        def run_plan(state, params):
+            for item in plan_items:
+                if item[0] == "relayout":
+                    _, before, after = item
+                    state = apply_relayout(state, n, before, after,
+                                           flat_sharding)
+                    continue
+                _, i, phys_targets, cmask, fmask, axis_order = item
+                op = ops[i]
+                if op.kind == "u":
+                    u = op.mat_fn(params) if op.mat_fn is not None else op.mat
+                    state = apply_unitary(state, n, u, phys_targets,
+                                          cmask, fmask)
+                else:
+                    d = op.diag_fn(params) if op.diag_fn is not None else op.diag
+                    d = jnp.transpose(jnp.asarray(d), axis_order)
+                    state = apply_diagonal(state, n, phys_targets, d)
+            return state
+
+        self._run_plan = run_plan
+        self._flat_sharding = flat_sharding
 
         def apply_fn(state_f, param_vec):
             params = {name: param_vec[i]
                       for i, name in enumerate(self.param_names)}
-            out = pack(_apply_ops(unpack(state_f), n, ops, params))
+            out = pack(run_plan(unpack(state_f), params))
             if sharding is not None:
                 out = jax.lax.with_sharding_constraint(out, sharding)
             return out
@@ -474,8 +493,11 @@ class CompiledCircuit:
 
         def energy(param_vec):
             params = {nm: param_vec[i] for i, nm in enumerate(self.param_names)}
-            state = _apply_ops(jnp.zeros(1 << n, dtype=cdtype).at[0].set(1.0),
-                               n, self._ops, params)
+            state = jnp.zeros(1 << n, dtype=cdtype).at[0].set(1.0)
+            if self._flat_sharding is not None:
+                state = jax.lax.with_sharding_constraint(
+                    state, self._flat_sharding)
+            state = self._run_plan(state, params)
             total = jnp.zeros((), dtype=jnp.float64)
             for term, c in zip(terms, coeffs):
                 phi = state

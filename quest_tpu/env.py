@@ -62,6 +62,13 @@ class QuESTEnv:
         spec = PartitionSpec(None, AMP_AXIS) if sharded else PartitionSpec()
         return NamedSharding(self.mesh, spec)
 
+    def sharding_flat(self) -> Optional[NamedSharding]:
+        """NamedSharding for a flat (2^N,) amplitude vector (jit-internal
+        complex form): leading bits over the mesh axis."""
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, PartitionSpec(AMP_AXIS))
+
     def seed(self, seeds: Sequence[int]) -> None:
         """Re-seed the measurement RNG (``seedQuEST`` ``QuEST.h:1858``)."""
         key = jax.random.key(int(seeds[0]) & 0xFFFFFFFF)

@@ -1,0 +1,16 @@
+"""Distribution machinery: qubit-layout planning over the device mesh.
+
+The reference's distributed brain (`QuEST_cpu_distributed.c`) decides, per
+gate, whether the target is chunk-local or needs an MPI pair exchange, and
+relocalises multi-qubit unitaries by physically SWAPping amplitudes down to
+low qubits (`statevec_multiControlledMultiQubitUnitary`
+`QuEST_cpu_distributed.c:1420-1461`). Here that becomes a *compile-time
+layout plan*: a lazily tracked logical->physical qubit permutation, with
+batched one-shot relayouts (a single sharded transpose that XLA lowers to an
+all-to-all over ICI) instead of per-gate swap storms. See
+:mod:`quest_tpu.parallel.layout`.
+"""
+
+from .layout import LayoutPlan, plan_layout, apply_relayout
+
+__all__ = ["LayoutPlan", "plan_layout", "apply_relayout"]

@@ -1,0 +1,201 @@
+"""Lazy qubit-layout planning for sharded circuit execution.
+
+Background (the problem the reference solves operationally): amplitudes are
+sharded on the *high* qubit axes — with ``D = 2^S`` devices, physical qubit
+positions ``n-S .. n-1`` index the device, so a paired (non-diagonal) gate on
+one of those positions couples amplitudes living on different devices. The
+reference answers per gate at run time: pair-exchange the whole chunk
+(``exchangeStateVectors``, ``QuEST_cpu_distributed.c:478-506``) or, for dense
+k-qubit gates, SWAP the target down to a low qubit, run locally, and SWAP
+back (``:1420-1461``) — paying two data moves per offending gate.
+
+Here the whole circuit is known at compile time, so layout becomes a
+*planning* problem:
+
+- a **logical->physical permutation** is tracked through the program; gates
+  are rewritten to their physical positions and applied wherever their
+  qubits live — relabeling is free;
+- when a paired gate targets a sharded physical position, the planner emits
+  ONE **relayout**: a transpose of the ``(2,)*n`` view (XLA lowers it to an
+  all-to-all over ICI) that pulls — in the same pass — *every* sharded
+  logical qubit needed by the next ``lookahead`` gates into local positions,
+  evicting the local qubits whose next paired use is farthest away (Belady's
+  rule);
+- diagonal gates never pair amplitudes, so they run at *any* position with
+  zero communication (the ``phaseShiftByTerm`` property,
+  ``QuEST_cpu.c:2946``), and are ignored by the planner's locality demands;
+- at program end one final relayout restores the identity permutation, so
+  register state remains position-transparent to the caller.
+
+A circuit touching high qubits every layer thus costs one all-to-all per
+*batch* of high-qubit gates rather than two exchanges per gate — the same
+economics as ring-attention's rotate-once-per-block schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LayoutPlan", "plan_layout", "apply_relayout"]
+
+
+@dataclasses.dataclass
+class LayoutPlan:
+    """The scheduled program: items are either
+
+    - ``("op", op_index, phys_targets, phys_ctrl_mask, phys_flip_mask,
+       diag_axis_order)`` — run op ``op_index`` at physical positions;
+    - ``("relayout", perm_before, perm_after)`` — transpose the state so the
+      qubit at physical position ``perm_before[l]`` moves to
+      ``perm_after[l]`` for each logical qubit ``l``.
+    """
+    items: list
+    num_qubits: int
+    shard_bits: int
+    num_relayouts: int
+
+
+def _phys_diag_order(op_targets_desc_logical: tuple[int, ...],
+                     perm: np.ndarray):
+    """Map a diag op's sorted-desc logical qubits to physical positions and
+    the axis order its tensor must be transposed by.
+
+    Returns (phys_sorted_desc, axes) where ``axes[i]`` is the index into the
+    op's stored (logical-sorted-desc) tensor axes for the i-th physical-desc
+    axis.
+    """
+    phys = tuple(int(perm[q]) for q in op_targets_desc_logical)
+    order = tuple(np.argsort(phys)[::-1])  # positions sorted desc
+    phys_desc = tuple(phys[i] for i in order)
+    return phys_desc, order
+
+
+def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
+                lookahead: int = 32) -> LayoutPlan:
+    """Schedule ``ops`` (quest_tpu.circuits._Op sequence) over a mesh that
+    shards the top ``shard_bits`` physical positions.
+
+    Paired ("u") ops must have all targets below ``num_qubits - shard_bits``;
+    the planner guarantees it by emitting relayouts. Controls and diagonal
+    ops are position-indifferent.
+    """
+    n = num_qubits
+    local_top = n - shard_bits  # phys positions >= local_top are sharded
+    if shard_bits == 0:
+        items = []
+        ident = np.arange(n)
+        for i, op in enumerate(ops):
+            items.append(_op_item(i, op, ident))
+        return LayoutPlan(items, n, 0, 0)
+
+    max_k = max((len(op.targets) for op in ops if op.kind == "u"), default=0)
+    if max_k > local_top:
+        raise ValueError(
+            f"a {max_k}-qubit unitary cannot be localised with "
+            f"{local_top} local qubit positions "
+            f"(2^{max_k} amplitudes per gather > local shard)")
+
+    # next paired-use index per logical qubit, per position in the op stream
+    INF = len(ops) + 1
+    next_use = np.full((len(ops) + 1, n), INF, dtype=np.int64)
+    for i in range(len(ops) - 1, -1, -1):
+        next_use[i] = next_use[i + 1]
+        if ops[i].kind == "u":
+            for t in ops[i].targets:
+                next_use[i, t] = i
+
+    perm = np.arange(n)  # perm[logical] = physical
+    items: list = []
+    n_relayouts = 0
+
+    for i, op in enumerate(ops):
+        if op.kind == "u":
+            offending = [t for t in op.targets if perm[t] >= local_top]
+            if offending:
+                # gather all sharded logical qubits paired-used in the window,
+                # current op's targets first (they are mandatory)
+                window_hot = []
+                for j in range(i, min(i + lookahead, len(ops))):
+                    if ops[j].kind != "u":
+                        continue
+                    for t in ops[j].targets:
+                        if perm[t] >= local_top and t not in window_hot:
+                            window_hot.append(t)
+                mandatory = [t for t in op.targets if perm[t] >= local_top]
+                # victims: local positions whose logical qubit's next paired
+                # use is farthest (Belady); never evict this op's targets
+                locals_ = [(int(next_use[i, l]), l)
+                           for l in range(n)
+                           if perm[l] < local_top and l not in op.targets]
+                locals_.sort(reverse=True)
+                capacity = len(locals_)
+                bring = mandatory + [t for t in window_hot
+                                     if t not in mandatory]
+                bring = bring[:capacity]
+                new_perm = perm.copy()
+                vi = 0
+                for t in bring:
+                    if vi >= len(locals_):
+                        break
+                    nu_victim, victim = locals_[vi]
+                    # optional prefetches must not evict a sooner-used qubit
+                    if t not in mandatory and next_use[i, t] >= nu_victim:
+                        continue
+                    new_perm[t], new_perm[victim] = perm[victim], perm[t]
+                    vi += 1
+                items.append(("relayout", perm.copy(), new_perm.copy()))
+                n_relayouts += 1
+                perm = new_perm
+            items.append(_op_item(i, op, perm))
+        else:
+            items.append(_op_item(i, op, perm))
+
+    if not np.array_equal(perm, np.arange(n)):
+        items.append(("relayout", perm.copy(), np.arange(n)))
+        n_relayouts += 1
+
+    return LayoutPlan(items, n, shard_bits, n_relayouts)
+
+
+def _op_item(i: int, op, perm: np.ndarray):
+    if op.kind == "u":
+        phys_targets = tuple(int(perm[t]) for t in op.targets)
+        ctrl_mask = 0
+        flip_mask = 0
+        m = op.ctrl_mask
+        q = 0
+        while m:
+            if m & 1:
+                ctrl_mask |= 1 << int(perm[q])
+                if (op.flip_mask >> q) & 1:
+                    flip_mask |= 1 << int(perm[q])
+            m >>= 1
+            q += 1
+        return ("op", i, phys_targets, ctrl_mask, flip_mask, None)
+    phys_desc, axis_order = _phys_diag_order(op.targets, perm)
+    return ("op", i, phys_desc, 0, 0, axis_order)
+
+
+def apply_relayout(state: jnp.ndarray, num_qubits: int,
+                   perm_before: np.ndarray, perm_after: np.ndarray,
+                   sharding=None) -> jnp.ndarray:
+    """Move the qubit at physical position ``perm_before[l]`` to
+    ``perm_after[l]``: one transpose of the ``(2,)*n`` view. Across the
+    sharded boundary XLA lowers this to an all-to-all over the mesh — the
+    single fused data movement replacing the reference's per-qubit
+    ``statevec_swapQubitAmps`` exchanges.
+    """
+    n = num_qubits
+    # axis index of physical position p is n-1-p (C-order, high bit first)
+    src_axis_of_dst = np.empty(n, dtype=np.int64)
+    for l in range(n):
+        src_axis_of_dst[n - 1 - int(perm_after[l])] = n - 1 - int(perm_before[l])
+    out = state.reshape((2,) * n).transpose(tuple(src_axis_of_dst)).reshape(-1)
+    if sharding is not None:
+        out = jax.lax.with_sharding_constraint(out, sharding)
+    return out
