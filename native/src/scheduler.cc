@@ -1,0 +1,428 @@
+// quest_sched — native circuit graph-builder and scheduler.
+//
+// The TPU framework's counterpart of the reference's native runtime layer:
+// where QuEST's dispatch/backend split decides per gate, at run time, whether
+// an op is chunk-local or needs communication (QuEST_cpu_distributed.c:
+// halfMatrixBlockFitsInChunk :353, getChunkPairId :300, swap-to-local
+// :1420-1461), this library plans the *whole program* ahead of time:
+//
+//   1. graph build: gates stream in through a C ABI (ctypes-friendly);
+//   2. peephole fusion: adjacent static unitaries on the same target/control
+//      set are matrix-multiplied host-side; adjacent static diagonal ops are
+//      merged over the union of their qubits (cap 6);
+//   3. layout planning: a logical->physical qubit permutation is tracked; a
+//      paired gate whose target sits on a sharded position triggers ONE
+//      batched relayout (Belady eviction over a lookahead window) instead of
+//      per-gate exchanges.
+//
+// Output is a schedule of items — ops at physical positions, plus relayout
+// permutations — that the Python/JAX side lowers into a single XLA program.
+// Semantics must match quest_tpu/parallel/layout.py (tested for equality).
+//
+// Build: native/Makefile -> quest_tpu/native/libquest_sched.so
+
+#include <algorithm>
+#include <complex>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace {
+
+using cplx = std::complex<double>;
+
+constexpr int KIND_U = 0;           // static unitary (matrix owned here)
+constexpr int KIND_DIAG = 1;        // static diagonal (tensor owned here)
+constexpr int KIND_U_PARAM = 2;     // parameterized unitary (opaque)
+constexpr int KIND_DIAG_PARAM = 3;  // parameterized diagonal (opaque)
+
+constexpr int MAX_DIAG_FUSE_QUBITS = 6;
+
+struct Op {
+  int kind;
+  std::vector<int> targets;   // user bit order (u) / sorted desc (diag)
+  int64_t ctrl_mask = 0;
+  int64_t flip_mask = 0;
+  std::vector<cplx> data;     // (2^k)^2 matrix or 2^k diagonal tensor
+  int source_index;           // index of the (first) source op, for param fns
+};
+
+struct Item {
+  bool is_relayout;
+  // op item
+  int op_index = -1;                  // into fused op table
+  std::vector<int> phys_targets;
+  int64_t phys_ctrl_mask = 0;
+  int64_t phys_flip_mask = 0;
+  std::vector<int> axis_order;        // diag tensor transpose (desc order)
+  // relayout item
+  std::vector<int> perm_before, perm_after;
+};
+
+struct Sched {
+  std::vector<Op> ops;        // as recorded
+  std::vector<Op> fused;      // after peephole fusion
+  std::vector<Item> items;    // final schedule
+  int num_qubits = 0;
+  int shard_bits = 0;
+  int num_relayouts = 0;
+  std::string error;
+};
+
+// ---------------------------------------------------------------------------
+// fusion pass (mirrors Circuit._fused_ops)
+// ---------------------------------------------------------------------------
+
+bool same_masks(const Op& a, const Op& b) {
+  return a.ctrl_mask == b.ctrl_mask && a.flip_mask == b.flip_mask;
+}
+
+// c = b . a applied as "a first, then b"  =>  matrix product b*a
+std::vector<cplx> matmul(const std::vector<cplx>& b, const std::vector<cplx>& a,
+                         int dim) {
+  std::vector<cplx> out(static_cast<size_t>(dim) * dim, cplx(0.0, 0.0));
+  for (int i = 0; i < dim; ++i)
+    for (int k = 0; k < dim; ++k) {
+      cplx bik = b[static_cast<size_t>(i) * dim + k];
+      if (bik == cplx(0.0, 0.0)) continue;
+      for (int j = 0; j < dim; ++j)
+        out[static_cast<size_t>(i) * dim + j] +=
+            bik * a[static_cast<size_t>(k) * dim + j];
+    }
+  return out;
+}
+
+// expand a diag tensor over `from_q` (sorted desc) onto union `to_q` (sorted
+// desc, superset): broadcast over the axes not in from_q
+std::vector<cplx> expand_diag(const std::vector<cplx>& t,
+                              const std::vector<int>& from_q,
+                              const std::vector<int>& to_q) {
+  int K = static_cast<int>(to_q.size());
+  std::vector<int> src_axis(K, -1);  // axis in from_q per to_q axis
+  for (int i = 0; i < K; ++i)
+    for (size_t j = 0; j < from_q.size(); ++j)
+      if (to_q[i] == from_q[j]) src_axis[i] = static_cast<int>(j);
+  std::vector<cplx> out(size_t{1} << K);
+  int k_from = static_cast<int>(from_q.size());
+  for (int64_t m = 0; m < (int64_t{1} << K); ++m) {
+    int64_t src = 0;
+    for (int i = 0; i < K; ++i) {
+      if (src_axis[i] < 0) continue;
+      // bit of axis i in m (axis 0 = most significant)
+      int bit = (m >> (K - 1 - i)) & 1;
+      if (bit) src |= int64_t{1} << (k_from - 1 - src_axis[i]);
+    }
+    out[static_cast<size_t>(m)] = t[static_cast<size_t>(src)];
+  }
+  return out;
+}
+
+void fuse(Sched& s) {
+  s.fused.clear();
+  for (const Op& op : s.ops) {
+    bool merged = false;
+    if (!s.fused.empty() &&
+        (op.kind == KIND_U || op.kind == KIND_DIAG)) {
+      Op& prev = s.fused.back();
+      if (op.kind == KIND_U && prev.kind == KIND_U &&
+          op.targets == prev.targets && same_masks(op, prev)) {
+        int dim = 1 << op.targets.size();
+        prev.data = matmul(op.data, prev.data, dim);
+        merged = true;
+      } else if (op.kind == KIND_DIAG && prev.kind == KIND_DIAG) {
+        std::vector<int> uni;
+        for (int q : prev.targets) uni.push_back(q);
+        for (int q : op.targets)
+          if (std::find(uni.begin(), uni.end(), q) == uni.end())
+            uni.push_back(q);
+        std::sort(uni.begin(), uni.end(), std::greater<int>());
+        if (static_cast<int>(uni.size()) <= MAX_DIAG_FUSE_QUBITS) {
+          std::vector<cplx> a = expand_diag(prev.data, prev.targets, uni);
+          std::vector<cplx> b = expand_diag(op.data, op.targets, uni);
+          for (size_t i = 0; i < a.size(); ++i) a[i] *= b[i];
+          prev.data = std::move(a);
+          prev.targets = uni;
+          merged = true;
+        }
+      }
+    }
+    if (!merged) s.fused.push_back(op);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// layout planning (mirrors quest_tpu/parallel/layout.py::plan_layout)
+// ---------------------------------------------------------------------------
+
+bool is_paired(const Op& op) {
+  return op.kind == KIND_U || op.kind == KIND_U_PARAM;
+}
+
+Item op_item(int idx, const Op& op, const std::vector<int>& perm) {
+  Item it;
+  it.is_relayout = false;
+  it.op_index = idx;
+  if (is_paired(op)) {
+    for (int t : op.targets) it.phys_targets.push_back(perm[t]);
+    int64_t m = op.ctrl_mask;
+    for (int q = 0; m != 0; ++q, m >>= 1) {
+      if (m & 1) {
+        it.phys_ctrl_mask |= int64_t{1} << perm[q];
+        if ((op.flip_mask >> q) & 1) it.phys_flip_mask |= int64_t{1} << perm[q];
+      }
+    }
+  } else {
+    // diag: targets stored sorted desc (logical); map and re-sort desc,
+    // recording the tensor axis order
+    size_t k = op.targets.size();
+    std::vector<int> phys(k);
+    for (size_t i = 0; i < k; ++i) phys[i] = perm[op.targets[i]];
+    std::vector<int> order(k);
+    for (size_t i = 0; i < k; ++i) order[i] = static_cast<int>(i);
+    std::stable_sort(order.begin(), order.end(),
+                     [&](int a, int b) { return phys[a] > phys[b]; });
+    for (int o : order) it.phys_targets.push_back(phys[o]);
+    it.axis_order.assign(order.begin(), order.end());
+  }
+  return it;
+}
+
+void plan(Sched& s, int lookahead) {
+  const int n = s.num_qubits;
+  const int S = s.shard_bits;
+  const int local_top = n - S;
+  auto& ops = s.fused;
+  s.items.clear();
+  s.num_relayouts = 0;
+
+  std::vector<int> perm(n);
+  for (int i = 0; i < n; ++i) perm[i] = i;
+
+  if (S == 0) {
+    for (size_t i = 0; i < ops.size(); ++i)
+      s.items.push_back(op_item(static_cast<int>(i), ops[i], perm));
+    return;
+  }
+
+  int max_k = 0;
+  for (const Op& op : ops)
+    if (is_paired(op)) max_k = std::max(max_k, (int)op.targets.size());
+  if (max_k > local_top) {
+    s.error = "a " + std::to_string(max_k) +
+              "-qubit unitary cannot be localised with " +
+              std::to_string(local_top) + " local qubit positions";
+    return;
+  }
+
+  const int64_t INF = static_cast<int64_t>(ops.size()) + 1;
+  // next paired-use table, next_use[i][q]
+  std::vector<std::vector<int64_t>> next_use(ops.size() + 1,
+                                             std::vector<int64_t>(n, INF));
+  for (int64_t i = static_cast<int64_t>(ops.size()) - 1; i >= 0; --i) {
+    next_use[i] = next_use[i + 1];
+    if (is_paired(ops[i]))
+      for (int t : ops[i].targets) next_use[i][t] = i;
+  }
+
+  for (size_t i = 0; i < ops.size(); ++i) {
+    const Op& op = ops[i];
+    if (is_paired(op)) {
+      std::vector<int> mandatory;
+      for (int t : op.targets)
+        if (perm[t] >= local_top) mandatory.push_back(t);
+      if (!mandatory.empty()) {
+        // hot sharded qubits over the lookahead window, stream order
+        std::vector<int> window_hot;
+        size_t wend = std::min(i + static_cast<size_t>(lookahead), ops.size());
+        for (size_t j = i; j < wend; ++j) {
+          if (!is_paired(ops[j])) continue;
+          for (int t : ops[j].targets)
+            if (perm[t] >= local_top &&
+                std::find(window_hot.begin(), window_hot.end(), t) ==
+                    window_hot.end())
+              window_hot.push_back(t);
+        }
+        // victims: local positions not targeted by this op, farthest
+        // next-use first (Belady)
+        std::vector<std::pair<int64_t, int>> locals_;
+        for (int l = 0; l < n; ++l) {
+          if (perm[l] >= local_top) continue;
+          if (std::find(op.targets.begin(), op.targets.end(), l) !=
+              op.targets.end())
+            continue;
+          locals_.emplace_back(next_use[i][l], l);
+        }
+        std::sort(locals_.begin(), locals_.end(),
+                  std::greater<std::pair<int64_t, int>>());
+        std::vector<int> bring = mandatory;
+        for (int t : window_hot)
+          if (std::find(bring.begin(), bring.end(), t) == bring.end())
+            bring.push_back(t);
+        if (bring.size() > locals_.size()) bring.resize(locals_.size());
+
+        std::vector<int> new_perm = perm;
+        size_t vi = 0;
+        for (int t : bring) {
+          if (vi >= locals_.size()) break;
+          auto [nu_victim, victim] = locals_[vi];
+          bool is_mand = std::find(mandatory.begin(), mandatory.end(), t) !=
+                         mandatory.end();
+          if (!is_mand && next_use[i][t] >= nu_victim) continue;
+          std::swap(new_perm[t], new_perm[victim]);
+          ++vi;
+        }
+        Item r;
+        r.is_relayout = true;
+        r.perm_before = perm;
+        r.perm_after = new_perm;
+        s.items.push_back(std::move(r));
+        ++s.num_relayouts;
+        perm = new_perm;
+      }
+    }
+    s.items.push_back(op_item(static_cast<int>(i), op, perm));
+  }
+
+  bool identity = true;
+  for (int l = 0; l < n; ++l)
+    if (perm[l] != l) { identity = false; break; }
+  if (!identity) {
+    Item r;
+    r.is_relayout = true;
+    r.perm_before = perm;
+    r.perm_after.resize(n);
+    for (int l = 0; l < n; ++l) r.perm_after[l] = l;
+    s.items.push_back(std::move(r));
+    ++s.num_relayouts;
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* qsched_create() { return new Sched(); }
+
+void qsched_destroy(void* h) { delete static_cast<Sched*>(h); }
+
+// data: interleaved re,im; for KIND_U (2^k)^2 entries, KIND_DIAG 2^k entries,
+// param kinds: data ignored (may be null)
+int qsched_add_op(void* h, int kind, int num_targets, const int* targets,
+                  int64_t ctrl_mask, int64_t flip_mask, const double* data,
+                  int source_index) {
+  Sched& s = *static_cast<Sched*>(h);
+  Op op;
+  op.kind = kind;
+  op.targets.assign(targets, targets + num_targets);
+  op.ctrl_mask = ctrl_mask;
+  op.flip_mask = flip_mask;
+  op.source_index = source_index;
+  if (kind == KIND_U) {
+    size_t dim = size_t{1} << num_targets;
+    op.data.resize(dim * dim);
+    for (size_t i = 0; i < dim * dim; ++i)
+      op.data[i] = cplx(data[2 * i], data[2 * i + 1]);
+  } else if (kind == KIND_DIAG) {
+    size_t dim = size_t{1} << num_targets;
+    op.data.resize(dim);
+    for (size_t i = 0; i < dim; ++i)
+      op.data[i] = cplx(data[2 * i], data[2 * i + 1]);
+  }
+  s.ops.push_back(std::move(op));
+  return static_cast<int>(s.ops.size()) - 1;
+}
+
+// run fusion + planning; returns 0 on success, nonzero on error
+int qsched_compile(void* h, int num_qubits, int shard_bits, int lookahead,
+                   int enable_fusion) {
+  Sched& s = *static_cast<Sched*>(h);
+  s.num_qubits = num_qubits;
+  s.shard_bits = shard_bits;
+  s.error.clear();
+  if (enable_fusion) {
+    fuse(s);
+  } else {
+    s.fused = s.ops;
+  }
+  plan(s, lookahead);
+  return s.error.empty() ? 0 : 1;
+}
+
+const char* qsched_error(void* h) {
+  return static_cast<Sched*>(h)->error.c_str();
+}
+
+int qsched_num_fused(void* h) {
+  return static_cast<int>(static_cast<Sched*>(h)->fused.size());
+}
+
+// fused-op metadata: returns kind; fills counts
+int qsched_fused_info(void* h, int idx, int* num_targets, int64_t* ctrl_mask,
+                      int64_t* flip_mask, int* source_index) {
+  const Op& op = static_cast<Sched*>(h)->fused[idx];
+  *num_targets = static_cast<int>(op.targets.size());
+  *ctrl_mask = op.ctrl_mask;
+  *flip_mask = op.flip_mask;
+  *source_index = op.source_index;
+  return op.kind;
+}
+
+void qsched_fused_targets(void* h, int idx, int* out) {
+  const Op& op = static_cast<Sched*>(h)->fused[idx];
+  std::memcpy(out, op.targets.data(), op.targets.size() * sizeof(int));
+}
+
+// copies interleaved re,im doubles; caller sizes from kind+num_targets
+void qsched_fused_data(void* h, int idx, double* out) {
+  const Op& op = static_cast<Sched*>(h)->fused[idx];
+  for (size_t i = 0; i < op.data.size(); ++i) {
+    out[2 * i] = op.data[i].real();
+    out[2 * i + 1] = op.data[i].imag();
+  }
+}
+
+int qsched_num_items(void* h) {
+  return static_cast<int>(static_cast<Sched*>(h)->items.size());
+}
+
+int qsched_num_relayouts(void* h) {
+  return static_cast<Sched*>(h)->num_relayouts;
+}
+
+// returns 1 if item is a relayout else 0; for ops fills op_index, num
+// phys targets, masks; for relayouts fills nothing here
+int qsched_item_info(void* h, int i, int* op_index, int* num_targets,
+                     int64_t* ctrl_mask, int64_t* flip_mask) {
+  const Item& it = static_cast<Sched*>(h)->items[i];
+  if (it.is_relayout) return 1;
+  *op_index = it.op_index;
+  *num_targets = static_cast<int>(it.phys_targets.size());
+  *ctrl_mask = it.phys_ctrl_mask;
+  *flip_mask = it.phys_flip_mask;
+  return 0;
+}
+
+void qsched_item_targets(void* h, int i, int* targets, int* axis_order) {
+  const Item& it = static_cast<Sched*>(h)->items[i];
+  std::memcpy(targets, it.phys_targets.data(),
+              it.phys_targets.size() * sizeof(int));
+  if (!it.axis_order.empty())
+    std::memcpy(axis_order, it.axis_order.data(),
+                it.axis_order.size() * sizeof(int));
+}
+
+void qsched_item_perms(void* h, int i, int* before, int* after) {
+  const Item& it = static_cast<Sched*>(h)->items[i];
+  std::memcpy(before, it.perm_before.data(),
+              it.perm_before.size() * sizeof(int));
+  std::memcpy(after, it.perm_after.data(),
+              it.perm_after.size() * sizeof(int));
+}
+
+}  // extern "C"

@@ -391,6 +391,55 @@ class Circuit:
                                lookahead=lookahead)
 
 
+def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
+              lookahead: int, fuse_flag: bool, circuit: "Circuit"):
+    """Fuse + layout-plan the op stream.
+
+    Prefers the native C++ scheduler (quest_tpu.native / native/src/
+    scheduler.cc); falls back to the pure-Python passes (Circuit._fused_ops +
+    quest_tpu.parallel.plan_layout). Both produce identical schedules.
+
+    Returns (ops_table, LayoutPlan).
+    """
+    from .parallel.layout import LayoutPlan
+
+    try:
+        from . import native as nat
+        use_native = nat.available()
+    except Exception:
+        use_native = False
+
+    if use_native:
+        sch = nat.NativeScheduler()
+        for i, op in enumerate(recorded):
+            if op.kind == "u":
+                kind = nat.KIND_U if op.mat_fn is None else nat.KIND_U_PARAM
+                data = op.mat
+            else:
+                kind = nat.KIND_DIAG if op.diag_fn is None \
+                    else nat.KIND_DIAG_PARAM
+                data = op.diag
+            sch.add_op(kind, op.targets, op.ctrl_mask, op.flip_mask,
+                       data, i)
+        sch.compile(num_qubits, shard_bits, lookahead, fuse_flag)
+        ops_table: list[_Op] = []
+        for kind, targets, cm, fm, data, si in sch.fused_ops():
+            if kind == nat.KIND_U:
+                ops_table.append(_Op("u", targets, cm, fm, mat=data))
+            elif kind == nat.KIND_DIAG:
+                ops_table.append(_Op("diag", targets, diag=data))
+            else:
+                ops_table.append(recorded[si])   # param ops pass through
+        plan = LayoutPlan(sch.items(num_qubits), num_qubits, shard_bits,
+                          sch.num_relayouts())
+        return ops_table, plan
+
+    from .parallel import plan_layout
+    ops_table = circuit._fused_ops() if fuse_flag else list(recorded)
+    return ops_table, plan_layout(ops_table, num_qubits, shard_bits,
+                                  lookahead=lookahead)
+
+
 class CompiledCircuit:
     """One jitted XLA program for a whole :class:`Circuit`.
 
@@ -406,16 +455,17 @@ class CompiledCircuit:
         self.env = env
         self.num_qubits = circuit.num_qubits
         self.param_names = circuit.param_names
-        ops = circuit._fused_ops() if fuse else list(circuit.ops)
-        self._ops = ops
         n = circuit.num_qubits
         sharding = env.sharding()
         shard_bits = env.num_devices.bit_length() - 1
 
-        # schedule gate positions over the mesh: lazy logical->physical
-        # permutation with batched relayouts (quest_tpu.parallel.layout)
-        from .parallel import plan_layout, apply_relayout
-        self.plan = plan_layout(ops, n, shard_bits, lookahead=lookahead)
+        # fuse + schedule gate positions over the mesh: lazy logical->
+        # physical permutation with batched relayouts (native scheduler when
+        # built, else quest_tpu.parallel.layout)
+        from .parallel import apply_relayout
+        ops, self.plan = _schedule(list(circuit.ops), n, shard_bits,
+                                   lookahead, fuse, circuit)
+        self._ops = ops
         plan_items = self.plan.items
         flat_sharding = env.sharding_flat()
 

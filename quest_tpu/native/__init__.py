@@ -1,0 +1,200 @@
+"""ctypes binding to the native scheduler (``native/src/scheduler.cc``).
+
+The shared library is built on demand with g++ (the repo ships no binary
+artifacts); set ``QUEST_TPU_NO_NATIVE=1`` to force the pure-Python planner
+(`quest_tpu.parallel.layout`). Both produce identical schedules — the test
+suite asserts it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["available", "load", "NativeScheduler"]
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libquest_sched.so")
+_SRC_PATH = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                         "native", "src", "scheduler.cc")
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+KIND_U, KIND_DIAG, KIND_U_PARAM, KIND_DIAG_PARAM = 0, 1, 2, 3
+
+
+def _build() -> bool:
+    src = os.path.abspath(_SRC_PATH)
+    if not os.path.exists(src):
+        return False
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
+           "-o", _LIB_PATH, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the scheduler library, or None."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("QUEST_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH) and not _build():
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        _load_failed = True
+        return None
+
+    lib.qsched_create.restype = ctypes.c_void_p
+    lib.qsched_destroy.argtypes = [ctypes.c_void_p]
+    lib.qsched_add_op.restype = ctypes.c_int
+    lib.qsched_add_op.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+    lib.qsched_compile.restype = ctypes.c_int
+    lib.qsched_compile.argtypes = [ctypes.c_void_p] + [ctypes.c_int] * 4
+    lib.qsched_error.restype = ctypes.c_char_p
+    lib.qsched_error.argtypes = [ctypes.c_void_p]
+    lib.qsched_num_fused.restype = ctypes.c_int
+    lib.qsched_num_fused.argtypes = [ctypes.c_void_p]
+    lib.qsched_fused_info.restype = ctypes.c_int
+    lib.qsched_fused_info.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.qsched_fused_targets.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.qsched_fused_data.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
+    lib.qsched_num_items.restype = ctypes.c_int
+    lib.qsched_num_items.argtypes = [ctypes.c_void_p]
+    lib.qsched_num_relayouts.restype = ctypes.c_int
+    lib.qsched_num_relayouts.argtypes = [ctypes.c_void_p]
+    lib.qsched_item_info.restype = ctypes.c_int
+    lib.qsched_item_info.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.qsched_item_targets.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.qsched_item_perms.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeScheduler:
+    """One scheduling session: feed ops, compile, read the schedule back.
+
+    Speaks the compact descriptor protocol of the C ABI; the caller
+    (quest_tpu.circuits) converts between `_Op` objects and descriptors.
+    """
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native scheduler unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.qsched_create())
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.qsched_destroy(h)
+            self._h = None
+
+    def add_op(self, kind: int, targets, ctrl_mask: int, flip_mask: int,
+               data: Optional[np.ndarray], source_index: int) -> int:
+        t = (ctypes.c_int * len(targets))(*targets)
+        if data is not None:
+            flat = np.ascontiguousarray(
+                data, dtype=np.complex128).reshape(-1).view(np.float64)
+            d = flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        else:
+            d = None
+        return self._lib.qsched_add_op(
+            self._h, kind, len(targets), t, ctrl_mask, flip_mask, d,
+            source_index)
+
+    def compile(self, num_qubits: int, shard_bits: int, lookahead: int,
+                fusion: bool) -> None:
+        rc = self._lib.qsched_compile(self._h, num_qubits, shard_bits,
+                                      lookahead, int(fusion))
+        if rc != 0:
+            raise ValueError(self._lib.qsched_error(self._h).decode())
+
+    # -- schedule readback -------------------------------------------------
+
+    def fused_ops(self):
+        """Yield (kind, targets, ctrl_mask, flip_mask, data, source_index)."""
+        out = []
+        for idx in range(self._lib.qsched_num_fused(self._h)):
+            nt = ctypes.c_int()
+            cm = ctypes.c_int64()
+            fm = ctypes.c_int64()
+            si = ctypes.c_int()
+            kind = self._lib.qsched_fused_info(
+                self._h, idx, ctypes.byref(nt), ctypes.byref(cm),
+                ctypes.byref(fm), ctypes.byref(si))
+            targets = (ctypes.c_int * nt.value)()
+            self._lib.qsched_fused_targets(self._h, idx, targets)
+            data = None
+            if kind in (KIND_U, KIND_DIAG):
+                count = (1 << nt.value) ** 2 if kind == KIND_U else 1 << nt.value
+                buf = np.empty(2 * count, dtype=np.float64)
+                self._lib.qsched_fused_data(
+                    self._h, idx,
+                    buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+                data = buf.view(np.complex128)
+                if kind == KIND_U:
+                    data = data.reshape(1 << nt.value, 1 << nt.value)
+                else:
+                    data = data.reshape((2,) * nt.value)
+            out.append((kind, tuple(targets), cm.value, fm.value, data,
+                        si.value))
+        return out
+
+    def items(self, num_qubits: int):
+        """Yield plan items in quest_tpu.parallel.layout format."""
+        out = []
+        for i in range(self._lib.qsched_num_items(self._h)):
+            oi = ctypes.c_int()
+            nt = ctypes.c_int()
+            cm = ctypes.c_int64()
+            fm = ctypes.c_int64()
+            is_re = self._lib.qsched_item_info(
+                self._h, i, ctypes.byref(oi), ctypes.byref(nt),
+                ctypes.byref(cm), ctypes.byref(fm))
+            if is_re:
+                before = (ctypes.c_int * num_qubits)()
+                after = (ctypes.c_int * num_qubits)()
+                self._lib.qsched_item_perms(self._h, i, before, after)
+                out.append(("relayout", np.array(before, dtype=np.int64),
+                            np.array(after, dtype=np.int64)))
+            else:
+                targets = (ctypes.c_int * nt.value)()
+                axis_order = (ctypes.c_int * nt.value)()
+                self._lib.qsched_item_targets(self._h, i, targets, axis_order)
+                out.append(("op", oi.value, tuple(targets), cm.value,
+                            fm.value, tuple(axis_order)))
+        return out
+
+    def num_relayouts(self) -> int:
+        return self._lib.qsched_num_relayouts(self._h)

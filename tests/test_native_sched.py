@@ -1,0 +1,108 @@
+"""Native (C++) scheduler vs pure-Python planner: identical schedules and
+identical execution results. The native path is the default when
+libquest_sched.so builds; QUEST_TPU_NO_NATIVE=1 forces the Python fallback.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import algorithms as alg
+from quest_tpu import native as nat
+from quest_tpu.circuits import Circuit, _schedule
+from quest_tpu.parallel import plan_layout
+
+pytestmark = pytest.mark.skipif(not nat.available(),
+                                reason="native scheduler did not build")
+
+
+def native_and_python_plans(circ, n, shard_bits, lookahead=32, fuse=True):
+    ops_n, plan_n = _schedule(list(circ.ops), n, shard_bits, lookahead,
+                              fuse, circ)
+    ops_p = circ._fused_ops() if fuse else list(circ.ops)
+    plan_p = plan_layout(ops_p, n, shard_bits, lookahead=lookahead)
+    return (ops_n, plan_n), (ops_p, plan_p)
+
+
+def assert_plans_equal(native, python):
+    (ops_n, plan_n), (ops_p, plan_p) = native, python
+    assert len(ops_n) == len(ops_p)
+    for a, b in zip(ops_n, ops_p):
+        assert a.kind == b.kind
+        assert tuple(a.targets) == tuple(b.targets)
+        assert a.ctrl_mask == b.ctrl_mask
+        assert a.flip_mask == b.flip_mask
+        if a.kind == "u" and a.mat is not None:
+            np.testing.assert_allclose(a.mat, b.mat, atol=1e-14)
+        if a.kind == "diag" and a.diag is not None:
+            np.testing.assert_allclose(a.diag, b.diag, atol=1e-14)
+    assert plan_n.num_relayouts == plan_p.num_relayouts
+    assert len(plan_n.items) == len(plan_p.items)
+    for ia, ib in zip(plan_n.items, plan_p.items):
+        assert ia[0] == ib[0]
+        if ia[0] == "relayout":
+            np.testing.assert_array_equal(ia[1], ib[1])
+            np.testing.assert_array_equal(ia[2], ib[2])
+        else:
+            assert ia[1] == ib[1]                       # op index
+            assert tuple(ia[2]) == tuple(ib[2])         # phys targets
+            assert ia[3] == ib[3] and ia[4] == ib[4]    # masks
+            if ops_n[ia[1]].kind == "diag":
+                assert tuple(ia[5]) == tuple(ib[5])     # axis order
+
+
+class TestScheduleEquality:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    @pytest.mark.parametrize("shard_bits", [0, 2, 3])
+    def test_random_circuits(self, seed, shard_bits):
+        n = 8
+        c = alg.random_circuit(n, depth=10, seed=seed)
+        a, b = native_and_python_plans(c, n, shard_bits)
+        assert_plans_equal(a, b)
+
+    def test_parameterized_passthrough(self):
+        n = 6
+        c = Circuit(n)
+        t = c.parameter("t")
+        c.h(0).ry(n - 1, t).cnot(n - 1, 0).rz(2, t).h(n - 1).crz(0, 5, 0.3)
+        a, b = native_and_python_plans(c, n, 2)
+        assert_plans_equal(a, b)
+        # param ops must be the *same objects* (carry their mat_fn/diag_fn)
+        ops_n = a[0]
+        assert any(op.mat_fn is not None for op in ops_n)
+        assert any(op.diag_fn is not None for op in ops_n)
+
+    def test_fusion_matches(self):
+        c = Circuit(4)
+        c.h(0).t(0).s(0).x(0)                # same-target unitary run
+        c.z(1).s(2).t(1).phase(2, 0.3)       # diagonal run
+        c.cnot(0, 1).cnot(0, 1)              # same-(target,ctrl) pair
+        a, b = native_and_python_plans(c, 4, 0)
+        assert_plans_equal(a, b)
+        assert len(a[0]) < len(c.ops)
+
+    def test_qft_and_grover(self):
+        for circ, n in [(alg.qft(6), 6), (alg.grover(6, 13, 2), 6)]:
+            a, b = native_and_python_plans(circ, n, 3)
+            assert_plans_equal(a, b)
+
+    def test_oversized_unitary_error(self):
+        c = Circuit(6)
+        rng = np.random.default_rng(0)
+        u, _ = np.linalg.qr(rng.normal(size=(8, 8))
+                            + 1j * rng.normal(size=(8, 8)))
+        c.gate(u, (0, 1, 2))
+        with pytest.raises(ValueError, match="cannot be localised"):
+            _schedule(list(c.ops), 6, 4, 32, True, c)
+
+
+class TestExecutionViaNative:
+    def test_sharded_run_matches_single(self, env, mesh_env):
+        c = alg.random_circuit(7, depth=8, seed=9)
+        outs = []
+        for e in (env, mesh_env):
+            q = qt.createQureg(7, e)
+            qt.initDebugState(q)
+            c.compile(e).run(q)
+            outs.append(q.to_numpy())
+        np.testing.assert_allclose(outs[1], outs[0], atol=1e-10)
