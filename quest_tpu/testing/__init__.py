@@ -1,0 +1,17 @@
+"""Golden-file test machinery (the reference's cross-configuration oracle).
+
+The reference tests every API function black-box through golden files: a
+trusted serial build *generates* expected probabilities/outcome
+distributions/states, and every other configuration (OpenMP/MPI/GPU) *replays*
+them (`utilities/QuESTTest/QuESTCore.py:380-496`, generator `:738`; format
+described in SURVEY.md §4). This package is that workflow rebuilt for the TPU
+framework: generate on the single-device float64 CPU path (cross-checked
+against the dense analytic oracle), replay under a sharded mesh or on a real
+TPU chip at its precision's tolerance.
+"""
+
+from .golden import (
+    GATE_SPECS, generate_files, run_file, GoldenFailure,
+)
+
+__all__ = ["GATE_SPECS", "generate_files", "run_file", "GoldenFailure"]

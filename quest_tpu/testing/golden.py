@@ -1,0 +1,459 @@
+"""Golden-file generator and runner.
+
+File format (one file per API function, text, reference-style semantics —
+quregType letter + check letters + expected values; written from scratch):
+
+    # golden <function>
+    <numTests>
+    <quregType>-<checks> <numQubits> <arg> <arg> ...
+    P <totalProb>
+    M <P(q0=0)> <P(q1=0)> ...
+    S
+    <re> <im>
+    ...
+
+- quregType: z=zero p=plus d=debug b=bitstring(0b101) r=random;
+  lowercase = state-vector, uppercase = density matrix (the reference's
+  case convention, `QuESTCore.py:382-403`).
+- checks: P total probability, M per-qubit zero-outcome probabilities,
+  S full state amplitudes, R scalar return value(s) of the function.
+- args: floats/ints space-separated; matrix/vector args are expanded inline
+  (re im pairs) and reconstructed by the runner from the function's spec.
+
+Functions and their argument schemas live in GATE_SPECS; argument sweeps are
+deterministic (fixed angles, seeded unitaries), so generated files are
+reproducible byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+import quest_tpu as qt
+
+__all__ = ["GATE_SPECS", "generate_files", "run_file", "GoldenFailure"]
+
+
+# ---------------------------------------------------------------------------
+# argument schemas
+# ---------------------------------------------------------------------------
+
+def _unitary(k: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(1000 + seed)
+    m = rng.normal(size=(1 << k, 1 << k)) + 1j * rng.normal(size=(1 << k, 1 << k))
+    u, _ = np.linalg.qr(m)
+    return u
+
+
+def _kraus_pair(seed: int) -> list[np.ndarray]:
+    p = 0.1 + 0.05 * (seed % 3)
+    flip = _unitary(1, seed)
+    return [np.sqrt(1 - p) * np.eye(2, dtype=np.complex128),
+            np.sqrt(p) * flip.astype(np.complex128)]
+
+
+@dataclasses.dataclass
+class Spec:
+    """How to sweep and encode one API function's arguments.
+
+    ``cases(n)`` yields argument tuples (python values, matrices included);
+    ``encode``/``decode`` map them to/from flat text tokens; ``density_only``
+    restricts to density registers (noise channels); ``returns`` marks
+    value-returning functions (checked with R).
+    """
+    cases: Callable[[int], list[tuple]]
+    encode: Callable[[tuple], list[str]]
+    decode: Callable[[list[str]], tuple]
+    density_only: bool = False
+    statevec_only: bool = False
+    returns: bool = False
+
+
+def _enc_simple(args: tuple) -> list[str]:
+    out = []
+    for a in args:
+        if isinstance(a, (list, tuple, np.ndarray)):
+            arr = np.asarray(a)
+            if np.iscomplexobj(arr):
+                flat = arr.astype(np.complex128).reshape(-1)
+                out.append(f"[{len(flat)}")
+                for z in flat:
+                    out += [repr(float(z.real)), repr(float(z.imag))]
+            elif arr.dtype.kind == "f":
+                flat = arr.reshape(-1)
+                out.append(f"f{len(flat)}")
+                out += [repr(float(v)) for v in flat]
+            else:
+                flat = arr.reshape(-1)
+                out.append(f"i{len(flat)}")
+                out += [str(int(v)) for v in flat]
+        elif isinstance(a, complex):
+            out += ["(", repr(a.real), repr(a.imag)]
+        elif isinstance(a, float):
+            out.append(repr(a))
+        else:
+            out.append(str(int(a)))
+    return out
+
+
+def _dec_simple(tokens: list[str]) -> tuple:
+    args = []
+    i = 0
+    while i < len(tokens):
+        t = tokens[i]
+        if t.startswith("["):
+            count = int(t[1:])
+            vals = np.array([complex(float(tokens[i + 1 + 2 * j]),
+                                     float(tokens[i + 2 + 2 * j]))
+                             for j in range(count)])
+            dim = int(round(np.sqrt(count)))
+            if dim * dim == count and dim >= 2:
+                vals = vals.reshape(dim, dim)
+            args.append(vals)
+            i += 1 + 2 * count
+        elif t.startswith("f") and t[1:].isdigit():
+            count = int(t[1:])
+            args.append(tuple(float(x) for x in tokens[i + 1:i + 1 + count]))
+            i += 1 + count
+        elif t.startswith("i") and t[1:].isdigit():
+            count = int(t[1:])
+            args.append(tuple(int(x) for x in tokens[i + 1:i + 1 + count]))
+            i += 1 + count
+        elif t == "(":
+            args.append(complex(float(tokens[i + 1]), float(tokens[i + 2])))
+            i += 3
+        elif ("." in t or "e" in t or "inf" in t) and not t.lstrip("-").isdigit():
+            args.append(float(t))
+            i += 1
+        else:
+            args.append(int(t))
+            i += 1
+    return tuple(args)
+
+
+def _spec(cases, **kw) -> Spec:
+    return Spec(cases=cases, encode=_enc_simple, decode=_dec_simple, **kw)
+
+
+_ANGLE = 0.37
+_AXIS = (1.0, -2.0, 0.5)
+
+
+def _targets(n):
+    return [(t,) for t in range(n)]
+
+
+def _target_angle(n):
+    return [(t, _ANGLE + 0.1 * t) for t in range(n)]
+
+
+def _ctrl_target(n):
+    return [(c, t) for c in range(n) for t in range(n) if c != t]
+
+
+def _ctrl_target_angle(n):
+    return [(c, t, _ANGLE + 0.05 * (c + n * t))
+            for c in range(n) for t in range(n) if c != t]
+
+
+def _pairs(n):
+    return [(a, b) for a in range(n) for b in range(n) if a != b]
+
+
+GATE_SPECS: dict[str, Spec] = {
+    # 1-qubit gates
+    "hadamard": _spec(_targets),
+    "pauliX": _spec(_targets),
+    "pauliY": _spec(_targets),
+    "pauliZ": _spec(_targets),
+    "sGate": _spec(_targets),
+    "tGate": _spec(_targets),
+    "phaseShift": _spec(_target_angle),
+    "rotateX": _spec(_target_angle),
+    "rotateY": _spec(_target_angle),
+    "rotateZ": _spec(_target_angle),
+    "rotateAroundAxis": _spec(
+        lambda n: [(t, _ANGLE + 0.1 * t, _AXIS) for t in range(n)]),
+    "compactUnitary": _spec(
+        lambda n: [(t, complex(0.6, 0.0), complex(0.0, 0.8)) for t in range(n)]),
+    "unitary": _spec(
+        lambda n: [(t, _unitary(1, t)) for t in range(n)]),
+    # controlled
+    "controlledNot": _spec(_ctrl_target),
+    "controlledPauliY": _spec(_ctrl_target),
+    "controlledPhaseShift": _spec(_ctrl_target_angle),
+    "controlledPhaseFlip": _spec(_pairs),
+    "controlledRotateX": _spec(_ctrl_target_angle),
+    "controlledRotateY": _spec(_ctrl_target_angle),
+    "controlledRotateZ": _spec(_ctrl_target_angle),
+    "controlledRotateAroundAxis": _spec(
+        lambda n: [(c, t, _ANGLE, _AXIS)
+                   for c in range(n) for t in range(n) if c != t]),
+    "controlledCompactUnitary": _spec(
+        lambda n: [(c, t, complex(0.6, 0.0), complex(0.0, 0.8))
+                   for c in range(n) for t in range(n) if c != t]),
+    "controlledUnitary": _spec(
+        lambda n: [(c, t, _unitary(1, c + n * t))
+                   for c in range(n) for t in range(n) if c != t]),
+    "multiControlledUnitary": _spec(
+        lambda n: [(tuple(c for c in range(n) if c != t), t, _unitary(1, t))
+                   for t in range(n)]),
+    "multiStateControlledUnitary": _spec(
+        lambda n: [(tuple(c for c in range(n) if c != t),
+                    tuple((c + t) % 2 for c in range(n) if c != t),
+                    t, _unitary(1, t))
+                   for t in range(n)]),
+    "multiControlledPhaseShift": _spec(
+        lambda n: [(tuple(range(n)), _ANGLE)]),
+    "multiControlledPhaseFlip": _spec(
+        lambda n: [(tuple(range(n)),)]),
+    # swaps / multi-qubit
+    "swapGate": _spec(lambda n: [(a, b) for a in range(n)
+                                 for b in range(a + 1, n)]),
+    "sqrtSwapGate": _spec(lambda n: [(a, b) for a in range(n)
+                                     for b in range(a + 1, n)]),
+    "multiRotateZ": _spec(
+        lambda n: [(tuple(range(n)), _ANGLE), ((0, n - 1), 0.8)]),
+    "multiRotatePauli": _spec(
+        lambda n: [(tuple(range(3)), (1, 2, 3), _ANGLE)]),
+    "twoQubitUnitary": _spec(
+        lambda n: [(a, b, _unitary(2, a + n * b)) for a, b in _pairs(n)]),
+    "controlledTwoQubitUnitary": _spec(
+        lambda n: [(2, 0, 1, _unitary(2, 5))]),
+    "multiQubitUnitary": _spec(
+        lambda n: [((0, 1, 2), _unitary(3, 9))]),
+    "multiControlledMultiQubitUnitary": _spec(
+        lambda n: [((2,), (0, 1), _unitary(2, 11))]),
+    # measurement-adjacent (deterministic only)
+    "collapseToOutcome": _spec(
+        lambda n: [(t, 0) for t in range(n)] + [(t, 1) for t in range(n)],
+        returns=True),
+    "calcProbOfOutcome": _spec(
+        lambda n: [(t, o) for t in range(n) for o in (0, 1)], returns=True),
+    # calculations
+    "calcTotalProb": _spec(lambda n: [()], returns=True),
+    "calcPurity": _spec(lambda n: [()], returns=True, density_only=True),
+    "calcExpecPauliProd": _spec(
+        lambda n: [((0, 1), (1, 3)), ((0, 1, 2), (2, 2, 1))], returns=True),
+    "calcExpecPauliSum": _spec(
+        lambda n: [((1, 0, 0, 3, 3, 0), (0.3, -0.7))], returns=True),
+    # noise channels (density only)
+    "mixDephasing": _spec(
+        lambda n: [(t, 0.2) for t in range(n)], density_only=True),
+    "mixDepolarising": _spec(
+        lambda n: [(t, 0.2) for t in range(n)], density_only=True),
+    "mixDamping": _spec(
+        lambda n: [(t, 0.3) for t in range(n)], density_only=True),
+    "mixTwoQubitDephasing": _spec(
+        lambda n: [(a, b, 0.25) for a, b in _pairs(n)], density_only=True),
+    "mixTwoQubitDepolarising": _spec(
+        lambda n: [(a, b, 0.4) for a, b in _pairs(n)], density_only=True),
+    "mixPauli": _spec(
+        lambda n: [(t, 0.1, 0.05, 0.15) for t in range(n)],
+        density_only=True),
+    "mixKrausMap": _spec(
+        lambda n: [(t, _kraus_pair(t)) for t in range(n)],
+        density_only=True),
+}
+
+# mixKrausMap takes a *list* of matrices: encode flattens both into one
+# block, decode must re-split — override its codec
+def _enc_kraus(args):
+    t, ops = args
+    out = [str(t), f"k{len(ops)}"]
+    for m in ops:
+        out += _enc_simple((m,))
+    return out
+
+
+def _dec_kraus(tokens):
+    t = int(tokens[0])
+    count = int(tokens[1][1:])
+    rest = tokens[2:]
+    ops = []
+    for _ in range(count):
+        n_ent = int(rest[0][1:])
+        (m,) = _dec_simple(rest[:1 + 2 * n_ent])
+        ops.append(m)
+        rest = rest[1 + 2 * n_ent:]
+    return (t, ops)
+
+
+GATE_SPECS["mixKrausMap"] = dataclasses.replace(
+    GATE_SPECS["mixKrausMap"], encode=_enc_kraus, decode=_dec_kraus)
+
+
+# ---------------------------------------------------------------------------
+# register preparation
+# ---------------------------------------------------------------------------
+
+_BITSTRING = 0b101
+
+
+def _prepare(qtype: str, n: int, env) -> "qt.Qureg":
+    is_density = qtype.isupper()
+    t = qtype.lower()
+    q = qt.createDensityQureg(n, env) if is_density else qt.createQureg(n, env)
+    if t == "z":
+        qt.initZeroState(q)
+    elif t == "p":
+        qt.initPlusState(q)
+    elif t == "d":
+        qt.initDebugState(q)
+    elif t == "b":
+        qt.initClassicalState(q, _BITSTRING & ((1 << n) - 1))
+    elif t == "r":
+        rng = np.random.default_rng(42 + n)
+        amps = rng.normal(size=1 << n) + 1j * rng.normal(size=1 << n)
+        amps /= np.linalg.norm(amps)
+        if is_density:
+            pure = qt.createQureg(n, env)
+            qt.initStateFromAmps(pure, amps.real, amps.imag)
+            qt.initPureState(q, pure)
+        else:
+            qt.initStateFromAmps(q, amps.real, amps.imag)
+    else:
+        raise ValueError(f"unknown qureg type {qtype!r}")
+    return q
+
+
+def _apply(fn_name: str, q, args: tuple):
+    """Call the API function; returns its value (or None)."""
+    return getattr(qt, fn_name)(q, *args)
+
+
+def _measurements(q, n: int) -> list[float]:
+    return [qt.calcProbOfOutcome(q, t, 0) for t in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# generation
+# ---------------------------------------------------------------------------
+
+def generate_files(outdir: str, env, names: Optional[Sequence[str]] = None,
+                   num_qubits: int = 3, qureg_types: str = "zpdb",
+                   checks: str = "PMS") -> list[str]:
+    """Write one golden file per function using the current build as the
+    trusted generator (run on the single-device float64 path)."""
+    os.makedirs(outdir, exist_ok=True)
+    written = []
+    for name in (names or sorted(GATE_SPECS)):
+        spec = GATE_SPECS[name]
+        lines_out: list[str] = [f"# golden {name}"]
+        tests = []
+        for qtype in qureg_types:
+            variants = [qtype.upper()] if spec.density_only else (
+                [qtype] if spec.statevec_only else [qtype, qtype.upper()])
+            for qt_variant in variants:
+                for args in spec.cases(num_qubits):
+                    tests.append((qt_variant, args))
+        lines_out.append(str(len(tests)))
+        for qt_variant, args in tests:
+            use_checks = checks if not spec.returns else checks + "R"
+            q = _prepare(qt_variant, num_qubits, env)
+            try:
+                ret = _apply(name, q, args)
+            except qt.QuESTError:
+                # validation rejections (e.g. collapse to a zero-probability
+                # outcome) are themselves golden: every config must reject
+                lines_out.append(" ".join(
+                    [f"{qt_variant}-E", str(num_qubits)] + spec.encode(args)))
+                continue
+            head = [f"{qt_variant}-{use_checks}", str(num_qubits)]
+            head += spec.encode(args)
+            lines_out.append(" ".join(head))
+            if "P" in use_checks:
+                lines_out.append(f"P {qt.calcTotalProb(q)!r}")
+            if "M" in use_checks:
+                probs = _measurements(q, num_qubits)
+                lines_out.append("M " + " ".join(repr(p) for p in probs))
+            if "S" in use_checks:
+                amps = q.to_numpy()
+                lines_out.append("S")
+                for a in amps:
+                    lines_out.append(f"{float(a.real)!r} {float(a.imag)!r}")
+            if "R" in use_checks:
+                vals = np.atleast_1d(np.asarray(ret, dtype=np.float64))
+                lines_out.append("R " + " ".join(repr(float(v)) for v in vals))
+        path = os.path.join(outdir, f"{name}.test")
+        with open(path, "w") as f:
+            f.write("\n".join(lines_out) + "\n")
+        written.append(path)
+    return written
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class GoldenFailure:
+    function: str
+    test_index: int
+    check: str
+    detail: str
+
+
+def run_file(path: str, env, tol: float = 1e-10) -> list[GoldenFailure]:
+    """Replay a golden file on ``env``; return failures (empty = pass)."""
+    with open(path) as f:
+        lines = [ln.rstrip("\n") for ln in f]
+    assert lines[0].startswith("# golden ")
+    name = lines[0].split()[-1]
+    spec = GATE_SPECS[name]
+    num_tests = int(lines[1])
+    i = 2
+    failures: list[GoldenFailure] = []
+    for test_idx in range(num_tests):
+        head = lines[i].split()
+        i += 1
+        qt_variant, use_checks = head[0].split("-")
+        n = int(head[1])
+        args = spec.decode(head[2:])
+        q = _prepare(qt_variant, n, env)
+
+        def fail(check, detail):
+            failures.append(GoldenFailure(name, test_idx, check, detail))
+
+        if use_checks == "E":
+            try:
+                _apply(name, q, args)
+                fail("E", "expected QuESTError, none raised")
+            except qt.QuESTError:
+                pass
+            continue
+        ret = _apply(name, q, args)
+
+        for check in use_checks:
+            if check == "P":
+                want = float(lines[i].split()[1]); i += 1
+                got = qt.calcTotalProb(q)
+                if abs(got - want) > tol:
+                    fail("P", f"totalProb {got} != {want}")
+            elif check == "M":
+                want = [float(x) for x in lines[i].split()[1:]]; i += 1
+                got = _measurements(q, n)
+                if np.max(np.abs(np.array(got) - np.array(want))) > tol:
+                    fail("M", f"outcome probs {got} != {want}")
+            elif check == "S":
+                i += 1  # "S" line
+                dim = q.num_amps_total
+                want = np.empty(dim, dtype=np.complex128)
+                for j in range(dim):
+                    re, im = lines[i + j].split()
+                    want[j] = complex(float(re), float(im))
+                i += dim
+                got = q.to_numpy()
+                err = np.max(np.abs(got - want))
+                if err > tol:
+                    fail("S", f"state max|Δ|={err:.3e}")
+            elif check == "R":
+                want = [float(x) for x in lines[i].split()[1:]]; i += 1
+                got = np.atleast_1d(np.asarray(ret, dtype=np.float64))
+                if np.max(np.abs(got - np.array(want))) > tol:
+                    fail("R", f"return {got} != {want}")
+    return failures

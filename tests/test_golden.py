@@ -1,0 +1,47 @@
+"""Golden-file corpus tests (the reference's cross-configuration oracle,
+SURVEY.md §4): the checked-in corpus under tests/golden/ was generated on the
+trusted single-device float64 path; every configuration must replay it.
+
+- generator stability: regenerating must reproduce the corpus byte-for-byte
+  (guards against silent behavior drift in any API function);
+- single-device replay: self-consistency of the runner;
+- 8-device mesh replay: the distributed build agrees with the serial one at
+  1e-10 — the reference's mpiexec-replays-the-same-suite strategy.
+"""
+
+import glob
+import os
+
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.testing import GATE_SPECS, generate_files, run_file
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+FILES = sorted(glob.glob(os.path.join(GOLDEN_DIR, "*.test")))
+
+
+def test_corpus_exists_and_covers_specs():
+    assert FILES, "tests/golden corpus missing — run generate_files"
+    names = {os.path.splitext(os.path.basename(f))[0] for f in FILES}
+    assert names == set(GATE_SPECS), names ^ set(GATE_SPECS)
+
+
+def test_generator_reproduces_corpus(tmp_path, env):
+    regen = generate_files(str(tmp_path), env)
+    for path in regen:
+        name = os.path.basename(path)
+        with open(path) as f_new, open(os.path.join(GOLDEN_DIR, name)) as f_old:
+            assert f_new.read() == f_old.read(), f"{name} drifted"
+
+
+@pytest.mark.parametrize("path", FILES, ids=[os.path.basename(f) for f in FILES])
+def test_replay_single_device(path, env):
+    failures = run_file(path, env)
+    assert not failures, failures[:3]
+
+
+@pytest.mark.parametrize("path", FILES, ids=[os.path.basename(f) for f in FILES])
+def test_replay_sharded(path, mesh_env):
+    failures = run_file(path, mesh_env)
+    assert not failures, failures[:3]
