@@ -120,38 +120,41 @@ def apply_unitary(
     k = len(targets)
     controls = tuple(q for q in range(num_qubits) if (ctrl_mask >> q) & 1)
 
-    pos_desc = tuple(sorted(targets + controls, reverse=True))
-    shape = split_shape(num_qubits, pos_desc)
-    axis_of = {p: 2 * i + 1 for i, p in enumerate(pos_desc)}
+    with jax.named_scope(
+            f"gate_u{k}q_t{'_'.join(map(str, targets))}"
+            + (f"_c{len(controls)}" if controls else "")):
+        pos_desc = tuple(sorted(targets + controls, reverse=True))
+        shape = split_shape(num_qubits, pos_desc)
+        axis_of = {p: 2 * i + 1 for i, p in enumerate(pos_desc)}
 
-    ctrl_axes = [axis_of[c] for c in controls]
-    targ_axes = [axis_of[t] for t in sorted(targets, reverse=True)]
-    moved = set(ctrl_axes) | set(targ_axes)
-    rest_axes = [ax for ax in range(len(shape)) if ax not in moved]
-    perm = ctrl_axes + targ_axes + rest_axes
+        ctrl_axes = [axis_of[c] for c in controls]
+        targ_axes = [axis_of[t] for t in sorted(targets, reverse=True)]
+        moved = set(ctrl_axes) | set(targ_axes)
+        rest_axes = [ax for ax in range(len(shape)) if ax not in moved]
+        perm = ctrl_axes + targ_axes + rest_axes
 
-    arr = state.reshape(shape).transpose(perm)
-    ctrl_idx = tuple(0 if (flip_mask >> c) & 1 else 1 for c in controls)
+        arr = state.reshape(shape).transpose(perm)
+        ctrl_idx = tuple(0 if (flip_mask >> c) & 1 else 1 for c in controls)
 
-    sub = arr[ctrl_idx] if controls else arr
-    rest_shape = sub.shape[k:]
+        sub = arr[ctrl_idx] if controls else arr
+        rest_shape = sub.shape[k:]
 
-    u = jnp.asarray(u, dtype=state.dtype)
-    row_perm = permutation_to_sorted_desc(targets)
-    if not np.array_equal(row_perm, np.arange(1 << k)):
-        u = u[row_perm][:, row_perm]
+        u = jnp.asarray(u, dtype=state.dtype)
+        row_perm = permutation_to_sorted_desc(targets)
+        if not np.array_equal(row_perm, np.arange(1 << k)):
+            u = u[row_perm][:, row_perm]
 
-    # HIGHEST keeps the MXU in full-f32 passes: the TPU default (bf16
-    # operands) loses ~1e-3 per gate, far outside simulation tolerance, and
-    # these tall-skinny matmuls are HBM-bound anyway so the extra MXU passes
-    # are free
-    new = jnp.matmul(u, sub.reshape(1 << k, -1),
-                     precision=jax.lax.Precision.HIGHEST)
-    new = new.reshape((2,) * k + rest_shape)
-    arr = arr.at[ctrl_idx].set(new) if controls else new
+        # HIGHEST keeps the MXU in full-f32 passes: the TPU default (bf16
+        # operands) loses ~1e-3 per gate, far outside simulation tolerance,
+        # and these tall-skinny matmuls are HBM-bound anyway so the extra MXU
+        # passes are free
+        new = jnp.matmul(u, sub.reshape(1 << k, -1),
+                         precision=jax.lax.Precision.HIGHEST)
+        new = new.reshape((2,) * k + rest_shape)
+        arr = arr.at[ctrl_idx].set(new) if controls else new
 
-    inv = np.argsort(perm)
-    return arr.transpose(inv).reshape(-1)
+        inv = np.argsort(perm)
+        return arr.transpose(inv).reshape(-1)
 
 
 def apply_diagonal(
@@ -168,9 +171,10 @@ def apply_diagonal(
     dephasing channels.
     """
     pos_desc = tuple(sorted((int(q) for q in qubits), reverse=True))
-    shape = split_shape(num_qubits, pos_desc)
-    bshape = [1] * len(shape)
-    for i in range(len(pos_desc)):
-        bshape[2 * i + 1] = 2
-    factor = jnp.asarray(diag_tensor, dtype=state.dtype).reshape(bshape)
-    return (state.reshape(shape) * factor).reshape(-1)
+    with jax.named_scope(f"gate_diag_q{'_'.join(map(str, pos_desc))}"):
+        shape = split_shape(num_qubits, pos_desc)
+        bshape = [1] * len(shape)
+        for i in range(len(pos_desc)):
+            bshape[2 * i + 1] = 2
+        factor = jnp.asarray(diag_tensor, dtype=state.dtype).reshape(bshape)
+        return (state.reshape(shape) * factor).reshape(-1)

