@@ -36,6 +36,7 @@ Usage::
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Optional, Sequence, Union
 
 import numpy as np
@@ -384,11 +385,81 @@ class Circuit:
         return fused
 
     def compile(self, env: QuESTEnv, donate: bool = True, fuse: bool = True,
-                lookahead: int = 32) -> "CompiledCircuit":
+                lookahead: int = 32,
+                pallas: Optional[object] = None) -> "CompiledCircuit":
         """Compile to one XLA program; ``lookahead`` is the layout planner's
-        relayout-batching window (quest_tpu.parallel.layout)."""
+        relayout-batching window (quest_tpu.parallel.layout); ``pallas``
+        controls the fused-layer kernel pass (None=auto on TPU,
+        "interpret"=interpreted kernels, False=off)."""
         return CompiledCircuit(self, env, donate=donate, fuse=fuse,
-                               lookahead=lookahead)
+                               lookahead=lookahead, pallas=pallas)
+
+
+def _collect_layers(ops: list, num_qubits: int,
+                    block_rows: Optional[int] = None,
+                    min_members: int = 2) -> list:
+    """Merge runs of eligible static gates into Pallas LayerOps.
+
+    Eligible: static gates entirely on lane qubits (any arity/controls,
+    folded into one 128x128 lane matrix) and uncontrolled static 1q gates on
+    mid qubits (in-block row pairing). An ineligible op ends the run; runs
+    shorter than ``min_members`` stay as-is.
+    """
+    from .ops import pallas_kernels as pk
+    if num_qubits < pk.LANE_QUBITS:
+        return ops
+    block_rows = block_rows or pk.DEFAULT_BLOCK_ROWS
+    total_rows = (1 << num_qubits) // 128
+    hi = pk.max_mid_qubit(min(block_rows, max(total_rows, 1)))
+    lane_limit = 1 << pk.LANE_QUBITS
+
+    def eligible(op) -> bool:
+        if getattr(op, "kind", None) not in ("u", "diag") or not op.is_static:
+            return False
+        if op.kind == "u":
+            if (all(t < pk.LANE_QUBITS for t in op.targets)
+                    and op.ctrl_mask < lane_limit):
+                return True
+            return (len(op.targets) == 1 and op.ctrl_mask == 0
+                    and pk.LANE_QUBITS <= op.targets[0] <= hi)
+        if all(q < pk.LANE_QUBITS for q in op.targets):
+            return True
+        return len(op.targets) == 1 and pk.LANE_QUBITS <= op.targets[0] <= hi
+
+    out: list = []
+    run: list = []
+
+    def flush():
+        if len(run) < min_members:
+            out.extend(run)
+        else:
+            lane = None
+            mids = []
+            for op in run:
+                if op.kind == "u" and all(t < pk.LANE_QUBITS
+                                          for t in op.targets):
+                    e = pk.embed_lane_matrix(op.mat, op.targets,
+                                             op.ctrl_mask, op.flip_mask)
+                    lane = e if lane is None else e @ lane
+                elif op.kind == "u":
+                    mids.append((op.targets[0], np.asarray(op.mat)))
+                elif all(q < pk.LANE_QUBITS for q in op.targets):
+                    e = pk.lane_diag_matrix(np.asarray(op.diag), op.targets)
+                    lane = e if lane is None else e @ lane
+                else:
+                    mids.append((op.targets[0],
+                                 np.diag(np.asarray(op.diag).reshape(-1))))
+            out.append(pk.LayerOp(num_qubits, len(run), lane, mids))
+        run.clear()
+
+    for op in ops:
+        if eligible(op):
+            run.append(op)
+        else:
+            flush()
+            out.append(op)
+    flush()
+    return out
 
 
 def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
@@ -450,7 +521,7 @@ class CompiledCircuit:
 
     def __init__(self, circuit: Circuit, env: QuESTEnv,
                  donate: bool = True, fuse: bool = True,
-                 lookahead: int = 32):
+                 lookahead: int = 32, pallas: Optional[object] = None):
         self.circuit = circuit
         self.env = env
         self.num_qubits = circuit.num_qubits
@@ -465,6 +536,22 @@ class CompiledCircuit:
         from .parallel import apply_relayout
         ops, self.plan = _schedule(list(circuit.ops), n, shard_bits,
                                    lookahead, fuse, circuit)
+
+        # Pallas fused-layer pass (single-device only; the mesh path keeps
+        # gates addressable by the layout planner). pallas=None -> auto (TPU
+        # backend only); "interpret" -> run kernels interpreted (tests);
+        # False -> off.
+        if pallas is None:
+            pallas = os.environ.get("QUEST_TPU_PALLAS", "auto")
+        interpret = pallas == "interpret"
+        enabled = pallas not in (False, "0", "off") and (
+            interpret or jax.default_backend() == "tpu")
+        self._pallas_interpret = interpret
+        if enabled and shard_bits == 0 and n >= 7:
+            from .parallel import plan_layout
+            ops = _collect_layers(ops, n)
+            self.plan = plan_layout(ops, n, 0, lookahead=lookahead)
+
         self._ops = ops
         plan_items = self.plan.items
         flat_sharding = env.sharding_flat()
@@ -478,7 +565,11 @@ class CompiledCircuit:
                     continue
                 _, i, phys_targets, cmask, fmask, axis_order = item
                 op = ops[i]
-                if op.kind == "u":
+                if op.kind == "layer":
+                    from .ops import pallas_kernels as pk
+                    state = pk.apply_layer(state, n, op,
+                                           interpret=self._pallas_interpret)
+                elif op.kind == "u":
                     u = op.mat_fn(params) if op.mat_fn is not None else op.mat
                     state = apply_unitary(state, n, u, phys_targets,
                                           cmask, fmask)

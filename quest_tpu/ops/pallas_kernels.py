@@ -1,0 +1,201 @@
+"""Pallas fused gate-layer kernel: one HBM pass for many gates.
+
+The XLA path applies every gate as its own full-state pass (2^n amplitudes
+read + written per gate) — the same roofline as the reference's per-gate CUDA
+kernels (`QuEST_gpu.cu:667-1246`). But a *layer* of gates on distinct low
+qubits is a single linear map acting block-locally, so one kernel can stream
+the state through VMEM once and apply the whole layer: an L-gate layer costs
+1 memory pass instead of L. XLA cannot do this fusion itself (each gate is a
+differently-reshaped matmul), which makes it exactly the Pallas case flagged
+in SURVEY.md §7.2.
+
+Qubit classes, with the state viewed as ``(rows, 128)`` float planes:
+
+- **lane qubits** (0..6): bits inside the 128-lane dimension. ANY static
+  gate — controlled and multi-qubit included — whose targets and controls
+  all live here is a 128x128 matrix on the lane axis (kron-embedded
+  host-side); a whole run of them multiplies into ONE matrix applied by MXU
+  matmuls. Diagonal (phase-family) ops embed as diagonal matrices.
+- **mid qubits** (7..7+log2(R)-1): bits inside the per-block row dimension.
+  Uncontrolled 1q gates pair rows at stride 2^(q-7); applied in-VMEM by
+  leading-axis reshape + broadcasted 2x2 combine (VPU).
+- **high qubits** (>= 7+log2(R)): pair across grid blocks; left to the
+  XLA/collective path (they are the few top qubits only).
+
+Complex arithmetic runs on split re/im planes (4 real matmuls per lane
+matrix; see `core/packing.py` for why planes are the storage format anyway).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+LANE_QUBITS = 7          # 2^7 = 128 lanes
+DEFAULT_BLOCK_ROWS = 1024
+
+__all__ = ["LANE_QUBITS", "DEFAULT_BLOCK_ROWS", "LayerOp",
+           "embed_lane_matrix", "lane_diag_matrix", "max_mid_qubit",
+           "apply_layer"]
+
+
+def embed_lane_matrix(u: np.ndarray, targets: Sequence[int],
+                      ctrl_mask: int = 0, flip_mask: int = 0) -> np.ndarray:
+    """Embed a gate on lane qubits into the full 128x128 lane operator
+    (bit ``j`` of the gate's index addresses ``targets[j]``, the
+    ComplexMatrixN convention; controls condition on 1 unless flipped)."""
+    k = len(targets)
+    dim = 1 << LANE_QUBITS
+    full = np.zeros((dim, dim), dtype=np.complex128)
+    t_mask = 0
+    for t in targets:
+        t_mask |= 1 << t
+    want = ctrl_mask & ~flip_mask
+    for col in range(dim):
+        if (col & ctrl_mask) != want:
+            full[col, col] = 1.0
+            continue
+        m = 0
+        for j, t in enumerate(targets):
+            if (col >> t) & 1:
+                m |= 1 << j
+        base = col & ~t_mask
+        for m2 in range(1 << k):
+            row = base
+            for j, t in enumerate(targets):
+                if (m2 >> j) & 1:
+                    row |= 1 << t
+            full[row, col] += u[m2, m]
+    return full
+
+
+def lane_diag_matrix(tensor: np.ndarray,
+                     qubits_desc: Sequence[int]) -> np.ndarray:
+    """Embed a diagonal factor tensor ((2,)*k, axes = qubits sorted desc)
+    over lane qubits as a diagonal 128x128 operator."""
+    dim = 1 << LANE_QUBITS
+    d = np.ones(dim, dtype=np.complex128)
+    k = len(qubits_desc)
+    for lane in range(dim):
+        idx = tuple((lane >> q) & 1 for q in qubits_desc)
+        d[lane] = tensor[idx] if k else 1.0
+    return np.diag(d)
+
+
+def max_mid_qubit(block_rows: int) -> int:
+    """Highest qubit index the kernel handles for a given block size."""
+    return LANE_QUBITS + int(np.log2(block_rows)) - 1
+
+
+class LayerOp:
+    """A fused layer: one lane matrix + an ordered list of mid-qubit gates.
+
+    ``mid_gates`` holds ``(qubit, u2x2)``; lane and mid sets act on disjoint
+    qubits, so the kernel applies the lane matmul first regardless of the
+    recorded interleaving. Quacks enough like circuits._Op for the layout
+    planner (kind/targets/masks/is_static).
+    """
+
+    kind = "layer"
+    ctrl_mask = 0
+    flip_mask = 0
+    is_static = True
+    mat_fn = None
+    diag_fn = None
+
+    def __init__(self, num_qubits: int, members: int,
+                 lane_matrix: Optional[np.ndarray],
+                 mid_gates: list[tuple[int, np.ndarray]]):
+        self.num_qubits = num_qubits
+        self.members = members            # how many recorded ops were fused
+        self.lane_matrix = lane_matrix    # 128x128 complex or None
+        self.mid_gates = mid_gates
+        self.targets = tuple(sorted(
+            {q for q, _ in mid_gates}
+            | (set(range(min(LANE_QUBITS, num_qubits)))
+               if lane_matrix is not None else set())))
+
+
+def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, ore_ref, oim_ref,
+                  *, mid_static, use_lane):
+    re = re_ref[:]
+    im = im_ref[:]
+    if use_lane:
+        mre_t = mre_ref[:].T
+        mim_t = mim_ref[:].T
+        acc = re.dtype  # f32 accumulate on TPU; f64 under x64 interpret
+        # out = v @ M^T (columns of M index the input lane), complex via 4
+        # real MXU matmuls on (rows,128)x(128,128)
+        new_re = (jnp.dot(re, mre_t, preferred_element_type=acc)
+                  - jnp.dot(im, mim_t, preferred_element_type=acc))
+        new_im = (jnp.dot(re, mim_t, preferred_element_type=acc)
+                  + jnp.dot(im, mre_t, preferred_element_type=acc))
+        re, im = new_re.astype(re.dtype), new_im.astype(im.dtype)
+    rows = re.shape[0]
+    for stride, (ar, ai, br, bi, cr, ci, dr, di) in mid_static:
+        blocks = rows // (2 * stride)
+        sre = re.reshape(blocks, 2, stride, 128)
+        sim = im.reshape(blocks, 2, stride, 128)
+        up_re, lo_re = sre[:, 0], sre[:, 1]
+        up_im, lo_im = sim[:, 0], sim[:, 1]
+        nu_re = ar * up_re - ai * up_im + br * lo_re - bi * lo_im
+        nu_im = ar * up_im + ai * up_re + br * lo_im + bi * lo_re
+        nl_re = cr * up_re - ci * up_im + dr * lo_re - di * lo_im
+        nl_im = cr * up_im + ci * up_re + dr * lo_im + di * lo_re
+        re = jnp.stack([nu_re, nl_re], axis=1).reshape(rows, 128)
+        im = jnp.stack([nu_im, nl_im], axis=1).reshape(rows, 128)
+    ore_ref[:] = re
+    oim_ref[:] = im
+
+
+def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
+                block_rows: int = DEFAULT_BLOCK_ROWS,
+                interpret: bool = False) -> jnp.ndarray:
+    """Apply a fused layer to a flat complex state (traceable; call under
+    jit — the pallas_call compiles into the surrounding program)."""
+    from jax.experimental import pallas as pl
+
+    total_rows = (1 << num_qubits) // 128
+    if total_rows < 1:
+        raise ValueError("fused layers need at least 7 qubits")
+    block_rows = min(block_rows, total_rows)
+    hi = max_mid_qubit(block_rows)
+    mid_static = []
+    for q, u in layer.mid_gates:
+        if not LANE_QUBITS <= q <= hi:
+            raise ValueError(f"mid gate qubit {q} outside [{LANE_QUBITS}, {hi}]")
+        mid_static.append((1 << (q - LANE_QUBITS),
+                           (float(u[0, 0].real), float(u[0, 0].imag),
+                            float(u[0, 1].real), float(u[0, 1].imag),
+                            float(u[1, 0].real), float(u[1, 0].imag),
+                            float(u[1, 1].real), float(u[1, 1].imag))))
+
+    rdtype = jnp.float32 if state.dtype == jnp.complex64 else jnp.float64
+    re = jnp.real(state).astype(rdtype).reshape(total_rows, 128)
+    im = jnp.imag(state).astype(rdtype).reshape(total_rows, 128)
+    use_lane = layer.lane_matrix is not None
+    if use_lane:
+        mre = jnp.asarray(np.ascontiguousarray(layer.lane_matrix.real), rdtype)
+        mim = jnp.asarray(np.ascontiguousarray(layer.lane_matrix.imag), rdtype)
+    else:
+        mre = jnp.zeros((128, 128), rdtype)
+        mim = jnp.zeros((128, 128), rdtype)
+
+    kernel = functools.partial(_layer_kernel, mid_static=tuple(mid_static),
+                               use_lane=use_lane)
+    state_spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
+    mat_spec = pl.BlockSpec((128, 128), lambda i: (0, 0))
+    with jax.named_scope(f"pallas_layer_{layer.members}gates"):
+        out_re, out_im = pl.pallas_call(
+            kernel,
+            grid=(total_rows // block_rows,),
+            in_specs=[state_spec, state_spec, mat_spec, mat_spec],
+            out_specs=[state_spec, state_spec],
+            out_shape=[jax.ShapeDtypeStruct((total_rows, 128), rdtype)] * 2,
+            interpret=interpret,
+        )(re, im, mre, mim)
+    return jax.lax.complex(out_re, out_im).reshape(-1).astype(state.dtype)

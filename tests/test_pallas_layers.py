@@ -1,0 +1,115 @@
+"""Fused-layer (Pallas) kernel tests, run with interpret=True on the CPU
+backend: layer collection must fuse the right runs, and execution through the
+kernel must agree with the plain XLA per-gate path to 1e-10.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import algorithms as alg
+from quest_tpu.circuits import Circuit, _collect_layers
+from quest_tpu.ops import pallas_kernels as pk
+
+
+def run(circ, env, pallas):
+    q = qt.createQureg(circ.num_qubits, env)
+    qt.initDebugState(q)
+    circ.compile(env, pallas=pallas).run(q)
+    return q.to_numpy()
+
+
+class TestCollection:
+    def test_lane_run_fuses(self):
+        c = Circuit(8)
+        for q in range(7):
+            c.h(q)
+        c.cnot(0, 1).cz(2, 3).t(4)
+        ops = _collect_layers(c._fused_ops(), 8)
+        layers = [o for o in ops if getattr(o, "kind", None) == "layer"]
+        assert len(layers) == 1
+        assert layers[0].lane_matrix is not None
+        assert layers[0].mid_gates == []
+
+    def test_mid_gates_collect(self):
+        c = Circuit(10)
+        c.h(0).h(8).h(9).h(7)
+        ops = _collect_layers(c._fused_ops(), 10)
+        (layer,) = [o for o in ops if getattr(o, "kind", None) == "layer"]
+        assert sorted(q for q, _ in layer.mid_gates) == [7, 8, 9]
+
+    def test_high_qubit_breaks_run(self):
+        c = Circuit(20)
+        c.h(0).h(1)
+        c.h(19)            # beyond mid range for 2^13-row block? no: 2^13
+        ops = _collect_layers(c._fused_ops(), 20, block_rows=8)
+        kinds = [getattr(o, "kind", None) for o in ops]
+        # block_rows=8 -> mid range is 7..9, so h(19) must stay un-fused
+        assert kinds.count("layer") == 1
+        assert kinds.count("u") == 1
+
+    def test_controlled_on_mid_not_fused(self):
+        c = Circuit(10)
+        c.h(0).h(1)
+        c.cnot(8, 0)       # control on mid qubit: ineligible
+        c.h(2).h(3)
+        ops = _collect_layers(c._fused_ops(), 10)
+        kinds = [getattr(o, "kind", None) for o in ops]
+        assert kinds.count("layer") == 2 and kinds.count("u") == 1
+
+    def test_embed_matches_oracle(self):
+        import sys, os
+        sys.path.insert(0, os.path.dirname(__file__))
+        from oracle import full_operator
+        rng = np.random.default_rng(3)
+        u, _ = np.linalg.qr(rng.normal(size=(4, 4))
+                            + 1j * rng.normal(size=(4, 4)))
+        got = pk.embed_lane_matrix(u, (2, 5), ctrl_mask=0b1001, flip_mask=0b1000)
+        want = full_operator(7, u, (2, 5), controls=(0, 3),
+                             control_states=(1, 0))
+        np.testing.assert_allclose(got, want, atol=1e-14)
+
+
+class TestExecution:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_random_circuit_matches_xla(self, env, seed):
+        c = alg.random_circuit(9, depth=6, seed=seed)
+        got = run(c, env, pallas="interpret")
+        want = run(c, env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_lane_and_mid_mix(self, env):
+        c = Circuit(9)
+        rng = np.random.default_rng(5)
+        for q in range(9):
+            c.rotate(q, float(rng.uniform(0, 6)), rng.normal(size=3))
+        c.cnot(0, 1).cz(5, 6).swap(2, 3)
+        for q in (7, 8):
+            c.rotate(q, 0.3 * q, (0.0, 1.0, 0.0))
+        got = run(c, env, pallas="interpret")
+        want = run(c, env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_same_mid_qubit_order_preserved(self, env):
+        c = Circuit(8)
+        c.h(0)
+        c.rx(7, 0.4)
+        c.rz(7, 1.1)       # diag on mid qubit; must compose after rx
+        c.ry(7, -0.2)
+        got = run(c, env, pallas="interpret")
+        want = run(c, env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_qft_through_layers(self, env):
+        got = run(alg.qft(8), env, pallas="interpret")
+        want = run(alg.qft(8), env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_layer_op_count_reduction(self, env):
+        c = alg.random_circuit(9, depth=8, seed=2)
+        cc_p = c.compile(env, pallas="interpret")
+        cc_x = c.compile(env, pallas=False)
+        n_layer = sum(1 for o in cc_p._ops
+                      if getattr(o, "kind", None) == "layer")
+        assert n_layer >= 1
+        assert len(cc_p._ops) < len(cc_x._ops)
