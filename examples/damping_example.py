@@ -1,0 +1,32 @@
+"""Single-qubit damping on a density register.
+
+Behavioral port of `/root/reference/examples/damping_example.c`: a 1-qubit
+density matrix in |+><+|, damped 10 times at probability 0.1, state printed
+after each application.
+
+Run: python examples/damping_example.py
+"""
+
+import quest_tpu as qt
+
+env = qt.createQuESTEnv()
+
+print("-------------------------------------------------------")
+print("Running QuEST-TPU damping example:")
+print("\t Basic circuit involving damping of a qubit.")
+print("-------------------------------------------------------")
+
+qubits = qt.createDensityQureg(1, env)
+qt.initPlusState(qubits)
+
+print("\n Reporting the qubit state to screen:")
+qt.reportStateToScreen(qubits, env, 0)
+
+print("\n Applying damping 10 times with probability 0.1")
+for counter in range(10):
+    qt.mixDamping(qubits, 0, 0.1)
+    print(f"\n Qubit state after applying damping {counter + 1} times:")
+    qt.reportStateToScreen(qubits, env, 0)
+
+qt.destroyQureg(qubits, env)
+qt.destroyQuESTEnv(env)

@@ -1,0 +1,77 @@
+"""QuEST-TPU tutorial: the reference's 3-qubit demo circuit.
+
+Behavioral port of `/root/reference/examples/tutorial_example.c:20-120`
+(same gates, same printed quantities) on the TPU-native framework — an
+existing QuEST user should recognise every line.
+
+Run: python examples/tutorial_example.py
+"""
+
+import numpy as np
+import quest_tpu as qt
+
+# prepare environment (once per program)
+env = qt.createQuESTEnv()
+
+print("-------------------------------------------------------")
+print("Running QuEST-TPU tutorial:")
+print("\t Basic circuit involving a system of 3 qubits.")
+print("-------------------------------------------------------")
+
+# prepare qubit system
+qubits = qt.createQureg(3, env)
+qt.initZeroState(qubits)
+
+# report system and environment
+print("\nThis is our environment:")
+qt.reportQuregParams(qubits)
+qt.reportQuESTEnv(env)
+
+# apply circuit
+qt.hadamard(qubits, 0)
+qt.controlledNot(qubits, 0, 1)
+qt.rotateY(qubits, 2, 0.1)
+
+qt.multiControlledPhaseFlip(qubits, [0, 1, 2])
+
+u = np.array([[0.5 + 0.5j, 0.5 - 0.5j],
+              [0.5 - 0.5j, 0.5 + 0.5j]])
+qt.unitary(qubits, 0, u)
+
+a = 0.5 + 0.5j
+b = 0.5 - 0.5j
+qt.compactUnitary(qubits, 1, a, b)
+
+v = (1.0, 0.0, 0.0)
+qt.rotateAroundAxis(qubits, 2, 3.14 / 2, v)
+
+qt.controlledCompactUnitary(qubits, 0, 1, a, b)
+
+qt.multiControlledUnitary(qubits, [0, 1], 2, u)
+
+toff = qt.createComplexMatrixN(3)          # a Toffoli as an explicit matrix
+for i in range(6):
+    toff[i, i] = 1.0
+toff[6, 7] = 1.0
+toff[7, 6] = 1.0
+qt.multiQubitUnitary(qubits, [0, 1, 2], toff)
+
+# study quantum state
+print("\nCircuit output:")
+
+prob = qt.getProbAmp(qubits, 7)
+print(f"Probability amplitude of |111>: {prob:f}")
+
+prob = qt.calcProbOfOutcome(qubits, 2, 1)
+print(f"Probability of qubit 2 being in state 1: {prob:f}")
+
+outcome = qt.measure(qubits, 0)
+print(f"Qubit 0 was measured in state {outcome}")
+
+outcome, prob = qt.measureWithStats(qubits, 2)
+print(f"Qubit 2 collapsed to {outcome} with probability {prob:f}")
+
+# free memory / close environment (no-ops here; kept for API parity)
+qt.destroyQureg(qubits, env)
+qt.destroyComplexMatrixN(toff)
+qt.destroyQuESTEnv(env)

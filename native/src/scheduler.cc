@@ -216,71 +216,83 @@ void plan(Sched& s, int lookahead) {
     return;
   }
 
+  // qubits a paired op needs local: targets plus controls (a control axis
+  // indexed on a sharded position degenerates to a full-remat scatter under
+  // GSPMD, so controls are relocalised best-effort too)
+  auto used_qubits = [](const Op& op) {
+    std::vector<int> qs;
+    if (!is_paired(op)) return qs;
+    qs = op.targets;
+    int64_t m = op.ctrl_mask;
+    for (int q = 0; m != 0; ++q, m >>= 1)
+      if (m & 1) qs.push_back(q);
+    return qs;
+  };
+
   const int64_t INF = static_cast<int64_t>(ops.size()) + 1;
-  // next paired-use table, next_use[i][q]
+  // next use (as target or control of a paired op), next_use[i][q]
   std::vector<std::vector<int64_t>> next_use(ops.size() + 1,
                                              std::vector<int64_t>(n, INF));
   for (int64_t i = static_cast<int64_t>(ops.size()) - 1; i >= 0; --i) {
     next_use[i] = next_use[i + 1];
-    if (is_paired(ops[i]))
-      for (int t : ops[i].targets) next_use[i][t] = i;
+    for (int q : used_qubits(ops[i])) next_use[i][q] = i;
   }
+
+  auto contains = [](const std::vector<int>& v, int q) {
+    return std::find(v.begin(), v.end(), q) != v.end();
+  };
 
   for (size_t i = 0; i < ops.size(); ++i) {
     const Op& op = ops[i];
-    if (is_paired(op)) {
-      std::vector<int> mandatory;
+    std::vector<int> used = used_qubits(op);
+    bool offending = false;
+    for (int q : used)
+      if (perm[q] >= local_top) offending = true;
+    if (offending) {
+      // everything needed now: sharded targets (hard), then sharded controls
+      std::vector<int> need_now;
       for (int t : op.targets)
-        if (perm[t] >= local_top) mandatory.push_back(t);
-      if (!mandatory.empty()) {
-        // hot sharded qubits over the lookahead window, stream order
-        std::vector<int> window_hot;
-        size_t wend = std::min(i + static_cast<size_t>(lookahead), ops.size());
-        for (size_t j = i; j < wend; ++j) {
-          if (!is_paired(ops[j])) continue;
-          for (int t : ops[j].targets)
-            if (perm[t] >= local_top &&
-                std::find(window_hot.begin(), window_hot.end(), t) ==
-                    window_hot.end())
-              window_hot.push_back(t);
-        }
-        // victims: local positions not targeted by this op, farthest
-        // next-use first (Belady)
-        std::vector<std::pair<int64_t, int>> locals_;
-        for (int l = 0; l < n; ++l) {
-          if (perm[l] >= local_top) continue;
-          if (std::find(op.targets.begin(), op.targets.end(), l) !=
-              op.targets.end())
-            continue;
-          locals_.emplace_back(next_use[i][l], l);
-        }
-        std::sort(locals_.begin(), locals_.end(),
-                  std::greater<std::pair<int64_t, int>>());
-        std::vector<int> bring = mandatory;
-        for (int t : window_hot)
-          if (std::find(bring.begin(), bring.end(), t) == bring.end())
-            bring.push_back(t);
-        if (bring.size() > locals_.size()) bring.resize(locals_.size());
-
-        std::vector<int> new_perm = perm;
-        size_t vi = 0;
-        for (int t : bring) {
-          if (vi >= locals_.size()) break;
-          auto [nu_victim, victim] = locals_[vi];
-          bool is_mand = std::find(mandatory.begin(), mandatory.end(), t) !=
-                         mandatory.end();
-          if (!is_mand && next_use[i][t] >= nu_victim) continue;
-          std::swap(new_perm[t], new_perm[victim]);
-          ++vi;
-        }
-        Item r;
-        r.is_relayout = true;
-        r.perm_before = perm;
-        r.perm_after = new_perm;
-        s.items.push_back(std::move(r));
-        ++s.num_relayouts;
-        perm = new_perm;
+        if (perm[t] >= local_top) need_now.push_back(t);
+      for (int q : used)
+        if (!contains(op.targets, q) && perm[q] >= local_top)
+          need_now.push_back(q);
+      // sharded qubits used in the lookahead window (prefetch)
+      std::vector<int> window_hot;
+      size_t wend = std::min(i + static_cast<size_t>(lookahead), ops.size());
+      for (size_t j = i; j < wend; ++j)
+        for (int q : used_qubits(ops[j]))
+          if (perm[q] >= local_top && !contains(window_hot, q) &&
+              !contains(need_now, q))
+            window_hot.push_back(q);
+      // victims: local positions not used by this op, farthest next use
+      // first (Belady)
+      std::vector<std::pair<int64_t, int>> locals_;
+      for (int l = 0; l < n; ++l) {
+        if (perm[l] >= local_top) continue;
+        if (contains(used, l)) continue;
+        locals_.emplace_back(next_use[i][l], l);
       }
+      std::sort(locals_.begin(), locals_.end(),
+                std::greater<std::pair<int64_t, int>>());
+      std::vector<int> bring = need_now;
+      for (int q : window_hot) bring.push_back(q);
+
+      std::vector<int> new_perm = perm;
+      size_t vi = 0;
+      for (int q : bring) {
+        if (vi >= locals_.size()) break;
+        auto [nu_victim, victim] = locals_[vi];
+        if (!contains(need_now, q) && next_use[i][q] >= nu_victim) continue;
+        std::swap(new_perm[q], new_perm[victim]);
+        ++vi;
+      }
+      Item r;
+      r.is_relayout = true;
+      r.perm_before = perm;
+      r.perm_after = new_perm;
+      s.items.push_back(std::move(r));
+      ++s.num_relayouts;
+      perm = new_perm;
     }
     s.items.push_back(op_item(static_cast<int>(i), op, perm));
   }

@@ -237,8 +237,9 @@ def _packed(qureg: Qureg, mat: np.ndarray) -> jnp.ndarray:
 
 
 def _shard(qureg: Qureg):
-    """Amplitude sharding for this register's env (None on single device)."""
-    return qureg.env.sharding()
+    """Amplitude sharding for this register's env (None on single device or
+    when the register is too small to split across the mesh)."""
+    return qureg.sharding()
 
 
 def _apply_gate(qureg: Qureg, u: np.ndarray, targets: Sequence[int],

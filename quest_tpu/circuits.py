@@ -527,8 +527,12 @@ class CompiledCircuit:
         self.num_qubits = circuit.num_qubits
         self.param_names = circuit.param_names
         n = circuit.num_qubits
-        sharding = env.sharding()
-        shard_bits = env.num_devices.bit_length() - 1
+        if (1 << n) < env.num_devices:   # register smaller than the mesh
+            sharding = None
+            shard_bits = 0
+        else:
+            sharding = env.sharding()
+            shard_bits = env.num_devices.bit_length() - 1
 
         # fuse + schedule gate positions over the mesh: lazy logical->
         # physical permutation with batched relayouts (native scheduler when
@@ -554,7 +558,7 @@ class CompiledCircuit:
 
         self._ops = ops
         plan_items = self.plan.items
-        flat_sharding = env.sharding_flat()
+        flat_sharding = env.sharding_flat() if shard_bits else None
 
         def run_plan(state, params):
             for item in plan_items:

@@ -100,60 +100,69 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
             f"{local_top} local qubit positions "
             f"(2^{max_k} amplitudes per gather > local shard)")
 
-    # next paired-use index per logical qubit, per position in the op stream
+    def used_qubits(op) -> tuple[int, ...]:
+        """Qubits a paired op needs local: targets, plus controls — a control
+        axis indexed on a sharded position degenerates to a scatter GSPMD can
+        only handle by full rematerialization, so controls are relocalised
+        (best-effort) too."""
+        if op.kind != "u":
+            return ()
+        qs = list(op.targets)
+        m, q = op.ctrl_mask, 0
+        while m:
+            if m & 1:
+                qs.append(q)
+            m >>= 1
+            q += 1
+        return tuple(qs)
+
+    # next use index (as target or control of a paired op) per logical qubit
     INF = len(ops) + 1
     next_use = np.full((len(ops) + 1, n), INF, dtype=np.int64)
     for i in range(len(ops) - 1, -1, -1):
         next_use[i] = next_use[i + 1]
-        if ops[i].kind == "u":
-            for t in ops[i].targets:
-                next_use[i, t] = i
+        for q in used_qubits(ops[i]):
+            next_use[i, q] = i
 
     perm = np.arange(n)  # perm[logical] = physical
     items: list = []
     n_relayouts = 0
 
     for i, op in enumerate(ops):
-        if op.kind == "u":
-            offending = [t for t in op.targets if perm[t] >= local_top]
-            if offending:
-                # gather all sharded logical qubits paired-used in the window,
-                # current op's targets first (they are mandatory)
-                window_hot = []
-                for j in range(i, min(i + lookahead, len(ops))):
-                    if ops[j].kind != "u":
-                        continue
-                    for t in ops[j].targets:
-                        if perm[t] >= local_top and t not in window_hot:
-                            window_hot.append(t)
-                mandatory = [t for t in op.targets if perm[t] >= local_top]
-                # victims: local positions whose logical qubit's next paired
-                # use is farthest (Belady); never evict this op's targets
-                locals_ = [(int(next_use[i, l]), l)
-                           for l in range(n)
-                           if perm[l] < local_top and l not in op.targets]
-                locals_.sort(reverse=True)
-                capacity = len(locals_)
-                bring = mandatory + [t for t in window_hot
-                                     if t not in mandatory]
-                bring = bring[:capacity]
-                new_perm = perm.copy()
-                vi = 0
-                for t in bring:
-                    if vi >= len(locals_):
-                        break
-                    nu_victim, victim = locals_[vi]
-                    # optional prefetches must not evict a sooner-used qubit
-                    if t not in mandatory and next_use[i, t] >= nu_victim:
-                        continue
-                    new_perm[t], new_perm[victim] = perm[victim], perm[t]
-                    vi += 1
-                items.append(("relayout", perm.copy(), new_perm.copy()))
-                n_relayouts += 1
-                perm = new_perm
-            items.append(_op_item(i, op, perm))
-        else:
-            items.append(_op_item(i, op, perm))
+        used = used_qubits(op)
+        if used and any(perm[q] >= local_top for q in used):
+            # everything this op needs now, targets (hard requirement) first
+            need_now = ([t for t in op.targets if perm[t] >= local_top]
+                        + [q for q in used if q not in op.targets
+                           and perm[q] >= local_top])
+            # plus sharded qubits used in the lookahead window (prefetch)
+            window_hot = []
+            for j in range(i, min(i + lookahead, len(ops))):
+                for q in used_qubits(ops[j]):
+                    if (perm[q] >= local_top and q not in window_hot
+                            and q not in need_now):
+                        window_hot.append(q)
+            # victims: local positions not used by this op, farthest next
+            # use first (Belady)
+            locals_ = [(int(next_use[i, l]), l)
+                       for l in range(n)
+                       if perm[l] < local_top and l not in used]
+            locals_.sort(reverse=True)
+            new_perm = perm.copy()
+            vi = 0
+            for q in need_now + window_hot:
+                if vi >= len(locals_):
+                    break
+                nu_victim, victim = locals_[vi]
+                # window prefetches must not evict a sooner-used qubit
+                if q not in need_now and next_use[i, q] >= nu_victim:
+                    continue
+                new_perm[q], new_perm[victim] = perm[victim], perm[q]
+                vi += 1
+            items.append(("relayout", perm.copy(), new_perm.copy()))
+            n_relayouts += 1
+            perm = new_perm
+        items.append(_op_item(i, op, perm))
 
     if not np.array_equal(perm, np.arange(n)):
         items.append(("relayout", perm.copy(), np.arange(n)))

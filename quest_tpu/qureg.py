@@ -66,6 +66,22 @@ class Qureg:
         """Storage dtype of the split re/im planes."""
         return self.env.precision.real_dtype
 
+    def sharding(self):
+        """Amplitude sharding for this register: the env mesh sharding, or
+        None when the register has fewer amplitudes than the mesh has devices
+        (a 1-qubit density register on an 8-device env stays replicated —
+        the analogue of the reference's numRanks <= 2^n requirement,
+        ``QuEST_cpu.c:1287``, relaxed to a fallback instead of an error)."""
+        if self.num_amps_total < self.env.num_devices:
+            return None
+        return self.env.sharding()
+
+    def sharding_flat(self):
+        """Same decision for the flat (2^N,) jit-internal complex form."""
+        if self.num_amps_total < self.env.num_devices:
+            return None
+        return self.env.sharding_flat()
+
     def device_put(self, host_array: np.ndarray) -> None:
         """Place a host complex array as the register state (packed to float
         planes), sharded over the mesh."""
@@ -75,7 +91,7 @@ class Qureg:
                 f"state array has shape {host_array.shape}; this register "
                 f"holds {self.num_amps_total} amplitudes")
         arr = jnp.asarray(pack_host(host_array, self.real_dtype))
-        sharding = self.env.sharding()
+        sharding = self.sharding()
         self._state = jax.device_put(arr, sharding) if sharding is not None else arr
 
     # -- convenience mirrors of the reference struct fields ---------------

@@ -66,6 +66,25 @@ class TestPlanner:
         # naive 2-exchanges-per-offending-gate (6+) of per-gate routing
         assert plan.num_relayouts <= 3
 
+    def test_controls_relocalised(self):
+        # a control on a sharded position triggers GSPMD full-remat scatter;
+        # the planner must pull controls local too
+        n, S = 8, 3
+        c = Circuit(n)
+        c.cnot(n - 1, 0)           # control on the top (sharded) qubit
+        c.gate(np.eye(2), (1,), controls=(n - 2,))
+        ops = make_ops(c)
+        plan = plan_layout(ops, n, S)
+        perm = np.arange(n)
+        for item in plan.items:
+            if item[0] == "relayout":
+                perm = item[2]
+                continue
+            _, i, phys_targets, cmask, _, _ = item
+            if ops[i].kind == "u":
+                assert all(p < n - S for p in phys_targets)
+                assert cmask < (1 << (n - S)), f"sharded control: {cmask:b}"
+
     def test_too_large_unitary_rejected(self):
         n, S = 6, 4   # only 2 local positions
         c = Circuit(n)
@@ -140,6 +159,18 @@ class TestShardedSemantics:
                 [0.7, -0.3])
             vals.append(float(f(np.array([0.41]))))
         assert vals[0] == pytest.approx(vals[1], abs=1e-10)
+
+    def test_small_register_on_big_mesh(self, mesh_env):
+        # 1-qubit density register (4 amps) on an 8-device env: replicated,
+        # not an error (relaxed numRanks <= 2^n, QuEST_cpu.c:1287)
+        d = qt.createDensityQureg(1, mesh_env)
+        qt.initPlusState(d)
+        qt.mixDamping(d, 0, 0.1)
+        assert abs(qt.calcTotalProb(d) - 1.0) < 1e-10
+        q = qt.createQureg(2, mesh_env)
+        qt.initZeroState(q)
+        alg.ghz(2).compile(mesh_env).run(q)
+        assert abs(qt.calcProbOfOutcome(q, 1, 1) - 0.5) < 1e-10
 
     def test_relayout_actually_planned(self, mesh_env):
         n = 7
