@@ -74,14 +74,15 @@ def main() -> None:
 
     gates_per_sec = n_gates * trials / dt
 
+    dtype = str(np.dtype(env.precision.complex_dtype))
     # A100 HBM-roofline baseline at the same width/precision
-    bytes_per_amp_pass = 16.0            # 8 B/amp complex64: read + write
+    bytes_per_amp_pass = 4.0 * np.dtype(env.precision.real_dtype).itemsize
     a100_bw = 2.0e12
     baseline = a100_bw / (bytes_per_amp_pass * (1 << num_qubits))
 
     print(json.dumps({
         "metric": f"1q+CNOT gate throughput, {num_qubits}-qubit statevector, "
-                  f"complex64, single {platform} chip",
+                  f"{dtype}, single {platform} chip",
         "value": round(gates_per_sec, 2),
         "unit": "gates/sec",
         "vs_baseline": round(gates_per_sec / baseline, 4),

@@ -385,14 +385,81 @@ class Circuit:
         return fused
 
     def compile(self, env: QuESTEnv, donate: bool = True, fuse: bool = True,
-                lookahead: int = 32,
-                pallas: Optional[object] = None) -> "CompiledCircuit":
+                lookahead: int = 32, pallas: Optional[object] = None,
+                supergate_k: int = 4) -> "CompiledCircuit":
         """Compile to one XLA program; ``lookahead`` is the layout planner's
         relayout-batching window (quest_tpu.parallel.layout); ``pallas``
         controls the fused-layer kernel pass (None=auto on TPU,
         "interpret"=interpreted kernels, False=off)."""
         return CompiledCircuit(self, env, donate=donate, fuse=fuse,
-                               lookahead=lookahead, pallas=pallas)
+                               lookahead=lookahead, pallas=pallas,
+                               supergate_k=supergate_k)
+
+
+def _group_supergates(ops: list, max_k: int = 4,
+                      fold_diags: bool = True) -> list:
+    """Merge consecutive static gates into k-qubit super-gates.
+
+    Every gate costs one full pass over the 2^n amplitudes, so L consecutive
+    gates whose combined qubit support (targets + controls) fits in ``max_k``
+    qubits collapse into one 2^k x 2^k operator — one pass instead of L, and
+    a fatter matmul (better MXU shape). Order is preserved: each member is
+    kron-embedded into the group support and composed left-to-right.
+    Parameterized ops and LayerOps break groups.
+    """
+    if max_k < 2:
+        return ops
+
+    out: list = []
+    group: list = []
+    support: set = set()
+
+    def op_qubits(op) -> set:
+        qs = set(op.targets)
+        m, q = op.ctrl_mask, 0
+        while m:
+            if m & 1:
+                qs.add(q)
+            m >>= 1
+            q += 1
+        return qs
+
+    def flush():
+        nonlocal support
+        if len(group) <= 1:
+            out.extend(group)
+        else:
+            sup = tuple(sorted(support))
+            m = np.eye(1 << len(sup), dtype=np.complex128)
+            for op in group:
+                if op.kind == "u":
+                    e = mats.embed_in_support(op.mat, op.targets, sup,
+                                              op.ctrl_mask, op.flip_mask)
+                else:
+                    e = mats.diag_in_support(np.asarray(op.diag),
+                                             op.targets, sup)
+                m = e @ m
+            out.append(_Op("u", sup, 0, 0, mat=m))
+        group.clear()
+        support = set()
+
+    for op in ops:
+        kinds = ("u", "diag") if fold_diags else ("u",)
+        if getattr(op, "kind", None) not in kinds or not op.is_static:
+            flush()
+            out.append(op)
+            continue
+        qs = op_qubits(op)
+        if len(qs) > max_k:
+            flush()
+            out.append(op)
+            continue
+        if len(support | qs) > max_k:
+            flush()
+        group.append(op)
+        support |= qs
+    flush()
+    return out
 
 
 def _collect_layers(ops: list, num_qubits: int,
@@ -521,7 +588,8 @@ class CompiledCircuit:
 
     def __init__(self, circuit: Circuit, env: QuESTEnv,
                  donate: bool = True, fuse: bool = True,
-                 lookahead: int = 32, pallas: Optional[object] = None):
+                 lookahead: int = 32, pallas: Optional[object] = None,
+                 supergate_k: int = 4):
         self.circuit = circuit
         self.env = env
         self.num_qubits = circuit.num_qubits
@@ -551,10 +619,27 @@ class CompiledCircuit:
         enabled = pallas not in (False, "0", "off") and (
             interpret or jax.default_backend() == "tpu")
         self._pallas_interpret = interpret
+        replan = False
         if enabled and shard_bits == 0 and n >= 7:
-            from .parallel import plan_layout
             ops = _collect_layers(ops, n)
-            self.plan = plan_layout(ops, n, 0, lookahead=lookahead)
+            replan = True
+
+        # super-gate grouping: consecutive static gates collapse into one
+        # k-qubit pass (runs after layer collection so lane/mid runs prefer
+        # the Pallas kernel). On a mesh, diagonal ops stay separate — they
+        # are communication-free at any position, and folding one into a
+        # dense super-gate would force relocalisation it never needed.
+        if supergate_k >= 2:
+            k_eff = min(supergate_k, n - shard_bits) if shard_bits else \
+                supergate_k
+            if k_eff >= 2:
+                before = len(ops)
+                ops = _group_supergates(ops, k_eff,
+                                        fold_diags=(shard_bits == 0))
+                replan = replan or len(ops) != before
+        if replan:
+            from .parallel import plan_layout
+            self.plan = plan_layout(ops, n, shard_bits, lookahead=lookahead)
 
         self._ops = ops
         plan_items = self.plan.items

@@ -48,6 +48,7 @@ __all__ = [
     "apply_unitary",
     "apply_diagonal",
     "bitmask",
+    "permutation_to_order",
     "permutation_to_sorted_desc",
     "split_shape",
 ]
@@ -77,6 +78,25 @@ def split_shape(num_qubits: int, positions_desc: Sequence[int]) -> tuple[int, ..
         upper = p
     shape.append(1 << upper)
     return tuple(shape)
+
+
+def permutation_to_order(targets: Sequence[int],
+                         order: Sequence[int]) -> np.ndarray:
+    """Index permutation re-expressing a gate matrix in a new bit order.
+
+    The input matrix indexes bit ``j`` by ``targets[j]``; the output indexes
+    bit ``i`` by ``order[i]`` (same qubit set). ``perm[m_new] = m_old``.
+    """
+    targets = tuple(targets)
+    k = len(targets)
+    perm = np.zeros(1 << k, dtype=np.int64)
+    for mp in range(1 << k):
+        m = 0
+        for i, q in enumerate(order):
+            if (mp >> i) & 1:
+                m |= 1 << targets.index(q)
+        perm[mp] = m
+    return perm
 
 
 def permutation_to_sorted_desc(targets: Sequence[int]) -> np.ndarray:
@@ -123,6 +143,33 @@ def apply_unitary(
     with jax.named_scope(
             f"gate_u{k}q_t{'_'.join(map(str, targets))}"
             + (f"_c{len(controls)}" if controls else "")):
+        # --- no-transpose fast paths (uncontrolled, contiguous ends) ------
+        # A gate on the lowest k qubits is a plain right-matmul on the
+        # (rest, 2^k) view; on the highest k, a left-matmul on (2^k, rest).
+        # Either costs exactly one read+write pass — the generic path below
+        # pays materialised transposes around the matmul.
+        if not controls and set(targets) == set(range(k)):
+            u = jnp.asarray(u, dtype=state.dtype)
+            if targets != tuple(range(k)):
+                perm_asc = permutation_to_order(targets, tuple(range(k)))
+                u = u[perm_asc][:, perm_asc]
+            s = state.reshape(-1, 1 << k)
+            out = jnp.matmul(s, u.T, precision=jax.lax.Precision.HIGHEST)
+            return out.reshape(-1)
+        lo = min(targets) if targets else 0
+        if not controls and set(targets) == set(range(lo, lo + k)):
+            # contiguous block [lo, lo+k): batched matmul on the
+            # (pre, 2^k, post) view — bit i of the middle index is qubit
+            # lo+i. pre==1 and post==1 degenerate to plain left-matmuls.
+            u = jnp.asarray(u, dtype=state.dtype)
+            order = tuple(range(lo, lo + k))
+            if targets != order:
+                perm_o = permutation_to_order(targets, order)
+                u = u[perm_o][:, perm_o]
+            s = state.reshape(-1, 1 << k, 1 << lo)
+            out = jnp.matmul(u, s, precision=jax.lax.Precision.HIGHEST)
+            return out.reshape(-1)
+
         pos_desc = tuple(sorted(targets + controls, reverse=True))
         shape = split_shape(num_qubits, pos_desc)
         axis_of = {p: 2 * i + 1 for i, p in enumerate(pos_desc)}

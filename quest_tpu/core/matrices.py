@@ -36,6 +36,8 @@ __all__ = [
     "matrix2",
     "matrix4",
     "unit_vector",
+    "embed_in_support",
+    "diag_in_support",
 ]
 
 _I = np.eye(2, dtype=np.complex128)
@@ -113,6 +115,66 @@ def sqrt_swap(conj: bool = False) -> np.ndarray:
     m[1, 1] = m[2, 2] = 0.5 + 0.5j
     m[1, 2] = m[2, 1] = 0.5 - 0.5j
     return m.conj() if conj else m
+
+
+def embed_in_support(u: np.ndarray, targets, support,
+                     ctrl_mask: int = 0, flip_mask: int = 0) -> np.ndarray:
+    """Embed a (controlled) gate into the full operator over ``support``.
+
+    ``support`` lists qubits; bit ``j`` of the output matrix index addresses
+    ``support[j]`` (same ComplexMatrixN convention as gate targets). All of
+    ``targets`` and the control qubits must be members of ``support``.
+    Controls condition on 1 unless their bit is set in ``flip_mask``.
+    """
+    support = list(support)
+    pos = {q: j for j, q in enumerate(support)}
+    k = len(support)
+    dim = 1 << k
+    t_local = [pos[t] for t in targets]
+    c_local = 0
+    f_local = 0
+    m, q = ctrl_mask, 0
+    while m:
+        if m & 1:
+            c_local |= 1 << pos[q]
+            if (flip_mask >> q) & 1:
+                f_local |= 1 << pos[q]
+        m >>= 1
+        q += 1
+    t_mask = 0
+    for t in t_local:
+        t_mask |= 1 << t
+    want = c_local & ~f_local
+    full = np.zeros((dim, dim), dtype=np.complex128)
+    for col in range(dim):
+        if (col & c_local) != want:
+            full[col, col] = 1.0
+            continue
+        m_in = 0
+        for j, t in enumerate(t_local):
+            if (col >> t) & 1:
+                m_in |= 1 << j
+        base = col & ~t_mask
+        for m_out in range(1 << len(t_local)):
+            row = base
+            for j, t in enumerate(t_local):
+                if (m_out >> j) & 1:
+                    row |= 1 << t
+            full[row, col] += u[m_out, m_in]
+    return full
+
+
+def diag_in_support(tensor: np.ndarray, qubits_desc, support) -> np.ndarray:
+    """Embed a diagonal factor ((2,)*k tensor, axes = qubits sorted desc)
+    as a diagonal operator over ``support`` (bit j <-> support[j])."""
+    support = list(support)
+    dim = 1 << len(support)
+    pos = {q: j for j, q in enumerate(support)}
+    d = np.ones(dim, dtype=np.complex128)
+    for idx in range(dim):
+        key = tuple((idx >> pos[q]) & 1 for q in qubits_desc)
+        d[idx] = tensor[key]
+    return np.diag(d)
 
 
 def matrix2(u) -> np.ndarray:

@@ -1,0 +1,34 @@
+"""Build hook: compile the native scheduler into the package tree.
+
+The C++ scheduler (native/src/scheduler.cc) is optional — the pure-Python
+planner is a full fallback — so a missing compiler degrades gracefully
+rather than failing the install. (The runtime also builds it on demand at
+first import; see quest_tpu/native/__init__.py.)
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        root = Path(__file__).parent
+        src = root / "native" / "src" / "scheduler.cc"
+        out = root / "quest_tpu" / "native" / "libquest_sched.so"
+        if src.exists():
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
+                     "-o", str(out), str(src)],
+                    check=True, timeout=300)
+            except (subprocess.SubprocessError, OSError) as e:
+                print(f"warning: native scheduler build skipped ({e}); "
+                      "the pure-Python planner will be used", file=sys.stderr)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

@@ -92,8 +92,8 @@ class TestCircuitVsApi:
         # consecutive diagonals on different qubits (fused into one pass)
         c.z(1).s(2).t(1).phase(2, 0.3)
         c.cnot(0, 1)
-        fused = c.compile(env, fuse=True)
-        plain = c.compile(env, fuse=False)
+        fused = c.compile(env, fuse=True, supergate_k=0)
+        plain = c.compile(env, fuse=False, supergate_k=0)
         assert len(fused._ops) < len(plain._ops)
         q1 = qt.createQureg(3, env)
         q2 = qt.createQureg(3, env)
