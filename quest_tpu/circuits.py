@@ -75,6 +75,7 @@ class _Op:
     mat_fn: Optional[Callable] = None
     diag: Optional[np.ndarray] = None
     diag_fn: Optional[Callable] = None
+    kraus: Optional[list] = None   # kind "kraus": channel operators
 
     @property
     def is_static(self) -> bool:
@@ -321,6 +322,82 @@ class Circuit:
                 self.z(q)
         return self
 
+    # -- channels (density-register circuits) ------------------------------
+
+    def kraus(self, ops: Sequence, targets: Sequence[int]) -> "Circuit":
+        """Record a Kraus channel (density compilation only): the map
+        ``rho -> sum_k K_k rho K_k^dag``. Lifts to one superoperator pass
+        on the flattened density vector (``QuEST_common.c:540-604``)."""
+        from . import validation as val
+        targets = tuple(int(t) for t in targets)
+        self._check(targets)
+        mats_l = [np.asarray(m, dtype=np.complex128) for m in ops]
+        val.validate_kraus_ops(mats_l, len(targets), "Circuit.kraus")
+        self.ops.append(_Op("kraus", targets, kraus=mats_l))
+        return self
+
+    def dephase(self, q: int, prob: float) -> "Circuit":
+        """rho -> (1-p) rho + p Z rho Z (mixDephasing semantics)."""
+        return self.kraus([np.sqrt(1 - prob) * np.eye(2),
+                           np.sqrt(prob) * mats.pauli_z()], (q,))
+
+    def depolarise(self, q: int, prob: float) -> "Circuit":
+        return self.kraus(
+            [np.sqrt(1 - prob) * np.eye(2),
+             np.sqrt(prob / 3) * mats.pauli_x(),
+             np.sqrt(prob / 3) * mats.pauli_y(),
+             np.sqrt(prob / 3) * mats.pauli_z()], (q,))
+
+    def damp(self, q: int, prob: float) -> "Circuit":
+        """Amplitude damping at rate ``prob`` (mixDamping semantics)."""
+        return self.kraus(
+            [np.array([[1.0, 0.0], [0.0, np.sqrt(1 - prob)]]),
+             np.array([[0.0, np.sqrt(prob)], [0.0, 0.0]])], (q,))
+
+    def _lifted_density(self) -> "Circuit":
+        """Rewrite this n-qubit program as a 2n-qubit program on the
+        flattened density vector: U becomes conj(U) (x) U on
+        (targets, targets+n) in ONE pass (the reference needs two backend
+        calls per gate, ``QuEST.c:175-658``); controlled gates keep the
+        two-pass form (row and column controls condition independently,
+        ``QuEST.c:352-357``); channels become superoperators."""
+        n = self.num_qubits
+        out = Circuit(2 * n)
+        out._params = list(self._params)
+        for op in self.ops:
+            if op.kind == "kraus":
+                t2 = op.targets + tuple(t + n for t in op.targets)
+                sup = sum(np.kron(np.conj(k), k) for k in op.kraus)
+                out.ops.append(_Op("u", t2, mat=sup))
+            elif op.kind == "u":
+                shifted = tuple(t + n for t in op.targets)
+                if op.ctrl_mask == 0 and op.mat_fn is None:
+                    out.ops.append(_Op("u", op.targets + shifted,
+                                       mat=np.kron(np.conj(op.mat), op.mat)))
+                elif op.mat_fn is None:
+                    out.ops.append(dataclasses.replace(op))
+                    out.ops.append(_Op("u", shifted, op.ctrl_mask << n,
+                                       op.flip_mask << n,
+                                       mat=np.conj(op.mat)))
+                else:
+                    out.ops.append(dataclasses.replace(op))
+                    out.ops.append(_Op(
+                        "u", shifted, op.ctrl_mask << n, op.flip_mask << n,
+                        mat_fn=lambda p, f=op.mat_fn: jnp.conj(f(p))))
+            else:
+                shifted = tuple(t + n for t in op.targets)
+                t2 = shifted + op.targets   # sorted desc overall
+                if op.diag_fn is None:
+                    out.ops.append(_Op("diag", t2,
+                                       diag=np.multiply.outer(
+                                           np.conj(op.diag), op.diag)))
+                else:
+                    out.ops.append(_Op(
+                        "diag", t2,
+                        diag_fn=lambda p, f=op.diag_fn: jnp.tensordot(
+                            jnp.conj(f(p)), f(p), axes=0)))
+        return out
+
     # -- composition -------------------------------------------------------
 
     def extend(self, other: "Circuit") -> "Circuit":
@@ -386,12 +463,23 @@ class Circuit:
 
     def compile(self, env: QuESTEnv, donate: bool = True, fuse: bool = True,
                 lookahead: int = 32, pallas: Optional[object] = None,
-                supergate_k: int = 4) -> "CompiledCircuit":
+                supergate_k: int = 4,
+                density: bool = False) -> "CompiledCircuit":
         """Compile to one XLA program; ``lookahead`` is the layout planner's
         relayout-batching window (quest_tpu.parallel.layout); ``pallas``
         controls the fused-layer kernel pass (None=auto on TPU,
-        "interpret"=interpreted kernels, False=off)."""
-        return CompiledCircuit(self, env, donate=donate, fuse=fuse,
+        "interpret"=interpreted kernels, False=off); ``density=True``
+        compiles the program for density registers (gates lift to
+        superoperator form; Kraus channels allowed)."""
+        if density:
+            circ = self._lifted_density()
+        else:
+            if any(op.kind == "kraus" for op in self.ops):
+                raise ValueError(
+                    "circuit contains Kraus channels; compile with "
+                    "density=True and run on a density register")
+            circ = self
+        return CompiledCircuit(circ, env, donate=donate, fuse=fuse,
                                lookahead=lookahead, pallas=pallas,
                                supergate_k=supergate_k)
 

@@ -1,0 +1,112 @@
+"""Density-register circuit compilation: gates lift to superoperator form,
+Kraus channels fold in, and the whole noisy program runs as one executable —
+must match the per-gate API path exactly."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+
+
+def api_reference(env, n, build):
+    d = qt.createDensityQureg(n, env)
+    qt.initPlusState(d)
+    build(d)
+    return d.to_numpy()
+
+
+def run_compiled(env, n, circ, params=None, **kw):
+    d = qt.createDensityQureg(n, env)
+    qt.initPlusState(d)
+    circ.compile(env, density=True, **kw).run(d, params=params)
+    return d.to_numpy()
+
+
+class TestDensityCompilation:
+    def test_gates_and_channels_match_api(self, env):
+        n = 3
+        c = Circuit(n)
+        c.h(0).cnot(0, 1).rz(2, 0.5).t(1)
+        c.dephase(0, 0.2).depolarise(1, 0.15).damp(2, 0.3)
+        c.cz(0, 2)
+
+        def api(d):
+            qt.hadamard(d, 0)
+            qt.controlledNot(d, 0, 1)
+            qt.rotateZ(d, 2, 0.5)
+            qt.tGate(d, 1)
+            qt.mixDephasing(d, 0, 0.2)
+            qt.mixDepolarising(d, 1, 0.15)
+            qt.mixDamping(d, 2, 0.3)
+            qt.controlledPhaseFlip(d, 0, 2)
+
+        np.testing.assert_allclose(run_compiled(env, n, c),
+                                   api_reference(env, n, api), atol=1e-10)
+
+    def test_custom_kraus_matches_mixKrausMap(self, env):
+        n = 2
+        rng = np.random.default_rng(4)
+        u, _ = np.linalg.qr(rng.normal(size=(2, 2))
+                            + 1j * rng.normal(size=(2, 2)))
+        k0 = np.sqrt(0.85) * np.eye(2)
+        k1 = np.sqrt(0.15) * u
+        c = Circuit(n)
+        c.h(0).kraus([k0, k1], (1,))
+
+        def api(d):
+            qt.hadamard(d, 0)
+            qt.mixKrausMap(d, 1, [k0, k1])
+
+        np.testing.assert_allclose(run_compiled(env, n, c),
+                                   api_reference(env, n, api), atol=1e-10)
+
+    def test_controlled_and_param_lift(self, env):
+        n = 3
+        c = Circuit(n)
+        t = c.parameter("t")
+        c.h(0).ry(1, t).crz(0, 2, 0.7)
+        c.gate(np.diag([1.0, 1j]).astype(complex), (1,), controls=(2,),
+               control_states=(0,))
+
+        def api(d):
+            qt.hadamard(d, 0)
+            qt.rotateY(d, 1, 0.9)
+            qt.controlledRotateZ(d, 0, 2, 0.7)
+            qt.multiStateControlledUnitary(d, [2], [0], 1, np.diag([1.0, 1j]))
+
+        np.testing.assert_allclose(
+            run_compiled(env, n, c, params={"t": 0.9}),
+            api_reference(env, n, api), atol=1e-10)
+
+    def test_trace_preserved_under_noise(self, env):
+        n = 4
+        c = Circuit(n)
+        for q in range(n):
+            c.h(q)
+            c.depolarise(q, 0.2)
+            c.damp(q, 0.1)
+        d = qt.createDensityQureg(n, env)
+        qt.initZeroState(d)
+        c.compile(env, density=True).run(d)
+        assert qt.calcTotalProb(d) == pytest.approx(1.0, abs=1e-10)
+        assert qt.calcPurity(d) < 1.0
+
+    def test_sharded_density_matches_single(self, env, mesh_env):
+        n = 4
+        c = Circuit(n)
+        c.h(0).cnot(0, 3).dephase(3, 0.25).crz(1, 2, 0.3).damp(0, 0.2)
+        a = run_compiled(env, n, c)
+        b = run_compiled(mesh_env, n, c)
+        np.testing.assert_allclose(b, a, atol=1e-10)
+
+    def test_kraus_in_statevec_compile_rejected(self, env):
+        c = Circuit(2)
+        c.h(0).dephase(0, 0.1)
+        with pytest.raises(ValueError, match="density"):
+            c.compile(env)
+
+    def test_invalid_kraus_rejected(self):
+        c = Circuit(2)
+        with pytest.raises(qt.QuESTError):
+            c.kraus([np.eye(2) * 2.0], (0,))   # not trace-preserving
