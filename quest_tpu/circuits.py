@@ -327,32 +327,36 @@ class Circuit:
     def kraus(self, ops: Sequence, targets: Sequence[int]) -> "Circuit":
         """Record a Kraus channel (density compilation only): the map
         ``rho -> sum_k K_k rho K_k^dag``. Lifts to one superoperator pass
-        on the flattened density vector (``QuEST_common.c:540-604``)."""
-        from . import validation as val
+        on the flattened density vector (``QuEST_common.c:540-604``).
+        CPTP validation happens at compile time, at the environment's
+        precision tolerance."""
         targets = tuple(int(t) for t in targets)
         self._check(targets)
         mats_l = [np.asarray(m, dtype=np.complex128) for m in ops]
-        val.validate_kraus_ops(mats_l, len(targets), "Circuit.kraus")
         self.ops.append(_Op("kraus", targets, kraus=mats_l))
         return self
 
     def dephase(self, q: int, prob: float) -> "Circuit":
-        """rho -> (1-p) rho + p Z rho Z (mixDephasing semantics)."""
+        """rho -> (1-p) rho + p Z rho Z (mixDephasing semantics; max prob
+        1/2, ``QuEST_validation.c:108``)."""
+        from . import validation as val
+        val.validate_prob(prob, "Circuit.dephase", 0.5)
         return self.kraus([np.sqrt(1 - prob) * np.eye(2),
                            np.sqrt(prob) * mats.pauli_z()], (q,))
 
     def depolarise(self, q: int, prob: float) -> "Circuit":
-        return self.kraus(
-            [np.sqrt(1 - prob) * np.eye(2),
-             np.sqrt(prob / 3) * mats.pauli_x(),
-             np.sqrt(prob / 3) * mats.pauli_y(),
-             np.sqrt(prob / 3) * mats.pauli_z()], (q,))
+        """Homogeneous depolarising (mixDepolarising semantics; max 3/4)."""
+        from . import validation as val
+        from .ops import channels as chan
+        val.validate_prob(prob, "Circuit.depolarise", 0.75)
+        return self.kraus(chan.depolarising_kraus(prob), (q,))
 
     def damp(self, q: int, prob: float) -> "Circuit":
         """Amplitude damping at rate ``prob`` (mixDamping semantics)."""
-        return self.kraus(
-            [np.array([[1.0, 0.0], [0.0, np.sqrt(1 - prob)]]),
-             np.array([[0.0, np.sqrt(prob)], [0.0, 0.0]])], (q,))
+        from . import validation as val
+        from .ops import channels as chan
+        val.validate_prob(prob, "Circuit.damp", 1.0)
+        return self.kraus(chan.damping_kraus(prob), (q,))
 
     def _lifted_density(self) -> "Circuit":
         """Rewrite this n-qubit program as a 2n-qubit program on the
@@ -366,9 +370,9 @@ class Circuit:
         out._params = list(self._params)
         for op in self.ops:
             if op.kind == "kraus":
+                from .ops.densmatr import kraus_superoperator
                 t2 = op.targets + tuple(t + n for t in op.targets)
-                sup = sum(np.kron(np.conj(k), k) for k in op.kraus)
-                out.ops.append(_Op("u", t2, mat=sup))
+                out.ops.append(_Op("u", t2, mat=kraus_superoperator(op.kraus)))
             elif op.kind == "u":
                 shifted = tuple(t + n for t in op.targets)
                 if op.ctrl_mask == 0 and op.mat_fn is None:
@@ -472,6 +476,12 @@ class Circuit:
         compiles the program for density registers (gates lift to
         superoperator form; Kraus channels allowed)."""
         if density:
+            from . import validation as val
+            for op in self.ops:
+                if op.kind == "kraus":
+                    val.validate_kraus_ops(op.kraus, len(op.targets),
+                                           "Circuit.kraus",
+                                           env.precision.eps)
             circ = self._lifted_density()
         else:
             if any(op.kind == "kraus" for op in self.ops):
@@ -479,9 +489,11 @@ class Circuit:
                     "circuit contains Kraus channels; compile with "
                     "density=True and run on a density register")
             circ = self
-        return CompiledCircuit(circ, env, donate=donate, fuse=fuse,
-                               lookahead=lookahead, pallas=pallas,
-                               supergate_k=supergate_k)
+        cc = CompiledCircuit(circ, env, donate=donate, fuse=fuse,
+                             lookahead=lookahead, pallas=pallas,
+                             supergate_k=supergate_k)
+        cc.is_density = density
+        return cc
 
 
 def _group_supergates(ops: list, max_k: int = 4,
@@ -782,8 +794,17 @@ class CompiledCircuit:
 
     # -- execution ---------------------------------------------------------
 
+    is_density = False   # set by Circuit.compile(density=True)
+
     def run(self, qureg: Qureg, params: Optional[dict] = None) -> None:
         """Apply in place (the donated buffer is reused by XLA)."""
+        if qureg.is_density_matrix != self.is_density:
+            if self.is_density:
+                raise ValueError("this circuit was compiled with "
+                                 "density=True; run it on a density register")
+            raise ValueError(
+                "running a statevector-compiled circuit on a density "
+                "register; compile with density=True")
         if qureg.num_qubits_in_state_vec != self.num_qubits:
             raise ValueError(
                 f"circuit has {self.num_qubits} qubits; register state vector "

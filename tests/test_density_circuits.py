@@ -106,7 +106,28 @@ class TestDensityCompilation:
         with pytest.raises(ValueError, match="density"):
             c.compile(env)
 
-    def test_invalid_kraus_rejected(self):
+    def test_invalid_kraus_rejected_at_compile(self, env):
+        c = Circuit(2)
+        c.kraus([np.eye(2) * 2.0], (0,))       # not trace-preserving
+        with pytest.raises(qt.QuESTError):
+            c.compile(env, density=True)
+
+    def test_register_type_mismatch_rejected(self, env):
+        c = Circuit(2)
+        c.h(0)
+        dc = c.compile(env, density=True)      # 4-qubit lifted program
+        sv = qt.createQureg(4, env)            # same state-vec size
+        with pytest.raises(ValueError, match="density register"):
+            dc.run(sv)
+        d = qt.createDensityQureg(2, env)
+        with pytest.raises(ValueError, match="density=True"):
+            c.compile(env).run(d)
+
+    def test_prob_caps_match_api(self):
         c = Circuit(2)
         with pytest.raises(qt.QuESTError):
-            c.kraus([np.eye(2) * 2.0], (0,))   # not trace-preserving
+            c.dephase(0, 0.6)                  # cap 1/2
+        with pytest.raises(qt.QuESTError):
+            c.depolarise(0, 0.8)               # cap 3/4
+        with pytest.raises(qt.QuESTError):
+            c.damp(0, 1.2)                     # cap 1
