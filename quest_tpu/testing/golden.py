@@ -63,14 +63,39 @@ class Spec:
     ``cases(n)`` yields argument tuples (python values, matrices included);
     ``encode``/``decode`` map them to/from flat text tokens; ``density_only``
     restricts to density registers (noise channels); ``returns`` marks
-    value-returning functions (checked with R).
-    """
+    value-returning functions (checked with R); ``aux`` names a deterministic
+    auxiliary-register builder (appended as the trailing argument and NOT
+    encoded — rebuilt identically at replay): one of ``"pure_plus"``,
+    ``"pure_debug"``, ``"same_kind_debug"``, ``"density_plus"``."""
     cases: Callable[[int], list[tuple]]
     encode: Callable[[tuple], list[str]]
     decode: Callable[[list[str]], tuple]
     density_only: bool = False
     statevec_only: bool = False
     returns: bool = False
+    aux: Optional[str] = None
+
+
+def _build_aux(kind: str, qtype: str, n: int, env):
+    """Deterministic auxiliary register per Spec.aux."""
+    if kind == "pure_plus":
+        p = qt.createQureg(n, env)
+        qt.initPlusState(p)
+        return p
+    if kind == "pure_debug":
+        p = qt.createQureg(n, env)
+        qt.initDebugState(p)
+        return p
+    if kind == "same_kind_debug":
+        p = qt.createDensityQureg(n, env) if qtype.isupper() \
+            else qt.createQureg(n, env)
+        qt.initDebugState(p)
+        return p
+    if kind == "density_plus":
+        p = qt.createDensityQureg(n, env)
+        qt.initPlusState(p)
+        return p
+    raise ValueError(kind)
 
 
 def _enc_simple(args: tuple) -> list[str]:
@@ -260,31 +285,79 @@ GATE_SPECS: dict[str, Spec] = {
         density_only=True),
 }
 
-# mixKrausMap takes a *list* of matrices: encode flattens both into one
-# block, decode must re-split — override its codec
-def _enc_kraus(args):
-    t, ops = args
-    out = [str(t), f"k{len(ops)}"]
-    for m in ops:
-        out += _enc_simple((m,))
-    return out
+# Kraus-map functions take a *list* of matrices after some plain int/tuple
+# args: encode the leading args normally, then a "k<count>" marker and the
+# matrices; decode re-splits.
+def _kraus_codec(n_lead: int):
+    def enc(args):
+        lead, ops = args[:n_lead], args[n_lead]
+        out = _enc_simple(lead) + [f"k{len(ops)}"]
+        for m in ops:
+            out += _enc_simple((m,))
+        return out
+
+    def dec(tokens):
+        ki = next(i for i, t in enumerate(tokens)
+                  if t.startswith("k") and t[1:].isdigit())
+        lead = _dec_simple(tokens[:ki])
+        count = int(tokens[ki][1:])
+        rest = tokens[ki + 1:]
+        ops = []
+        for _ in range(count):
+            n_ent = int(rest[0][1:])
+            (m,) = _dec_simple(rest[:1 + 2 * n_ent])
+            ops.append(m)
+            rest = rest[1 + 2 * n_ent:]
+        return lead + (ops,)
+
+    return enc, dec
 
 
-def _dec_kraus(tokens):
-    t = int(tokens[0])
-    count = int(tokens[1][1:])
-    rest = tokens[2:]
-    ops = []
-    for _ in range(count):
-        n_ent = int(rest[0][1:])
-        (m,) = _dec_simple(rest[:1 + 2 * n_ent])
-        ops.append(m)
-        rest = rest[1 + 2 * n_ent:]
-    return (t, ops)
-
-
+_enc_k1, _dec_k1 = _kraus_codec(1)
 GATE_SPECS["mixKrausMap"] = dataclasses.replace(
-    GATE_SPECS["mixKrausMap"], encode=_enc_kraus, decode=_dec_kraus)
+    GATE_SPECS["mixKrausMap"], encode=_enc_k1, decode=_dec_k1)
+
+
+def _kraus_4(seed: int) -> list[np.ndarray]:
+    xx = np.kron(mats_pauli_x(), mats_pauli_x())
+    p = 0.1 + 0.02 * (seed % 3)
+    return [np.sqrt(1 - p) * np.eye(4, dtype=np.complex128),
+            np.sqrt(p) * xx.astype(np.complex128)]
+
+
+def _kraus_8() -> list[np.ndarray]:
+    x = mats_pauli_x()
+    xxx = np.kron(x, np.kron(x, x))
+    return [np.sqrt(0.8) * np.eye(8, dtype=np.complex128),
+            np.sqrt(0.2) * xxx.astype(np.complex128)]
+
+
+def mats_pauli_x() -> np.ndarray:
+    return np.array([[0.0, 1.0], [1.0, 0.0]], dtype=np.complex128)
+
+
+_enc_k2, _dec_k2 = _kraus_codec(2)
+_enc_kN, _dec_kN = _kraus_codec(1)
+
+GATE_SPECS.update({
+    "mixTwoQubitKrausMap": Spec(
+        cases=lambda n: [(a, b, _kraus_4(a + n * b)) for a, b in _pairs(n)],
+        encode=_enc_k2, decode=_dec_k2, density_only=True),
+    "mixMultiQubitKrausMap": Spec(
+        cases=lambda n: [((0, 1, 2), _kraus_8())],
+        encode=_enc_kN, decode=_dec_kN, density_only=True),
+    # two-register functions: the trailing register is rebuilt from Spec.aux
+    "calcFidelity": _spec(lambda n: [()], returns=True, aux="pure_plus"),
+    "calcInnerProduct": _spec(lambda n: [()], returns=True,
+                              statevec_only=True, aux="pure_debug"),
+    "calcDensityInnerProduct": _spec(lambda n: [()], returns=True,
+                                     density_only=True, aux="density_plus"),
+    "calcHilbertSchmidtDistance": _spec(lambda n: [()], returns=True,
+                                        density_only=True, aux="density_plus"),
+    "mixDensityMatrix": _spec(lambda n: [(0.3,)], density_only=True,
+                              aux="density_plus"),
+    "initPureState": _spec(lambda n: [()], aux="pure_plus"),
+})
 
 
 # ---------------------------------------------------------------------------
@@ -321,9 +394,21 @@ def _prepare(qtype: str, n: int, env) -> "qt.Qureg":
     return q
 
 
-def _apply(fn_name: str, q, args: tuple):
-    """Call the API function; returns its value (or None)."""
+def _apply(fn_name: str, q, args: tuple, spec: "Spec", qtype: str,
+           n: int, env):
+    """Call the API function (building the aux register if the spec has
+    one); returns its value (or None)."""
+    if spec.aux is not None:
+        args = args + (_build_aux(spec.aux, qtype, n, env),)
     return getattr(qt, fn_name)(q, *args)
+
+
+def _ret_values(ret) -> np.ndarray:
+    """Flatten a scalar/complex/sequence return into comparable floats."""
+    arr = np.atleast_1d(np.asarray(ret))
+    if np.iscomplexobj(arr):
+        arr = np.stack([arr.real, arr.imag], -1).reshape(-1)
+    return arr.astype(np.float64)
 
 
 def _measurements(q, n: int) -> list[float]:
