@@ -441,7 +441,7 @@ def generate_files(outdir: str, env, names: Optional[Sequence[str]] = None,
             use_checks = checks if not spec.returns else checks + "R"
             q = _prepare(qt_variant, num_qubits, env)
             try:
-                ret = _apply(name, q, args)
+                ret = _apply(name, q, args, spec, qt_variant, num_qubits, env)
             except qt.QuESTError:
                 # validation rejections (e.g. collapse to a zero-probability
                 # outcome) are themselves golden: every config must reject
@@ -462,7 +462,7 @@ def generate_files(outdir: str, env, names: Optional[Sequence[str]] = None,
                 for a in amps:
                     lines_out.append(f"{float(a.real)!r} {float(a.imag)!r}")
             if "R" in use_checks:
-                vals = np.atleast_1d(np.asarray(ret, dtype=np.float64))
+                vals = _ret_values(ret)
                 lines_out.append("R " + " ".join(repr(float(v)) for v in vals))
         path = os.path.join(outdir, f"{name}.test")
         with open(path, "w") as f:
@@ -506,12 +506,12 @@ def run_file(path: str, env, tol: float = 1e-10) -> list[GoldenFailure]:
 
         if use_checks == "E":
             try:
-                _apply(name, q, args)
+                _apply(name, q, args, spec, qt_variant, n, env)
                 fail("E", "expected QuESTError, none raised")
             except qt.QuESTError:
                 pass
             continue
-        ret = _apply(name, q, args)
+        ret = _apply(name, q, args, spec, qt_variant, n, env)
 
         for check in use_checks:
             if check == "P":
@@ -538,7 +538,7 @@ def run_file(path: str, env, tol: float = 1e-10) -> list[GoldenFailure]:
                     fail("S", f"state max|Δ|={err:.3e}")
             elif check == "R":
                 want = [float(x) for x in lines[i].split()[1:]]; i += 1
-                got = np.atleast_1d(np.asarray(ret, dtype=np.float64))
+                got = _ret_values(ret)
                 if np.max(np.abs(got - np.array(want))) > tol:
                     fail("R", f"return {got} != {want}")
     return failures
