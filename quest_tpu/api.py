@@ -19,6 +19,7 @@ gate application stays asynchronous on device.
 from __future__ import annotations
 
 import functools
+import math
 import numbers
 from typing import Optional, Sequence
 
@@ -29,10 +30,11 @@ import numpy as np
 from . import validation as val
 from .config import Precision
 from .core import matrices as mats
-from .core.apply import apply_diagonal, apply_unitary
+from .core.apply import apply_diagonal, apply_unitary, split_shape
 from .env import QuESTEnv, create_quest_env, destroy_quest_env
 from .ops import channels as chan
 from .ops import densmatr as dm
+from .ops import reductions as red
 from .ops import statevec as sv
 from .qureg import Qureg
 from .types import PauliOpType, QuESTError
@@ -191,6 +193,73 @@ def _jit_prob_outcome_dm(state_f, num_qubits, qubit, outcome):
     return dm.calc_prob_of_outcome(unpack(state_f), num_qubits, qubit, outcome)
 
 
+# -- compensated (pair-returning) variants: error-free reductions whose
+# (sum, err) output is combined by the caller in host double precision —
+# the float32-register route to the reference's 1e-10 scalar tolerances
+# (Kahan analogue, ``QuEST_cpu_distributed.c:87-109``; ops/reductions.py)
+
+def _pair(pair) -> float:
+    s, e = pair
+    return float(s) + float(e)
+
+
+@jax.jit
+def _jit_pair_sum_sq(state_f):
+    return red.dot_pair(state_f, state_f)
+
+
+def _dm_diag_real(state_f, num_qubits):
+    dim = 1 << num_qubits
+    return jnp.diagonal(state_f[0].reshape(dim, dim))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _jit_pair_total_prob_dm(state_f, num_qubits):
+    return red.sum_pair(_dm_diag_real(state_f, num_qubits))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _jit_pair_prob_outcome_sv(state_f, num_qubits, qubit, outcome):
+    pre, _, post = split_shape(num_qubits, (qubit,))
+    sub = state_f.reshape(2, pre, 2, post)[:, :, outcome, :]
+    return red.dot_pair(sub, sub)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _jit_pair_prob_zero_dm(state_f, num_qubits, qubit):
+    diag = _dm_diag_real(state_f, num_qubits)
+    return red.sum_pair(diag.reshape(split_shape(num_qubits, (qubit,)))[:, 0, :])
+
+
+@jax.jit
+def _jit_pair_inner_product(bra_f, ket_f):
+    return red.vdot_pair(unpack(bra_f), unpack(ket_f))
+
+
+@jax.jit
+def _jit_pair_dm_inner(a_f, b_f):
+    re_pair, _ = red.vdot_pair(unpack(a_f), unpack(b_f))
+    return re_pair
+
+
+@jax.jit
+def _jit_pair_hs_sq(a_f, b_f):
+    d = a_f - b_f
+    return red.dot_pair(d, d)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _jit_pair_fidelity_dm(state_f, num_qubits, pure_f):
+    # rho|psi> via the MXU (f32 matvec rounding remains), then an
+    # error-free final dot
+    flat, psi = unpack(state_f), unpack(pure_f)
+    dim = 1 << num_qubits
+    rho_psi = jnp.einsum("cr,r->c", flat.reshape(dim, dim), psi,
+                         precision=jax.lax.Precision.HIGHEST)
+    re_pair, _ = red.vdot_pair(psi, rho_psi)
+    return re_pair
+
+
 @_state_kernel(static_argnums=(1, 2, 3))
 def _jit_collapse_sv(state_f, num_qubits, qubit, outcome, prob):
     return pack(sv.collapse_to_known_prob_outcome(
@@ -294,8 +363,10 @@ def _apply_diag_gate(qureg: Qureg, tensor: np.ndarray,
 
 def createQuESTEnv(num_devices: Optional[int] = None,
                    precision: Optional[Precision] = None,
-                   seed: Optional[Sequence[int]] = None) -> QuESTEnv:
-    return create_quest_env(num_devices=num_devices, precision=precision, seed=seed)
+                   seed: Optional[Sequence[int]] = None,
+                   compensated: Optional[bool] = None) -> QuESTEnv:
+    return create_quest_env(num_devices=num_devices, precision=precision,
+                            seed=seed, compensated=compensated)
 
 
 def destroyQuESTEnv(env: QuESTEnv) -> None:
@@ -984,6 +1055,13 @@ def applyPauliSum(in_qureg: Qureg, all_codes: Sequence[int],
 def calcProbOfOutcome(qureg: Qureg, qubit: int, outcome: int) -> float:
     val.validate_target(qureg.num_qubits_represented, qubit, "calcProbOfOutcome")
     val.validate_outcome(outcome, "calcProbOfOutcome")
+    if qureg.env.compensated:
+        if qureg.is_density_matrix:
+            p0 = _pair(_jit_pair_prob_zero_dm(
+                qureg.state, qureg.num_qubits_represented, qubit))
+            return p0 if outcome == 0 else 1.0 - p0
+        return _pair(_jit_pair_prob_outcome_sv(
+            qureg.state, qureg.num_qubits_in_state_vec, qubit, outcome))
     if qureg.is_density_matrix:
         p = _jit_prob_outcome_dm(qureg.state, qureg.num_qubits_represented,
                                  qubit, outcome)
@@ -1083,6 +1161,11 @@ def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
 
 
 def calcTotalProb(qureg: Qureg) -> float:
+    if qureg.env.compensated:
+        if qureg.is_density_matrix:
+            return _pair(_jit_pair_total_prob_dm(
+                qureg.state, qureg.num_qubits_represented))
+        return _pair(_jit_pair_sum_sq(qureg.state))
     if qureg.is_density_matrix:
         return float(_jit_total_prob_dm(qureg.state,
                                         qureg.num_qubits_represented))
@@ -1094,6 +1177,9 @@ def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
     val.validate_state_vec(ket.is_density_matrix, "calcInnerProduct")
     val.validate_matching_dims(bra.num_qubits_represented,
                                ket.num_qubits_represented, "calcInnerProduct")
+    if bra.env.compensated:
+        re_pair, im_pair = _jit_pair_inner_product(bra.state, ket.state)
+        return complex(_pair(re_pair), _pair(im_pair))
     re, im = _jit_inner_product(bra.state, ket.state)
     return complex(float(re), float(im))
 
@@ -1104,11 +1190,15 @@ def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
     val.validate_matching_dims(rho1.num_qubits_represented,
                                rho2.num_qubits_represented,
                                "calcDensityInnerProduct")
+    if rho1.env.compensated:
+        return _pair(_jit_pair_dm_inner(rho1.state, rho2.state))
     return float(_jit_dm_inner(rho1.state, rho2.state))
 
 
 def calcPurity(qureg: Qureg) -> float:
     val.validate_density_matr(qureg.is_density_matrix, "calcPurity")
+    if qureg.env.compensated:
+        return _pair(_jit_pair_sum_sq(qureg.state))
     return float(_jit_purity(qureg.state))
 
 
@@ -1118,9 +1208,16 @@ def calcFidelity(qureg: Qureg, pure_state: Qureg) -> float:
                                pure_state.num_qubits_represented,
                                "calcFidelity")
     if qureg.is_density_matrix:
+        if qureg.env.compensated:
+            return _pair(_jit_pair_fidelity_dm(
+                qureg.state, qureg.num_qubits_represented, pure_state.state))
         return float(_jit_fidelity_dm(qureg.state,
                                       qureg.num_qubits_represented,
                                       pure_state.state))
+    if qureg.env.compensated:
+        re_pair, im_pair = _jit_pair_inner_product(qureg.state,
+                                                   pure_state.state)
+        return _pair(re_pair) ** 2 + _pair(im_pair) ** 2
     re, im = _jit_inner_product(qureg.state, pure_state.state)
     return float(re) ** 2 + float(im) ** 2
 
@@ -1131,6 +1228,8 @@ def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
     val.validate_matching_dims(a.num_qubits_represented,
                                b.num_qubits_represented,
                                "calcHilbertSchmidtDistance")
+    if a.env.compensated:
+        return math.sqrt(max(0.0, _pair(_jit_pair_hs_sq(a.state, b.state))))
     return float(_jit_hs_dist(a.state, b.state))
 
 

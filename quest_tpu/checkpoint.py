@@ -15,12 +15,10 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Optional
 
 import jax
 import numpy as np
 
-from .env import QuESTEnv
 from .qureg import Qureg
 
 __all__ = ["save", "load", "save_npz", "load_npz"]
@@ -46,6 +44,12 @@ def _check_meta(meta: dict, qureg: Qureg) -> None:
             f"register; target register is "
             f"{qureg.num_qubits_represented}-qubit "
             f"{'density' if qureg.is_density_matrix else 'statevector'}")
+    saved_prec = meta.get("precision")
+    if saved_prec is not None and saved_prec != qureg.env.precision.name:
+        raise ValueError(
+            f"checkpoint was saved in {saved_prec} precision; target "
+            f"register uses {qureg.env.precision.name} — create the env "
+            f"with precision={saved_prec} (or re-save) to restore")
 
 
 def save(qureg: Qureg, path: str) -> None:
@@ -63,10 +67,9 @@ def save(qureg: Qureg, path: str) -> None:
         json.dump(_meta(qureg), f)
 
 
-def load(qureg: Qureg, path: str, env: Optional[QuESTEnv] = None) -> None:
+def load(qureg: Qureg, path: str) -> None:
     """Restore a checkpoint into ``qureg`` (re-sharding onto its env's mesh
     as needed)."""
-    env = env or qureg.env
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         if os.path.exists(path + ".npz"):
@@ -77,7 +80,9 @@ def load(qureg: Qureg, path: str, env: Optional[QuESTEnv] = None) -> None:
     with open(os.path.join(path, _META_NAME)) as f:
         _check_meta(json.load(f), qureg)
     shape = (2, qureg.num_amps_total)
-    sharding = env.sharding()
+    # the register's own sharding decision (falls back to replicated for
+    # registers smaller than the mesh — mirrors Qureg.device_put)
+    sharding = qureg.sharding()
     if sharding is None:
         sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     target = jax.ShapeDtypeStruct(shape, qureg.real_dtype, sharding=sharding)

@@ -38,6 +38,11 @@ class QuESTEnv:
     precision: Precision
     mesh: Optional[Mesh] = None
     key: jax.Array = None  # type: ignore[assignment]
+    # error-compensated scalar reductions (TwoSum cascade,
+    # ops/reductions.py) — the runtime analogue of the reference's Kahan
+    # summation (``QuEST_cpu_distributed.c:87-109``); restores
+    # 1e-10-class totals/inner-products for single-precision registers
+    compensated: bool = False
 
     @property
     def num_devices(self) -> int:
@@ -105,13 +110,20 @@ def create_quest_env(
     num_devices: Optional[int] = None,
     precision: Optional[Precision] = None,
     seed: Optional[Sequence[int]] = None,
+    compensated: Optional[bool] = None,
 ) -> QuESTEnv:
     """Create the execution environment (``createQuESTEnv`` ``QuEST.h:785``).
 
     ``num_devices=None`` uses all local devices when more than one is present
     (as the reference's MPI build uses all ranks), else single-device.
+    ``compensated=None`` enables TwoSum-compensated scalar reductions
+    automatically for single precision (where naive float32 accumulation
+    falls ~5 decades short of the reference's 1e-10 tolerance) and disables
+    them for double.
     """
     precision = precision or default_precision()
+    if compensated is None:
+        compensated = precision.quest_prec == 1
     devices = jax.devices()
     n = len(devices) if num_devices is None else num_devices
     if n > len(devices):
@@ -122,7 +134,7 @@ def create_quest_env(
             raise ValueError("the device count must be a power of 2 "
                              "(amplitude sharding halves per device)")
         mesh = Mesh(np.asarray(devices[:n]), (AMP_AXIS,))
-    env = QuESTEnv(precision=precision, mesh=mesh)
+    env = QuESTEnv(precision=precision, mesh=mesh, compensated=compensated)
     if seed is not None:
         env.seed(seed)
     else:

@@ -1,1 +1,1 @@
-from . import statevec, densmatr, channels  # noqa: F401
+from . import statevec, densmatr, channels, reductions  # noqa: F401

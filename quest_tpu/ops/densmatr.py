@@ -15,11 +15,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.apply import apply_diagonal, apply_unitary, split_shape
-from . import statevec as sv
 
 __all__ = [
-    "init_plus_state",
-    "init_classical_state",
     "init_pure_state",
     "calc_total_prob",
     "calc_prob_of_outcome",
@@ -41,19 +38,6 @@ def _as_matrix(flat, num_qubits):
     because columns occupy the high index bits)."""
     dim = 1 << num_qubits
     return flat.reshape(dim, dim)
-
-
-def init_plus_state(num_qubits: int, dtype) -> jnp.ndarray:
-    """|+><+|: every element 1/2^n (``QuEST_cpu.c:1159``)."""
-    dim = 1 << (2 * num_qubits)
-    return jnp.full(dim, 1.0 / (1 << num_qubits), dtype=dtype)
-
-
-def init_classical_state(num_qubits: int, state_ind: int, dtype) -> jnp.ndarray:
-    """|s><s|: single 1 on the diagonal (``QuEST_cpu.c:1120``)."""
-    dim = 1 << (2 * num_qubits)
-    ind = state_ind * ((1 << num_qubits) + 1)
-    return jnp.zeros(dim, dtype=dtype).at[ind].set(1.0)
 
 
 def init_pure_state(pure_state) -> jnp.ndarray:

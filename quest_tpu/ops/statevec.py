@@ -1,180 +1,38 @@
-"""Pure-functional statevector operations.
+"""Pure-functional statevector operations (jit-internal backend layer).
 
-This is the TPU implementation of the reference's backend contract for
-``statevec_*`` ops (``QuEST_internal.h:108-246``): every function takes a flat
-amplitude array plus static qubit metadata and returns a new array. Under jit,
-XLA fuses these into single memory passes; under a sharded mesh the same code
-lowers to ICI collectives.
+TPU implementation of the reduction / data-movement / collapse slice of the
+reference's ``statevec_*`` backend contract (``QuEST_internal.h:108-246``).
+Every function takes a flat complex amplitude array plus static qubit
+metadata and returns a new array; under jit XLA fuses these into single
+memory passes, and under a sharded mesh the same code lowers to ICI
+collectives.
+
+Unitary/diagonal gate application does NOT live here: gates route through
+the axis-contraction engine (``core/apply.py``) via the API layer and the
+circuit compiler — one engine subsumes the reference's entire per-gate
+kernel family (``QuEST_cpu.c:1662-3114``). State initialisation is host-side
+in the API layer (``api.py:initZeroState`` etc.): inits are one-time
+host→device transfers, not compiled kernels.
 
 All ops are dtype-preserving and jit-compatible (static ints/tuples only).
 """
 
 from __future__ import annotations
 
-from typing import Sequence
-
 import numpy as np
 import jax.numpy as jnp
 
-from ..core.apply import apply_unitary, apply_diagonal, split_shape
-from ..core import matrices as mats
+from ..core.apply import split_shape
 
 __all__ = [
-    "init_blank_state",
-    "init_zero_state",
-    "init_plus_state",
-    "init_classical_state",
-    "init_debug_state",
-    "init_state_of_single_qubit",
-    "unitary",
-    "compact_unitary",
-    "pauli_x",
-    "pauli_y",
-    "pauli_z",
-    "hadamard",
-    "s_gate",
-    "t_gate",
-    "phase_shift",
-    "controlled_phase_shift",
-    "multi_controlled_phase_shift",
-    "controlled_phase_flip",
-    "multi_controlled_phase_flip",
-    "multi_rotate_z",
+    "multi_rotate_z_diag",
     "swap_amps",
     "calc_total_prob",
     "calc_inner_product",
     "calc_prob_of_outcome",
     "collapse_to_known_prob_outcome",
     "set_weighted",
-    "get_amp",
 ]
-
-
-# ---------------------------------------------------------------------------
-# initialisation (QuEST_cpu.c:1372-1598)
-# ---------------------------------------------------------------------------
-
-def init_blank_state(num_qubits: int, dtype) -> jnp.ndarray:
-    return jnp.zeros(1 << num_qubits, dtype=dtype)
-
-
-def init_zero_state(num_qubits: int, dtype) -> jnp.ndarray:
-    return init_classical_state(num_qubits, 0, dtype)
-
-
-def init_plus_state(num_qubits: int, dtype) -> jnp.ndarray:
-    dim = 1 << num_qubits
-    amp = 1.0 / np.sqrt(dim)
-    return jnp.full(dim, amp, dtype=dtype)
-
-
-def init_classical_state(num_qubits: int, state_ind: int, dtype) -> jnp.ndarray:
-    dim = 1 << num_qubits
-    return jnp.zeros(dim, dtype=dtype).at[state_ind].set(1.0)
-
-
-def init_debug_state(num_qubits: int, dtype) -> jnp.ndarray:
-    """amp[i] = (2i + i(2i+1))/10 — deterministic unnormalised fixture
-    (``QuEST_cpu.c:1565-1592``)."""
-    dim = 1 << num_qubits
-    idx = np.arange(dim, dtype=np.float64)
-    re = (2.0 * idx) / 10.0
-    im = (2.0 * idx + 1.0) / 10.0
-    return jnp.asarray(re + 1j * im, dtype=dtype)
-
-
-def init_state_of_single_qubit(num_qubits: int, qubit: int, outcome: int, dtype) -> jnp.ndarray:
-    """Qubit fixed to ``outcome``; the rest in uniform superposition
-    (``QuEST_cpu.c:1519``)."""
-    shape = split_shape(num_qubits, (qubit,))
-    norm = 1.0 / np.sqrt(1 << (num_qubits - 1))
-    col = np.zeros((1, 2, 1), dtype=np.complex128)
-    col[0, outcome, 0] = norm
-    return jnp.broadcast_to(jnp.asarray(col, dtype=dtype), shape).reshape(-1)
-
-
-# ---------------------------------------------------------------------------
-# unitaries
-# ---------------------------------------------------------------------------
-
-def unitary(
-    state, num_qubits: int, u, targets: Sequence[int],
-    ctrl_mask: int = 0, flip_mask: int = 0,
-) -> jnp.ndarray:
-    """General k-qubit (multi-controlled) unitary — subsumes the reference's
-    unitary/controlledUnitary/multiControlledUnitary/twoQubitUnitary/
-    multiQubitUnitary kernel family."""
-    return apply_unitary(state, num_qubits, u, tuple(targets), ctrl_mask, flip_mask)
-
-
-def compact_unitary(state, num_qubits, alpha, beta, target, ctrl_mask=0):
-    return unitary(state, num_qubits, mats.compact_unitary(alpha, beta), (target,), ctrl_mask)
-
-
-def pauli_x(state, num_qubits, target, ctrl_mask=0):
-    return unitary(state, num_qubits, mats.pauli_x(), (target,), ctrl_mask)
-
-
-def pauli_y(state, num_qubits, target, ctrl_mask=0, conj=False):
-    return unitary(state, num_qubits, mats.pauli_y(conj), (target,), ctrl_mask)
-
-
-def hadamard(state, num_qubits, target):
-    return unitary(state, num_qubits, mats.hadamard(), (target,))
-
-
-def _diag_on(state, num_qubits, qubits, one_factors):
-    """Diagonal gate: qubit ``qubits[i]``'s |1> component scaled by
-    ``one_factors[i]`` multiplicatively (outer product over qubits)."""
-    qs = sorted(qubits, reverse=True)
-    tensor = np.ones((2,) * len(qs), dtype=np.complex128)
-    for i, q in enumerate(qs):
-        f = one_factors[qubits.index(q)]
-        sl = [slice(None)] * len(qs)
-        sl[i] = 1
-        tensor[tuple(sl)] *= f
-    return apply_diagonal(state, num_qubits, qs, tensor)
-
-
-def pauli_z(state, num_qubits, target):
-    return _diag_on(state, num_qubits, (target,), (-1.0,))
-
-
-def s_gate(state, num_qubits, target, conj=False):
-    return _diag_on(state, num_qubits, (target,), (-1j if conj else 1j,))
-
-
-def t_gate(state, num_qubits, target, conj=False):
-    ph = np.exp(-1j * np.pi / 4) if conj else np.exp(1j * np.pi / 4)
-    return _diag_on(state, num_qubits, (target,), (ph,))
-
-
-def phase_shift(state, num_qubits, target, angle):
-    return _diag_on(state, num_qubits, (target,), (np.exp(1j * angle),))
-
-
-def controlled_phase_shift(state, num_qubits, q1, q2, angle):
-    return multi_controlled_phase_shift(state, num_qubits, (q1, q2), angle)
-
-
-def multi_controlled_phase_shift(state, num_qubits, qubits, angle):
-    """exp(i angle) phase on amplitudes where *all* listed qubits are 1
-    (``QuEST_cpu.c:3025``)."""
-    qs = tuple(sorted(qubits, reverse=True))
-    tensor = np.ones((2,) * len(qs), dtype=np.complex128)
-    tensor[(1,) * len(qs)] = np.exp(1j * angle)
-    return apply_diagonal(state, num_qubits, qs, tensor)
-
-
-def controlled_phase_flip(state, num_qubits, q1, q2):
-    return multi_controlled_phase_flip(state, num_qubits, (q1, q2))
-
-
-def multi_controlled_phase_flip(state, num_qubits, qubits):
-    qs = tuple(sorted(qubits, reverse=True))
-    tensor = np.ones((2,) * len(qs), dtype=np.complex128)
-    tensor[(1,) * len(qs)] = -1.0
-    return apply_diagonal(state, num_qubits, qs, tensor)
 
 
 def multi_rotate_z_diag(k: int, angle: float) -> np.ndarray:
@@ -186,12 +44,6 @@ def multi_rotate_z_diag(k: int, angle: float) -> np.ndarray:
         parity ^= (idx >> b) & 1
     fac = np.where(parity == 0, np.exp(-0.5j * angle), np.exp(0.5j * angle))
     return fac.reshape((2,) * k)
-
-
-def multi_rotate_z(state, num_qubits, qubits, angle):
-    """amp *= exp(-i angle/2 * (-1)^parity(bits))."""
-    qs = tuple(sorted(qubits, reverse=True))
-    return apply_diagonal(state, num_qubits, qs, multi_rotate_z_diag(len(qs), angle))
 
 
 def swap_amps(state, num_qubits, q1, q2):
@@ -207,9 +59,10 @@ def swap_amps(state, num_qubits, q1, q2):
 # ---------------------------------------------------------------------------
 
 def calc_total_prob(state) -> jnp.ndarray:
-    """Sum of |amp|^2. XLA owns the reduction tree (no hand-rolled Kahan as in
-    ``QuEST_cpu_distributed.c:96-109``; accumulation is float32/float64 per
-    the register precision)."""
+    """Sum of |amp|^2. XLA owns the reduction tree; the error-compensated
+    route (the reference's Kahan analogue,
+    ``QuEST_cpu_distributed.c:96-109``) lives in ``ops.reductions`` and is
+    selected by the API layer via ``env.compensated``."""
     return jnp.sum(jnp.real(state) ** 2 + jnp.imag(state) ** 2)
 
 
@@ -241,7 +94,3 @@ def set_weighted(fac1, state1, fac2, state2, fac_out, out):
     f2 = jnp.asarray(fac2, dtype=out.dtype)
     fo = jnp.asarray(fac_out, dtype=out.dtype)
     return f1 * state1 + f2 * state2 + fo * out
-
-
-def get_amp(state, index):
-    return state[index]

@@ -59,6 +59,27 @@ class TestCheckpoint:
         with pytest.raises(ValueError, match="statevector"):
             ckpt.load(dens, str(tmp_path / "ck"))
 
+    def test_small_register_on_mesh_restore(self, mesh_env, tmp_path):
+        # a register with fewer amplitudes than the mesh has devices stays
+        # replicated (Qureg.sharding fallback); load must honour that
+        q = qt.createDensityQureg(1, mesh_env)   # 4 amps < 8 devices
+        qt.initPlusState(q)
+        qt.mixDephasing(q, 0, 0.3)
+        want = q.to_numpy()
+        ckpt.save(q, str(tmp_path / "tiny"))
+        q2 = qt.createDensityQureg(1, mesh_env)
+        ckpt.load(q2, str(tmp_path / "tiny"))
+        np.testing.assert_allclose(q2.to_numpy(), want, atol=0)
+
+    def test_precision_mismatch_rejected(self, env, tmp_path):
+        q = self._prepared(env, 3)
+        ckpt.save(q, str(tmp_path / "ck"))
+        env32 = qt.createQuESTEnv(num_devices=1, seed=[1],
+                                  precision=qt.SINGLE)
+        other = qt.createQureg(3, env32)
+        with pytest.raises(ValueError, match="precision"):
+            ckpt.load(other, str(tmp_path / "ck"))
+
     def test_npz_roundtrip(self, env, tmp_path):
         q = self._prepared(env)
         want = q.to_numpy()

@@ -1,0 +1,61 @@
+"""Measure single-precision accuracy vs circuit depth (VERDICT r1 #6).
+
+Runs the same random brickwork circuit (bench.py's workload) at f32 and f64
+on CPU, and reports per-depth:
+  - max |amp_f32 - amp_f64| over the full state (per-gate rounding drift);
+  - calcTotalProb absolute error in f32, naive vs compensated reduction,
+    against the f64 value.
+
+Usage: python tools/accuracy_table.py [num_qubits] [depths...]
+Writes a markdown table to stdout (pasted into docs/accuracy.md).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+from bench import build_bench_circuit  # noqa: E402
+
+
+def run(num_qubits: int, layers: int, precision, compensated: bool):
+    env = qt.createQuESTEnv(num_devices=1, seed=[2026], precision=precision,
+                            compensated=compensated)
+    q = qt.createQureg(num_qubits, env)
+    qt.initPlusState(q)
+    circ, n_gates = build_bench_circuit(num_qubits, layers)
+    circ.compile(env).run(q)
+    return q.to_numpy(), qt.calcTotalProb(q), n_gates
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    layer_list = [int(a) for a in sys.argv[2:]] or [2, 8, 32, 64]
+    print(f"| gates (at {n}q) | max state |Δ| f32 vs f64 "
+          f"| reduction err, naive f32 | reduction err, compensated f32 "
+          f"| totalProb err vs f64 golden (comp) |")
+    print("|---|---|---|---|---|")
+    for layers in layer_list:
+        ref, p_ref, n_gates = run(n, layers, qt.DOUBLE, False)
+        s_naive, p_naive, _ = run(n, layers, qt.SINGLE, False)
+        _, p_comp, _ = run(n, layers, qt.SINGLE, True)
+        state_err = float(np.max(np.abs(s_naive - ref)))
+        # exact (f64 host) totalProb of the *same* f32 state isolates
+        # reduction error from per-gate amplitude drift
+        p_exact_f32 = float(np.sum(np.abs(s_naive.astype(np.complex128)) ** 2))
+        print(f"| {n_gates} | {state_err:.2e} "
+              f"| {abs(p_naive - p_exact_f32):.2e} "
+              f"| {abs(p_comp - p_exact_f32):.2e} "
+              f"| {abs(p_comp - p_ref):.2e} |")
+
+
+if __name__ == "__main__":
+    main()
