@@ -74,6 +74,10 @@ class Spec:
     statevec_only: bool = False
     returns: bool = False
     aux: Optional[str] = None
+    # deterministically re-seed the env's RNG before each call — makes
+    # sampling functions (measure/measureWithStats) golden-testable, the
+    # reference's broadcast-seeded-mt19937 strategy (`QuEST_common.c:181`)
+    reseed: bool = False
 
 
 def _build_aux(kind: str, qtype: str, n: int, env):
@@ -187,6 +191,10 @@ def _ctrl_target_angle(n):
 
 def _pairs(n):
     return [(a, b) for a in range(n) for b in range(n) if a != b]
+
+
+def _amp_indices(n):
+    return [(i,) for i in range(1 << n)]
 
 
 GATE_SPECS: dict[str, Spec] = {
@@ -357,6 +365,23 @@ GATE_SPECS.update({
     "mixDensityMatrix": _spec(lambda n: [(0.3,)], density_only=True,
                               aux="density_plus"),
     "initPureState": _spec(lambda n: [()], aux="pure_plus"),
+    # getter tier (reference goldens: tests/unit/state_vector/maths/getAmp*
+    # and friends)
+    "getAmp": _spec(_amp_indices, returns=True, statevec_only=True),
+    "getRealAmp": _spec(_amp_indices, returns=True, statevec_only=True),
+    "getImagAmp": _spec(_amp_indices, returns=True, statevec_only=True),
+    "getProbAmp": _spec(_amp_indices, returns=True, statevec_only=True),
+    "getDensityAmp": _spec(
+        lambda n: [(r, c) for r in range(1 << n) for c in (0, (1 << n) - 1)],
+        returns=True, density_only=True),
+    "getNumAmps": _spec(lambda n: [()], returns=True, statevec_only=True),
+    "getNumQubits": _spec(lambda n: [()], returns=True),
+    # seeded-sampling tier (reference goldens: measure.test,
+    # measureWithStats.test — deterministic via the broadcast seed)
+    "measure": _spec(lambda n: [(t,) for t in range(n)],
+                     returns=True, reseed=True),
+    "measureWithStats": _spec(lambda n: [(t,) for t in range(n)],
+                              returns=True, reseed=True),
 })
 
 
@@ -400,6 +425,9 @@ def _apply(fn_name: str, q, args: tuple, spec: "Spec", qtype: str,
     one); returns its value (or None)."""
     if spec.aux is not None:
         args = args + (_build_aux(spec.aux, qtype, n, env),)
+    if spec.reseed:
+        env.seed([51966, n, ord(qtype)]
+                 + [int(a) for a in args if isinstance(a, (int, np.integer))])
     return getattr(qt, fn_name)(q, *args)
 
 

@@ -24,6 +24,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Run the essential tier first (the reference runs tests/essential/
+    before everything and aborts on failure — `QuESTTest/__main__.py`)."""
+    items.sort(key=lambda it: 0 if "test_essential" in it.nodeid else 1)
+
+
 @pytest.fixture
 def env():
     import quest_tpu as qt

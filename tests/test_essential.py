@@ -1,0 +1,82 @@
+"""Essential-first tier (reference: tests/essential/state_vector/, 9 files —
+alloc/init/seed basics whose failure aborts the whole reference run,
+`utilities/QuESTTest/__main__.py`). Collected first via conftest ordering;
+everything else is meaningless if these fail.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+
+class TestEssential:
+    def test_create_qureg(self, env):
+        q = qt.createQureg(3, env)
+        assert qt.getNumQubits(q) == 3
+        assert qt.getNumAmps(q) == 8
+        assert not q.is_density_matrix
+
+    def test_create_density_qureg(self, env):
+        d = qt.createDensityQureg(3, env)
+        assert qt.getNumQubits(d) == 3
+        assert d.is_density_matrix
+        assert d.num_amps_total == 64
+
+    def test_destroy_qureg(self, env):
+        q = qt.createQureg(3, env)
+        qt.destroyQureg(q, env)   # parity no-op; must not raise
+
+    def test_init_zero_state(self, env):
+        q = qt.createQureg(3, env)
+        qt.initZeroState(q)
+        want = np.zeros(8, dtype=complex)
+        want[0] = 1.0
+        np.testing.assert_allclose(q.to_numpy(), want, atol=0)
+
+    def test_init_plus_state(self, env):
+        q = qt.createQureg(3, env)
+        qt.initPlusState(q)
+        np.testing.assert_allclose(q.to_numpy(),
+                                   np.full(8, 1 / np.sqrt(8)), atol=1e-15)
+
+    def test_init_classical_state(self, env):
+        q = qt.createQureg(3, env)
+        qt.initClassicalState(q, 5)
+        assert qt.getProbAmp(q, 5) == pytest.approx(1.0)
+        assert qt.calcTotalProb(q) == pytest.approx(1.0)
+
+    def test_init_debug_state(self, env):
+        q = qt.createQureg(2, env)
+        qt.initDebugState(q)
+        # amp[i] = (2i + i(2i+1))/10  (QuEST.h:450-459)
+        want = np.array([(2 * i + 1j * (2 * i + 1)) / 10 for i in range(4)])
+        np.testing.assert_allclose(q.to_numpy(), want, atol=0)
+
+    def test_set_amps(self, env):
+        q = qt.createQureg(3, env)
+        qt.initZeroState(q)
+        qt.setAmps(q, 2, [0.5, 0.5], [0.1, -0.1], 2)
+        got = q.to_numpy()
+        assert got[2] == pytest.approx(0.5 + 0.1j)
+        assert got[3] == pytest.approx(0.5 - 0.1j)
+
+    def test_seeding_is_deterministic(self, env):
+        outs = []
+        for _ in range(2):
+            env.seed([777])
+            q = qt.createQureg(4, env)
+            qt.initPlusState(q)
+            outs.append([qt.measure(q, t) for t in range(4)])
+        assert outs[0] == outs[1]
+
+    def test_seed_default_differs(self):
+        e1 = qt.createQuESTEnv(num_devices=1)
+        e2 = qt.createQuESTEnv(num_devices=1)
+        assert not np.array_equal(
+            np.asarray(jaxkey(e1)), np.asarray(jaxkey(e2)))
+
+
+def jaxkey(env):
+    import jax
+    return jax.random.key_data(env.key)

@@ -1,0 +1,61 @@
+"""Generate the algorithm-tier golden files (reference: tests/algor/).
+
+QFT.test mirrors the reference's QFTtests data file
+(`/root/reference/tests/algor/QFT.test:26-38`): the zero-state register is
+QFT-transformed twice, with the full state stored after each transform.
+grover.test stores the marked-state hit probability after each Grover
+iteration. Both files are replayed by tests/test_algor.py on every
+configuration (single device + 8-device mesh).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import quest_tpu as qt  # noqa: E402
+from quest_tpu import algorithms as alg  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "tests", "golden", "algor")
+N_QFT = 5
+N_GROVER = 6
+MARKED = 41
+
+
+def write_state(f, q):
+    for a in q.to_numpy():
+        f.write(f"{float(a.real)!r} {float(a.imag)!r}\n")
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    env = qt.createQuESTEnv(num_devices=1, seed=[12345])
+
+    q = qt.createQureg(N_QFT, env)
+    qt.initZeroState(q)
+    qft = alg.qft(N_QFT).compile(env)
+    with open(os.path.join(OUT, "QFT.test"), "w") as f:
+        f.write(f"# golden-algor QFT\n{N_QFT}\n")
+        qft.run(q)
+        write_state(f, q)
+        qft.run(q)
+        write_state(f, q)
+
+    with open(os.path.join(OUT, "grover.test"), "w") as f:
+        f.write(f"# golden-algor grover\n{N_GROVER} {MARKED}\n")
+        for iters in range(1, 7):
+            q = qt.createQureg(N_GROVER, env)
+            qt.initZeroState(q)
+            alg.grover(N_GROVER, MARKED, num_iterations=iters).compile(env).run(q)
+            f.write(f"{qt.getProbAmp(q, MARKED)!r}\n")
+
+    print("wrote", os.path.join(OUT, "QFT.test"), "and grover.test")
+
+
+if __name__ == "__main__":
+    main()
