@@ -34,6 +34,7 @@ from .core.apply import apply_diagonal, apply_unitary, split_shape
 from .env import QuESTEnv, create_quest_env, destroy_quest_env
 from .ops import channels as chan
 from .ops import densmatr as dm
+from .ops import initstates as ist
 from .ops import reductions as red
 from .ops import statevec as sv
 from .qureg import Qureg
@@ -461,38 +462,34 @@ def copyStateFromGPU(qureg: Qureg) -> None:
 # ---------------------------------------------------------------------------
 
 def initBlankState(qureg: Qureg) -> None:
-    qureg.device_put(np.zeros(qureg.num_amps_total, dtype=np.complex128))
+    qureg.state = ist.blank(qureg.num_amps_total, qureg.real_dtype,
+                            qureg.sharding())
     qureg.qasm_log.record_comment(
         "the register was set to the unphysical all-zero-amplitudes state")
 
 
 def initZeroState(qureg: Qureg) -> None:
-    arr = np.zeros(qureg.num_amps_total, dtype=np.complex128)
-    arr[0] = 1.0
-    qureg.device_put(arr)
+    qureg.state = ist.zero(qureg.num_amps_total, qureg.real_dtype,
+                           qureg.sharding())
     qureg.qasm_log.record_init_zero()
 
 
 def initPlusState(qureg: Qureg) -> None:
     n = qureg.num_qubits_represented
-    if qureg.is_density_matrix:
-        arr = np.full(qureg.num_amps_total, 1.0 / (1 << n), dtype=np.complex128)
-    else:
-        arr = np.full(qureg.num_amps_total, 1.0 / np.sqrt(1 << n),
-                      dtype=np.complex128)
-    qureg.device_put(arr)
+    amp = (1.0 / (1 << n)) if qureg.is_density_matrix \
+        else (1.0 / np.sqrt(1 << n))
+    qureg.state = ist.plus(qureg.num_amps_total, qureg.real_dtype,
+                           qureg.sharding(), amp)
     qureg.qasm_log.record_init_plus()
 
 
 def initClassicalState(qureg: Qureg, state_ind: int) -> None:
     val.validate_state_index(qureg.num_qubits_represented, state_ind,
                              "initClassicalState")
-    arr = np.zeros(qureg.num_amps_total, dtype=np.complex128)
-    if qureg.is_density_matrix:
-        arr[state_ind * ((1 << qureg.num_qubits_represented) + 1)] = 1.0
-    else:
-        arr[state_ind] = 1.0
-    qureg.device_put(arr)
+    idx = state_ind * ((1 << qureg.num_qubits_represented) + 1) \
+        if qureg.is_density_matrix else state_ind
+    qureg.state = ist.classical(qureg.num_amps_total, qureg.real_dtype,
+                                qureg.sharding(), idx)
     qureg.qasm_log.record_init_classical(state_ind)
 
 
@@ -509,8 +506,8 @@ def initPureState(qureg: Qureg, pure: Qureg) -> None:
 
 
 def initDebugState(qureg: Qureg) -> None:
-    idx = np.arange(qureg.num_amps_total, dtype=np.float64)
-    qureg.device_put((2.0 * idx + 1j * (2.0 * idx + 1.0)) / 10.0)
+    qureg.state = ist.debug(qureg.num_amps_total, qureg.real_dtype,
+                            qureg.sharding())
 
 
 def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
@@ -580,10 +577,9 @@ def initStateOfSingleQubit(qureg: Qureg, qubit: int, outcome: int) -> None:
     val.validate_target(qureg.num_qubits_represented, qubit,
                         "initStateOfSingleQubit")
     val.validate_outcome(outcome, "initStateOfSingleQubit")
-    idx = np.arange(qureg.num_amps_total)
-    amp = np.where(((idx >> qubit) & 1) == outcome,
-                   1.0 / np.sqrt(qureg.num_amps_total // 2), 0.0)
-    qureg.device_put(amp.astype(np.complex128))
+    qureg.state = ist.single_qubit_outcome(
+        qureg.num_amps_total, qureg.real_dtype, qureg.sharding(),
+        qubit, outcome)
 
 
 # ---------------------------------------------------------------------------
@@ -1131,11 +1127,27 @@ def getNumAmps(qureg: Qureg) -> int:
     return qureg.num_amps_total
 
 
+@jax.jit
+def _jit_take_amp(state_f, idx):
+    """Read one (re, im) pair from the (possibly sharded) state — the
+    analogue of the owner-rank read + broadcast in ``statevec_getRealAmp``
+    (``QuEST_cpu_distributed.c:195-203``): a dynamic-index gather that XLA's
+    SPMD partitioner serves from the owning shard, transferring 2 floats to
+    host, never the register. One executable serves every index."""
+    return jax.lax.dynamic_slice_in_dim(state_f, idx, 1, axis=1)[:, 0]
+
+
+def _get_amp_pair(qureg: Qureg, index: int) -> complex:
+    idx_dt = jnp.int64 if (index > np.iinfo(np.int32).max
+                           and jax.config.jax_enable_x64) else jnp.int32
+    pair = np.asarray(_jit_take_amp(qureg.state, jnp.asarray(index, idx_dt)))
+    return complex(pair[0], pair[1])
+
+
 def getAmp(qureg: Qureg, index: int) -> complex:
     val.validate_state_vec(qureg.is_density_matrix, "getAmp")
     val.validate_amp_index(qureg.num_amps_total, index, "getAmp")
-    pair = np.asarray(qureg.state[:, index])
-    return complex(pair[0], pair[1])
+    return _get_amp_pair(qureg, index)
 
 
 def getRealAmp(qureg: Qureg, index: int) -> float:
@@ -1156,8 +1168,7 @@ def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
     dim = 1 << qureg.num_qubits_represented
     val.validate_amp_index(dim, row, "getDensityAmp")
     val.validate_amp_index(dim, col, "getDensityAmp")
-    pair = np.asarray(qureg.state[:, row + col * dim])
-    return complex(pair[0], pair[1])
+    return _get_amp_pair(qureg, row + col * dim)
 
 
 def calcTotalProb(qureg: Qureg) -> float:

@@ -105,9 +105,12 @@ class Qureg:
         return self.env.num_devices
 
     def to_numpy(self) -> np.ndarray:
-        """Gather the full state to host as a complex vector (debug/test
-        seam). Transfers the float planes (complex transfers are unsupported
-        on the TPU backend) and recombines host-side."""
+        """Gather the FULL state to host as a complex vector — debug/test
+        seam ONLY: this is O(2^n) host memory and tunnel bandwidth. Use
+        ``getAmp``/``getProbAmp`` (shard-local single-element reads) or
+        ``calc*`` reductions in real programs. Transfers the float planes
+        (complex transfers are unsupported on the TPU backend) and
+        recombines host-side."""
         return unpack_host(np.asarray(self._state))
 
     def density_matrix_numpy(self) -> np.ndarray:

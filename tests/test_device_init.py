@@ -1,0 +1,85 @@
+"""Device-side init: no O(2^n) host allocation (VERDICT r2 item 2).
+
+The reference allocates per chunk (``QuEST_cpu.c:1284-1320``) so no process
+ever holds the full register; the TPU build must likewise materialise init
+states shard-by-shard on device. These tests pin (a) correctness of every
+canned init against the numpy oracle at small n — single-device and on the
+8-device mesh — and (b) the host-memory bound: a 24-qubit init must not
+allocate the 256 MiB host array the old path built.
+"""
+
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import debug_state
+
+
+def _check_inits(q, n, env):
+    qt.initZeroState(q)
+    expect = np.zeros(1 << n, complex)
+    expect[0] = 1.0
+    np.testing.assert_allclose(q.to_numpy(), expect, atol=1e-12)
+
+    qt.initPlusState(q)
+    np.testing.assert_allclose(q.to_numpy(),
+                               np.full(1 << n, (1 << n) ** -0.5), atol=1e-12)
+
+    qt.initClassicalState(q, 5)
+    expect = np.zeros(1 << n, complex)
+    expect[5] = 1.0
+    np.testing.assert_allclose(q.to_numpy(), expect, atol=1e-12)
+
+    qt.initDebugState(q)
+    np.testing.assert_allclose(q.to_numpy(), debug_state(n), atol=1e-12)
+
+    qt.initBlankState(q)
+    np.testing.assert_allclose(q.to_numpy(), np.zeros(1 << n), atol=1e-12)
+
+    qt.initStateOfSingleQubit(q, 2, 1)
+    idx = np.arange(1 << n)
+    expect = np.where((idx >> 2) & 1 == 1, (1 << (n - 1)) ** -0.5, 0.0)
+    np.testing.assert_allclose(q.to_numpy(), expect, atol=1e-12)
+
+
+def test_inits_single_device(env):
+    n = 5
+    _check_inits(qt.createQureg(n, env), n, env)
+
+
+def test_inits_mesh(mesh_env):
+    n = 6
+    _check_inits(qt.createQureg(n, mesh_env), n, mesh_env)
+
+
+def test_density_inits_mesh(mesh_env):
+    n = 3
+    q = qt.createDensityQureg(n, mesh_env)
+    qt.initPlusState(q)
+    np.testing.assert_allclose(q.density_matrix_numpy(),
+                               np.full((8, 8), 1 / 8), atol=1e-12)
+    qt.initClassicalState(q, 6)
+    rho = np.zeros((8, 8), complex)
+    rho[6, 6] = 1.0
+    np.testing.assert_allclose(q.density_matrix_numpy(), rho, atol=1e-12)
+
+
+@pytest.mark.slow
+def test_init_no_host_blowup(mesh_env):
+    """24-qubit inits stay under a few MiB of host (Python-side) memory —
+    the state (256 MiB as complex128) is built only in XLA device buffers."""
+    n = 24
+    q = qt.createQureg(n, mesh_env)
+    tracemalloc.start()
+    qt.initZeroState(q)
+    qt.initPlusState(q)
+    qt.initDebugState(q)
+    qt.initClassicalState(q, 123456)
+    q.state.block_until_ready()
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak < 16 * 1024 * 1024, f"host peak {peak/2**20:.1f} MiB"
+    # spot-check amplitudes via the shard-local getter path
+    assert abs(qt.getProbAmp(q, 123456) - 1.0) < 1e-12

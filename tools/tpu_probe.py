@@ -1,0 +1,67 @@
+"""Standalone TPU liveness probe + mini-benchmark for the axon tunnel.
+
+Run (optionally in the background):  python tools/tpu_probe.py
+Prints timestamped progress lines so a log tail shows exactly how far
+backend init got (the r1/r2 failure mode was an indefinite hang inside
+``jax.devices()`` when no chip grant arrives).
+"""
+
+import os
+import sys
+import time
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - T0:8.1f}s] {msg}", flush=True)
+
+
+def main():
+    log(f"python {sys.version.split()[0]}; JAX_PLATFORMS="
+        f"{os.environ.get('JAX_PLATFORMS')}")
+    import jax
+    log(f"jax {jax.__version__} imported; calling jax.devices() ...")
+    d = jax.devices()
+    log(f"devices: {d} (platform={d[0].platform})")
+
+    import jax.numpy as jnp
+    t = time.time()
+    x = jnp.ones((2048, 2048), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    log(f"2048^2 bf16 matmul (compile+run): {time.time() - t:.1f}s")
+
+    t = time.time()
+    y = (x @ x).block_until_ready()
+    log(f"matmul again (cached): {time.time() - t:.3f}s")
+
+    # mini gate-layer benchmark: 20-qubit statevector, f32 planes
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import quest_tpu as qt
+    env = qt.createQuESTEnv(num_devices=1, seed=[7])
+    n = int(os.environ.get("PROBE_QUBITS", "20"))
+    q = qt.createQureg(n, env)
+    t = time.time()
+    qt.initZeroState(q)
+    q.state.block_until_ready()
+    log(f"initZeroState({n}) device-side: {time.time() - t:.1f}s")
+
+    from bench import build_bench_circuit
+    circ, n_gates = build_bench_circuit(n, 1)
+    t = time.time()
+    cc = circ.compile(env)
+    cc.run(q)
+    q.state.block_until_ready()
+    log(f"compile+first-run {n_gates}-gate layer at {n}q: {time.time() - t:.1f}s")
+
+    t = time.time()
+    trials = 5
+    for _ in range(trials):
+        cc.run(q)
+    q.state.block_until_ready()
+    dt = time.time() - t
+    log(f"{trials} trials: {dt:.3f}s -> {n_gates * trials / dt:,.0f} gates/s")
+
+
+if __name__ == "__main__":
+    main()
