@@ -716,8 +716,9 @@ class CompiledCircuit:
         if pallas is None:
             pallas = os.environ.get("QUEST_TPU_PALLAS", "auto")
         interpret = pallas == "interpret"
+        # "axon" is the tunneled TPU PJRT plugin — same Mosaic lowering
         enabled = pallas not in (False, "0", "off") and (
-            interpret or jax.default_backend() == "tpu")
+            interpret or jax.default_backend() in ("tpu", "axon"))
         self._pallas_interpret = interpret
         replan = False
         if enabled and shard_bits == 0 and n >= 7:
