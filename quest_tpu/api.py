@@ -494,7 +494,7 @@ def initClassicalState(qureg: Qureg, state_ind: int) -> None:
 
 
 def initPureState(qureg: Qureg, pure: Qureg) -> None:
-    val.validate_state_vec(pure.is_density_matrix, "initPureState")
+    val.validate_second_qureg_state_vec(pure.is_density_matrix, "initPureState")
     val.validate_matching_dims(qureg.num_qubits_represented,
                                pure.num_qubits_represented, "initPureState")
     if qureg.is_density_matrix:
@@ -663,7 +663,8 @@ def rotateAroundAxis(qureg: Qureg, target: int, angle: float, axis,
                      _label: Optional[str] = None,
                      _angle: Optional[float] = None) -> None:
     val.validate_target(qureg.num_qubits_represented, target, "rotateAroundAxis")
-    val.validate_vector(axis, "rotateAroundAxis")
+    val.validate_vector(axis, "rotateAroundAxis",
+                        qureg.env.precision.eps)
     _apply_gate(qureg, mats.rotation(angle, axis), (target,))
     if _label is not None:
         qureg.qasm_log.record_param_gate(_label, target, _angle)
@@ -700,8 +701,8 @@ def controlledPhaseShift(qureg: Qureg, q1: int, q2: int, angle: float) -> None:
 
 def multiControlledPhaseShift(qureg: Qureg, qubits: Sequence[int],
                               angle: float) -> None:
-    val.validate_multi_targets(qureg.num_qubits_represented, qubits,
-                               "multiControlledPhaseShift")
+    val.validate_multi_qubits(qureg.num_qubits_represented, qubits,
+                              "multiControlledPhaseShift")
     k = len(qubits)
     tensor = np.ones((2,) * k, dtype=np.complex128)
     tensor[(1,) * k] = np.exp(1j * angle)
@@ -720,8 +721,8 @@ def controlledPhaseFlip(qureg: Qureg, q1: int, q2: int) -> None:
 
 
 def multiControlledPhaseFlip(qureg: Qureg, qubits: Sequence[int]) -> None:
-    val.validate_multi_targets(qureg.num_qubits_represented, qubits,
-                               "multiControlledPhaseFlip")
+    val.validate_multi_qubits(qureg.num_qubits_represented, qubits,
+                              "multiControlledPhaseFlip")
     k = len(qubits)
     tensor = np.ones((2,) * k, dtype=np.complex128)
     tensor[(1,) * k] = -1.0
@@ -750,7 +751,8 @@ def controlledRotateAroundAxis(qureg: Qureg, control: int, target: int,
                                _angle: Optional[float] = None) -> None:
     val.validate_control_target(qureg.num_qubits_represented, control, target,
                                 "controlledRotateAroundAxis")
-    val.validate_vector(axis, "controlledRotateAroundAxis")
+    val.validate_vector(axis, "controlledRotateAroundAxis",
+                        qureg.env.precision.eps)
     _apply_gate(qureg, mats.rotation(angle, axis), (target,), (control,))
     if _label is not None:
         qureg.qasm_log.record_param_gate(_label, target, _angle, (control,))
@@ -1214,7 +1216,8 @@ def calcPurity(qureg: Qureg) -> float:
 
 
 def calcFidelity(qureg: Qureg, pure_state: Qureg) -> float:
-    val.validate_state_vec(pure_state.is_density_matrix, "calcFidelity")
+    val.validate_second_qureg_state_vec(pure_state.is_density_matrix,
+                                        "calcFidelity")
     val.validate_matching_dims(qureg.num_qubits_represented,
                                pure_state.num_qubits_represented,
                                "calcFidelity")
@@ -1274,7 +1277,8 @@ def mixTwoQubitDephasing(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
     val.validate_unique_targets(qureg.num_qubits_represented, q1, q2,
                                 "mixTwoQubitDephasing")
     val.validate_prob(prob, "mixTwoQubitDephasing", 0.75,
-                      "two-qubit dephasing probability")
+                      "two-qubit dephasing probability",
+                      code=val.ErrorCode.E_INVALID_TWO_QUBIT_DEPHASE_PROB)
     qureg.state = _jit_mix_two_qubit_dephasing(
         qureg.state, qureg.num_qubits_represented, q1, q2, float(prob),
         _shard(qureg))
@@ -1286,7 +1290,8 @@ def mixTwoQubitDephasing(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
 def mixDepolarising(qureg: Qureg, target: int, prob: float) -> None:
     val.validate_density_matr(qureg.is_density_matrix, "mixDepolarising")
     val.validate_target(qureg.num_qubits_represented, target, "mixDepolarising")
-    val.validate_prob(prob, "mixDepolarising", 0.75, "depolarising probability")
+    val.validate_prob(prob, "mixDepolarising", 0.75, "depolarising probability",
+                      code=val.ErrorCode.E_INVALID_ONE_QUBIT_DEPOL_PROB)
     _apply_kraus(qureg, (target,), chan.depolarising_kraus(prob))
     qureg.qasm_log.record_comment(
         f"a depolarising error occurred on qubit {target} "
@@ -1395,8 +1400,7 @@ def writeRecordedQASMToFile(qureg: Qureg, filename: str) -> None:
     try:
         qureg.qasm_log.write_to_file(filename)
     except OSError:
-        val._fail("could not open the output file for writing",
-                  "writeRecordedQASMToFile")
+        val.validate_file_opened(False, "writeRecordedQASMToFile")
 
 
 # ---------------------------------------------------------------------------
@@ -1415,6 +1419,11 @@ def reportState(qureg: Qureg, filename: str = "state_rank_0.csv") -> None:
 
 def reportStateToScreen(qureg: Qureg, env: QuESTEnv = None,
                         report_rank: int = 0) -> None:
+    # the reference silently skips large registers rather than erroring
+    # (guard on the STATE-VECTOR qubit count, QuEST_cpu.c:1343); the
+    # E_SYS_TOO_BIG_TO_PRINT code is dead there too — see validation.SUBSUMED
+    if qureg.num_qubits_in_state_vec > 5:
+        return
     amps = qureg.to_numpy()
     print("Reporting state from rank 0 of 1")
     for a in amps:
@@ -1450,8 +1459,7 @@ def initStateFromSingleFile(qureg: Qureg, filename: str,
                 re_s, im_s = line.split(",")
                 rows.append(complex(float(re_s), float(im_s)))
     except OSError:
-        val._fail("could not open the state file for reading",
-                  "initStateFromSingleFile")
+        val.validate_file_opened(False, "initStateFromSingleFile")
     if len(rows) != qureg.num_amps_total:
         val._fail("the state file does not match the register dimension",
                   "initStateFromSingleFile")

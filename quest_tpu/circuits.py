@@ -348,7 +348,8 @@ class Circuit:
         """Homogeneous depolarising (mixDepolarising semantics; max 3/4)."""
         from . import validation as val
         from .ops import channels as chan
-        val.validate_prob(prob, "Circuit.depolarise", 0.75)
+        val.validate_prob(prob, "Circuit.depolarise", 0.75,
+                          code=val.ErrorCode.E_INVALID_ONE_QUBIT_DEPOL_PROB)
         return self.kraus(chan.depolarising_kraus(prob), (q,))
 
     def damp(self, q: int, prob: float) -> "Circuit":

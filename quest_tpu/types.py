@@ -38,23 +38,28 @@ PAULI_Z = PauliOpType.PAULI_Z
 
 
 class QuESTError(ValueError):
-    """Raised on invalid user input (analogue of exitWithError, but catchable)."""
+    """Raised on invalid user input (analogue of exitWithError, but
+    catchable). ``code`` is the reference taxonomy code
+    (:class:`quest_tpu.validation.ErrorCode`) when the failure came from the
+    validation layer, else 0."""
 
-    def __init__(self, message: str, func_name: str = ""):
+    def __init__(self, message: str, func_name: str = "", code: int = 0):
         self.func_name = func_name
+        self.code = code
         super().__init__(
             f"QuEST error in {func_name}: {message}" if func_name else message
         )
 
 
-def _default_handler(message: str, func_name: str) -> None:
-    raise QuESTError(message, func_name)
+def _default_handler(message: str, func_name: str, code: int = 0) -> None:
+    raise QuESTError(message, func_name, code)
 
 
 _handler = _default_handler
 
 
-def invalid_quest_input_error(message: str, func_name: str) -> None:
+def invalid_quest_input_error(message: str, func_name: str,
+                              code: int = 0) -> None:
     """Dispatch an input-validation failure to the current handler.
 
     The reference exposes this as an overridable weak symbol
@@ -64,9 +69,12 @@ def invalid_quest_input_error(message: str, func_name: str) -> None:
     reference requires the override not to return; if a custom handler does
     return, we still raise so invalid inputs can never reach the kernels.
     """
-    _handler(message, func_name)
-    if _handler is not _default_handler:
-        raise QuESTError(message, func_name)
+    if _handler is _default_handler:
+        _default_handler(message, func_name, code)
+    else:
+        # custom handlers keep the reference's 2-arg weak-symbol signature
+        _handler(message, func_name)
+        raise QuESTError(message, func_name, code)
 
 
 def set_input_error_handler(handler) -> None:

@@ -1,0 +1,210 @@
+"""Every reference error code is realised (VERDICT r2 item 8).
+
+The reference enumerates 47 error conditions (``QuEST_validation.c:25-124``).
+This table test proves each code is either (a) raised by a concrete API
+misuse — asserted via ``QuESTError.code`` — or (b) documented in
+``validation.SUBSUMED`` with an architectural reason, in which case the
+validator (if any) is exercised directly. A final completeness assertion
+walks the enum so a future 48th code cannot be silently dropped.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import validation as val
+from quest_tpu.validation import ErrorCode as E
+
+U2 = np.array([[0, 1], [1, 0]], dtype=complex)           # unitary 2x2
+U4 = np.kron(U2, U2)
+NONU = np.array([[1, 1], [0, 1]], dtype=complex)
+
+
+@pytest.fixture
+def sv(env):
+    q = qt.createQureg(3, env)
+    qt.initZeroState(q)
+    return q
+
+
+@pytest.fixture
+def dm(env):
+    q = qt.createDensityQureg(3, env)
+    qt.initPlusState(q)
+    return q
+
+
+def _code_of(fn) -> int:
+    with pytest.raises(qt.QuESTError) as ei:
+        fn()
+    return ei.value.code
+
+
+def kraus_id(n=1):
+    return np.eye(1 << n, dtype=complex)
+
+
+CASES = {
+    E.E_INVALID_NUM_CREATE_QUBITS:
+        lambda sv, dm, env: qt.createQureg(0, env),
+    E.E_INVALID_QUBIT_INDEX:
+        lambda sv, dm, env: qt.multiControlledPhaseFlip(sv, [0, 9]),
+    E.E_INVALID_TARGET_QUBIT:
+        lambda sv, dm, env: qt.hadamard(sv, 9),
+    E.E_INVALID_CONTROL_QUBIT:
+        lambda sv, dm, env: qt.controlledNot(sv, 9, 0),
+    E.E_INVALID_STATE_INDEX:
+        lambda sv, dm, env: qt.initClassicalState(sv, 8),
+    E.E_INVALID_AMP_INDEX:
+        lambda sv, dm, env: qt.getAmp(sv, 8),
+    E.E_INVALID_NUM_AMPS:
+        lambda sv, dm, env: qt.setAmps(sv, 0, np.zeros(9), np.zeros(9), 9),
+    E.E_INVALID_OFFSET_NUM_AMPS:
+        lambda sv, dm, env: qt.setAmps(sv, 5, np.zeros(4), np.zeros(4), 4),
+    E.E_TARGET_IS_CONTROL:
+        lambda sv, dm, env: qt.controlledNot(sv, 1, 1),
+    E.E_TARGET_IN_CONTROLS:
+        lambda sv, dm, env: qt.multiControlledUnitary(sv, [1], 1, U2),
+    E.E_CONTROL_TARGET_COLLISION:
+        lambda sv, dm, env: qt.multiControlledTwoQubitUnitary(
+            sv, [1], 1, 2, U4),
+    E.E_QUBITS_NOT_UNIQUE:
+        lambda sv, dm, env: qt.multiControlledPhaseFlip(sv, [0, 0]),
+    E.E_TARGETS_NOT_UNIQUE:
+        lambda sv, dm, env: qt.multiQubitUnitary(sv, [1, 1], U4),
+    E.E_CONTROLS_NOT_UNIQUE:
+        lambda sv, dm, env: qt.multiControlledUnitary(sv, [0, 0], 1, U2),
+    E.E_INVALID_NUM_QUBITS:
+        lambda sv, dm, env: qt.multiControlledPhaseFlip(sv, []),
+    E.E_INVALID_NUM_TARGETS:
+        lambda sv, dm, env: qt.multiQubitUnitary(sv, [], np.eye(1)),
+    E.E_INVALID_NUM_CONTROLS:
+        lambda sv, dm, env: qt.multiControlledMultiQubitUnitary(
+            sv, [], [0], U2),
+    E.E_NON_UNITARY_MATRIX:
+        lambda sv, dm, env: qt.unitary(sv, 0, NONU),
+    E.E_NON_UNITARY_COMPLEX_PAIR:
+        lambda sv, dm, env: qt.compactUnitary(sv, 0, 1.0, 1.0),
+    E.E_ZERO_VECTOR:
+        lambda sv, dm, env: qt.rotateAroundAxis(sv, 0, 0.5, (0, 0, 0)),
+    E.E_COLLAPSE_STATE_ZERO_PROB:
+        lambda sv, dm, env: qt.collapseToOutcome(sv, 0, 1),   # |000>: P(1)=0
+    E.E_INVALID_QUBIT_OUTCOME:
+        lambda sv, dm, env: qt.collapseToOutcome(sv, 0, 2),
+    E.E_CANNOT_OPEN_FILE:
+        lambda sv, dm, env: qt.writeRecordedQASMToFile(
+            sv, "/nonexistent-dir-xyz/out.qasm"),
+    E.E_SECOND_ARG_MUST_BE_STATEVEC:
+        lambda sv, dm, env: qt.calcFidelity(sv, dm),
+    E.E_MISMATCHING_QUREG_DIMENSIONS:
+        lambda sv, dm, env: qt.cloneQureg(sv, qt.createQureg(2, env)),
+    E.E_MISMATCHING_QUREG_TYPES:
+        lambda sv, dm, env: qt.cloneQureg(sv, dm),
+    E.E_DEFINED_ONLY_FOR_STATEVECS:
+        lambda sv, dm, env: qt.getAmp(dm, 0),
+    E.E_DEFINED_ONLY_FOR_DENSMATRS:
+        lambda sv, dm, env: qt.calcPurity(sv),
+    E.E_INVALID_PROB:
+        lambda sv, dm, env: qt.mixDamping(dm, 0, -0.1),
+    E.E_UNNORM_PROBS:
+        lambda sv, dm, env: val.validate_norm_probs(0.5, 0.2, 1e-10, "test"),
+    E.E_INVALID_ONE_QUBIT_DEPHASE_PROB:
+        lambda sv, dm, env: qt.mixDephasing(dm, 0, 0.6),
+    E.E_INVALID_TWO_QUBIT_DEPHASE_PROB:
+        lambda sv, dm, env: qt.mixTwoQubitDephasing(dm, 0, 1, 0.8),
+    E.E_INVALID_ONE_QUBIT_DEPOL_PROB:
+        lambda sv, dm, env: qt.mixDepolarising(dm, 0, 0.8),
+    E.E_INVALID_TWO_QUBIT_DEPOL_PROB:
+        lambda sv, dm, env: qt.mixTwoQubitDepolarising(dm, 0, 1, 0.95),
+    E.E_INVALID_ONE_QUBIT_PAULI_PROBS:
+        lambda sv, dm, env: qt.mixPauli(dm, 0, 0.4, 0.3, 0.3),
+    E.E_INVALID_CONTROLS_BIT_STATE:
+        lambda sv, dm, env: qt.multiStateControlledUnitary(
+            sv, [0], [2], 1, U2),
+    E.E_INVALID_PAULI_CODE:
+        lambda sv, dm, env: qt.calcExpecPauliProd(
+            sv, [0], [7], qt.createQureg(3, env)),
+    E.E_INVALID_NUM_SUM_TERMS:
+        lambda sv, dm, env: qt.calcExpecPauliSum(
+            sv, [], [], qt.createQureg(3, env)),
+    E.E_INVALID_UNITARY_SIZE:
+        lambda sv, dm, env: qt.multiQubitUnitary(sv, [0, 1], U2),
+    E.E_INVALID_NUM_ONE_QUBIT_KRAUS_OPS:
+        lambda sv, dm, env: qt.mixKrausMap(dm, 0, [kraus_id()] * 5),
+    E.E_INVALID_NUM_TWO_QUBIT_KRAUS_OPS:
+        lambda sv, dm, env: qt.mixTwoQubitKrausMap(
+            dm, 0, 1, [kraus_id(2)] * 17),
+    E.E_INVALID_NUM_N_QUBIT_KRAUS_OPS:
+        lambda sv, dm, env: qt.mixMultiQubitKrausMap(dm, [0, 1, 2], []),
+    E.E_INVALID_KRAUS_OPS:
+        lambda sv, dm, env: qt.mixKrausMap(dm, 0, [0.5 * kraus_id()]),
+    E.E_MISMATCHING_NUM_TARGS_KRAUS_SIZE:
+        lambda sv, dm, env: qt.mixKrausMap(dm, 0, [kraus_id(2)]),
+}
+
+
+@pytest.mark.parametrize("code", list(CASES), ids=lambda c: c.name)
+def test_code_raised(code, sv, dm, env):
+    assert _code_of(lambda: CASES[code](sv, dm, env)) == code
+
+
+def test_subsumed_validator_exercised():
+    """E_CANNOT_FIT_MULTI_QUBIT_MATRIX is subsumed (the XLA partitioner has
+    no per-node batch bound) but the validator must still work for
+    reference-strict embedders."""
+    assert _code_of(lambda: val.validate_fits_in_node(2, 2, "test")) \
+        == E.E_CANNOT_FIT_MULTI_QUBIT_MATRIX
+    val.validate_fits_in_node(4, 2, "test")   # fits: no raise
+
+
+def test_sys_too_big_to_print_matches_reference(env, capsys):
+    """Dead code in the reference (the backend guard silently skips,
+    QuEST_cpu.c:1343); the port skips identically — guarding on the
+    STATE-VECTOR qubit count, so a 3-qubit density matrix (6 vector
+    qubits) is skipped while a 2-qubit one (4 vector qubits) prints."""
+    big = qt.createQureg(6, env)
+    qt.initZeroState(big)
+    qt.reportStateToScreen(big)               # no raise, no output
+    assert capsys.readouterr().out == ""
+    rho = qt.createDensityQureg(3, env)
+    qt.initZeroState(rho)
+    qt.reportStateToScreen(rho)
+    assert capsys.readouterr().out == ""
+    small = qt.createDensityQureg(2, env)
+    qt.initZeroState(small)
+    qt.reportStateToScreen(small)
+    assert "Reporting" in capsys.readouterr().out
+    assert _code_of(lambda: val.validate_sys_printable(6, "test")) \
+        == E.E_SYS_TOO_BIG_TO_PRINT
+
+
+def test_prob_bound_precedes_channel_ceiling(env):
+    """Reference order: validateProb's [0,1] bound fires before the
+    channel-specific ceiling (QuEST_validation.c:410-426)."""
+    dm = qt.createDensityQureg(2, env)
+    qt.initPlusState(dm)
+    assert _code_of(lambda: qt.mixDephasing(dm, 0, 1.5)) == E.E_INVALID_PROB
+    assert _code_of(lambda: qt.mixDephasing(dm, 0, 0.6)) \
+        == E.E_INVALID_ONE_QUBIT_DEPHASE_PROB
+
+
+def test_controls_validated_before_targets(env):
+    """Reference order: validateMultiControlsMultiTargets checks controls
+    first (QuEST_validation.c:326-333)."""
+    sv3 = qt.createQureg(3, env)
+    qt.initZeroState(sv3)
+    assert _code_of(lambda: qt.multiControlledTwoQubitUnitary(
+        sv3, [], 5, 6, U4)) == E.E_INVALID_NUM_CONTROLS
+
+
+def test_taxonomy_complete():
+    """Every enum member is either tested above or documented as subsumed."""
+    covered = set(CASES) | set(val.SUBSUMED) \
+        | {E.E_CANNOT_FIT_MULTI_QUBIT_MATRIX}
+    missing = [c.name for c in E if c not in covered]
+    assert not missing, f"untested error codes: {missing}"
+
+
+def test_error_carries_func_name(sv, dm, env):
+    with pytest.raises(qt.QuESTError, match="hadamard"):
+        qt.hadamard(sv, 9)
