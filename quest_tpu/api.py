@@ -516,7 +516,7 @@ def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
     val.validate_num_amps(qureg.num_amps_total, 0, arr.size, "initStateFromAmps")
     if arr.size != qureg.num_amps_total:
         val._fail("the amplitude arrays must cover the full register",
-                  "initStateFromAmps")
+                  "initStateFromAmps", val.ErrorCode.E_INVALID_NUM_AMPS)
     qureg.device_put(arr)
     qureg.qasm_log.record_comment(
         "the register was initialised to an undisclosed pure state")
@@ -537,7 +537,7 @@ def setDensityAmps(qureg: Qureg, reals, imags) -> None:
         + 1j * np.asarray(imags, np.float64).reshape(-1)
     if arr.size != qureg.num_amps_total:
         val._fail("the amplitude arrays must cover the full density matrix",
-                  "setDensityAmps")
+                  "setDensityAmps", val.ErrorCode.E_INVALID_NUM_AMPS)
     qureg.device_put(arr)
     qureg.qasm_log.record_comment("density-matrix amplitudes were manually edited")
 
@@ -781,8 +781,8 @@ def controlledUnitary(qureg: Qureg, control: int, target: int, u) -> None:
 
 def multiControlledUnitary(qureg: Qureg, controls: Sequence[int],
                            target: int, u) -> None:
-    val.validate_multi_controls_multi_targets(
-        qureg.num_qubits_represented, controls, (target,),
+    val.validate_multi_controls_target(
+        qureg.num_qubits_represented, controls, target,
         "multiControlledUnitary")
     u = mats.matrix2(u)
     val.validate_unitary(u, "multiControlledUnitary", qureg.env.precision.eps)
@@ -793,8 +793,8 @@ def multiControlledUnitary(qureg: Qureg, controls: Sequence[int],
 def multiStateControlledUnitary(qureg: Qureg, controls: Sequence[int],
                                 control_state: Sequence[int],
                                 target: int, u) -> None:
-    val.validate_multi_controls_multi_targets(
-        qureg.num_qubits_represented, controls, (target,),
+    val.validate_multi_controls_target(
+        qureg.num_qubits_represented, controls, target,
         "multiStateControlledUnitary")
     val.validate_control_state(control_state, len(controls),
                                "multiStateControlledUnitary")
@@ -1265,7 +1265,8 @@ def _apply_kraus(qureg: Qureg, targets: Sequence[int], ops) -> None:
 def mixDephasing(qureg: Qureg, target: int, prob: float) -> None:
     val.validate_density_matr(qureg.is_density_matrix, "mixDephasing")
     val.validate_target(qureg.num_qubits_represented, target, "mixDephasing")
-    val.validate_prob(prob, "mixDephasing", 0.5, "dephasing probability")
+    val.validate_prob(prob, "mixDephasing", 0.5, "dephasing probability",
+                      code=val.ErrorCode.E_INVALID_ONE_QUBIT_DEPHASE_PROB)
     qureg.state = _jit_mix_dephasing(qureg.state, qureg.num_qubits_represented,
                                      target, float(prob), _shard(qureg))
     qureg.qasm_log.record_comment(
@@ -1310,7 +1311,8 @@ def mixTwoQubitDepolarising(qureg: Qureg, q1: int, q2: int, prob: float) -> None
     val.validate_unique_targets(qureg.num_qubits_represented, q1, q2,
                                 "mixTwoQubitDepolarising")
     val.validate_prob(prob, "mixTwoQubitDepolarising", 15.0 / 16.0,
-                      "two-qubit depolarising probability")
+                      "two-qubit depolarising probability",
+                      code=val.ErrorCode.E_INVALID_TWO_QUBIT_DEPOL_PROB)
     _apply_kraus(qureg, (q1, q2), chan.two_qubit_depolarising_kraus(prob))
     qureg.qasm_log.record_comment(
         f"a depolarising error occurred on qubits {q1} and {q2} "
@@ -1462,7 +1464,8 @@ def initStateFromSingleFile(qureg: Qureg, filename: str,
         val.validate_file_opened(False, "initStateFromSingleFile")
     if len(rows) != qureg.num_amps_total:
         val._fail("the state file does not match the register dimension",
-                  "initStateFromSingleFile")
+                  "initStateFromSingleFile",
+                  val.ErrorCode.E_INVALID_NUM_AMPS)
     qureg.device_put(np.asarray(rows, dtype=np.complex128))
 
 

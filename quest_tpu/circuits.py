@@ -340,7 +340,8 @@ class Circuit:
         """rho -> (1-p) rho + p Z rho Z (mixDephasing semantics; max prob
         1/2, ``QuEST_validation.c:108``)."""
         from . import validation as val
-        val.validate_prob(prob, "Circuit.dephase", 0.5)
+        val.validate_prob(prob, "Circuit.dephase", 0.5,
+                          code=val.ErrorCode.E_INVALID_ONE_QUBIT_DEPHASE_PROB)
         return self.kraus([np.sqrt(1 - prob) * np.eye(2),
                            np.sqrt(prob) * mats.pauli_z()], (q,))
 

@@ -200,24 +200,35 @@ def validate_multi_targets(num_qubits: int, targets, func: str) -> None:
               ErrorCode.E_TARGETS_NOT_UNIQUE)
 
 
-def validate_multi_controls_multi_targets(num_qubits: int, controls, targets,
-                                          func: str) -> None:
-    # controls are validated before targets, as in the reference
-    # (validateMultiControlsMultiTargets, QuEST_validation.c:326-333)
+def _validate_multi_controls(num_qubits: int, controls, func: str) -> None:
     validate_num_controls(num_qubits, len(controls), func)
     for c in controls:
         validate_control(num_qubits, c, func)
     if len(set(controls)) != len(controls):
         _fail("control qubits must be unique", func,
               ErrorCode.E_CONTROLS_NOT_UNIQUE)
+
+
+def validate_multi_controls_target(num_qubits: int, controls, target: int,
+                                   func: str) -> None:
+    """``validateMultiControlsTarget`` (``QuEST_validation.c:319-324``):
+    target first, then controls, then the membership check."""
+    validate_target(num_qubits, target, func)
+    _validate_multi_controls(num_qubits, controls, func)
+    if target in set(controls):
+        _fail("the control qubits may not include the target qubit", func,
+              ErrorCode.E_TARGET_IN_CONTROLS)
+
+
+def validate_multi_controls_multi_targets(num_qubits: int, controls, targets,
+                                          func: str) -> None:
+    # controls are validated before targets, as in the reference
+    # (validateMultiControlsMultiTargets, QuEST_validation.c:326-333)
+    _validate_multi_controls(num_qubits, controls, func)
     validate_multi_targets(num_qubits, targets, func)
     if set(controls) & set(targets):
-        # the reference differentiates the single-target form
-        # (validateMultiControlsTarget -> E_TARGET_IN_CONTROLS) from the
-        # multi-target form (E_CONTROL_TARGET_COLLISION)
-        code = (ErrorCode.E_TARGET_IN_CONTROLS if len(targets) == 1
-                else ErrorCode.E_CONTROL_TARGET_COLLISION)
-        _fail("control and target qubits must be disjoint", func, code)
+        _fail("control and target qubits must be disjoint", func,
+              ErrorCode.E_CONTROL_TARGET_COLLISION)
 
 
 def validate_control_state(control_state, num_controls: int, func: str) -> None:
@@ -271,27 +282,18 @@ def validate_measurement_prob(prob: float, func: str) -> None:
               "impossible", func, ErrorCode.E_COLLAPSE_STATE_ZERO_PROB)
 
 
-_DECOHERENCE_CODES = {
-    1 / 2: ErrorCode.E_INVALID_ONE_QUBIT_DEPHASE_PROB,
-    3 / 4: None,   # ambiguous: two-qubit dephase AND one-qubit depol share 3/4
-    15 / 16: ErrorCode.E_INVALID_TWO_QUBIT_DEPOL_PROB,
-}
-
-
 def validate_prob(prob: float, func: str, max_prob: float = 1.0,
                   name: str = "probability",
                   code: ErrorCode | None = None) -> None:
     # the reference checks the [0,1] bound first (validateProb,
     # QuEST_validation.c:410-412), then the channel-specific ceiling
+    # (callers pass the ceiling's code explicitly)
     if not 0.0 <= prob <= 1.0:
         _fail(f"the {name} must lie in [0, 1]", func,
               ErrorCode.E_INVALID_PROB)
     if prob > max_prob:
-        if code is None:
-            code = _DECOHERENCE_CODES.get(max_prob) \
-                or ErrorCode.E_INVALID_PROB
         _fail(f"the {name} exceeds its physical maximum of {max_prob}",
-              func, code)
+              func, code or ErrorCode.E_INVALID_PROB)
 
 
 def validate_norm_probs(prob1: float, prob2: float, eps: float,

@@ -195,6 +195,10 @@ def test_controls_validated_before_targets(env):
     qt.initZeroState(sv3)
     assert _code_of(lambda: qt.multiControlledTwoQubitUnitary(
         sv3, [], 5, 6, U4)) == E.E_INVALID_NUM_CONTROLS
+    # ... but the single-target form checks the TARGET first
+    # (validateMultiControlsTarget, QuEST_validation.c:319-324)
+    assert _code_of(lambda: qt.multiControlledUnitary(
+        sv3, [9], 5, U2)) == E.E_INVALID_TARGET_QUBIT
 
 
 def test_taxonomy_complete():
