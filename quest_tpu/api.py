@@ -219,10 +219,12 @@ def _jit_pair_total_prob_dm(state_f, num_qubits):
     return red.sum_pair(_dm_diag_real(state_f, num_qubits))
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
-def _jit_pair_prob_outcome_sv(state_f, num_qubits, qubit, outcome):
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _jit_pair_prob_zero_sv(state_f, num_qubits, qubit):
+    # outcome-1 probability is derived host-side as 1 - P0, matching the
+    # reference (``statevec_calcProbOfOutcome`` QuEST_cpu_local.c:279-285)
     pre, _, post = split_shape(num_qubits, (qubit,))
-    sub = state_f.reshape(2, pre, 2, post)[:, :, outcome, :]
+    sub = state_f.reshape(2, pre, 2, post)[:, :, 0, :]
     return red.dot_pair(sub, sub)
 
 
@@ -1057,9 +1059,10 @@ def calcProbOfOutcome(qureg: Qureg, qubit: int, outcome: int) -> float:
         if qureg.is_density_matrix:
             p0 = _pair(_jit_pair_prob_zero_dm(
                 qureg.state, qureg.num_qubits_represented, qubit))
-            return p0 if outcome == 0 else 1.0 - p0
-        return _pair(_jit_pair_prob_outcome_sv(
-            qureg.state, qureg.num_qubits_in_state_vec, qubit, outcome))
+        else:
+            p0 = _pair(_jit_pair_prob_zero_sv(
+                qureg.state, qureg.num_qubits_in_state_vec, qubit))
+        return p0 if outcome == 0 else 1.0 - p0
     if qureg.is_density_matrix:
         p = _jit_prob_outcome_dm(qureg.state, qureg.num_qubits_represented,
                                  qubit, outcome)
@@ -1085,7 +1088,8 @@ def collapseToOutcome(qureg: Qureg, qubit: int, outcome: int) -> float:
     val.validate_target(qureg.num_qubits_represented, qubit, "collapseToOutcome")
     val.validate_outcome(outcome, "collapseToOutcome")
     prob = calcProbOfOutcome(qureg, qubit, outcome)
-    val.validate_measurement_prob(prob, "collapseToOutcome")
+    val.validate_measurement_prob(prob, qureg.env.precision.eps,
+                                  "collapseToOutcome")
     _collapse(qureg, qubit, outcome, prob)
     qureg.qasm_log.record_measurement(qubit)
     return prob

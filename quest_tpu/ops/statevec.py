@@ -73,10 +73,14 @@ def calc_inner_product(bra, ket) -> jnp.ndarray:
 
 
 def calc_prob_of_outcome(state, num_qubits: int, qubit: int, outcome: int) -> jnp.ndarray:
+    """P(outcome 0) summed directly; P(outcome 1) as its complement 1-P0 —
+    the reference's exact semantics (``statevec_calcProbOfOutcome``
+    ``QuEST_cpu_local.c:279-285``), observable on unnormalised registers
+    (debug state): summing the outcome-1 amplitudes would differ."""
     shape = split_shape(num_qubits, (qubit,))
-    arr = state.reshape(shape)
-    sub = arr[:, 0, :] if outcome == 0 else arr[:, 1, :]
-    return jnp.sum(jnp.real(sub) ** 2 + jnp.imag(sub) ** 2)
+    sub = state.reshape(shape)[:, 0, :]
+    zero_prob = jnp.sum(jnp.real(sub) ** 2 + jnp.imag(sub) ** 2)
+    return zero_prob if outcome == 0 else 1.0 - zero_prob
 
 
 def collapse_to_known_prob_outcome(state, num_qubits, qubit, outcome, prob):

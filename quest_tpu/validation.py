@@ -276,8 +276,11 @@ def validate_outcome(outcome: int, func: str) -> None:
               ErrorCode.E_INVALID_QUBIT_OUTCOME)
 
 
-def validate_measurement_prob(prob: float, func: str) -> None:
-    if prob <= 0:
+def validate_measurement_prob(prob: float, eps: float, func: str) -> None:
+    """``validateMeasurementProb`` (``QuEST_validation.c:390-392``): the
+    outcome probability must exceed REAL_EPS, not merely zero — collapse
+    renormalises by 1/prob, which is numerically meaningless below eps."""
+    if not prob > eps:
         _fail("the probability of the chosen outcome is zero; collapse is "
               "impossible", func, ErrorCode.E_COLLAPSE_STATE_ZERO_PROB)
 

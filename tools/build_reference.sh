@@ -1,0 +1,20 @@
+#!/bin/sh
+# Build the reference QuEST as a serial, double-precision shared library
+# (out of tree -- nothing is written under /root/reference).
+# Used only to REGENERATE tests/golden_ref/; the committed golden files
+# replay without it.
+set -e
+REF=${1:-/root/reference}
+OUT=${2:-/tmp/refbuild}
+mkdir -p "$OUT"
+gcc -O2 -fPIC -shared -DQuEST_PREC=2 \
+  -I"$REF/QuEST/include" -I"$REF/QuEST/src" \
+  "$REF/QuEST/src/QuEST.c" \
+  "$REF/QuEST/src/QuEST_common.c" \
+  "$REF/QuEST/src/QuEST_validation.c" \
+  "$REF/QuEST/src/QuEST_qasm.c" \
+  "$REF/QuEST/src/mt19937ar.c" \
+  "$REF/QuEST/src/CPU/QuEST_cpu.c" \
+  "$REF/QuEST/src/CPU/QuEST_cpu_local.c" \
+  -lm -o "$OUT/libquest_ref.so"
+echo "$OUT/libquest_ref.so"
