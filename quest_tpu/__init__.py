@@ -24,7 +24,8 @@ from .types import (
     PauliOpType, PAULI_I, PAULI_X, PAULI_Y, PAULI_Z,
     QuESTError, invalid_quest_input_error, set_input_error_handler,
 )
-from .env import QuESTEnv, create_quest_env, destroy_quest_env
+from .env import (QuESTEnv, create_quest_env, destroy_quest_env,
+                  initialize_multihost)
 from .qureg import Qureg
 from .circuits import Circuit, CompiledCircuit, Param
 from .api import *  # noqa: F401,F403  (the QuEST-compatible surface)

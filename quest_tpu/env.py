@@ -26,7 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .config import Precision, default_precision
 
-__all__ = ["QuESTEnv", "create_quest_env", "destroy_quest_env"]
+__all__ = ["QuESTEnv", "create_quest_env", "destroy_quest_env",
+           "initialize_multihost"]
 
 AMP_AXIS = "amps"
 
@@ -52,6 +53,15 @@ class QuESTEnv:
     def rank(self) -> int:
         """Process index (0 on single-host; mirrors QuESTEnv.rank)."""
         return jax.process_index()
+
+    @property
+    def is_multihost(self) -> bool:
+        """True when the mesh spans more than one controller process —
+        the TPU-pod analogue of the reference's multi-node MPI run
+        (``QuEST_cpu_distributed.c:128-157``). Data paths switch to
+        shard-local construction + allgather reads (qureg.py) and the
+        default seed is agreed by rank-0 broadcast (:meth:`seed_default`)."""
+        return jax.process_count() > 1
 
     @property
     def num_ranks(self) -> int:
@@ -83,8 +93,16 @@ class QuESTEnv:
 
     def seed_default(self) -> None:
         """Seed from time and pid (``seedQuESTDefault``
-        ``QuEST_common.c:181-213``)."""
-        self.seed([int(time.time() * 1e6) & 0xFFFFFFFF, os.getpid()])
+        ``QuEST_common.c:181-213``). Multi-host: every process must hold
+        the SAME key (one logical SPMD program), so rank 0's seed is
+        broadcast — the reference's ``MPI_Bcast`` of the mt19937 key
+        (``QuEST_cpu_distributed.c:1318-1329``)."""
+        seeds = [int(time.time() * 1e6) & 0xFFFFFFFF, os.getpid()]
+        if self.is_multihost:
+            from jax.experimental import multihost_utils
+            seeds = [int(s) for s in
+                     multihost_utils.broadcast_one_to_all(np.asarray(seeds))]
+        self.seed(seeds)
 
     def next_key(self) -> jax.Array:
         self.key, sub = jax.random.split(self.key)
@@ -140,6 +158,26 @@ def create_quest_env(
     else:
         env.seed_default()
     return env
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Join a multi-controller (multi-host) run BEFORE creating the env —
+    the analogue of ``MPI_Init`` (``QuEST_cpu_distributed.c:128-157``).
+
+    Thin wrapper over ``jax.distributed.initialize``: on TPU pods all
+    arguments auto-detect from the runtime; on CPU/GPU clusters pass the
+    coordinator endpoint and process coordinates. After this,
+    ``jax.devices()`` spans every host's chips, ``create_quest_env()``
+    meshes over all of them, and the amplitude axis shards across the pod
+    with XLA collectives riding ICI/DCN — no further code changes; the
+    same SPMD program runs on every process. Untestable on this
+    single-host rig; the mesh/collective path it feeds is exercised by
+    the 8-device tests and the driver's multichip dryrun."""
+    jax.distributed.initialize(coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
 
 
 def destroy_quest_env(env: QuESTEnv) -> None:

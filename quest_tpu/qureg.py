@@ -90,8 +90,17 @@ class Qureg:
             raise ValueError(
                 f"state array has shape {host_array.shape}; this register "
                 f"holds {self.num_amps_total} amplitudes")
-        arr = jnp.asarray(pack_host(host_array, self.real_dtype))
+        arr = pack_host(host_array, self.real_dtype)
         sharding = self.sharding()
+        if sharding is not None and self.env.is_multihost:
+            # multi-host: each process materialises only ITS addressable
+            # shards from the (replicated) host array — the analogue of the
+            # reference's per-rank chunk fill (QuEST_cpu.c:1284-1320); a
+            # plain device_put of a global array is invalid across hosts
+            self._state = jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
+            return
+        arr = jnp.asarray(arr)
         self._state = jax.device_put(arr, sharding) if sharding is not None else arr
 
     # -- convenience mirrors of the reference struct fields ---------------
@@ -110,7 +119,17 @@ class Qureg:
         ``getAmp``/``getProbAmp`` (shard-local single-element reads) or
         ``calc*`` reductions in real programs. Transfers the float planes
         (complex transfers are unsupported on the TPU backend) and
-        recombines host-side."""
+        recombines host-side. Multi-host: shards on other processes are
+        not addressable, so the state is allgathered first (every process
+        must call this collectively, as with the reference's
+        ``copyVecIntoMatrixPairState`` replication)."""
+        if self.env.is_multihost and self.sharding() is not None:
+            # replicated (unsharded) registers are already host-local;
+            # only sharded states need the cross-process gather
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(self._state,
+                                                         tiled=True)
+            return unpack_host(np.asarray(gathered))
         return unpack_host(np.asarray(self._state))
 
     def density_matrix_numpy(self) -> np.ndarray:
