@@ -15,10 +15,13 @@ as consistency tests.
 """
 
 import glob
+import json
 import os
 
+import numpy as np
 import pytest
 
+import quest_tpu as qt
 from quest_tpu.testing.golden import run_file
 
 GOLDEN_REF_DIR = os.path.join(os.path.dirname(__file__), "golden_ref")
@@ -51,3 +54,46 @@ def test_reference_golden_on_mesh(path, mesh_env):
     assert not failures, "\n".join(
         f"{f.function}[{f.test_index}] {f.check}: {f.detail}"
         for f in failures[:10])
+
+
+# --- algorithm tier: whole-circuit states from the reference binary --------
+
+_ALGOR_PATH = os.path.join(GOLDEN_REF_DIR, "algor.json")
+if os.path.exists(_ALGOR_PATH):
+    with open(_ALGOR_PATH) as _f:
+        _ALGOR = json.load(_f)
+else:          # missing data file skips only this tier, not the module
+    _ALGOR = []
+
+
+def test_algor_corpus_present():
+    assert _ALGOR, "tests/golden_ref/algor.json missing — " \
+                   "run tools/ref_algor_gen.py"
+
+
+@pytest.mark.parametrize("entry", _ALGOR, ids=[
+    f"{e['algorithm']}-{e['n']}{e.get('qtype', '')}" for e in _ALGOR])
+def test_reference_algorithm_states(entry, env):
+    """The framework's COMPILED circuit path (supergate fusion, layer
+    collection — the TPU fast path) vs final states computed by the
+    reference's C kernels (tools/ref_algor_gen.py)."""
+    from quest_tpu import algorithms as alg
+    n = entry["n"]
+    want = np.array([complex(r, i) for r, i in entry["state"]])
+    q = qt.createQureg(n, env)
+    if entry["algorithm"] == "qft":
+        t = entry["qtype"]
+        if t == "z":
+            qt.initZeroState(q)
+        elif t == "p":
+            qt.initPlusState(q)
+        else:
+            qt.initDebugState(q)
+        circ = alg.qft(n)
+    else:
+        qt.initZeroState(q)
+        circ = alg.grover(n, marked=entry["marked"],
+                          num_iterations=entry["iters"])
+    circ.compile(env).run(q)
+    err = np.max(np.abs(q.to_numpy() - want))
+    assert err < 1e-10, f"max amp err vs reference: {err:.3e}"

@@ -1,0 +1,99 @@
+"""Algorithm-level goldens from the reference binary (QFT + Grover).
+
+The reference's algorithm tier (`tests/algor/QFT.test`) checks whole-circuit
+final states, not single gates. This tool drives the SAME gate sequences as
+``quest_tpu.algorithms.qft``/``grover`` through the locally-built reference
+libQuEST (gate-for-gate: hadamard, controlledPhaseShift, swapGate, pauliX,
+multiControlledPhaseFlip) and stores the full final statevectors in
+``tests/golden_ref/algor.json``; ``tests/test_golden_ref.py`` replays the
+framework's *compiled-circuit* path (the TPU fast path, including supergate
+fusion and the Pallas layer collector) against them at 1e-10.
+
+Usage::
+
+    sh tools/build_reference.sh
+    python tools/ref_algor_gen.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ref_golden_gen import LIB_PATH, Ref, _ints, _load  # noqa: E402
+
+
+def ref_qft(ref: Ref, n: int, qtype: str) -> np.ndarray:
+    q = ref.prepare(qtype, n)
+    lib = ref.lib
+    for t in range(n - 1, -1, -1):
+        lib.hadamard(q, t)
+        for k, ctrl in enumerate(range(t - 1, -1, -1), start=2):
+            lib.controlledPhaseShift(q, ctrl, t, 2.0 * np.pi / (1 << k))
+    for t in range(n // 2):
+        lib.swapGate(q, t, n - 1 - t)
+    state = ref.state(q)
+    lib.destroyQureg(q, ref.env)
+    return state
+
+
+def ref_grover(ref: Ref, n: int, marked: int, iters: int) -> np.ndarray:
+    """Oracle/diffusion with X-sandwiched multiControlledPhaseFlip — exactly
+    equivalent (in floating point too: X permutes, the flip negates) to the
+    framework's flipped-control formulation."""
+    lib = ref.lib
+    q = lib.createQureg(n, ref.env)
+    lib.initZeroState(q)
+    all_qubits = _ints(range(n))
+    for t in range(n):
+        lib.hadamard(q, t)
+    for _ in range(iters):
+        zero_bits = [b for b in range(n) if not (marked >> b) & 1]
+        for b in zero_bits:
+            lib.pauliX(q, b)
+        lib.multiControlledPhaseFlip(q, all_qubits, n)
+        for b in zero_bits:
+            lib.pauliX(q, b)
+        for t in range(n):
+            lib.hadamard(q, t)
+        for t in range(n):
+            lib.pauliX(q, t)
+        lib.multiControlledPhaseFlip(q, all_qubits, n)
+        for t in range(n):
+            lib.pauliX(q, t)
+        for t in range(n):
+            lib.hadamard(q, t)
+    state = ref.state(q)
+    lib.destroyQureg(q, ref.env)
+    return state
+
+
+def main(out_path: str) -> None:
+    ref = Ref(_load(LIB_PATH))
+    entries = []
+    for n in (3, 5, 7):
+        for qtype in "zpd":
+            entries.append({
+                "algorithm": "qft", "n": n, "qtype": qtype,
+                "state": [[a.real, a.imag] for a in ref_qft(ref, n, qtype)],
+            })
+    for n, marked, iters in ((3, 5, 2), (5, 19, 4), (7, 100, 6)):
+        entries.append({
+            "algorithm": "grover", "n": n, "marked": marked, "iters": iters,
+            "state": [[a.real, a.imag]
+                      for a in ref_grover(ref, n, marked, iters)],
+        })
+    with open(out_path, "w") as f:
+        json.dump(entries, f)
+    print(f"wrote {out_path} ({len(entries)} states)")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "golden_ref", "algor.json"))
