@@ -497,6 +497,20 @@ class Circuit:
         cc.is_density = density
         return cc
 
+    def compile_dd(self, env: QuESTEnv):
+        """Compile to the double-double amplitude path (two-f32 per
+        component, ~48 significand bits): one jitted donated-buffer
+        program holding the reference quad-build's accuracy class on
+        f32-only TPU hardware (``ops/doubledouble.py``). Raises
+        ``ValueError`` for ops outside the dd subset (parameterised or
+        multi-target dense gates)."""
+        if env.mesh is not None:
+            raise ValueError(
+                "dd mode is single-device for now; create the env with "
+                "num_devices=1 (sharded dd planes are future work)")
+        from .ops.doubledouble import DDProgram
+        return DDProgram(list(self.ops), self.num_qubits)
+
 
 def _group_supergates(ops: list, max_k: int = 4,
                       fold_diags: bool = True) -> list:

@@ -99,3 +99,56 @@ def test_dd_roundtrip_and_perm():
     # X then X is identity, exactly (permutations are error-free)
     out = dd.dd_apply_perm_1q(dd.dd_apply_perm_1q(planes, 6, 2), 6, 2)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(planes))
+
+
+def test_dd_program_brickwork():
+    """compile_dd on the bench workload (rotations + CNOT brickwork):
+    one jitted program tracking the f64 compiled path below 1e-12."""
+    import quest_tpu as qt
+    from bench import build_bench_circuit
+    env = qt.createQuESTEnv(num_devices=1, seed=[9], precision=qt.DOUBLE)
+    n = 8
+    circ, n_gates = build_bench_circuit(n, 4)
+
+    q = qt.createQureg(n, env)
+    qt.initZeroState(q)
+    circ.compile(env).run(q)
+    ref = q.to_numpy()
+
+    prog = circ.compile_dd(env)
+    planes = prog.run(prog.init_zero())
+    got = prog.unpack(planes)
+    assert np.max(np.abs(got - ref)) < 1e-12
+    assert abs(prog.total_prob(planes) - 1.0) < 1e-12
+
+
+def test_dd_program_qft_phase_family():
+    """QFT exercises the dd diagonal path (cphase) + SWAP decomposition."""
+    import quest_tpu as qt
+    from quest_tpu import algorithms as alg
+    env = qt.createQuESTEnv(num_devices=1, seed=[9], precision=qt.DOUBLE)
+    n = 6
+    circ = alg.qft(n)
+    q = qt.createQureg(n, env)
+    qt.initDebugState(q)
+    circ.compile(env).run(q)
+    ref = q.to_numpy()
+
+    prog = circ.compile_dd(env)
+    q2 = qt.createQureg(n, env)
+    qt.initDebugState(q2)
+    planes = prog.run(prog.pack(q2.to_numpy()))
+    assert np.max(np.abs(prog.unpack(planes) - ref)) < 1e-12
+
+
+def test_dd_program_rejects_unsupported():
+    import quest_tpu as qt
+    from quest_tpu.circuits import Circuit
+    env = qt.createQuESTEnv(num_devices=1, seed=[9])
+    c = Circuit(3)
+    c.gate(np.kron(np.eye(2), np.eye(2)), (0, 1))   # 2-target dense
+    try:
+        c.compile_dd(env)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
