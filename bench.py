@@ -215,6 +215,35 @@ def bench_pallas_compare(qt, env, platform: str, num_qubits: int,
     }
 
 
+def bench_dd(qt, env, platform: str) -> dict:
+    """Double-double (two-f32) high-precision compiled program: the
+    reference quad-build analogue on f32-only hardware (docs/accuracy.md).
+    The roofline baseline is scaled to the dd state's byte width (16 B/amp
+    = same bytes as the complex128 the TPU cannot natively compute on)."""
+    num_qubits = int(os.environ.get(
+        "QUEST_BENCH_DD_QUBITS", "20" if _is_accel(platform) else "16"))
+    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 3)
+    circ, n_gates = build_bench_circuit(num_qubits, 1)
+    prog = circ.compile_dd(env)
+    planes = prog.run(prog.init_zero())          # compile + warm-up
+    planes.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        planes = prog.run(planes)
+    planes.block_until_ready()
+    dt = time.perf_counter() - t0
+    ops_per_sec = n_gates * trials / dt
+    # dd state is 16 B/amp (4 f32 planes) — same roofline bytes as f64
+    baseline = _roofline_baseline(num_qubits, 8)
+    return {
+        "metric": f"double-double (2xf32) gate throughput, {num_qubits}-"
+                  f"qubit statevector, single {platform} chip",
+        "value": round(ops_per_sec, 2),
+        "unit": "gates/sec",
+        "vs_baseline": round(ops_per_sec / baseline, 4),
+    }
+
+
 def bench_qft(qt, env, platform: str) -> dict:
     from quest_tpu.algorithms import qft
     num_qubits = int(os.environ.get(
@@ -338,6 +367,7 @@ def main() -> None:
         ("qft", 60, lambda: bench_qft(qt, env, platform)),
         ("grover", 45, lambda: bench_grover(qt, env, platform)),
         ("density", 45, lambda: bench_density_noise(qt, env, platform)),
+        ("dd", 45, lambda: bench_dd(qt, env, platform)),
     ]
     if accel:
         # on CPU the Pallas pass is inert (circuits.py enable gate), so the
