@@ -710,7 +710,8 @@ def multiControlledPhaseShift(qureg: Qureg, qubits: Sequence[int],
     tensor[(1,) * k] = np.exp(1j * angle)
     _apply_diag_gate(qureg, tensor, qubits)
     qureg.qasm_log.record_param_gate("phase_shift", qubits[-1], angle,
-                                     tuple(qubits[:-1]))
+                                     tuple(qubits[:-1]),
+                                     kind="multicontrolled")
 
 
 def controlledPhaseFlip(qureg: Qureg, q1: int, q2: int) -> None:
@@ -789,7 +790,8 @@ def multiControlledUnitary(qureg: Qureg, controls: Sequence[int],
     u = mats.matrix2(u)
     val.validate_unitary(u, "multiControlledUnitary", qureg.env.precision.eps)
     _apply_gate(qureg, u, (target,), tuple(controls))
-    qureg.qasm_log.record_unitary(u, target, tuple(controls))
+    qureg.qasm_log.record_unitary(u, target, tuple(controls),
+                                  kind="multicontrolled")
 
 
 def multiStateControlledUnitary(qureg: Qureg, controls: Sequence[int],

@@ -49,6 +49,12 @@ def _zyz_from_complex_pair(alpha: complex, beta: complex):
     return rz2, ry, rz1
 
 
+# the reference prints gate parameters with REAL_QASM_FORMAT = "%.14g" in
+# its double build (QuEST_precision.h:47); parameters are host f64 here
+def _fmt(x: float) -> str:
+    return f"{float(x):.14g}"
+
+
 def _pair_and_phase_from_unitary(u):
     """Split u into exp(i phase) * compact(alpha, beta)
     (``getComplexPairAndPhaseFromUnitary`` ``QuEST_common.c:135-147``)."""
@@ -99,25 +105,48 @@ class QASMLogger:
         self._add(f"{self._ctrl_label(gate, len(controls))} "
                   f"{self._qubits(*controls, target)};")
 
+    def _restore_phase(self, noun: str, angle: float, target: int,
+                       controls: tuple, kind: str) -> None:
+        """QASM's cRz / controlled-U drop a global phase that becomes
+        physical under control; the reference restores it with an explicit
+        uncontrolled Rz on the target plus a comment
+        (``qasm_recordControlledParamGate`` ``QuEST_qasm.c:256-261``,
+        ``qasm_record(Multi)ControlledUnitary`` ``:277-297,341-360``)."""
+        kind = kind or ("controlled" if len(controls) == 1
+                        else "multicontrolled")
+        self.record_comment(
+            "Restoring the discarded global phase of the previous "
+            f"{kind} {noun}")
+        self._add(f"{GATE_LABELS['rotate_z']}({_fmt(angle)}) "
+                  f"{self._qubits(target)};")
+
     def record_param_gate(self, gate: str, target: int, param: float,
-                          controls: tuple = ()) -> None:
-        self._add(f"{self._ctrl_label(gate, len(controls))}({param:g}) "
+                          controls: tuple = (), kind: str = None) -> None:
+        """``kind`` names the API entry point ("controlled" /
+        "multicontrolled") for the phase-restoration comment — the
+        reference words it per function, not per control count."""
+        self._add(f"{self._ctrl_label(gate, len(controls))}({_fmt(param)}) "
                   f"{self._qubits(*controls, target)};")
+        # the reference's multicontrolled form restores the phase even with
+        # zero controls (qasm_recordMultiControlledParamGate fires on the
+        # gate type alone, QuEST_qasm.c:331-338)
+        if gate == "phase_shift" and (controls or kind == "multicontrolled"):
+            self._restore_phase("phase gate", param / 2.0, target,
+                                controls, kind)
 
     def record_compact_unitary(self, alpha, beta, target: int,
                                controls: tuple = ()) -> None:
         rz2, ry, rz1 = _zyz_from_complex_pair(complex(alpha), complex(beta))
         label = CTRL_PREFIX * len(controls) + GATE_LABELS["unitary"]
-        self._add(f"{label}({rz2:g},{ry:g},{rz1:g}) "
+        self._add(f"{label}({_fmt(rz2)},{_fmt(ry)},{_fmt(rz1)}) "
                   f"{self._qubits(*controls, target)};")
 
-    def record_unitary(self, u, target: int, controls: tuple = ()) -> None:
+    def record_unitary(self, u, target: int, controls: tuple = (),
+                       kind: str = None) -> None:
         alpha, beta, phase = _pair_and_phase_from_unitary(u)
-        if controls and abs(phase) > 1e-12:
-            self.record_comment(
-                "the following gate has an un-recorded global phase of "
-                f"{phase:g} (significant when controlled)")
         self.record_compact_unitary(alpha, beta, target, controls)
+        if controls:
+            self._restore_phase("unitary", phase, target, controls, kind)
 
     def record_axis_rotation(self, angle: float, axis, target: int,
                              controls: tuple = ()) -> None:
@@ -128,9 +157,14 @@ class QASMLogger:
     def record_multi_state_controlled_unitary(self, u, controls, control_state,
                                               target: int) -> None:
         flips = [c for c, s in zip(controls, control_state) if s == 0]
+        self.record_comment("NOTing some gates so that the subsequent "
+                            "unitary is controlled-on-0")
         for c in flips:
             self.record_gate("sigma_x", c)
-        self.record_unitary(u, target, tuple(controls))
+        self.record_unitary(u, target, tuple(controls),
+                            kind="multicontrolled")
+        self.record_comment("Undoing the NOTing of the controlled-on-0 "
+                            "qubits of the previous unitary")
         for c in flips:
             self.record_gate("sigma_x", c)
 

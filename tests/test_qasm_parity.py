@@ -1,0 +1,92 @@
+"""QASM transcript parity with the reference logger.
+
+``tests/golden_ref/qasm_ref.txt`` was written by the reference's own QASM
+logger (libQuEST driven over ctypes — the generator sequence is embedded in
+the file's sibling ``tools/ref_golden_gen.py`` ecosystem; see the git log)
+for the mixed gate sequence below. This test replays the SAME sequence
+through the framework's recorder and compares structurally: gate labels,
+comment lines, and qubit operands must match exactly; numeric parameters to
+1e-10 (both sides print ``%.14g`` but compute the ZYZ angles through
+different code paths).
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+REF_PATH = os.path.join(os.path.dirname(__file__), "golden_ref",
+                        "qasm_ref.txt")
+
+
+def record_sequence(q):
+    u = np.exp(0.4j) * np.array([[0.6, 0.8], [-0.8, 0.6]], complex)
+    qt.hadamard(q, 0)
+    qt.controlledNot(q, 0, 1)
+    qt.rotateY(q, 2, 0.31)
+    qt.rotateX(q, 3, -1.2)
+    qt.sGate(q, 1)
+    qt.tGate(q, 0)
+    qt.pauliX(q, 2)
+    qt.pauliY(q, 3)
+    qt.pauliZ(q, 0)
+    qt.phaseShift(q, 1, 0.5)
+    qt.controlledPhaseShift(q, 0, 2, 0.25)
+    qt.multiControlledPhaseShift(q, [0, 1], 0.75)
+    qt.controlledPhaseFlip(q, 1, 3)
+    qt.multiControlledPhaseFlip(q, [0, 2, 3])
+    qt.unitary(q, 1, u)
+    qt.controlledUnitary(q, 0, 2, u)
+    qt.multiControlledUnitary(q, [1, 3], 2, u)
+    qt.multiStateControlledUnitary(q, [0, 3], [0, 1], 1, u)
+    qt.compactUnitary(q, 0, complex(0.6, 0.0), complex(0.0, 0.8))
+    qt.controlledCompactUnitary(q, 1, 0, complex(0.6, 0.0),
+                                complex(0.0, 0.8))
+    qt.rotateAroundAxis(q, 1, 0.7, (1.0, -2.0, 0.5))
+    qt.controlledRotateAroundAxis(q, 2, 1, 0.7, (1.0, -2.0, 0.5))
+    qt.controlledRotateZ(q, 3, 0, 0.9)
+    qt.swapGate(q, 0, 3)
+    qt.sqrtSwapGate(q, 1, 2)
+    qt.measure(q, 2)
+
+
+_NUM = re.compile(r"-?\d+\.?\d*(?:[eE][-+]?\d+)?")
+
+
+def _structure(text: str):
+    """Split each line into (skeleton-with-numbers-masked, [numbers])."""
+    out = []
+    for line in text.strip().splitlines():
+        nums = [float(m) for m in _NUM.findall(line)
+                if "." in m or "e" in m or "E" in m]
+        skel = _NUM.sub(lambda m: "#" if ("." in m.group() or "e" in
+                                          m.group().lower()) else m.group(),
+                        line)
+        out.append((skel, nums))
+    return out
+
+
+def test_qasm_matches_reference(env):
+    assert os.path.exists(REF_PATH), \
+        "qasm_ref.txt missing — regenerate via the reference binary"
+    q = qt.createQureg(4, env)
+    qt.initZeroState(q)
+    qt.startRecordingQASM(q)
+    record_sequence(q)
+    mine = _structure(q.qasm_log.text())
+    ref = _structure(open(REF_PATH).read())
+    assert len(mine) == len(ref), (
+        f"{len(mine)} lines vs reference {len(ref)}:\n"
+        + q.qasm_log.text())
+    for i, ((ms, mn), (rs, rn)) in enumerate(zip(mine, ref)):
+        assert ms == rs, f"line {i}: {ms!r} != reference {rs!r}"
+        assert len(mn) == len(rn), f"line {i}: params {mn} vs {rn}"
+        for a, b in zip(mn, rn):
+            # angles may differ by 2*pi (equivalent rotations; the two
+            # ZYZ implementations pick different branches)
+            d = abs(a - b)
+            assert min(d, abs(d - 2 * np.pi)) < 1e-10, \
+                f"line {i}: param {a} vs reference {b}"
