@@ -1,9 +1,9 @@
 """QASM transcript parity with the reference logger.
 
 ``tests/golden_ref/qasm_ref.txt`` was written by the reference's own QASM
-logger (libQuEST driven over ctypes — the generator sequence is embedded in
-the file's sibling ``tools/ref_golden_gen.py`` ecosystem; see the git log)
-for the mixed gate sequence below. This test replays the SAME sequence
+logger (libQuEST driven over ctypes by ``tools/ref_qasm_gen.py``, which
+mirrors :func:`record_sequence` below — keep the two in lockstep) for the
+mixed gate sequence below. This test replays the SAME sequence
 through the framework's recorder and compares structurally: gate labels,
 comment lines, and qubit operands must match exactly; numeric parameters to
 1e-10 (both sides print ``%.14g`` but compute the ZYZ angles through

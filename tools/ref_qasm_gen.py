@@ -1,0 +1,73 @@
+"""Regenerate tests/golden_ref/qasm_ref.txt from the reference binary.
+
+Drives the reference's own QASM logger (libQuEST built by
+``tools/build_reference.sh``) through the exact gate sequence of
+``tests/test_qasm_parity.py::record_sequence`` and writes the transcript
+the parity test compares against. Keep the two sequences in lockstep.
+
+Usage::
+
+    sh tools/build_reference.sh
+    python tools/ref_qasm_gen.py
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ref_golden_gen import (  # noqa: E402
+    LIB_PATH, Ref, Complex, Vector, _ints, _load, _m2)
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "golden_ref", "qasm_ref.txt")
+
+
+def main() -> None:
+    lib = _load(LIB_PATH)
+    lib.startRecordingQASM.restype = None
+    lib.writeRecordedQASMToFile.restype = None
+
+    ref = Ref(lib)
+    q = ref.prepare("z", 4)
+    lib.startRecordingQASM(q)
+    u = _m2(np.exp(0.4j) * np.array([[0.6, 0.8], [-0.8, 0.6]], complex))
+    lib.hadamard(q, 0)
+    lib.controlledNot(q, 0, 1)
+    lib.rotateY(q, 2, ct.c_double(0.31))
+    lib.rotateX(q, 3, ct.c_double(-1.2))
+    lib.sGate(q, 1)
+    lib.tGate(q, 0)
+    lib.pauliX(q, 2)
+    lib.pauliY(q, 3)
+    lib.pauliZ(q, 0)
+    lib.phaseShift(q, 1, ct.c_double(0.5))
+    lib.controlledPhaseShift(q, 0, 2, ct.c_double(0.25))
+    lib.multiControlledPhaseShift(q, _ints([0, 1]), 2, ct.c_double(0.75))
+    lib.controlledPhaseFlip(q, 1, 3)
+    lib.multiControlledPhaseFlip(q, _ints([0, 2, 3]), 3)
+    lib.unitary(q, 1, u)
+    lib.controlledUnitary(q, 0, 2, u)
+    lib.multiControlledUnitary(q, _ints([1, 3]), 2, 2, u)
+    lib.multiStateControlledUnitary(q, _ints([0, 3]), _ints([0, 1]), 2, 1, u)
+    lib.compactUnitary(q, 0, Complex(0.6, 0.0), Complex(0.0, 0.8))
+    lib.controlledCompactUnitary(q, 1, 0, Complex(0.6, 0.0),
+                                 Complex(0.0, 0.8))
+    lib.rotateAroundAxis(q, 1, ct.c_double(0.7), Vector(1.0, -2.0, 0.5))
+    lib.controlledRotateAroundAxis(q, 2, 1, ct.c_double(0.7),
+                                   Vector(1.0, -2.0, 0.5))
+    lib.controlledRotateZ(q, 3, 0, ct.c_double(0.9))
+    lib.swapGate(q, 0, 3)
+    lib.sqrtSwapGate(q, 1, 2)
+    lib.measure(q, 2)
+    lib.writeRecordedQASMToFile(q, OUT.encode())
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()
