@@ -21,20 +21,45 @@ __all__ = [
     "bernstein_vazirani",
     "ghz",
     "random_circuit",
+    "phase_estimation",
+    "trotter_evolution",
 ]
+
+
+def _append_qft(c: Circuit, qubits, inverse: bool = False,
+                swap_order: bool = True) -> None:
+    """Emit the QFT gate ladder onto ``qubits`` of an existing circuit
+    (single source of the gate ordering/angle convention, shared by
+    :func:`qft` and :func:`phase_estimation`)."""
+    qubits = list(qubits)
+    nq = len(qubits)
+    ops = []
+    for i in range(nq - 1, -1, -1):
+        ops.append(("h", qubits[i], None, None))
+        for k, j in enumerate(range(i - 1, -1, -1), start=2):
+            ops.append(("cphase", qubits[j], qubits[i],
+                        2.0 * np.pi / (1 << k)))
+    if swap_order:
+        for i in range(nq // 2):
+            ops.append(("swap", qubits[i], qubits[nq - 1 - i], None))
+    if inverse:
+        # h and swap are self-inverse; cphase inverts by angle negation
+        ops = [(o[0], o[1], o[2], -o[3] if o[0] == "cphase" else None)
+               for o in reversed(ops)]
+    for kind, a, b, angle in ops:
+        if kind == "h":
+            c.h(a)
+        elif kind == "swap":
+            c.swap(a, b)
+        else:
+            c.cphase(a, b, angle)
 
 
 def qft(num_qubits: int, swap_order: bool = True) -> Circuit:
     """Quantum Fourier transform (the reference's `tests/algor/QFT.test`
     workload): H + controlled phase ladder, optional bit-reversal swaps."""
     c = Circuit(num_qubits)
-    for q in range(num_qubits - 1, -1, -1):
-        c.h(q)
-        for k, ctrl in enumerate(range(q - 1, -1, -1), start=2):
-            c.cphase(ctrl, q, 2.0 * np.pi / (1 << k))
-    if swap_order:
-        for q in range(num_qubits // 2):
-            c.swap(q, num_qubits - 1 - q)
+    _append_qft(c, range(num_qubits), swap_order=swap_order)
     return c
 
 
@@ -126,4 +151,104 @@ def random_circuit(num_qubits: int, depth: int, seed: int = 0,
                 c.cnot(q, q + 1)
             else:
                 c.cz(q, q + 1)
+    return c
+
+
+def phase_estimation(num_counting: int, unitary: np.ndarray,
+                     num_target: int | None = None) -> Circuit:
+    """Quantum phase estimation: ``num_counting`` counting qubits estimate
+    the eigenphase of ``unitary`` applied to the high ``num_target`` qubits.
+
+    Layout: qubits ``[0, num_counting)`` are the counting register (the
+    estimate ends up bit-reversed-free after the inverse QFT with swaps);
+    qubits ``[num_counting, num_counting+num_target)`` hold the eigenstate,
+    which the caller prepares before running. Controlled powers ``U^(2^j)``
+    are formed by repeated host-side squaring (exact for the matrix sizes
+    QPE uses) and applied through the engine's controlled dense path. No
+    reference counterpart — the compiled-circuit fast path makes whole-QPE
+    a single executable.
+    """
+    u = np.asarray(unitary, dtype=np.complex128)
+    k = int(np.log2(u.shape[0]))
+    if num_target is None:
+        num_target = k
+    if u.shape != (1 << num_target, 1 << num_target):
+        raise ValueError("unitary dimension does not match num_target")
+    n = num_counting + num_target
+    targets = tuple(range(num_counting, n))
+    c = Circuit(n)
+    for q in range(num_counting):
+        c.h(q)
+    u_pow = u
+    for j in range(num_counting):
+        c.gate(u_pow, targets, controls=(j,))
+        u_pow = u_pow @ u_pow
+    # inverse QFT on the counting register (phases accumulate as
+    # |x> -> e^{2 pi i phi x}, little-endian in counting qubit index)
+    _append_qft(c, range(num_counting), inverse=True)
+    return c
+
+
+def trotter_evolution(num_qubits: int, pauli_terms, coeffs, time: float,
+                      num_steps: int, order: int = 1) -> Circuit:
+    """First- or second-order Trotterised ``exp(-i H t)`` for
+    ``H = sum_j coeffs[j] * P_j`` (each ``pauli_terms[j]`` a sequence of
+    ``(qubit, code)`` with codes 1=X, 2=Y, 3=Z).
+
+    Each Pauli-product exponential is basis-rotated to Z...Z, applied as a
+    parity-phase diagonal (the engine's communication-free fast path — the
+    ``multiRotateZ`` machinery), and rotated back; the whole evolution
+    compiles to one executable. No reference counterpart (the reference
+    offers only ``multiRotatePauli`` as the single-term primitive).
+    """
+    terms = []
+    for t in pauli_terms:
+        term = tuple((int(q), int(code)) for q, code in t
+                     if int(code) != 0)      # identity factors drop out
+        for q, code in term:
+            if code not in (1, 2, 3):
+                raise ValueError(f"invalid Pauli code {code} "
+                                 "(0=I, 1=X, 2=Y, 3=Z)")
+        if not term:
+            raise ValueError(
+                "an all-identity Pauli term contributes only a global "
+                "phase, which a gate circuit cannot represent; fold it "
+                "into the observable instead")
+        terms.append(term)
+    coeffs = [float(x) for x in coeffs]
+    if len(terms) != len(coeffs):
+        raise ValueError("one coefficient per Pauli term is required")
+    if num_steps < 1:
+        raise ValueError("num_steps must be >= 1")
+    if order not in (1, 2):
+        raise ValueError("order must be 1 or 2")
+    c = Circuit(num_qubits)
+
+    def apply_term(term, angle):
+        if not term:
+            return                      # identity term: global phase only
+        qubits = [q for q, _ in term]
+        # basis rotation: X -> H, Y -> Rx(pi/2), Z -> nothing
+        for q, code in term:
+            if code == 1:
+                c.h(q)
+            elif code == 2:
+                c.rx(q, np.pi / 2.0)
+        c.multi_rotate_z(qubits, angle)
+        for q, code in term:
+            if code == 1:
+                c.h(q)
+            elif code == 2:
+                c.rx(q, -np.pi / 2.0)
+
+    dt = time / num_steps
+    for _ in range(num_steps):
+        if order == 1:
+            for term, w in zip(terms, coeffs):
+                apply_term(term, 2.0 * w * dt)
+        else:
+            for term, w in zip(terms, coeffs):
+                apply_term(term, w * dt)
+            for term, w in zip(reversed(terms), reversed(coeffs)):
+                apply_term(term, w * dt)
     return c
