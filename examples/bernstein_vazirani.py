@@ -7,7 +7,11 @@ whole-circuit fast path (quest_tpu.algorithms.bernstein_vazirani).
 Run: python examples/bernstein_vazirani.py [num_qubits] [secret]
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from anywhere, uninstalled
 
 import quest_tpu as qt
 from quest_tpu import algorithms as alg

@@ -7,6 +7,12 @@ after each application.
 Run: python examples/damping_example.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from anywhere, uninstalled
+
 import quest_tpu as qt
 
 env = qt.createQuESTEnv()

@@ -12,6 +12,12 @@ Four things QuEST cannot do, in ~60 lines:
 Run: python examples/tpu_features.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from anywhere, uninstalled
+
 import numpy as np
 import jax
 

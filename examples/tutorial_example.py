@@ -7,6 +7,12 @@ existing QuEST user should recognise every line.
 Run: python examples/tutorial_example.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from anywhere, uninstalled
+
 import numpy as np
 import quest_tpu as qt
 

@@ -1,0 +1,105 @@
+"""Variational quantum eigensolver on the compiled-circuit fast path.
+
+Beyond-reference capability demo: a compiled circuit's expectation value is
+a pure, jitted function of its parameter vector, so ``jax.value_and_grad``
+gives exact gradients (no parameter-shift sampling) and optax runs the
+optimisation loop entirely on device. The reference exposes only per-gate
+imperative calls — no autodiff is possible there.
+
+Problem: ground state of the 4-qubit transverse-field Ising Hamiltonian
+    H = -J sum_i Z_i Z_{i+1} - h sum_i X_i
+with a hardware-efficient Ry+CNOT ansatz.
+
+Run:  python examples/vqe.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from anywhere, uninstalled
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    import optax
+except ImportError:                      # pragma: no cover
+    optax = None
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+
+N = 4
+J, H_FIELD = 1.0, 0.7
+LAYERS = 3
+
+
+def ansatz() -> Circuit:
+    c = Circuit(N)
+    for layer in range(LAYERS):
+        for q in range(N):
+            c.ry(q, c.parameter(f"t{layer}_{q}"))
+        for q in range(N - 1):
+            c.cnot(q, q + 1)
+    return c
+
+
+def hamiltonian_terms():
+    terms, coeffs = [], []
+    for i in range(N - 1):
+        terms.append([(i, int(qt.PAULI_Z)), (i + 1, int(qt.PAULI_Z))])
+        coeffs.append(-J)
+    for i in range(N):
+        terms.append([(i, int(qt.PAULI_X))])
+        coeffs.append(-H_FIELD)
+    return terms, coeffs
+
+
+def exact_ground_energy(terms, coeffs) -> float:
+    mats = {1: np.array([[0, 1], [1, 0]], complex),
+            3: np.diag([1.0, -1.0]).astype(complex)}
+    h = np.zeros((1 << N, 1 << N), complex)
+    for term, w in zip(terms, coeffs):
+        full = np.eye(1, dtype=complex)
+        sel = {q: mats[c] for q, c in term}
+        for q in range(N - 1, -1, -1):
+            full = np.kron(full, sel.get(q, np.eye(2, dtype=complex)))
+        h += w * full
+    return float(np.linalg.eigvalsh(h)[0])
+
+
+def main() -> None:
+    env = qt.createQuESTEnv(num_devices=1, seed=[7])
+    circ = ansatz()
+    terms, coeffs = hamiltonian_terms()
+    energy = circ.compile(env).expectation_fn(terms, coeffs)
+    loss = jax.jit(jax.value_and_grad(energy))
+
+    rng = np.random.default_rng(0)
+    params = jnp.asarray(rng.uniform(-0.1, 0.1, size=LAYERS * N),
+                         dtype=env.precision.real_dtype)
+
+    if optax is None:
+        print("optax unavailable; showing a single gradient step instead")
+        e, g = loss(params)
+        print(f"E = {float(e):+.6f}, |grad| = {float(jnp.linalg.norm(g)):.4f}")
+        return
+
+    opt = optax.adam(5e-2)
+    state = opt.init(params)
+    for step in range(200):
+        e, g = loss(params)
+        updates, state = opt.update(g, state)
+        params = optax.apply_updates(params, updates)
+        if step % 40 == 0:
+            print(f"step {step:3d}: E = {float(e):+.6f}")
+    e_final = float(loss(params)[0])
+    e_exact = exact_ground_energy(terms, coeffs)
+    print(f"final:     E = {e_final:+.6f}")
+    print(f"exact:     E = {e_exact:+.6f}  (error {e_final - e_exact:+.2e})")
+
+
+if __name__ == "__main__":
+    main()

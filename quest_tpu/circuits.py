@@ -501,15 +501,16 @@ class Circuit:
         """Compile to the double-double amplitude path (two-f32 per
         component, ~48 significand bits): one jitted donated-buffer
         program holding the reference quad-build's accuracy class on
-        f32-only TPU hardware (``ops/doubledouble.py``). Raises
-        ``ValueError`` for ops outside the dd subset (parameterised or
-        multi-target dense gates)."""
-        if env.mesh is not None:
-            raise ValueError(
-                "dd mode is single-device for now; create the env with "
-                "num_devices=1 (sharded dd planes are future work)")
+        f32-only TPU hardware (``ops/doubledouble.py``). On a mesh env
+        the planes shard on the amplitude axis like every other register
+        form. Raises ``ValueError`` for ops outside the dd subset
+        (parameterised or multi-target dense gates)."""
         from .ops.doubledouble import DDProgram
-        return DDProgram(list(self.ops), self.num_qubits)
+        sharding = env.sharding() if (
+            env.mesh is not None
+            and (1 << self.num_qubits) >= env.num_devices) else None
+        return DDProgram(list(self.ops), self.num_qubits,
+                         sharding=sharding)
 
 
 def _group_supergates(ops: list, max_k: int = 4,
