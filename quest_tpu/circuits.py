@@ -497,12 +497,14 @@ class Circuit:
         cc.is_density = density
         return cc
 
-    def compile_dd(self, env: QuESTEnv):
-        """Compile to the double-double amplitude path (two-f32 per
-        component, ~48 significand bits): one jitted donated-buffer
-        program holding the reference quad-build's accuracy class on
-        f32-only TPU hardware (``ops/doubledouble.py``). On a mesh env
-        the planes shard on the amplitude axis like every other register
+    def compile_dd(self, env: QuESTEnv, dtype=None):
+        """Compile to the double-double amplitude path: each amplitude
+        component is an unevaluated hi+lo pair of ``dtype`` floats
+        (``ops/doubledouble.py``). ``dtype`` defaults to the env's real
+        dtype: float32 planes give a ~48-bit significand (f64-class
+        results on f32-only TPU hardware); float64 planes give ~106 bits
+        — the reference quad-build analogue (CPU/x64). On a mesh env the
+        planes shard on the amplitude axis like every other register
         form. Raises ``ValueError`` for ops outside the dd subset
         (parameterised or multi-target dense gates)."""
         from .ops.doubledouble import DDProgram
@@ -510,7 +512,8 @@ class Circuit:
             env.mesh is not None
             and (1 << self.num_qubits) >= env.num_devices) else None
         return DDProgram(list(self.ops), self.num_qubits,
-                         sharding=sharding)
+                         sharding=sharding,
+                         dtype=np.dtype(dtype or env.precision.real_dtype))
 
 
 def _group_supergates(ops: list, max_k: int = 4,

@@ -72,14 +72,16 @@ def _dd_neg(xh, xl):
 
 # --- packing ---------------------------------------------------------------
 
-def dd_pack(z: np.ndarray) -> jnp.ndarray:
-    """complex128 host vector -> (4, n) float32 dd planes."""
-    z = np.asarray(z, dtype=np.complex128)
-    re_hi = z.real.astype(np.float32)
-    re_lo = (z.real - re_hi).astype(np.float32)
-    im_hi = z.imag.astype(np.float32)
-    im_lo = (z.imag - im_hi).astype(np.float32)
-    return jnp.asarray(np.stack([re_hi, re_lo, im_hi, im_lo]))
+def dd_pack(z: np.ndarray, dtype=np.float32) -> jnp.ndarray:
+    """complex128 host vector -> (4, n) dd planes.
+
+    ``dtype=float32`` (default): ~48-bit significand on TPU hardware.
+    ``dtype=float64`` (CPU/x64): double-double over f64 — a ~106-bit
+    significand, the analogue of the reference's quad-precision build
+    (``QuEST_PREC=4``, ``QuEST_precision.h:53-65``). Note a float64
+    ``hi`` already captures a complex128 input exactly, so the extra
+    precision manifests during gate arithmetic, not at packing."""
+    return jnp.asarray(_dd_split_host(z, dtype))
 
 
 def dd_unpack(planes) -> np.ndarray:
@@ -119,9 +121,10 @@ def _dd_apply_1q_jit(planes, u_dd, num_qubits, target):
 
 
 def dd_apply_1q(planes, num_qubits: int, u: np.ndarray, target: int):
-    """Apply a 1-qubit unitary (f64 numpy, dd-split internally) to dd
-    planes of shape (4, 2^n)."""
-    u_dd = _dd_split_host(np.asarray(u, dtype=np.complex128))
+    """Apply a 1-qubit unitary (f64 numpy, dd-split to the planes' dtype)
+    to dd planes of shape (4, 2^n)."""
+    u_dd = _dd_split_host(np.asarray(u, dtype=np.complex128),
+                          np.dtype(planes.dtype))
     return _dd_apply_1q_jit(planes, jnp.asarray(u_dd), num_qubits, target)
 
 
@@ -169,13 +172,13 @@ def _index_bits_cond(num_amps: int, mask: int, pattern: int):
     return cond.reshape(num_amps)
 
 
-def _dd_split_host(z: np.ndarray) -> np.ndarray:
-    """complex128 array -> (4, ...) f32 dd planes (host-side)."""
+def _dd_split_host(z: np.ndarray, dtype=np.float32) -> np.ndarray:
+    """complex128 array -> (4, ...) dd planes (host-side)."""
     z = np.asarray(z, dtype=np.complex128)
-    re_hi = z.real.astype(np.float32)
-    im_hi = z.imag.astype(np.float32)
-    return np.stack([re_hi, (z.real - re_hi).astype(np.float32),
-                     im_hi, (z.imag - im_hi).astype(np.float32)])
+    re_hi = z.real.astype(dtype)
+    im_hi = z.imag.astype(dtype)
+    return np.stack([re_hi, (z.real - re_hi).astype(dtype),
+                     im_hi, (z.imag - im_hi).astype(dtype)])
 
 
 def _dd_u1_traced(planes, u_dd, num_qubits, target, ctrl_mask, flip_mask):
@@ -226,9 +229,10 @@ def _dd_diag_traced(planes, f_dd, num_qubits, targets_desc):
 
 def dd_apply_diag(planes, num_qubits: int, factors: np.ndarray,
                   targets_desc) -> jnp.ndarray:
-    """Apply a static diagonal factor tensor in dd arithmetic."""
-    f_dd = _dd_split_host(np.asarray(factors,
-                                     np.complex128).reshape(-1))
+    """Apply a static diagonal factor tensor in dd arithmetic (factors
+    dd-split to the planes' dtype)."""
+    f_dd = _dd_split_host(np.asarray(factors, np.complex128).reshape(-1),
+                          np.dtype(planes.dtype))
     return _dd_diag_jit(planes, jnp.asarray(f_dd), num_qubits,
                         tuple(int(q) for q in targets_desc))
 
@@ -264,9 +268,18 @@ class DDProgram:
     Built via :meth:`quest_tpu.circuits.Circuit.compile_dd`.
     """
 
-    def __init__(self, ops, num_qubits: int, sharding=None):
+    def __init__(self, ops, num_qubits: int, sharding=None,
+                 dtype=np.float32):
         self.num_qubits = num_qubits
         self.sharding = sharding
+        # float32 planes: ~48-bit significand (TPU hardware);
+        # float64 planes (CPU/x64): ~106 bits — the quad-build analogue
+        self.dtype = np.dtype(dtype)
+        if self.dtype == np.float64 and not jax.config.jax_enable_x64:
+            raise ValueError(
+                "float64 dd planes require jax_enable_x64; without it JAX "
+                "would silently downcast to float32 and the quad-tier "
+                "accuracy would quietly not exist")
         plan = []
         for op in ops:
             plan.extend(self._lower(op))
@@ -289,9 +302,10 @@ class DDProgram:
 
         self._jitted = jax.jit(run_body, donate_argnums=(0,))
 
+        dt = jnp.dtype(self.dtype)
+
         def init_zero_body():
-            return jnp.zeros((4, 1 << num_qubits),
-                             jnp.float32).at[0, 0].set(1.0)
+            return jnp.zeros((4, 1 << num_qubits), dt).at[0, 0].set(1.0)
 
         self._init_zero_jit = jax.jit(
             init_zero_body, out_shardings=sharding) if sharding is not None \
@@ -303,7 +317,8 @@ class DDProgram:
                 "parameterised gates are not supported in dd mode")
         if op.kind == "diag":
             f_dd = jnp.asarray(_dd_split_host(
-                np.asarray(op.diag, np.complex128).reshape(-1)))
+                np.asarray(op.diag, np.complex128).reshape(-1),
+                self.dtype))
             desc = op.targets
             return [lambda p, f=f_dd, d=desc: _dd_diag_traced(
                 p, f, self.num_qubits, d)]
@@ -324,7 +339,7 @@ class DDProgram:
             ctrl = op.ctrl_mask.bit_length() - 1 if op.ctrl_mask else -1
             return [lambda p, t=target, c=ctrl: _dd_apply_perm_1q_jit(
                 p, self.num_qubits, t, c)]
-        u_dd = jnp.asarray(_dd_split_host(op.mat))
+        u_dd = jnp.asarray(_dd_split_host(op.mat, self.dtype))
         cm, fm = op.ctrl_mask, op.flip_mask
         return [lambda p, u=u_dd, t=target, c=cm, f=fm: _dd_u1_traced(
             p, u, self.num_qubits, t, c, f)]
@@ -335,7 +350,8 @@ class DDProgram:
         return self._init_zero_jit()
 
     def pack(self, host_state: np.ndarray) -> jnp.ndarray:
-        planes = _dd_split_host(np.asarray(host_state, np.complex128))
+        planes = _dd_split_host(np.asarray(host_state, np.complex128),
+                                self.dtype)
         if self.sharding is None:
             return jnp.asarray(planes)
         if jax.process_count() > 1:
