@@ -76,7 +76,12 @@ class Spec:
     aux: Optional[str] = None
     # deterministically re-seed the env's RNG before each call — makes
     # sampling functions (measure/measureWithStats) golden-testable, the
-    # reference's broadcast-seeded-mt19937 strategy (`QuEST_common.c:181`)
+    # reference's broadcast-seeded-mt19937 strategy (`QuEST_common.c:181`).
+    # NOTE: reseed-spec goldens are CONSISTENCY tests of the framework's
+    # own threefry key stream, not cross-implementation oracles — any
+    # key-splitting change legitimately invalidates them (regenerate),
+    # and they are deliberately absent from tests/golden_ref/
+    # (docs/accuracy.md)
     reseed: bool = False
 
 
