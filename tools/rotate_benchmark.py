@@ -1,0 +1,93 @@
+"""The reference's own benchmark procedure, reproduced exactly.
+
+Mirrors ``/root/reference/tests/benchmarks/rotate_benchmark.test:10-60``:
+an n-qubit zero register, ``nTrials`` timed ``compactUnitary`` calls per
+target qubit (same alpha/beta derived from the same angle triple), logging
+``qubit, mean, stdev, max-mean, mean-min`` per target — apples-to-apples
+with the reference binary for the per-gate (imperative-dispatch) path.
+A second sweep times the same probe through a compiled single-gate circuit
+(parameter-free, one cached executable per target) to show the dispatch
+overhead the compiled path removes.
+
+Usage: python tools/rotate_benchmark.py [nQubits] [nTrials]
+(the reference uses 29 qubits / 20 trials; defaults here are 24/20 so the
+CPU fallback finishes quickly — pass 29 on a real chip)
+"""
+
+import os
+import statistics
+import sys
+import time
+from math import cos, sin
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    n_qubits = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    n_trials = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    if n_trials < 2:
+        sys.exit("nTrials must be >= 2 (stdev needs two data points)")
+
+    import jax
+    if os.environ.get("ROTBENCH_FORCE_CPU", "0") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    import quest_tpu as qt
+
+    env = qt.createQuESTEnv(num_devices=1, seed=[2026])
+    q = qt.createQureg(n_qubits, env)
+    qt.initZeroState(q)
+
+    ang = [1.2320, 0.4230, -0.6523]          # angles[0] of the reference
+    alpha = complex(cos(ang[0]) * cos(ang[1]), cos(ang[0]) * sin(ang[1]))
+    beta = complex(sin(ang[0]) * cos(ang[2]), sin(ang[0]) * sin(ang[2]))
+
+    print(qt.getEnvironmentString(env))
+    print(f"Rotating ({n_qubits} qubits, {n_trials} trials/target)")
+    print("qubit, mean, stdev, max-mean, mean-min   [imperative per-gate]")
+    for target in range(n_qubits):
+        # one untimed warm-up excludes the per-shape jit compile: the
+        # reference's C kernels have no JIT, so including the one-off
+        # trace would measure the toolchain, not the dispatch+kernel
+        qt.compactUnitary(q, target, alpha, beta)
+        q.state.block_until_ready()
+        timing = []
+        for _ in range(n_trials):
+            t0 = time.perf_counter()
+            qt.compactUnitary(q, target, alpha, beta)
+            q.state.block_until_ready()
+            timing.append(time.perf_counter() - t0)
+        mean = statistics.mean(timing)
+        sd = statistics.stdev(timing)
+        print(f"{target}, {mean:.6e}, {sd:.6e}, "
+              f"{max(timing) - mean:.6e}, {mean - min(timing):.6e}")
+    print("Done Rotating")
+    print(f"Total probability conservation : {qt.calcTotalProb(q)}")
+
+    # compiled-path sweep: one cached executable per target
+    from quest_tpu.circuits import Circuit
+    print("qubit, mean, stdev, max-mean, mean-min   [compiled circuit]")
+    for target in range(n_qubits):
+        c = Circuit(n_qubits)
+        c.gate(
+            [[alpha, -beta.conjugate()], [beta, alpha.conjugate()]],
+            (target,))
+        cc = c.compile(env)
+        cc.run(q)                             # compile + warm-up
+        q.state.block_until_ready()
+        timing = []
+        for _ in range(n_trials):
+            t0 = time.perf_counter()
+            cc.run(q)
+            q.state.block_until_ready()
+            timing.append(time.perf_counter() - t0)
+        mean = statistics.mean(timing)
+        sd = statistics.stdev(timing)
+        print(f"{target}, {mean:.6e}, {sd:.6e}, "
+              f"{max(timing) - mean:.6e}, {mean - min(timing):.6e}")
+    print("Done Rotating (compiled)")
+    print(f"Total probability conservation : {qt.calcTotalProb(q)}")
+
+
+if __name__ == "__main__":
+    main()
