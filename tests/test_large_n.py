@@ -199,3 +199,84 @@ def _np_mc_phase_flip(psi, n, qubits):
     for qb in qubits:
         allset &= ((idx >> qb) & 1).astype(bool)
     return psi * np.where(allset, -1.0, 1.0)
+
+
+@pytest.mark.slow
+def test_large_n_density_gate_by_gate(mesh_env):
+    """11-qubit density register = 22 flat qubits on the 8-device mesh:
+    every gate lifts to conj(U) x U on (t, t+11) — pairs that straddle the
+    lane (7) and shard (19+) boundaries by construction. Channels apply
+    per-Kraus-branch. Checked against a streamed flat-vector oracle after
+    every op."""
+    import quest_tpu as qt
+    n = 11
+    nf = 2 * n
+    rng = np.random.default_rng(42)
+    q = qt.createDensityQureg(n, mesh_env)
+    qt.initPlusState(q)
+    flat = np.full(1 << nf, 1.0 / (1 << n), dtype=np.complex128)
+
+    def lift_gate(u, targets, controls=()):
+        """conj(U) x U on the flat vector (QuEST.c:8-10): U on targets,
+        conj(U) on shifted targets; controls likewise duplicated."""
+        def orc(p):
+            cu = controlled_mat(u, len(controls)) if controls else u
+            ts = tuple(targets) + tuple(controls)
+            p = np_apply(p, nf, cu, ts)
+            ts2 = tuple(t + n for t in ts)
+            p = np_apply(p, nf, np.conj(cu), ts2)
+            return p
+        return orc
+
+    def lift_channel(kraus, targets):
+        def orc(p):
+            out = np.zeros_like(p)
+            for k in kraus:
+                b = np_apply(p, nf, k, tuple(targets))
+                b = np_apply(b, nf, np.conj(k),
+                             tuple(t + n for t in targets))
+                out += b
+            return out
+        return orc
+
+    damp = 0.23
+    damp_kraus = [np.array([[1, 0], [0, np.sqrt(1 - damp)]], complex),
+                  np.array([[0, np.sqrt(damp)], [0, 0]], complex)]
+    dep = 0.3
+    dep_kraus = [np.sqrt(1 - dep) * np.eye(2, dtype=complex)] + [
+        np.sqrt(dep / 3) * m for m in
+        (X, np.array([[0, -1j], [1j, 0]]), np.diag([1.0, -1.0]).astype(complex))]
+
+    u3 = random_unitary(1, rng)
+    program = [
+        ("h q10", lambda: qt.hadamard(q, 10), lift_gate(H, (10,))),
+        ("h q6", lambda: qt.hadamard(q, 6), lift_gate(H, (6,))),
+        ("cnot 10->0", lambda: qt.controlledNot(q, 10, 0),
+         lift_gate(X, (0,), (10,))),
+        ("u q8", lambda: qt.unitary(q, 8, u3), lift_gate(u3, (8,))),
+        ("rot q7", lambda: qt.rotateAroundAxis(q, 7, 0.71, (1, -2, .5)),
+         lift_gate(rot_mat(0.71, (1, -2, .5)), (7,))),
+        ("swap 3,9", lambda: qt.swapGate(q, 3, 9),
+         lift_gate(SWAP, (3, 9))),
+        ("damp q10", lambda: qt.mixDamping(q, 10, damp),
+         lift_channel(damp_kraus, (10,))),
+        ("depol q6", lambda: qt.mixDepolarising(q, 6, dep),
+         lift_channel(dep_kraus, (6,))),
+        ("dephase q0", lambda: qt.mixDephasing(q, 0, 0.4),
+         lift_channel([np.sqrt(0.6) * np.eye(2, dtype=complex),
+                       np.sqrt(0.4) * np.diag([1.0, -1.0]).astype(complex)],
+                      (0,))),
+        ("cphase 2,10", lambda: qt.controlledPhaseShift(q, 2, 10, 0.45),
+         lift_gate(np.diag([1, 1, 1, np.exp(0.45j)]).astype(complex),
+                   (2, 10))),
+    ]
+    for i, (name, fw, orc) in enumerate(program):
+        fw()
+        flat = orc(flat)
+        got = q.to_numpy()
+        err = np.max(np.abs(got - flat))
+        assert err < 1e-10, f"op {i} ({name}): max err {err:.2e}"
+    assert abs(qt.calcTotalProb(q) - 1.0) < 1e-10
+    # purity decreased under the channels, physical bounds hold
+    pur = qt.calcPurity(q)
+    assert 1.0 / (1 << n) - 1e-10 <= pur < 1.0

@@ -20,6 +20,20 @@ import sys
 import time
 from math import cos, sin
 
+
+def timed_sweep(apply_once, n_trials):
+    """One untimed warm-up (excludes the per-shape jit trace the
+    reference's C kernels never pay), then n_trials timed calls;
+    returns (mean, stdev, max, min)."""
+    apply_once()
+    timing = []
+    for _ in range(n_trials):
+        t0 = time.perf_counter()
+        apply_once()
+        timing.append(time.perf_counter() - t0)
+    return (statistics.mean(timing), statistics.stdev(timing),
+            max(timing), min(timing))
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -46,21 +60,12 @@ def main() -> None:
     print(f"Rotating ({n_qubits} qubits, {n_trials} trials/target)")
     print("qubit, mean, stdev, max-mean, mean-min   [imperative per-gate]")
     for target in range(n_qubits):
-        # one untimed warm-up excludes the per-shape jit compile: the
-        # reference's C kernels have no JIT, so including the one-off
-        # trace would measure the toolchain, not the dispatch+kernel
-        qt.compactUnitary(q, target, alpha, beta)
-        q.state.block_until_ready()
-        timing = []
-        for _ in range(n_trials):
-            t0 = time.perf_counter()
-            qt.compactUnitary(q, target, alpha, beta)
+        def once(t=target):
+            qt.compactUnitary(q, t, alpha, beta)
             q.state.block_until_ready()
-            timing.append(time.perf_counter() - t0)
-        mean = statistics.mean(timing)
-        sd = statistics.stdev(timing)
+        mean, sd, mx, mn = timed_sweep(once, n_trials)
         print(f"{target}, {mean:.6e}, {sd:.6e}, "
-              f"{max(timing) - mean:.6e}, {mean - min(timing):.6e}")
+              f"{mx - mean:.6e}, {mean - mn:.6e}")
     print("Done Rotating")
     print(f"Total probability conservation : {qt.calcTotalProb(q)}")
 
@@ -73,18 +78,13 @@ def main() -> None:
             [[alpha, -beta.conjugate()], [beta, alpha.conjugate()]],
             (target,))
         cc = c.compile(env)
-        cc.run(q)                             # compile + warm-up
-        q.state.block_until_ready()
-        timing = []
-        for _ in range(n_trials):
-            t0 = time.perf_counter()
+
+        def once():
             cc.run(q)
             q.state.block_until_ready()
-            timing.append(time.perf_counter() - t0)
-        mean = statistics.mean(timing)
-        sd = statistics.stdev(timing)
+        mean, sd, mx, mn = timed_sweep(once, n_trials)
         print(f"{target}, {mean:.6e}, {sd:.6e}, "
-              f"{max(timing) - mean:.6e}, {mean - min(timing):.6e}")
+              f"{mx - mean:.6e}, {mean - mn:.6e}")
     print("Done Rotating (compiled)")
     print(f"Total probability conservation : {qt.calcTotalProb(q)}")
 
