@@ -391,8 +391,14 @@ def reportQuESTEnv(env: QuESTEnv) -> None:
 
 
 def getEnvironmentString(env: QuESTEnv) -> str:
+    """Backend capability summary (``getEnvironmentString`` ``QuEST.h:832``,
+    which reports CUDA/OpenMP/MPI flags): reports the backend actually
+    carrying the computation, not a hardcoded assumption."""
     mode = "mesh" if env.mesh is not None else "local"
-    return (f"CUDA=0 OpenMP=0 MPI=0 TPU=1 mode={mode} "
+    platforms = {d.platform for d in jax.devices()}
+    on_tpu = 1 if platforms & {"tpu", "axon"} else 0
+    return (f"CUDA=0 OpenMP=0 MPI=0 TPU={on_tpu} backend="
+            f"{jax.default_backend()} mode={mode} "
             f"threads=1 ranks={env.num_ranks}")
 
 

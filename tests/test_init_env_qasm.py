@@ -127,7 +127,8 @@ def test_report_and_load_roundtrip(env, rng, tmp_path):
 
 def test_env_report_and_string(env):
     s = qt.getEnvironmentString(env)
-    assert "TPU=1" in s
+    # reports the live backend: TPU=0 on the CPU test rig
+    assert "TPU=0" in s and "backend=cpu" in s
     qt.reportQuESTEnv(env)
     qt.reportQuregParams(qt.createQureg(2, env))
     qt.syncQuESTEnv(env)
