@@ -1,0 +1,251 @@
+"""Differential fuzzing against the live reference binary.
+
+Seeded random API sequences run through BOTH the locally-built reference
+libQuEST (over the ctypes binding in ``tools/ref_golden_gen.py``, reusing
+its per-function ``ADAPTERS`` marshalling) and the framework, with the
+full state compared after EVERY operation at the reference's 1e-10
+tolerance — a stronger oracle than the fixed golden sweeps, reaching
+argument corners (control orders, target combinations, channel
+compositions) the sweeps don't enumerate.
+
+Skips cleanly when the reference library isn't available (it is built on
+demand by ``tools/build_reference.sh`` when ``/root/reference`` exists).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import random_kraus, random_unitary
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+LIB = os.environ.get("QUEST_REF_LIB", "/tmp/refbuild/libquest_ref.so")
+
+
+def _ensure_lib():
+    if os.path.exists(LIB):
+        return None
+    ref_dir = "/root/reference"
+    script = os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "build_reference.sh")
+    if not os.path.isdir(ref_dir):
+        return "reference sources not present"
+    try:
+        subprocess.run(["sh", script], check=True, capture_output=True,
+                       text=True, timeout=120)
+    except subprocess.CalledProcessError as e:
+        return f"reference build FAILED: {e.stderr[-500:]}"
+    except Exception as e:
+        return f"reference build error: {e}"
+    if not os.path.exists(LIB):
+        return "build succeeded but library missing"
+    return None
+
+
+_skip = _ensure_lib()
+if _skip:
+    pytest.skip(_skip, allow_module_level=True)
+
+from ref_golden_gen import ADAPTERS, Ref, _load  # noqa: E402
+
+N = 4
+
+
+def _build_moves(rng, density: bool):
+    """Yield (label, framework_fn(q), reference_name, reference_args):
+    the reference side is applied uniformly through ADAPTERS, so both
+    sides consume the same argument tuple."""
+    moves = []
+
+    def pick(k=1):
+        return [int(x) for x in rng.choice(N, k, replace=False)]
+
+    def add(label, fw, ref_name, args):
+        moves.append((label, fw, ref_name, args))
+
+    ops = ["1q", "rot", "compact", "u1", "c1q", "cps", "cpf", "mcps",
+           "mcpf", "swap2", "u2", "cu1", "mcu1", "mrz", "mrp", "u3",
+           "phase"]
+    if density:
+        ops += ["chan1", "2chan", "pauli", "kraus1", "kraus2"]
+
+    for _ in range(28):
+        kind = ops[int(rng.integers(len(ops)))]
+        if kind == "1q":
+            (t,) = pick()
+            f = ["hadamard", "pauliX", "pauliY", "pauliZ", "sGate",
+                 "tGate"][int(rng.integers(6))]
+            add(f"{f}({t})",
+                lambda q, f=f, t=t: getattr(qt, f)(q, t), f, (t,))
+        elif kind == "rot":
+            (t,) = pick()
+            ang = float(rng.uniform(0, 2 * np.pi))
+            ax = tuple(float(v) for v in rng.normal(size=3))
+            add(f"rotateAroundAxis({t})",
+                lambda q, t=t, a=ang, x=ax: qt.rotateAroundAxis(q, t, a, x),
+                "rotateAroundAxis", (t, ang, ax))
+        elif kind == "compact":
+            (t,) = pick()
+            th, p1, p2 = rng.uniform(0, 2 * np.pi, size=3)
+            al = complex(np.cos(th) * np.cos(p1), np.cos(th) * np.sin(p1))
+            be = complex(np.sin(th) * np.cos(p2), np.sin(th) * np.sin(p2))
+            add(f"compactUnitary({t})",
+                lambda q, t=t, a=al, b=be: qt.compactUnitary(q, t, a, b),
+                "compactUnitary", (t, al, be))
+        elif kind == "u1":
+            (t,) = pick()
+            u = random_unitary(1, rng)
+            add(f"unitary({t})",
+                lambda q, t=t, u=u: qt.unitary(q, t, u), "unitary", (t, u))
+        elif kind == "c1q":
+            c, t = pick(2)
+            f = ["controlledNot", "controlledPauliY"][int(rng.integers(2))]
+            add(f"{f}({c},{t})",
+                lambda q, f=f, c=c, t=t: getattr(qt, f)(q, c, t), f, (c, t))
+        elif kind == "cps":
+            c, t = pick(2)
+            ang = float(rng.uniform(0, 2 * np.pi))
+            add(f"controlledPhaseShift({c},{t})",
+                lambda q, c=c, t=t, a=ang:
+                qt.controlledPhaseShift(q, c, t, a),
+                "controlledPhaseShift", (c, t, ang))
+        elif kind == "cpf":
+            a, b = pick(2)
+            add(f"controlledPhaseFlip({a},{b})",
+                lambda q, a=a, b=b: qt.controlledPhaseFlip(q, a, b),
+                "controlledPhaseFlip", (a, b))
+        elif kind == "mcps":
+            qs = pick(int(rng.integers(2, N + 1)))
+            ang = float(rng.uniform(0, 2 * np.pi))
+            add(f"multiControlledPhaseShift({qs})",
+                lambda q, qs=qs, a=ang:
+                qt.multiControlledPhaseShift(q, qs, a),
+                "multiControlledPhaseShift", (tuple(qs), ang))
+        elif kind == "mcpf":
+            qs = pick(int(rng.integers(2, N + 1)))
+            add(f"multiControlledPhaseFlip({qs})",
+                lambda q, qs=qs: qt.multiControlledPhaseFlip(q, qs),
+                "multiControlledPhaseFlip", (tuple(qs),))
+        elif kind == "swap2":
+            a, b = pick(2)
+            f = ["swapGate", "sqrtSwapGate"][int(rng.integers(2))]
+            add(f"{f}({a},{b})",
+                lambda q, f=f, a=a, b=b: getattr(qt, f)(q, a, b), f, (a, b))
+        elif kind == "u2":
+            a, b = pick(2)
+            u = random_unitary(2, rng)
+            add(f"twoQubitUnitary({a},{b})",
+                lambda q, a=a, b=b, u=u: qt.twoQubitUnitary(q, a, b, u),
+                "twoQubitUnitary", (a, b, u))
+        elif kind == "cu1":
+            c, t = pick(2)
+            u = random_unitary(1, rng)
+            add(f"controlledUnitary({c},{t})",
+                lambda q, c=c, t=t, u=u: qt.controlledUnitary(q, c, t, u),
+                "controlledUnitary", (c, t, u))
+        elif kind == "mcu1":
+            sel = pick(int(rng.integers(2, N + 1)))
+            cs, t = tuple(sel[:-1]), sel[-1]
+            u = random_unitary(1, rng)
+            add(f"multiControlledUnitary({list(cs)},{t})",
+                lambda q, cs=cs, t=t, u=u:
+                qt.multiControlledUnitary(q, list(cs), t, u),
+                "multiControlledUnitary", (cs, t, u))
+        elif kind == "mrz":
+            qs = pick(int(rng.integers(1, N + 1)))
+            ang = float(rng.uniform(0, 2 * np.pi))
+            add(f"multiRotateZ({qs})",
+                lambda q, qs=qs, a=ang: qt.multiRotateZ(q, qs, a),
+                "multiRotateZ", (tuple(qs), ang))
+        elif kind == "mrp":
+            qs = pick(int(rng.integers(1, N + 1)))
+            codes = tuple(int(rng.integers(1, 4)) for _ in qs)
+            ang = float(rng.uniform(0, 2 * np.pi))
+            add(f"multiRotatePauli({qs},{list(codes)})",
+                lambda q, qs=qs, cd=codes, a=ang:
+                qt.multiRotatePauli(q, qs, list(cd), a),
+                "multiRotatePauli", (tuple(qs), codes, ang))
+        elif kind == "u3":
+            ts = tuple(pick(3))
+            u = random_unitary(3, rng)
+            add(f"multiQubitUnitary({list(ts)})",
+                lambda q, ts=ts, u=u: qt.multiQubitUnitary(q, list(ts), u),
+                "multiQubitUnitary", (ts, u))
+        elif kind == "phase":
+            (t,) = pick()
+            ang = float(rng.uniform(0, 2 * np.pi))
+            add(f"phaseShift({t})",
+                lambda q, t=t, a=ang: qt.phaseShift(q, t, a),
+                "phaseShift", (t, ang))
+        elif kind == "chan1":
+            (t,) = pick()
+            f, pmax = [("mixDephasing", 0.5), ("mixDepolarising", 0.75),
+                       ("mixDamping", 1.0)][int(rng.integers(3))]
+            p = float(rng.uniform(0, pmax))
+            add(f"{f}({t},{p:.3f})",
+                lambda q, f=f, t=t, p=p: getattr(qt, f)(q, t, p), f, (t, p))
+        elif kind == "2chan":
+            a, b = pick(2)
+            f, pmax = [("mixTwoQubitDephasing", 0.75),
+                       ("mixTwoQubitDepolarising", 15.0 / 16.0)][
+                int(rng.integers(2))]
+            p = float(rng.uniform(0, pmax))
+            add(f"{f}({a},{b},{p:.3f})",
+                lambda q, f=f, a=a, b=b, p=p: getattr(qt, f)(q, a, b, p),
+                f, (a, b, p))
+        elif kind == "pauli":
+            (t,) = pick()
+            px, py, pz = (float(v) for v in rng.uniform(0, 0.2, size=3))
+            add(f"mixPauli({t})",
+                lambda q, t=t, x=px, y=py, z=pz: qt.mixPauli(q, t, x, y, z),
+                "mixPauli", (t, px, py, pz))
+        elif kind == "kraus1":
+            (t,) = pick()
+            ops_k = random_kraus(1, int(rng.integers(1, 5)), rng)
+            add(f"mixKrausMap({t})",
+                lambda q, t=t, o=ops_k: qt.mixKrausMap(q, t, o),
+                "mixKrausMap", (t, ops_k))
+        elif kind == "kraus2":
+            a, b = pick(2)
+            ops_k = random_kraus(2, int(rng.integers(1, 4)), rng)
+            add(f"mixTwoQubitKrausMap({a},{b})",
+                lambda q, a=a, b=b, o=ops_k:
+                qt.mixTwoQubitKrausMap(q, a, b, o),
+                "mixTwoQubitKrausMap", (a, b, ops_k))
+    return moves
+
+
+@pytest.fixture(scope="module")
+def ref():
+    return Ref(_load(LIB))
+
+
+@pytest.mark.parametrize("seed", [11, 22, 33])
+@pytest.mark.parametrize("density", [False, True],
+                         ids=["statevec", "density"])
+def test_differential_random_sequence(env, ref, seed, density):
+    rng = np.random.default_rng(seed)
+    moves = _build_moves(rng, density)
+
+    q = qt.createDensityQureg(N, env) if density else qt.createQureg(N, env)
+    qt.initPlusState(q)
+    rq = ref.prepare("P" if density else "p", N)
+    try:
+        for i, (name, fw, ref_name, args) in enumerate(moves):
+            fw(q)
+            ADAPTERS[ref_name](ref, rq, args)
+            err = np.max(np.abs(q.to_numpy() - ref.state(rq)))
+            assert err < 1e-10, f"seed {seed} op {i} ({name}): |Δ|={err:.2e}"
+        # scalar cross-checks at the end
+        assert abs(qt.calcTotalProb(q)
+                   - ref.lib.calcTotalProb(rq)) < 1e-10
+        for t in range(N):
+            assert abs(qt.calcProbOfOutcome(q, t, 1)
+                       - ref.lib.calcProbOfOutcome(rq, t, 1)) < 1e-10
+    finally:
+        ref.lib.destroyQureg(rq, ref.env)
