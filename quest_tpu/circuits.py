@@ -805,7 +805,8 @@ class CompiledCircuit:
         self._donate = donate
 
     def _param_vec(self, params: Optional[dict]) -> jnp.ndarray:
-        params = params or {}
+        if params is None:
+            params = {}
         missing = [p for p in self.param_names if p not in params]
         if missing:
             raise ValueError(f"missing circuit parameters: {missing}")
@@ -832,9 +833,26 @@ class CompiledCircuit:
                 f"has {qureg.num_qubits_in_state_vec}")
         qureg.state = self._jitted(qureg.state, self._param_vec(params))
 
-    def apply(self, state_f: jnp.ndarray, params: Optional[dict] = None):
-        """Pure form: packed planes in -> packed planes out."""
-        return self._jitted(state_f, self._param_vec(params))
+    def apply(self, state_f: jnp.ndarray, params=None):
+        """Pure form: packed planes in -> packed planes out.
+
+        ``params`` may be a name->angle dict (as in :meth:`run`) or an
+        already-built parameter vector ordered like ``param_names`` —
+        including a traced one, so ``apply`` composes with ``jax.vmap`` /
+        ``lax.scan`` for batched simulation (no reference counterpart)."""
+        if params is None or isinstance(params, dict):
+            vec = self._param_vec(params)
+        else:
+            vec = jnp.asarray(params, dtype=self.env.precision.real_dtype)
+            if vec.shape[-1:] != (len(self.param_names),):
+                # shapes are static even under vmap/scan, so this check is
+                # free — and JAX's clamped gather would otherwise turn a
+                # wrong-length vector into silently wrong angles
+                raise ValueError(
+                    f"parameter vector has shape {vec.shape}; this circuit "
+                    f"has {len(self.param_names)} parameters "
+                    f"{list(self.param_names)}")
+        return self._jitted(state_f, vec)
 
     # -- analysis / autodiff ----------------------------------------------
 
