@@ -844,14 +844,16 @@ class CompiledCircuit:
             vec = self._param_vec(params)
         else:
             vec = jnp.asarray(params, dtype=self.env.precision.real_dtype)
-            if vec.shape[-1:] != (len(self.param_names),):
-                # shapes are static even under vmap/scan, so this check is
-                # free — and JAX's clamped gather would otherwise turn a
-                # wrong-length vector into silently wrong angles
+            if vec.shape != (len(self.param_names),):
+                # shapes are static even under vmap/scan (each mapped call
+                # sees the unbatched shape), so this check is free — and
+                # JAX's clamped gather would otherwise turn a wrong-length
+                # vector into silently wrong angles; a still-batched
+                # (batch, n_params) array must go through vmap, not raw
                 raise ValueError(
-                    f"parameter vector has shape {vec.shape}; this circuit "
-                    f"has {len(self.param_names)} parameters "
-                    f"{list(self.param_names)}")
+                    f"parameter vector has shape {vec.shape}; expected "
+                    f"({len(self.param_names)},) ordered like "
+                    f"{list(self.param_names)} (use jax.vmap for batches)")
         return self._jitted(state_f, vec)
 
     # -- analysis / autodiff ----------------------------------------------
