@@ -243,6 +243,39 @@ def bench_dd(qt, env, platform: str) -> dict:
     }
 
 
+def bench_native_cpu() -> dict:
+    """Native C++ executor (compile_native): the head-to-head against the
+    reference's serial CPU build (BASELINE.md: 307 gates/s @ 20q f64 on
+    this machine's core). Single-threaded, f64 — the reference's own
+    conditions; vs_baseline here is vs that measured reference figure."""
+    num_qubits = int(os.environ.get("QUEST_BENCH_NATIVE_QUBITS", "20"))
+    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 2)
+    circ, n_gates = build_bench_circuit(num_qubits, 4)
+    prog = circ.compile_native(threads=1)
+    re, im = prog.init_zero()
+    prog.run(re, im)                       # warm-up
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        prog.run(re, im)
+    dt = time.perf_counter() - t0
+    ops_per_sec = n_gates * trials / dt
+    # measured reference-serial figures from BASELINE.md for this machine;
+    # other widths fall back to the A100 roofline like every other config
+    ref_serial = {20: 307.0, 24: 17.9}.get(num_qubits)
+    baseline = ref_serial if ref_serial is not None \
+        else _roofline_baseline(num_qubits, 8)
+    return {
+        "metric": f"native C++ executor, {num_qubits}-qubit statevector, "
+                  "f64, 1 thread",
+        "value": round(ops_per_sec, 2),
+        "unit": "gates/sec",
+        "vs_baseline": round(ops_per_sec / baseline, 4),
+        "baseline": "reference QuEST serial C build on this core "
+                    "(BASELINE.md)" if ref_serial else
+                    "A100 HBM roofline",
+    }
+
+
 def bench_qft(qt, env, platform: str) -> dict:
     from quest_tpu.algorithms import qft
     num_qubits = int(os.environ.get(
@@ -406,6 +439,10 @@ def main() -> None:
         # comparison would be XLA-vs-XLA noise — accel platforms only
         configs.insert(1, ("pallas", 60, lambda: bench_pallas_compare(
             qt, env, platform, nq_small, trials=max(1, trials // 3))))
+    else:
+        # CPU run: the native C++ executor head-to-head vs the measured
+        # reference serial build (its home turf — BASELINE.md)
+        configs.insert(0, ("native", 30, lambda: bench_native_cpu()))
     for name, min_time_s, fn in configs:
         if not accel:
             min_time_s /= 4  # CPU compiles are fast (and cache-warmed)

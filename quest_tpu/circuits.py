@@ -497,6 +497,19 @@ class Circuit:
         cc.is_density = density
         return cc
 
+    def compile_native(self, threads: Optional[int] = None):
+        """Lower to the native C++ CPU executor (one ctypes call runs the
+        whole program over split f64 planes; ``quest_tpu/native/statevec.py``).
+        CPU/single-device only — the framework's analogue of the reference's
+        native CPU backend, and an XLA-independent cross-checking oracle.
+        Raises ``RuntimeError`` if the library can't build, ``ValueError``
+        for ops outside the unitary/diagonal set (Kraus channels)."""
+        if any(op.kind == "kraus" for op in self.ops):
+            raise ValueError("native executor is statevector-only; "
+                             "compile Kraus channels with the XLA path")
+        from .native.statevec import NativeProgram
+        return NativeProgram(self, threads=threads)
+
     def compile_dd(self, env: QuESTEnv, dtype=None):
         """Compile to the double-double amplitude path: each amplitude
         component is an unevaluated hi+lo pair of ``dtype`` floats

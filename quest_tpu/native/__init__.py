@@ -15,7 +15,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["available", "load", "NativeScheduler"]
+__all__ = ["available", "load", "build_and_load", "NativeScheduler"]
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libquest_sched.so")
 _SRC_PATH = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
@@ -26,17 +26,33 @@ _load_failed = False
 KIND_U, KIND_DIAG, KIND_U_PARAM, KIND_DIAG_PARAM = 0, 1, 2, 3
 
 
-def _build() -> bool:
-    src = os.path.abspath(_SRC_PATH)
-    if not os.path.exists(src):
-        return False
-    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
-           "-o", _LIB_PATH, src]
+def build_and_load(src_name: str, lib_path: str,
+                   extra_flags: tuple[str, ...] = ()) -> Optional[ctypes.CDLL]:
+    """Build (if absent) and dlopen one native library, or return None.
+
+    Shared on-demand g++ pattern for every native component: the repo ships
+    no binary artifacts, ``QUEST_TPU_NO_NATIVE=1`` disables all of them, and
+    a failed build/load is reported as None so callers fall back to their
+    pure-Python/XLA path.
+    """
+    if os.environ.get("QUEST_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(lib_path):
+        src = os.path.abspath(os.path.join(
+            os.path.dirname(__file__), os.pardir, os.pardir,
+            "native", "src", src_name))
+        if not os.path.exists(src):
+            return None
+        cmd = [os.environ.get("CXX", "g++"), "-O2", "-std=c++17", "-fPIC",
+               "-Wall", *extra_flags, "-shared", "-o", lib_path, src]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, OSError):
+            return None
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except (subprocess.SubprocessError, OSError):
-        return False
+        return ctypes.CDLL(lib_path)
+    except OSError:
+        return None
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -44,14 +60,10 @@ def load() -> Optional[ctypes.CDLL]:
     global _lib, _load_failed
     if _lib is not None:
         return _lib
-    if _load_failed or os.environ.get("QUEST_TPU_NO_NATIVE"):
+    if _load_failed:
         return None
-    if not os.path.exists(_LIB_PATH) and not _build():
-        _load_failed = True
-        return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
+    lib = build_and_load("scheduler.cc", _LIB_PATH)
+    if lib is None:
         _load_failed = True
         return None
 
