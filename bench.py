@@ -60,9 +60,12 @@ def _run_child(extra_env: dict, first_line_deadline: float,
                total_deadline: float) -> int:
     """Spawn this script as a measurement child and relay its stdout.
 
-    Returns the number of JSON lines relayed. The child is killed (and the
-    count returned) if it prints nothing by ``first_line_deadline`` or is
-    still running at ``total_deadline`` (both absolute, vs perf_counter).
+    Returns the number of REAL result lines relayed (JSON with value > 0 —
+    error/skip rows carry the 0.0 sentinel and don't count, so a child
+    whose backend is alive but failing still triggers the CPU fallback).
+    Every JSON line is relayed regardless. The child is killed if it
+    prints nothing by ``first_line_deadline`` or is still running at
+    ``total_deadline`` (both absolute, vs perf_counter).
     """
     import subprocess
     import threading
@@ -83,7 +86,7 @@ def _run_child(extra_env: dict, first_line_deadline: float,
         lines.put(None)
 
     threading.Thread(target=_reader, daemon=True).start()
-    relayed = 0
+    relayed = delivered = 0
     while True:
         deadline = first_line_deadline if relayed == 0 else total_deadline
         try:
@@ -92,15 +95,20 @@ def _run_child(extra_env: dict, first_line_deadline: float,
         except queue.Empty:
             if time.perf_counter() >= deadline:
                 proc.kill()
-                return relayed
+                return delivered
             continue
         if raw is None:
             proc.wait()
-            return relayed
+            return delivered
         raw = raw.strip()
         if raw.startswith("{"):
             print(raw, flush=True)
             relayed += 1
+            try:
+                if float(json.loads(raw).get("value", 0.0)) > 0.0:
+                    delivered += 1
+            except (ValueError, TypeError):
+                pass
         elif raw:
             # stray non-JSON noise (plugin banners etc): keep it out of the
             # driver's parse stream and don't let it mask a missing result
@@ -160,18 +168,24 @@ def _result(metric: str, n_ops: int, trials: int, dt: float,
 
 
 def bench_gate_throughput(qt, env, platform: str, num_qubits: int,
-                          layers: int, trials: int, metric: str) -> dict:
+                          layers: int, trials: int, metric: str,
+                          pallas=None) -> dict:
+    """``pallas``: None = auto (kernel pass on accel, with an XLA-only
+    retry if it fails); "off" = pure-XLA path only. The HEADLINE config
+    passes "off" — the Pallas kernel is unproven on the tunneled TPU and
+    a hang (rather than a raise) inside its first compile would starve
+    the whole child; the dedicated pallas config measures it instead."""
     q = qt.createQureg(num_qubits, env)
     qt.initZeroState(q)
     circ, n_gates = build_bench_circuit(num_qubits, layers)
     note = {}
     try:
-        dt = _time_compiled(circ.compile(env), q, trials)
+        dt = _time_compiled(circ.compile(env, pallas=pallas), q, trials)
     except Exception as e:
-        if not _is_accel(platform):
-            raise      # Pallas is inert off-accel; a retry would be identical
+        if pallas == "off" or not _is_accel(platform):
+            raise      # Pallas wasn't involved; a retry would be identical
         # first real-TPU contact for the Pallas pass (auto-enabled on
-        # tpu/axon) is unproven — never let it sink the headline
+        # tpu/axon) is unproven — never let it sink this config
         note = {"pallas_fallback": f"{type(e).__name__}: {e}"[:200]}
         qt.initZeroState(q)
         dt = _time_compiled(circ.compile(env, pallas="off"), q, trials)
@@ -407,7 +421,8 @@ def main() -> None:
     try:
         first = bench_gate_throughput(
             qt, env, platform, nq_small, layers=1,
-            trials=max(1, trials // 3), metric="1q+CNOT gate throughput")
+            trials=max(1, trials // 3), metric="1q+CNOT gate throughput",
+            pallas="off")
     except Exception as e:
         first = {
             "metric": "1q+CNOT gate throughput (bench error)",

@@ -497,18 +497,35 @@ class Circuit:
         cc.is_density = density
         return cc
 
-    def compile_native(self, threads: Optional[int] = None):
+    def compile_native(self, threads: Optional[int] = None,
+                       density: bool = False):
         """Lower to the native C++ CPU executor (one ctypes call runs the
         whole program over split f64 planes; ``quest_tpu/native/statevec.py``).
         CPU/single-device only — the framework's analogue of the reference's
         native CPU backend, and an XLA-independent cross-checking oracle.
-        Raises ``RuntimeError`` if the library can't build, ``ValueError``
-        for ops outside the unitary/diagonal set (Kraus channels)."""
-        if any(op.kind == "kraus" for op in self.ops):
-            raise ValueError("native executor is statevector-only; "
-                             "compile Kraus channels with the XLA path")
+
+        ``density=True`` lowers the 2n-qubit flattened-density form
+        (channels become superoperator ops, `_lifted_density`); the planes
+        then hold the flat density vector. Raises ``RuntimeError`` if the
+        library can't build, ``ValueError`` for Kraus channels without
+        ``density=True``."""
+        if density:
+            from . import validation as val
+            from .config import default_precision
+            for op in self.ops:
+                if op.kind == "kraus":
+                    val.validate_kraus_ops(op.kraus, len(op.targets),
+                                           "Circuit.kraus",
+                                           default_precision().eps)
+            circ = self._lifted_density()
+        else:
+            if any(op.kind == "kraus" for op in self.ops):
+                raise ValueError(
+                    "circuit contains Kraus channels; pass density=True "
+                    "(the flattened-density form) or use the XLA path")
+            circ = self
         from .native.statevec import NativeProgram
-        return NativeProgram(self, threads=threads)
+        return NativeProgram(circ, threads=threads)
 
     def compile_dd(self, env: QuESTEnv, dtype=None):
         """Compile to the double-double amplitude path: each amplitude

@@ -33,10 +33,10 @@ def build_and_load(src_name: str, lib_path: str,
     Shared on-demand g++ pattern for every native component: the repo ships
     no binary artifacts, ``QUEST_TPU_NO_NATIVE=1`` disables all of them, and
     a failed build/load is reported as None so callers fall back to their
-    pure-Python/XLA path.
+    pure-Python/XLA path. Callers gate on QUEST_TPU_NO_NATIVE per call
+    (so clearing the variable re-enables native in-process) — this
+    function only builds and loads.
     """
-    if os.environ.get("QUEST_TPU_NO_NATIVE"):
-        return None
     if not os.path.exists(lib_path):
         src = os.path.abspath(os.path.join(
             os.path.dirname(__file__), os.pardir, os.pardir,
@@ -58,6 +58,8 @@ def build_and_load(src_name: str, lib_path: str,
 def load() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the scheduler library, or None."""
     global _lib, _load_failed
+    if os.environ.get("QUEST_TPU_NO_NATIVE"):
+        return None               # checked per call: unsetting re-enables
     if _lib is not None:
         return _lib
     if _load_failed:

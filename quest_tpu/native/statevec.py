@@ -44,6 +44,8 @@ _F64P = ctypes.POINTER(ctypes.c_double)
 def load() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the executor library, or None."""
     global _lib, _load_failed
+    if os.environ.get("QUEST_TPU_NO_NATIVE"):
+        return None               # checked per call: unsetting re-enables
     if _lib is not None:
         return _lib
     if _load_failed:

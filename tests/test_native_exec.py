@@ -77,9 +77,12 @@ def test_native_matches_xla(n):
 
 
 def test_native_threads_deterministic():
-    n = 10
+    # 17 qubits: the k=1 pair loop iterates 2^16 pairs, crossing the
+    # kernel's serial-below-2^16 threshold so threads>1 actually forks
+    # (disjoint ranges -> results must be bit-identical to serial)
+    n = 17
     rng = np.random.default_rng(7)
-    c = random_circuit(n, rng, gates=40)
+    c = random_circuit(n, rng, gates=12)
     res = []
     for threads in (1, 4):
         prog = c.compile_native(threads=threads)
@@ -115,12 +118,45 @@ def test_native_parameterized():
         prog.run(re, im)          # missing parameter
 
 
+def test_native_density_with_channels():
+    """density=True: flattened-density program with noise channels matches
+    the XLA density path (channels lower to superoperator dense ops)."""
+    n = 4
+    c = Circuit(n)
+    for q in range(n):
+        c.h(q)
+    c.cnot(0, 2)
+    c.dephase(1, 0.1)
+    c.damp(3, 0.2)
+    c.depolarise(0, 0.05)
+    c.cphase(1, 3, 0.7)
+
+    env = qt.createQuESTEnv(num_devices=1, seed=[5])
+    d = qt.createDensityQureg(n, env)
+    qt.initPlusState(d)
+    c.compile(env, density=True, pallas=False).run(d)
+    expect = d.to_numpy()               # flat 2n-qubit density vector
+
+    prog = c.compile_native(density=True)
+    # |+><+| of n qubits: every flat-density entry is 1/2^n
+    flat = np.full(1 << (2 * n), 1.0 / (1 << n), dtype=np.complex128)
+    got = prog.run_statevector(flat)
+    np.testing.assert_allclose(got, expect, atol=1e-10, rtol=0)
+
+
 def test_native_rejects_kraus_and_bad_state():
     c = Circuit(2)
     c.h(0)
     c.damp(0, 0.1)
     with pytest.raises(ValueError):
         c.compile_native()
+
+    # density=True validates CPTP like compile(density=True) does — a
+    # malformed channel must raise, not corrupt the descriptor buffer
+    bad = Circuit(2)
+    bad.kraus([np.eye(2) * 0.3], (0,))        # sum K^dag K != I
+    with pytest.raises(qt.QuESTError):
+        bad.compile_native(density=True)
 
     c2 = Circuit(2)
     c2.h(0)
