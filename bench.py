@@ -354,8 +354,8 @@ def bench_density_noise(qt, env, platform: str) -> dict:
 
 def supervise() -> None:
     """Parent: try the default (TPU) backend in a killable child; fall
-    back to a CPU child if it delivers nothing. Always exits 0 so the
-    driver records whatever lines were relayed."""
+    back to a CPU child if it delivers no successful result rows. Always
+    exits 0 so the driver records whatever lines were relayed."""
     # never hand the reserve more than a third of the budget, so a small
     # QUEST_BENCH_BUDGET_S can't zero the TPU child's first-line window
     cpu_reserve = min(float(os.environ.get("QUEST_BENCH_CPU_RESERVE_S", "75")),
@@ -367,10 +367,11 @@ def supervise() -> None:
             total_deadline=budget_end - 5.0)
         if relayed:
             return
-        # tunnel TPU dead or hung: real numbers from a CPU child instead
-        emit({"metric": "default backend produced no output "
-                        f"within {time.perf_counter() - T0:.0f}s "
-                        "(init hang/failure) — falling back to CPU",
+        # tunnel TPU dead, hung, or failing every config: real numbers
+        # from a CPU child instead
+        emit({"metric": "default backend delivered no successful result "
+                        f"rows within {time.perf_counter() - T0:.0f}s "
+                        "(hang/init/config failure) — falling back to CPU",
               "value": 0.0, "unit": "gates/sec", "vs_baseline": 0.0})
     cpu_end = max(budget_end, time.perf_counter() + cpu_reserve)
     relayed = _run_child({"QUEST_BENCH_FORCE_CPU": "1"},

@@ -23,6 +23,9 @@ __all__ = [
     "random_circuit",
     "phase_estimation",
     "trotter_evolution",
+    "modular_multiplication_unitary",
+    "order_finding",
+    "order_from_phase",
 ]
 
 
@@ -252,3 +255,64 @@ def trotter_evolution(num_qubits: int, pauli_terms, coeffs, time: float,
             for term, w in zip(reversed(terms), reversed(coeffs)):
                 apply_term(term, w * dt)
     return c
+
+
+def modular_multiplication_unitary(a: int, modulus: int,
+                                   num_bits: int | None = None) -> np.ndarray:
+    """Permutation matrix ``U|y> = |a*y mod modulus>`` (identity for
+    ``y >= modulus``) — the arithmetic primitive of Shor order finding.
+
+    Requires ``gcd(a, modulus) == 1`` so the map is a bijection (else it
+    is not unitary). ``num_bits`` defaults to ``modulus.bit_length()``.
+    """
+    import math
+    if modulus < 2:
+        raise ValueError("modulus must be >= 2")
+    a %= modulus
+    if math.gcd(a, modulus) != 1:
+        raise ValueError(f"gcd({a}, {modulus}) != 1: the modular "
+                         "multiplication map is not a permutation")
+    if num_bits is None:
+        num_bits = modulus.bit_length()
+    if (1 << num_bits) < modulus:
+        raise ValueError(f"{num_bits} bits cannot hold values mod {modulus}")
+    dim = 1 << num_bits
+    u = np.zeros((dim, dim), dtype=np.complex128)
+    for y in range(dim):
+        u[(a * y) % modulus if y < modulus else y, y] = 1.0
+    return u
+
+
+def order_finding(a: int, modulus: int,
+                  num_counting: int | None = None) -> Circuit:
+    """Shor order finding: QPE over ``U_a`` with eigenstate register |1>.
+
+    Layout: counting qubits ``[0, num_counting)`` (default ``2 *
+    modulus.bit_length()``), work register above holding ``|1>`` — an
+    equal superposition of the order-r eigenstates of ``U_a``, so the
+    measured counting value concentrates on multiples of ``2^nc / r``.
+    Feed the measured integer to :func:`order_from_phase`. Controlled
+    powers ``U^(2^j)`` come from the shared QPE builder (host-side
+    squaring of the permutation matrix — exact, it stays a permutation).
+    """
+    k = modulus.bit_length()
+    if num_counting is None:
+        num_counting = 2 * k
+    u = modular_multiplication_unitary(a, modulus, k)
+    c = Circuit(num_counting + k)
+    c.x(num_counting)                      # work register |0..01> = |1>
+    return c.extend(phase_estimation(num_counting, u))
+
+
+def order_from_phase(measured: int, num_counting: int, modulus: int) -> int:
+    """Classical post-processing: continued-fraction expansion of the
+    measured phase ``measured / 2^num_counting`` with denominator capped
+    at ``modulus`` — the order candidate (verify ``a^r = 1 mod N``; re-run
+    on failure, as Shor's algorithm prescribes)."""
+    from fractions import Fraction
+    if not 0 <= measured < (1 << num_counting):
+        raise ValueError("measured value outside the counting register")
+    if measured == 0:
+        return 1
+    frac = Fraction(measured, 1 << num_counting).limit_denominator(modulus)
+    return frac.denominator

@@ -113,3 +113,35 @@ def test_trotter_input_validation(env):
     qt.initPlusState(qb)
     b.compile(env).run(qb)
     np.testing.assert_allclose(qa.to_numpy(), qb.to_numpy(), atol=1e-12)
+
+
+def test_modular_multiplication_unitary_validation():
+    with pytest.raises(ValueError):
+        alg.modular_multiplication_unitary(3, 15)   # gcd(3,15)=3
+    with pytest.raises(ValueError):
+        alg.modular_multiplication_unitary(7, 15, num_bits=3)
+    u = alg.modular_multiplication_unitary(7, 15)
+    np.testing.assert_allclose(u @ u.conj().T, np.eye(16), atol=1e-15)
+    # y >= modulus is identity (15 -> 15)
+    assert u[15, 15] == 1.0
+
+
+def test_order_finding_shor15(env):
+    """a=7 mod 15 has order 4: counting distribution concentrates on
+    multiples of 2^nc/4 and continued fractions recover r=4 — the full
+    Shor pipeline minus the (seeded-random) measurement draw."""
+    nc = 8
+    c = alg.order_finding(7, 15, num_counting=nc)
+    q = qt.createQureg(c.num_qubits, env)
+    qt.initZeroState(q)
+    c.compile(env).run(q)
+    psi = q.to_numpy().reshape(-1, 1 << nc)   # [work, counting] split
+    probs = np.sum(np.abs(psi) ** 2, axis=0)
+    peaks = sorted(int(i) for i in np.argsort(probs)[-4:])
+    assert peaks == [0, 64, 128, 192]
+    assert probs[peaks].sum() > 1.0 - 1e-9
+    assert alg.order_from_phase(64, nc, 15) == 4
+    assert alg.order_from_phase(192, nc, 15) == 4
+    assert alg.order_from_phase(0, nc, 15) == 1
+    with pytest.raises(ValueError):
+        alg.order_from_phase(256, nc, 15)
