@@ -1,0 +1,39 @@
+"""Every shipped example stays runnable (the reference treats its
+examples as build targets — `CMakeLists.txt` compiles `USER_SOURCE`
+against libQuEST — so a broken example is a broken build; here each runs
+as a subprocess under the test env's CPU pin)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), os.pardir, "examples")
+
+_PIN = (
+    "import jax; jax.config.update('jax_platforms', 'cpu'); "
+    "jax.config.update('jax_enable_x64', True); "
+)
+
+
+@pytest.mark.parametrize("script", [
+    "tutorial_example.py",
+    "damping_example.py",
+    "bernstein_vazirani.py",
+    "tpu_features.py",
+    "vqe.py",
+    "shor.py",
+])
+def test_example_runs(script):
+    path = os.path.join(EXAMPLES, script)
+    code = (_PIN + "import runpy, sys; sys.argv=[{p!r}]; "
+            "runpy.run_path({p!r}, run_name='__main__')").format(p=path)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    res = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=600, env=env,
+                         cwd=os.path.dirname(EXAMPLES))
+    assert res.returncode == 0, (
+        f"{script} failed:\n{res.stderr[-2000:]}\n{res.stdout[-500:]}")
