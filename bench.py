@@ -275,7 +275,7 @@ def bench_native_cpu() -> dict:
     ops_per_sec = n_gates * trials / dt
     # measured reference-serial figures from BASELINE.md for this machine;
     # other widths fall back to the A100 roofline like every other config
-    ref_serial = {20: 307.0, 24: 17.9}.get(num_qubits)
+    ref_serial = {20: 307.0, 24: 17.9, 26: 4.97}.get(num_qubits)
     baseline = ref_serial if ref_serial is not None \
         else _roofline_baseline(num_qubits, 8)
     return {
@@ -416,12 +416,19 @@ def main() -> None:
     accel = _is_accel(platform)
 
     # headline: small-compile config FIRST so a number always lands.
-    # On CPU the native C++ executor leads instead — it is the number
-    # with a MEASURED baseline (the reference serial build on this very
-    # machine, BASELINE.md) rather than an A100 roofline model.
-    if not accel:
+    # On CPU the native C++ executor leads when its library is ALREADY
+    # BUILT (dlopen + run, no g++ step that could stall pre-headline) —
+    # it is the number with a MEASURED baseline (the reference serial
+    # build on this machine, BASELINE.md) rather than a roofline model;
+    # otherwise it runs later as a budget-gated config that absorbs the
+    # build cost.
+    native_led = False
+    if not accel and os.environ.get("QUEST_BENCH_HEADLINE_ONLY", "0") != "1":
         try:
-            emit(bench_native_cpu())
+            from quest_tpu.native import statevec as natsv
+            if os.path.exists(natsv._LIB_PATH):
+                emit(bench_native_cpu())
+                native_led = True
         except Exception as e:
             emit({"metric": "native C++ executor (bench error)",
                   "value": 0.0, "unit": "gates/sec", "vs_baseline": 0.0,
@@ -465,7 +472,9 @@ def main() -> None:
         # comparison would be XLA-vs-XLA noise — accel platforms only
         configs.insert(1, ("pallas", 60, lambda: bench_pallas_compare(
             qt, env, platform, nq_small, trials=max(1, trials // 3))))
-    # (CPU runs already led with the native C++ executor head-to-head)
+    if not accel and not native_led:
+        # library wasn't prebuilt: run native gated, absorbing the g++ step
+        configs.insert(0, ("native", 30, lambda: bench_native_cpu()))
     for name, min_time_s, fn in configs:
         if not accel:
             min_time_s /= 4  # CPU compiles are fast (and cache-warmed)

@@ -70,6 +70,7 @@ __all__ = [
     "applyPauliSum",
     # measurement
     "calcProbOfOutcome", "collapseToOutcome", "measure", "measureWithStats",
+    "sampleOutcomes",                # TPU-native addition (no ref counterpart)
     # calculations
     "getNumQubits", "getNumAmps", "getAmp", "getRealAmp", "getImagAmp",
     "getProbAmp", "getDensityAmp", "calcTotalProb", "calcInnerProduct",
@@ -1126,6 +1127,65 @@ def measureWithStats(qureg: Qureg, qubit: int):
 def measure(qureg: Qureg, qubit: int) -> int:
     outcome, _ = measureWithStats(qureg, qubit)
     return outcome
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _jit_sample(state_f, key, num_samples, density):
+    """Inverse-CDF sampling of basis indices: one cumsum pass + a
+    searchsorted per shot, all on device (sharded states included — XLA
+    lowers the scan/gather with collectives). Statevector planes sample
+    |amp|^2; density input is the diagonal, whose REAL parts already ARE
+    the probabilities (same convention as ``densmatr`` reductions) —
+    clipped at 0 against round-off. Normalises by the total so norm
+    drift cannot bias the tail bin, and clips the result so a draw that
+    rounds up to exactly the total cannot index past the register."""
+    if density:
+        probs = jnp.maximum(state_f[0], 0.0)
+    else:
+        probs = state_f[0] * state_f[0] + state_f[1] * state_f[1]
+    cum = jnp.cumsum(probs)
+    draws = jax.random.uniform(key, (num_samples,), dtype=cum.dtype)
+    idx = jnp.searchsorted(cum, draws * cum[-1], side="right")
+    return jnp.minimum(idx, probs.shape[0] - 1)
+
+
+def sampleOutcomes(qureg: Qureg, num_samples: int, qubits=None) -> np.ndarray:
+    """Draw ``num_samples`` computational-basis outcomes from the state's
+    probability distribution WITHOUT collapsing it — M measurement shots
+    in one device pass. TPU-native addition: the reference can only
+    measure-and-collapse, so M shots there cost M register copies and
+    M full measurement passes (``measure``, ``QuEST_common.c:360-374``).
+
+    Statevector registers sample ``|amp|^2``; density registers sample
+    the diagonal (the outcome distribution of a full measurement).
+    Returns an int64 array of basis indices, or — when ``qubits`` is
+    given — the outcomes of those qubits packed little-endian (bit ``j``
+    = ``qubits[j]``). The register is untouched; the env RNG stream
+    advances once.
+    """
+    if int(num_samples) < 1:
+        raise ValueError("num_samples must be >= 1")
+    n = qureg.num_qubits_represented
+    if qubits is not None:
+        qubits = [int(q) for q in qubits]
+        val.validate_multi_targets(n, qubits, "sampleOutcomes")
+    if qureg.is_density_matrix:
+        # diagonal of the flat density vector via a reshape view (no
+        # index vector: a materialised arange would overflow int32 on
+        # x64-disabled backends once n >= 16)
+        planes = jnp.diagonal(qureg.state.reshape(2, 1 << n, 1 << n),
+                              axis1=1, axis2=2)
+    else:
+        planes = qureg.state
+    idx = np.asarray(_jit_sample(planes, qureg.env.next_key(),
+                                 int(num_samples),
+                                 qureg.is_density_matrix), dtype=np.int64)
+    if qubits is None:
+        return idx
+    out = np.zeros_like(idx)
+    for j, q in enumerate(qubits):
+        out |= ((idx >> q) & 1) << j
+    return out
 
 
 # ---------------------------------------------------------------------------

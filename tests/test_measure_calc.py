@@ -218,3 +218,67 @@ def test_set_weighted_qureg(env, rng):
     qt.setWeightedQureg(0.3 + 0.1j, qa, -0.2j, qb, 0.5, out)
     expected = (0.3 + 0.1j) * a + (-0.2j) * b + 0.5 * np.eye(1 << N)[0]  # out was |0..0>
     np.testing.assert_allclose(oracle.get_sv(out), expected, atol=TOL)
+
+
+class TestSampleOutcomes:
+    """sampleOutcomes: M shots in one pass, no collapse (TPU-native
+    addition; the reference's only sampling primitive is
+    measure-and-collapse, QuEST_common.c:360-374)."""
+
+    def test_matches_distribution_statevec(self, env):
+        q = qt.createQureg(3, env)
+        qt.initZeroState(q)
+        qt.hadamard(q, 0)
+        qt.controlledNot(q, 0, 1)       # Bell pair on (0,1): half 00, half 11
+        before = q.to_numpy()
+        s = qt.sampleOutcomes(q, 4000)
+        np.testing.assert_array_equal(before, q.to_numpy())  # no collapse
+        assert set(np.unique(s)) == {0, 3}
+        frac = float(np.mean(s == 3))
+        assert abs(frac - 0.5) < 0.05    # ~6 sigma at 4000 shots
+        # env RNG advanced: a second batch differs
+        assert not np.array_equal(s, qt.sampleOutcomes(q, 4000))
+
+    def test_qubit_subset_packing(self, env):
+        q = qt.createQureg(3, env)
+        qt.initClassicalState(q, 0b101)
+        s = qt.sampleOutcomes(q, 16, qubits=[2, 0])
+        # bit0 <- qubit 2 (=1), bit1 <- qubit 0 (=1) -> always 0b11
+        np.testing.assert_array_equal(s, np.full(16, 3))
+
+    def test_density_diagonal(self, env):
+        # NON-uniform diagonal (a uniform one is invariant under the
+        # squared-probabilities bug this guards against): rotateY puts
+        # p(1) = sin^2(0.4/2) ~ 0.0395 on each qubit, then full dephasing
+        # kills coherences without touching the diagonal
+        d = qt.createDensityQureg(2, env)
+        qt.initZeroState(d)
+        qt.rotateY(d, 0, 0.4)
+        qt.rotateY(d, 1, 1.2)
+        qt.mixDephasing(d, 0, 0.5)
+        qt.mixDephasing(d, 1, 0.5)
+        p0 = float(np.sin(0.2) ** 2)
+        p1 = float(np.sin(0.6) ** 2)
+        expect = np.array([(1 - p0) * (1 - p1), p0 * (1 - p1),
+                           (1 - p0) * p1, p0 * p1])
+        s = qt.sampleOutcomes(d, 6000)
+        counts = np.bincount(s, minlength=4) / 6000.0
+        assert np.all(np.abs(counts - expect) < 0.05), (counts, expect)
+
+    def test_validation(self, env):
+        q = qt.createQureg(2, env)
+        qt.initZeroState(q)
+        with pytest.raises(ValueError):
+            qt.sampleOutcomes(q, 0)
+        with pytest.raises(qt.QuESTError):
+            qt.sampleOutcomes(q, 4, qubits=[0, 0])
+        with pytest.raises(qt.QuESTError):
+            qt.sampleOutcomes(q, 4, qubits=[5])
+
+    def test_sharded_register(self, mesh_env):
+        q = qt.createQureg(6, mesh_env)
+        qt.initZeroState(q)
+        qt.hadamard(q, 5)               # cross-shard superposition
+        s = qt.sampleOutcomes(q, 1000)
+        assert set(np.unique(s)) <= {0, 32}
+        assert abs(float(np.mean(s == 32)) - 0.5) < 0.1
