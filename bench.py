@@ -430,6 +430,7 @@ def main() -> None:
                 emit(bench_native_cpu())
                 native_led = True
         except Exception as e:
+            native_led = True    # don't re-run (and re-fail) as a config
             emit({"metric": "native C++ executor (bench error)",
                   "value": 0.0, "unit": "gates/sec", "vs_baseline": 0.0,
                   "errors": [f"{type(e).__name__}: {e}"]})

@@ -1164,7 +1164,8 @@ def sampleOutcomes(qureg: Qureg, num_samples: int, qubits=None) -> np.ndarray:
     advances once.
     """
     if int(num_samples) < 1:
-        raise ValueError("num_samples must be >= 1")
+        val._fail("num_samples must be >= 1", "sampleOutcomes",
+                  val.ErrorCode.E_INVALID_NUM_AMPS)
     n = qureg.num_qubits_represented
     if qubits is not None:
         qubits = [int(q) for q in qubits]
@@ -1177,6 +1178,12 @@ def sampleOutcomes(qureg: Qureg, num_samples: int, qubits=None) -> np.ndarray:
                               axis1=1, axis2=2)
     else:
         planes = qureg.state
+    if calcTotalProb(qureg) < qureg.env.precision.eps:
+        # an (unnormalised) zero-norm register has no distribution to
+        # sample; without this the clamp would return the last basis
+        # index for every shot — valid-looking garbage
+        val._fail("cannot sample a zero-probability register",
+                  "sampleOutcomes", val.ErrorCode.E_COLLAPSE_STATE_ZERO_PROB)
     idx = np.asarray(_jit_sample(planes, qureg.env.next_key(),
                                  int(num_samples),
                                  qureg.is_density_matrix), dtype=np.int64)

@@ -56,7 +56,7 @@ from ref_golden_gen import ADAPTERS, Ref, _load  # noqa: E402
 N = 4
 
 
-def _build_moves(rng, density: bool):
+def _build_moves(rng, density: bool, length: int = 28):
     """Yield (label, framework_fn(q), reference_name, reference_args):
     the reference side is applied uniformly through ADAPTERS, so both
     sides consume the same argument tuple."""
@@ -74,7 +74,7 @@ def _build_moves(rng, density: bool):
     if density:
         ops += ["chan1", "2chan", "pauli", "kraus1", "kraus2"]
 
-    for _ in range(28):
+    for _ in range(length):
         kind = ops[int(rng.integers(len(ops)))]
         if kind == "1q":
             (t,) = pick()
@@ -247,5 +247,51 @@ def test_differential_random_sequence(env, ref, seed, density):
         for t in range(N):
             assert abs(qt.calcProbOfOutcome(q, t, 1)
                        - ref.lib.calcProbOfOutcome(rq, t, 1)) < 1e-10
+    finally:
+        ref.lib.destroyQureg(rq, ref.env)
+
+
+@pytest.mark.parametrize("density", [False, True],
+                         ids=["statevec", "density"])
+def test_differential_deep_sequence(env, ref, density):
+    """120-op sequence: accumulation/drift corners the 28-op runs miss."""
+    rng = np.random.default_rng(77)
+    moves = _build_moves(rng, density, length=120)
+    q = qt.createDensityQureg(N, env) if density else qt.createQureg(N, env)
+    qt.initPlusState(q)
+    rq = ref.prepare("P" if density else "p", N)
+    try:
+        for name, fw, ref_name, args in moves:
+            fw(q)
+            ADAPTERS[ref_name](ref, rq, args)
+        err = np.max(np.abs(q.to_numpy() - ref.state(rq)))
+        assert err < 1e-10, f"after 120 ops ({name} last): |Δ|={err:.2e}"
+    finally:
+        ref.lib.destroyQureg(rq, ref.env)
+
+
+@pytest.mark.parametrize("density", [False, True],
+                         ids=["statevec", "density"])
+def test_differential_collapse(env, ref, density):
+    """collapseToOutcome cross-check: same outcome forced on both
+    implementations (chosen from the exact probability so it is never a
+    zero-probability collapse), state and returned prob compared."""
+    rng = np.random.default_rng(55)
+    moves = _build_moves(rng, density, length=10)
+    q = qt.createDensityQureg(N, env) if density else qt.createQureg(N, env)
+    qt.initPlusState(q)
+    rq = ref.prepare("P" if density else "p", N)
+    try:
+        for _, fw, ref_name, args in moves:
+            fw(q)
+            ADAPTERS[ref_name](ref, rq, args)
+        for t in range(N):
+            p1 = qt.calcProbOfOutcome(q, t, 1)
+            outcome = 1 if p1 > 0.5 else 0
+            fw_prob = qt.collapseToOutcome(q, t, outcome)
+            ref_prob = ref.lib.collapseToOutcome(rq, t, outcome)
+            assert abs(fw_prob - ref_prob) < 1e-10
+            err = np.max(np.abs(q.to_numpy() - ref.state(rq)))
+            assert err < 1e-10, f"collapse q{t}->{outcome}: |Δ|={err:.2e}"
     finally:
         ref.lib.destroyQureg(rq, ref.env)

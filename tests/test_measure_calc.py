@@ -282,3 +282,11 @@ class TestSampleOutcomes:
         s = qt.sampleOutcomes(q, 1000)
         assert set(np.unique(s)) <= {0, 32}
         assert abs(float(np.mean(s == 32)) - 0.5) < 0.1
+
+    def test_zero_norm_register_rejected(self, env):
+        q = qt.createQureg(3, env)
+        qt.initBlankState(q)
+        with pytest.raises(qt.QuESTError):
+            qt.sampleOutcomes(q, 8)
+        with pytest.raises(qt.QuESTError):
+            qt.sampleOutcomes(q, 0)
