@@ -28,6 +28,7 @@ from .env import (QuESTEnv, create_quest_env, destroy_quest_env,
                   initialize_multihost)
 from .qureg import Qureg
 from .circuits import Circuit, CompiledCircuit, Param
+from .qasm_import import ParsedQASM, parse_qasm, load_qasm_file
 from .api import *  # noqa: F401,F403  (the QuEST-compatible surface)
 from .api import __all__ as _api_all
 
@@ -40,6 +41,7 @@ __all__ = (
         "QuESTError", "invalid_quest_input_error", "set_input_error_handler",
         "QuESTEnv", "create_quest_env", "destroy_quest_env", "Qureg",
         "Circuit", "CompiledCircuit", "Param",
+        "ParsedQASM", "parse_qasm", "load_qasm_file",
     ]
     + list(_api_all)
 )

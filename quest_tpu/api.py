@@ -1146,7 +1146,7 @@ def _jit_sample(state_f, key, num_samples, density):
     cum = jnp.cumsum(probs)
     draws = jax.random.uniform(key, (num_samples,), dtype=cum.dtype)
     idx = jnp.searchsorted(cum, draws * cum[-1], side="right")
-    return jnp.minimum(idx, probs.shape[0] - 1)
+    return jnp.minimum(idx, probs.shape[0] - 1), cum[-1]
 
 
 def sampleOutcomes(qureg: Qureg, num_samples: int, qubits=None) -> np.ndarray:
@@ -1178,15 +1178,16 @@ def sampleOutcomes(qureg: Qureg, num_samples: int, qubits=None) -> np.ndarray:
                               axis1=1, axis2=2)
     else:
         planes = qureg.state
-    if calcTotalProb(qureg) < qureg.env.precision.eps:
+    idx_dev, total = _jit_sample(planes, qureg.env.next_key(),
+                                 int(num_samples), qureg.is_density_matrix)
+    if float(total) < qureg.env.precision.eps:
         # an (unnormalised) zero-norm register has no distribution to
         # sample; without this the clamp would return the last basis
-        # index for every shot — valid-looking garbage
+        # index for every shot — valid-looking garbage. The total comes
+        # back from the same fused pass, so the guard costs nothing.
         val._fail("cannot sample a zero-probability register",
                   "sampleOutcomes", val.ErrorCode.E_COLLAPSE_STATE_ZERO_PROB)
-    idx = np.asarray(_jit_sample(planes, qureg.env.next_key(),
-                                 int(num_samples),
-                                 qureg.is_density_matrix), dtype=np.int64)
+    idx = np.asarray(idx_dev, dtype=np.int64)
     if qubits is None:
         return idx
     out = np.zeros_like(idx)

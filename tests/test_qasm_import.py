@@ -1,0 +1,202 @@
+"""QASM importer: round-trips through the recorder and standard-dialect
+parsing. The reference has no QASM reader — its recorded circuits are
+write-only (`QuEST_qasm.c`); here `record -> parse -> compile -> run`
+must reproduce the recorded evolution (up to the global phase the
+recorder's uncontrolled-ZYZ split drops, as the reference's does)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import random_unitary
+
+
+def _phase_aligned(a, b):
+    """Max |a - e^{i g} b| over the optimal global phase g."""
+    k = int(np.argmax(np.abs(b)))
+    if abs(b[k]) < 1e-14:
+        return float(np.max(np.abs(a - b)))
+    g = a[k] / b[k]
+    g /= abs(g)
+    return float(np.max(np.abs(a - g * b)))
+
+
+def _record_and_reparse(env, build, n):
+    """Apply `build(q)` with recording on; parse the log; run the parsed
+    circuit from |0..0>; return (recorded_state, replayed_state)."""
+    q = qt.createQureg(n, env)
+    qt.initZeroState(q)
+    qt.startRecordingQASM(q)
+    build(q)
+    qt.stopRecordingQASM(q)
+    text = q.qasm_log.text()
+    parsed = qt.parse_qasm(text)
+    assert parsed.circuit.num_qubits == n
+    q2 = qt.createQureg(n, env)
+    qt.initZeroState(q2)
+    parsed.circuit.compile(env, pallas=False).run(q2)
+    return q.to_numpy(), q2.to_numpy()
+
+
+def test_roundtrip_named_gates(env):
+    def build(q):
+        qt.hadamard(q, 0)
+        qt.pauliX(q, 1)
+        qt.pauliY(q, 2)
+        qt.pauliZ(q, 0)
+        qt.sGate(q, 1)
+        qt.tGate(q, 2)
+        qt.rotateX(q, 0, 0.37)
+        qt.rotateY(q, 1, -1.2)
+        qt.rotateZ(q, 2, 2.9)
+        qt.controlledNot(q, 0, 1)
+        qt.controlledPauliY(q, 1, 2)
+        qt.controlledPhaseFlip(q, 0, 2)
+        qt.swapGate(q, 0, 2)
+        qt.sqrtSwapGate(q, 1, 2)
+    a, b = _record_and_reparse(env, build, 3)
+    assert _phase_aligned(a, b) < 1e-10
+
+
+def _compact(alpha, beta):
+    return np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]])
+
+
+def test_roundtrip_param_and_unitary(env):
+    # controlled records round-trip exactly when the matrix is in compact
+    # (det-1, zero-phase) form: the recorder's cU(a,b,c) IS that matrix
+    # (the ZYZ product reproduces it exactly, no sign ambiguity)
+    cu = _compact(complex(0.6, 0.0), complex(0.0, 0.8))
+
+    def build(q):
+        qt.phaseShift(q, 0, 0.7)                   # global phase only
+        qt.compactUnitary(q, 1, complex(0.6, 0.0), complex(0.0, 0.8))
+        qt.controlledCompactUnitary(q, 2, 0, complex(0.28, 0.96), 0j)
+        qt.controlledUnitary(q, 2, 0, cu)          # restore line is Rz(0)
+        qt.rotateAroundAxis(q, 2, 1.3, (1.0, 1.0, 0.0))
+        qt.controlledRotateZ(q, 0, 2, -0.9)
+        qt.controlledRotateX(q, 1, 0, 0.55)
+        qt.multiStateControlledUnitary(q, [0, 1], [1, 0], 2, cu)
+    a, b = _record_and_reparse(env, build, 3)
+    assert _phase_aligned(a, b) < 1e-10
+
+
+def test_controlled_phase_shift_reference_quirk(env):
+    """controlledPhaseShift QASM is NOT faithful: the reference restores
+    the dropped phase with an uncontrolled Rz on the TARGET
+    (``qasm_recordControlledParamGate``, ``QuEST_qasm.c:256-261``), which
+    differs from the true controlled phase by a relative phase between
+    control subspaces. Our writer mirrors the reference byte-for-byte
+    (test_qasm_parity), so the importer reproduces the text's semantics —
+    this test pins the deviation so a future 'fix' of either side is a
+    conscious choice."""
+    def build(q):
+        qt.hadamard(q, 0)
+        qt.hadamard(q, 1)
+        qt.controlledPhaseShift(q, 0, 1, 1.1)
+    a, b = _record_and_reparse(env, build, 2)
+    # per-amplitude magnitudes always survive (diagonal gates)
+    np.testing.assert_allclose(np.abs(a), np.abs(b), atol=1e-10)
+    # and the deviation is exactly the documented misplaced phase
+    dev = _phase_aligned(a, b)
+    assert dev > 1e-3, "reference quirk vanished — update this test"
+
+
+def test_roundtrip_unitary_global_phase_dropped(env):
+    """An uncontrolled `unitary` record keeps only the compact part (the
+    reference drops the global phase the same way) — states agree up to
+    phase but not exactly when the matrix has det != 1."""
+    rng = np.random.default_rng(9)
+    u = np.exp(0.31j) * random_unitary(1, rng)
+
+    def build(q):
+        qt.hadamard(q, 0)
+        qt.unitary(q, 0, u)
+    a, b = _record_and_reparse(env, build, 2)
+    assert _phase_aligned(a, b) < 1e-10
+
+
+def test_standard_dialect():
+    text = """
+    OPENQASM 2.0;
+    include "qelib1.inc";
+    qreg qr[3]; creg m[3];
+    h qr[0];
+    cx qr[0],qr[1];
+    crz(pi/2) qr[1],qr[2];
+    ccx qr[0],qr[1],qr[2];
+    u3(pi/2, 0, pi) qr[0];
+    barrier qr;
+    id qr[1];
+    measure qr[2] -> m[2];
+    """
+    parsed = qt.parse_qasm(text)
+    assert parsed.circuit.num_qubits == 3
+    assert parsed.measurements == [(2, 2)]
+    env = qt.createQuESTEnv(num_devices=1, seed=[1])
+    q = qt.createQureg(3, env)
+    qt.initZeroState(q)
+    parsed.circuit.compile(env, pallas=False).run(q)
+    assert abs(qt.calcTotalProb(q) - 1.0) < 1e-10
+
+
+def test_reset_and_errors():
+    ok = qt.parse_qasm("qreg q[2];\nreset q;\nh q[0];")
+    assert ok.resets == 1
+    with pytest.raises(ValueError):
+        qt.parse_qasm("qreg q[2];\nh q[0];\nreset q;")   # mid-circuit
+    with pytest.raises(ValueError):
+        qt.parse_qasm("qreg q[1];\nfrobnicate q[0];")
+    with pytest.raises(ValueError):
+        qt.parse_qasm("h q[0];")                         # gate before qreg
+    with pytest.raises(ValueError):
+        qt.parse_qasm("qreg q[1];\nh q[4];")             # out of range
+    with pytest.raises(ValueError):
+        qt.parse_qasm("qreg q[1];\nrx(__import__) q[0];")
+
+
+def test_written_file_roundtrip(env, tmp_path):
+    q = qt.createQureg(3, env)
+    qt.initZeroState(q)
+    qt.startRecordingQASM(q)
+    qt.hadamard(q, 0)
+    qt.controlledNot(q, 0, 1)
+    qt.rotateY(q, 2, 0.25)
+    path = tmp_path / "c.qasm"
+    qt.writeRecordedQASMToFile(q, str(path))
+    parsed = qt.load_qasm_file(str(path))
+    q2 = qt.createQureg(3, env)
+    qt.initZeroState(q2)
+    parsed.circuit.compile(env, pallas=False).run(q2)
+    assert _phase_aligned(q.to_numpy(), q2.to_numpy()) < 1e-12
+
+
+def test_dialect_u_disambiguation():
+    text = "qreg q[1];\nU(pi/2,0,pi) q[0];"
+    env = qt.createQuESTEnv(num_devices=1, seed=[1])
+
+    def final_state(dialect):
+        parsed = qt.parse_qasm(text, dialect=dialect)
+        q = qt.createQureg(1, env)
+        qt.initZeroState(q)
+        parsed.circuit.compile(env, pallas=False).run(q)
+        return q.to_numpy()
+
+    # spec dialect: U(pi/2, 0, pi) is a Hadamard (up to global phase)
+    h = np.array([1.0, 1.0]) / np.sqrt(2.0)
+    assert _phase_aligned(final_state("openqasm"), h) < 1e-10
+    # recorder dialect multiplies in printed order -> different gate
+    assert _phase_aligned(final_state("quest"), h) > 1e-3
+    with pytest.raises(ValueError):
+        qt.parse_qasm(text, dialect="qiskit")
+
+
+def test_uppercase_builtin_cx():
+    parsed = qt.parse_qasm("qreg q[2];\nh q[0];\nCX q[0],q[1];")
+    env = qt.createQuESTEnv(num_devices=1, seed=[1])
+    q = qt.createQureg(2, env)
+    qt.initZeroState(q)
+    parsed.circuit.compile(env, pallas=False).run(q)
+    psi = q.to_numpy()
+    bell = np.zeros(4); bell[0] = bell[3] = 1 / np.sqrt(2.0)
+    assert _phase_aligned(psi, bell.astype(complex)) < 1e-10
