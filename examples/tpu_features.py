@@ -59,7 +59,18 @@ for step in range(5):                     # 5 steps of gradient descent
 print(f"VQE-style descent: E={float(energy(params)):.6f} "
       f"at theta={float(params[0]):.4f}")
 
-# 4. mesh sharding ------------------------------------------------------------
+# 4. batched simulation: one executable, a whole parameter sweep -------------
+from quest_tpu.core.packing import pack  # noqa: E402
+
+angles = np.linspace(0.0, np.pi, 16).reshape(16, 1)
+zero = np.zeros(1 << 4, dtype=np.complex64)
+zero[0] = 1.0
+batch = np.asarray(jax.vmap(f.apply, in_axes=(None, 0))(pack(zero), angles))
+p0 = batch[:, 0, 0] ** 2 + batch[:, 1, 0] ** 2     # |amp(|0000>)|^2 per angle
+print(f"vmap sweep: 16 angles through ONE executable, "
+      f"P(|0000>) from {p0.max():.4f} to {p0.min():.4f}")
+
+# 5. mesh sharding ------------------------------------------------------------
 if len(jax.devices()) >= 8:
     mesh_env = qt.createQuESTEnv(num_devices=8, seed=[7])
     qm = qt.createQureg(10, mesh_env)
