@@ -57,7 +57,7 @@ def emit(line: dict) -> None:
 
 
 def _run_child(extra_env: dict, first_line_deadline: float,
-               total_deadline: float) -> int:
+               total_deadline: float, argv=None) -> int:
     """Spawn this script as a measurement child and relay its stdout.
 
     Returns the number of REAL result lines relayed (JSON with value > 0 —
@@ -72,12 +72,12 @@ def _run_child(extra_env: dict, first_line_deadline: float,
     import queue
 
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__)],
+        argv or [sys.executable, os.path.abspath(__file__)],
         env={**os.environ, **extra_env,
              "QUEST_BENCH_CHILD": "1",
              "QUEST_BENCH_BUDGET_S": str(max(10.0, total_deadline
                                              - time.perf_counter()))},
-        stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
+        stdout=subprocess.PIPE, stderr=None, text=True)  # stderr inherits
     lines: "queue.Queue[str | None]" = queue.Queue()
 
     def _reader():

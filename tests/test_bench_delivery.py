@@ -1,0 +1,76 @@
+"""The bench delivery machinery (bench.py supervisor) under fault
+injection: hanging children, noise-only children, error-row-only
+children. This is the component that turned rounds 1-2 into empty
+BENCH_r*.json files — it gets real tests, not just field debugging."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+import bench  # noqa: E402
+
+
+def _run(code: str, first_rel: float, total_rel: float, capsys):
+    t0 = time.perf_counter()
+    delivered = bench._run_child(
+        {}, first_line_deadline=t0 + first_rel,
+        total_deadline=t0 + total_rel,
+        argv=[sys.executable, "-u", "-c", code])
+    elapsed = time.perf_counter() - t0
+    return delivered, elapsed, capsys.readouterr().out
+
+
+def test_healthy_child_relays_all_lines(capsys):
+    code = ("import json\n"
+            "for i in range(3):\n"
+            "    print(json.dumps({'metric': 'm%d' % i, 'value': 1.0 + i}))\n")
+    delivered, elapsed, out = _run(code, 5.0, 10.0, capsys)
+    assert delivered == 3
+    lines = [json.loads(x) for x in out.strip().splitlines()]
+    assert [ln["metric"] for ln in lines] == ["m0", "m1", "m2"]
+    assert elapsed < 5.0
+
+
+def test_silent_hang_killed_at_first_line_deadline(capsys):
+    delivered, elapsed, out = _run(
+        "import time; time.sleep(60)", 1.0, 30.0, capsys)
+    assert delivered == 0
+    assert out == ""
+    assert elapsed < 5.0          # killed at the 1s deadline, not 30s
+
+
+def test_hang_after_results_keeps_them(capsys):
+    code = ("import json, time\n"
+            "print(json.dumps({'metric': 'early', 'value': 2.5}))\n"
+            "time.sleep(60)\n")
+    delivered, elapsed, out = _run(code, 5.0, 2.0, capsys)
+    assert delivered == 1
+    assert json.loads(out.strip())["value"] == 2.5
+    assert elapsed < 6.0          # killed at total_deadline, line survives
+
+
+def test_noise_lines_do_not_count_as_delivery(capsys):
+    code = ("import time\n"
+            "print('WARNING: some plugin banner')\n"
+            "time.sleep(60)\n")
+    delivered, elapsed, out = _run(code, 2.0, 30.0, capsys)
+    assert delivered == 0         # noise relayed to stderr, not counted
+    assert out == ""
+
+
+def test_error_rows_do_not_count_as_delivery(capsys):
+    code = ("import json\n"
+            "print(json.dumps({'metric': 'x (bench error)', 'value': 0.0}))\n")
+    delivered, _, out = _run(code, 5.0, 10.0, capsys)
+    assert delivered == 0         # relayed for the record, but not success
+    assert json.loads(out.strip())["value"] == 0.0
+
+
+def test_fast_exit_returns_promptly(capsys):
+    delivered, elapsed, _ = _run("pass", 30.0, 60.0, capsys)
+    assert delivered == 0
+    assert elapsed < 5.0          # EOF ends the wait, no deadline sleep
