@@ -527,6 +527,15 @@ class Circuit:
         from .native.statevec import NativeProgram
         return NativeProgram(circ, threads=threads)
 
+    def compile_trajectories(self, env: QuESTEnv):
+        """Lower to a quantum-trajectory program: channels applied
+        stochastically to a STATEVECTOR (Monte-Carlo wavefunction), so a
+        noisy n-qubit circuit costs 2^n amplitudes per trajectory instead
+        of the density path's 2^(2n) (``ops/trajectories.py``). Batch
+        trajectories with ``run_batch`` — one vmapped executable."""
+        from .ops.trajectories import TrajectoryProgram
+        return TrajectoryProgram(self, env)
+
     def compile_dd(self, env: QuESTEnv, dtype=None):
         """Compile to the double-double amplitude path: each amplitude
         component is an unevaluated hi+lo pair of ``dtype`` floats

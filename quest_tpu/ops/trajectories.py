@@ -1,0 +1,159 @@
+"""Quantum-trajectory (Monte-Carlo wavefunction) unraveling of noisy
+circuits: channels applied stochastically to a STATEVECTOR.
+
+The reference can simulate noise only on density matrices — 2^(2n)
+amplitudes per register (``mixDamping`` etc. on the flattened vector,
+``QuEST_common.c:540-604``). The trajectory method simulates the same
+channel as an ensemble of 2^n-amplitude pure states: at each Kraus
+channel, one operator ``K_j`` is drawn with the physical probability
+``p_j = <psi| K_j^dag K_j |psi>`` and applied with renormalisation.
+Averaging ``|psi><psi|`` over trajectories converges to the exact
+density evolution at O(1/sqrt(T)) — exponentially cheaper per
+trajectory, embarrassingly parallel across them.
+
+TPU-native shape: the whole stochastic program is ONE jitted function of
+``(state planes, PRNG key)`` — channel probabilities via a ``lax.map``
+over the stacked Kraus matrices (no k-fold state materialisation), the
+draw via Gumbel-max, the chosen operator applied by dynamic indexing
+into the stack (``apply_unitary`` takes a traced matrix). Batch with
+``jax.vmap`` over keys to run hundreds of trajectories in one executable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.apply import apply_unitary, apply_diagonal
+from ..core.packing import pack, unpack
+
+__all__ = ["TrajectoryProgram"]
+
+
+class TrajectoryProgram:
+    """A recorded circuit lowered to a stochastic pure-state program.
+
+    ``apply(state_f, key)`` is pure and jitted: packed float planes +
+    PRNG key -> packed planes. Unitary/diagonal ops apply as in the
+    deterministic path; each Kraus channel consumes one ``fold_in`` of
+    the key. Parameterized circuits are not supported (bind angles
+    before recording); use :meth:`run_batch` for an ensemble.
+    """
+
+    def __init__(self, circuit, env):
+        self.env = env
+        self.num_qubits = circuit.num_qubits
+        if circuit.param_names or any(not op.is_static
+                                      for op in circuit.ops):
+            raise ValueError(
+                "trajectory programs need a fully-bound static circuit "
+                f"(unbound parameters: {list(circuit.param_names)})")
+        ops = []
+        n_channels = 0
+        # reuse the host-side peephole fusion every other compile path
+        # gets; kraus ops match neither fusion branch, so they act as
+        # barriers and pass through untouched
+        for op in circuit._fused_ops():
+            if op.kind == "kraus":
+                from .. import validation as val
+                val.validate_kraus_ops(op.kraus, len(op.targets),
+                                       "TrajectoryProgram",
+                                       env.precision.eps)
+                stack = np.stack([np.asarray(k, dtype=np.complex128)
+                                  for k in op.kraus])
+                # E_j = K_j^dag K_j, precomputed: channel probabilities
+                # then need only the reduced density of the targets
+                estack = np.einsum("kba,kbc->kac", stack.conj(), stack)
+                ops.append(("kraus", op.targets, (stack, estack),
+                            n_channels))
+                n_channels += 1
+            elif op.kind == "u":
+                ops.append(("u", op.targets, op.mat,
+                            (op.ctrl_mask, op.flip_mask)))
+            else:
+                ops.append(("diag", op.targets, op.diag, None))
+        self._ops = ops
+        self.num_channels = n_channels
+        n = self.num_qubits
+        cdtype = env.precision.complex_dtype
+
+        def apply_fn(state_f, key):
+            psi = unpack(state_f)
+            for i, (kind, targets, data, extra) in enumerate(ops):
+                if kind == "u":
+                    cmask, fmask = extra
+                    psi = apply_unitary(psi, n, jnp.asarray(data, cdtype),
+                                        targets, cmask, fmask)
+                elif kind == "diag":
+                    psi = apply_diagonal(psi, n, targets,
+                                         jnp.asarray(data, cdtype))
+                else:
+                    kstack = jnp.asarray(data[0], cdtype)
+                    estack = jnp.asarray(data[1], cdtype)
+                    sub = jax.random.fold_in(key, extra)
+                    # p_j = <psi| E_j |psi> = tr(E_j rho_T): ONE state
+                    # pass builds the 2^t x 2^t reduced density of the
+                    # targets, then every probability is a tiny trace
+                    k = len(targets)
+                    axes_front = [n - 1 - targets[j]
+                                  for j in reversed(range(k))]
+                    rest = [ax for ax in range(n) if ax not in axes_front]
+                    a = jnp.transpose(psi.reshape((2,) * n),
+                                      axes_front + rest).reshape(1 << k, -1)
+                    rho_t = a @ a.conj().T
+                    probs = jnp.real(jnp.einsum("kab,ba->k", estack, rho_t))
+                    # categorical draw over the physical channel probs
+                    # (log space; zero-prob branches get ~-inf)
+                    logp = jnp.log(jnp.maximum(
+                        probs, jnp.finfo(probs.dtype).tiny))
+                    j = jax.random.categorical(sub, logp)
+                    psi = apply_unitary(psi, n, kstack[j], targets)
+                    psi = psi * jax.lax.rsqrt(
+                        jnp.maximum(probs[j],
+                                    jnp.finfo(probs.dtype).tiny)
+                    ).astype(psi.dtype)
+            return pack(psi)
+
+        self._apply = jax.jit(apply_fn)
+        self._vmapped = jax.jit(jax.vmap(apply_fn, in_axes=(None, 0)))
+
+    # -- execution ---------------------------------------------------------
+
+    def apply(self, state_f, key):
+        """Pure form: packed planes + key -> packed planes (one draw)."""
+        return self._apply(state_f, key)
+
+    def run(self, qureg, key: Optional[jax.Array] = None) -> None:
+        """One trajectory in place on a statevector register; the env RNG
+        stream advances when ``key`` is not given."""
+        if qureg.is_density_matrix:
+            raise ValueError("trajectory programs run on statevector "
+                             "registers (that is the point)")
+        if qureg.num_qubits_represented != self.num_qubits:
+            raise ValueError(
+                f"program has {self.num_qubits} qubits; register has "
+                f"{qureg.num_qubits_represented}")
+        if key is None:
+            key = self.env.next_key()
+        qureg.state = self._apply(qureg.state, key)
+
+    def run_batch(self, state_f, num_trajectories: int,
+                  key: Optional[jax.Array] = None):
+        """``num_trajectories`` independent draws from one initial packed
+        state — a ``(T, 2, 2^n)`` batch through ONE executable."""
+        if key is None:
+            key = self.env.next_key()
+        keys = jax.random.split(key, num_trajectories)
+        return self._vmapped(state_f, keys)
+
+    def average_density(self, state_f, num_trajectories: int,
+                        key: Optional[jax.Array] = None) -> np.ndarray:
+        """Monte-Carlo estimate of the channel-evolved density matrix:
+        mean of |psi><psi| over trajectories (host-side, debug/analysis
+        scale — the matrix is materialised)."""
+        batch = np.asarray(self.run_batch(state_f, num_trajectories, key))
+        psis = batch[:, 0] + 1j * batch[:, 1]
+        return np.einsum("ti,tj->ij", psis, psis.conj()) / len(psis)

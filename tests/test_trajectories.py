@@ -1,0 +1,101 @@
+"""Quantum-trajectory unraveling vs the exact density path: the
+trajectory average of |psi><psi| must converge to the density evolution
+the XLA channel path computes exactly (the two share no channel code —
+superoperator lifting vs stochastic Kraus draws)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+from quest_tpu.core.packing import pack
+
+
+def _exact_density(c, n, env):
+    d = qt.createDensityQureg(n, env)
+    qt.initZeroState(d)
+    c.compile(env, density=True, pallas=False).run(d)
+    flat = d.to_numpy()
+    # flat index = row | (col << n)  (conjugate side on the high bits)
+    return flat.reshape(1 << n, 1 << n).T
+
+
+def _zero_planes(n, env):
+    psi = np.zeros(1 << n, dtype=np.complex128)
+    psi[0] = 1.0
+    return pack(psi.astype(env.precision.complex_dtype))
+
+
+def test_unitary_only_trajectory_is_deterministic(env):
+    n = 3
+    c = Circuit(n)
+    c.h(0).cnot(0, 1).rz(2, 0.7).ry(1, 1.1)
+    prog = c.compile_trajectories(env)
+    q = qt.createQureg(n, env)
+    qt.initZeroState(q)
+    prog.run(q)
+    q2 = qt.createQureg(n, env)
+    qt.initZeroState(q2)
+    c.compile(env, pallas=False).run(q2)
+    np.testing.assert_allclose(q.to_numpy(), q2.to_numpy(), atol=1e-12)
+
+
+@pytest.mark.parametrize("noise", ["damp", "dephase", "depolarise"])
+def test_trajectory_average_matches_density(env, noise):
+    n = 2
+    c = Circuit(n)
+    c.h(0).cnot(0, 1).ry(1, 0.6)
+    getattr(c, noise)(0, 0.3)
+    c.rx(0, 0.4)
+    getattr(c, noise)(1, 0.2)
+
+    rho_exact = _exact_density(c, n, env)
+    prog = c.compile_trajectories(env)
+    rho_mc = prog.average_density(_zero_planes(n, env), 600)
+
+    assert prog.num_channels == 2
+    assert abs(np.trace(rho_mc) - 1.0) < 1e-6
+    # Monte-Carlo error ~ 1/sqrt(600) per entry; 6-sigma-ish bound
+    assert np.max(np.abs(rho_mc - rho_exact)) < 0.12
+
+
+def test_trajectory_norm_preserved_per_draw(env):
+    n = 3
+    c = Circuit(n)
+    for q_ in range(n):
+        c.h(q_)
+    c.damp(0, 0.5)
+    c.kraus([np.sqrt(0.5) * np.eye(4),
+             np.sqrt(0.5) * np.kron(np.array([[0, 1], [1, 0]]),
+                                    np.eye(2))], (0, 1))
+    prog = c.compile_trajectories(env)
+    batch = np.asarray(prog.run_batch(_zero_planes(n, env), 32))
+    norms = np.sum(batch[:, 0] ** 2 + batch[:, 1] ** 2, axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-6)
+
+
+def test_trajectory_validation(env):
+    c = Circuit(2)
+    th = c.parameter("th")
+    c.rz(0, th)
+    with pytest.raises(ValueError):
+        c.compile_trajectories(env)
+
+    # a callable-matrix gate with no registered Param must also be
+    # rejected at compile time, not crash inside the trace
+    cc = Circuit(1)
+    cc.gate(lambda p: np.eye(2), (0,))
+    with pytest.raises(ValueError):
+        cc.compile_trajectories(env)
+
+    c2 = Circuit(2)
+    c2.kraus([np.eye(2) * 0.2], (0,))          # not CPTP
+    with pytest.raises(qt.QuESTError):
+        c2.compile_trajectories(env)
+
+    c3 = Circuit(2)
+    c3.h(0)
+    prog = c3.compile_trajectories(env)
+    d = qt.createDensityQureg(2, env)
+    with pytest.raises(ValueError):
+        prog.run(d)
