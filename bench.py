@@ -322,6 +322,55 @@ def bench_grover(qt, env, platform: str) -> dict:
         n_gates, trials, dt, num_qubits, env)
 
 
+def bench_trajectories(qt, env, platform: str) -> dict:
+    """Quantum-trajectory unraveling throughput: T noisy trajectories
+    vmapped through ONE executable. The reference's only noise path is
+    the 2^(2n) density vector; the roofline comparison is therefore the
+    density config's op rate at the same logical width — here each
+    trajectory op streams 2^n amps instead of 2^(2n)."""
+    num_qubits = int(os.environ.get(
+        "QUEST_BENCH_TRAJ_QUBITS", "16" if _is_accel(platform) else "12"))
+    n_traj = int(os.environ.get("QUEST_BENCH_TRAJ_COUNT", "32"))
+    from quest_tpu.circuits import Circuit
+    rng = np.random.default_rng(2026)
+    c = Circuit(num_qubits)
+    n_ops = 0
+    for q_ in range(num_qubits):
+        c.rotate(q_, float(rng.uniform(0, 2 * np.pi)), rng.normal(size=3))
+        n_ops += 1
+    for q_ in range(0, num_qubits - 1, 2):
+        c.cnot(q_, q_ + 1)
+        n_ops += 1
+    for q_ in range(num_qubits):
+        c.dephase(q_, 0.05)
+        c.damp(q_, 0.02)
+        n_ops += 2
+    prog = c.compile_trajectories(env)
+    psi = np.zeros(1 << num_qubits, dtype=env.precision.complex_dtype)
+    psi[0] = 1.0
+    from quest_tpu.core.packing import pack
+    planes = pack(psi)
+    out = prog.run_batch(planes, n_traj)           # compile + warm-up
+    out.block_until_ready()
+    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 3)
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        out = prog.run_batch(planes, n_traj)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    traj_ops = n_ops * n_traj * trials / dt
+    baseline = _roofline_baseline(
+        2 * num_qubits, np.dtype(env.precision.real_dtype).itemsize)
+    return {
+        "metric": f"trajectory noise unraveling, {num_qubits}-qubit "
+                  f"statevector x {n_traj} trajectories, "
+                  f"single {platform} chip",
+        "value": round(traj_ops, 2),
+        "unit": "trajectory-ops/sec",
+        "vs_baseline": round(traj_ops / baseline, 4),
+    }
+
+
 def bench_density_noise(qt, env, platform: str) -> dict:
     """Density register with dephasing/damping channels (BASELINE.json
     config 4: 15 qubits on TPU; width-reduced on CPU where the 2^30 flat
@@ -466,6 +515,7 @@ def main() -> None:
         ("qft", 60, lambda: bench_qft(qt, env, platform)),
         ("grover", 45, lambda: bench_grover(qt, env, platform)),
         ("density", 45, lambda: bench_density_noise(qt, env, platform)),
+        ("traj", 45, lambda: bench_trajectories(qt, env, platform)),
         ("dd", 45, lambda: bench_dd(qt, env, platform)),
     ]
     if accel:

@@ -325,9 +325,11 @@ class Circuit:
     # -- channels (density-register circuits) ------------------------------
 
     def kraus(self, ops: Sequence, targets: Sequence[int]) -> "Circuit":
-        """Record a Kraus channel (density compilation only): the map
-        ``rho -> sum_k K_k rho K_k^dag``. Lifts to one superoperator pass
-        on the flattened density vector (``QuEST_common.c:540-604``).
+        """Record a Kraus channel ``rho -> sum_k K_k rho K_k^dag``.
+
+        Consumed by ``compile(density=True)`` (one superoperator pass on
+        the flattened density vector, ``QuEST_common.c:540-604``) and by
+        ``compile_trajectories`` (stochastic statevector unraveling).
         CPTP validation happens at compile time, at the environment's
         precision tolerance."""
         targets = tuple(int(t) for t in targets)

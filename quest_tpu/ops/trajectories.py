@@ -12,11 +12,14 @@ density evolution at O(1/sqrt(T)) — exponentially cheaper per
 trajectory, embarrassingly parallel across them.
 
 TPU-native shape: the whole stochastic program is ONE jitted function of
-``(state planes, PRNG key)`` — channel probabilities via a ``lax.map``
-over the stacked Kraus matrices (no k-fold state materialisation), the
-draw via Gumbel-max, the chosen operator applied by dynamic indexing
-into the stack (``apply_unitary`` takes a traced matrix). Batch with
-``jax.vmap`` over keys to run hundreds of trajectories in one executable.
+``(state planes, PRNG key)`` — channel probabilities come from a single
+state pass that builds the targets' 2^t x 2^t reduced density matrix
+(every ``p_j`` is then a tiny trace against the precomputed
+``E_j = K_j^dag K_j`` stack), the draw is a categorical over log
+probabilities, and the chosen operator is applied by dynamic indexing
+into the Kraus stack (``apply_unitary`` takes a traced matrix). Batch
+with ``jax.vmap`` over keys to run hundreds of trajectories in one
+executable.
 """
 
 from __future__ import annotations
@@ -103,8 +106,14 @@ class TrajectoryProgram:
                     rest = [ax for ax in range(n) if ax not in axes_front]
                     a = jnp.transpose(psi.reshape((2,) * n),
                                       axes_front + rest).reshape(1 << k, -1)
-                    rho_t = a @ a.conj().T
-                    probs = jnp.real(jnp.einsum("kab,ba->k", estack, rho_t))
+                    # HIGHEST: these feed the renormalisation, so the
+                    # TPU bf16 matmul default would drift every
+                    # trajectory's norm (same reason as core/apply.py)
+                    rho_t = jnp.matmul(a, a.conj().T,
+                                       precision=jax.lax.Precision.HIGHEST)
+                    probs = jnp.real(jnp.einsum(
+                        "kab,ba->k", estack, rho_t,
+                        precision=jax.lax.Precision.HIGHEST))
                     # categorical draw over the physical channel probs
                     # (log space; zero-prob branches get ~-inf)
                     logp = jnp.log(jnp.maximum(
