@@ -24,6 +24,7 @@ _PIN = (
     "tpu_features.py",
     "vqe.py",
     "shor.py",
+    "noisy_trajectories.py",
 ])
 def test_example_runs(script):
     path = os.path.join(EXAMPLES, script)
