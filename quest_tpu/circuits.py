@@ -362,6 +362,38 @@ class Circuit:
         val.validate_prob(prob, "Circuit.damp", 1.0)
         return self.kraus(chan.damping_kraus(prob), (q,))
 
+    def with_noise(self, p1: float = 0.0, p2: float = 0.0,
+                   damping: float = 0.0) -> "Circuit":
+        """Return a copy with a uniform noise model applied: after every
+        gate, each touched qubit (targets and controls) gets depolarising
+        noise — ``p1`` for single-qubit gates, ``p2`` for multi-qubit —
+        followed by amplitude damping at rate ``damping``. The standard
+        way to make any clean algorithm noisy without hand-inserting
+        channels; run the result on a density register or through
+        ``compile_trajectories``. Existing channels are preserved and not
+        re-noised."""
+        from . import validation as val
+        for name, p, cap in (("p1", p1, 0.75), ("p2", p2, 0.75),
+                             ("damping", damping, 1.0)):
+            val.validate_prob(p, f"Circuit.with_noise({name})", cap)
+        out = Circuit(self.num_qubits)
+        out._params = list(self._params)
+        for op in self.ops:
+            out.ops.append(op)
+            if op.kind == "kraus":
+                continue
+            touched = sorted(
+                set(op.targets)
+                | {q for q in range(self.num_qubits)
+                   if (op.ctrl_mask >> q) & 1})
+            p = p1 if len(touched) == 1 else p2
+            for q in touched:
+                if p > 0.0:
+                    out.depolarise(q, p)
+                if damping > 0.0:
+                    out.damp(q, damping)
+        return out
+
     def _lifted_density(self) -> "Circuit":
         """Rewrite this n-qubit program as a 2n-qubit program on the
         flattened density vector: U becomes conj(U) (x) U on

@@ -99,3 +99,44 @@ def test_trajectory_validation(env):
     d = qt.createDensityQureg(2, env)
     with pytest.raises(ValueError):
         prog.run(d)
+
+
+class TestWithNoise:
+    def test_inserts_channels_after_gates(self, env):
+        c = Circuit(3)
+        c.h(0)
+        c.cnot(0, 1)
+        noisy = c.with_noise(p1=0.01, p2=0.02, damping=0.005)
+        kinds = [op.kind for op in noisy.ops]
+        # h -> 2 channels on q0; cnot -> 2 channels each on q0,q1
+        assert kinds == ["u", "kraus", "kraus",
+                         "u", "kraus", "kraus", "kraus", "kraus"]
+        assert [op.kind for op in c.ops] == ["u", "u"]   # original untouched
+
+    def test_noise_free_copy_is_identity(self, env):
+        c = Circuit(2)
+        c.h(0).cnot(0, 1)
+        assert len(c.with_noise().ops) == len(c.ops)
+
+    def test_existing_channels_not_renoised(self, env):
+        c = Circuit(2)
+        c.h(0)
+        c.damp(1, 0.3)
+        noisy = c.with_noise(p1=0.1)
+        assert [op.kind for op in noisy.ops] == ["u", "kraus", "kraus"]
+
+    def test_noisy_ghz_purity_drops(self, env):
+        c = Circuit(3)
+        c.h(0).cnot(0, 1).cnot(1, 2)
+        noisy = c.with_noise(p1=0.05, p2=0.1)
+        d = qt.createDensityQureg(3, env)
+        qt.initZeroState(d)
+        noisy.compile(env, density=True, pallas=False).run(d)
+        assert abs(qt.calcTotalProb(d) - 1.0) < 1e-10
+        assert qt.calcPurity(d) < 0.95
+
+    def test_validation(self, env):
+        c = Circuit(1)
+        c.h(0)
+        with pytest.raises(qt.QuESTError):
+            c.with_noise(p1=0.9)         # over the depolarising cap
