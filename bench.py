@@ -371,6 +371,32 @@ def bench_trajectories(qt, env, platform: str) -> dict:
     }
 
 
+def bench_sharded_mesh(qt, platform: str) -> dict:
+    """Same 1q+CNOT workload over an 8-device amplitude-sharded mesh:
+    exercises the layout planner + XLA collectives (the reference's MPI
+    path analogue) end-to-end. Runs wherever 8+ devices exist — the CPU
+    child's virtual mesh here, a real pod slice in production."""
+    import jax as _jax
+    import quest_tpu as _qt
+    n_dev = len(_jax.devices())
+    if n_dev < 8:
+        raise RuntimeError(f"needs 8 devices, found {n_dev}")
+    env = _qt.createQuESTEnv(num_devices=8, seed=[2026])
+    num_qubits = int(os.environ.get(
+        "QUEST_BENCH_MESH_QUBITS", "24" if _is_accel(platform) else "18"))
+    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 3)
+    q = _qt.createQureg(num_qubits, env)
+    _qt.initZeroState(q)
+    circ, n_gates = build_bench_circuit(num_qubits, 1)
+    cc = circ.compile(env, pallas="off")
+    dt = _time_compiled(cc, q, trials)
+    return {**_result(
+        f"1q+CNOT gate throughput, {num_qubits}-qubit statevector "
+        f"sharded over 8 {platform} devices",
+        n_gates, trials, dt, num_qubits, env),
+        "planned_relayouts": cc.plan.num_relayouts}
+
+
 def bench_density_noise(qt, env, platform: str) -> dict:
     """Density register with dephasing/damping channels (BASELINE.json
     config 4: 15 qubits on TPU; width-reduced on CPU where the 2^30 flat
@@ -423,7 +449,13 @@ def supervise() -> None:
                         "(hang/init/config failure) — falling back to CPU",
               "value": 0.0, "unit": "gates/sec", "vs_baseline": 0.0})
     cpu_end = max(budget_end, time.perf_counter() + cpu_reserve)
-    relayed = _run_child({"QUEST_BENCH_FORCE_CPU": "1"},
+    cpu_env = {"QUEST_BENCH_FORCE_CPU": "1",
+               # 8 virtual devices so the CPU child can also exercise the
+               # sharded-mesh config (ppermute/psum path) end-to-end
+               "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                             + " --xla_force_host_platform_device_count=8"
+                             ).strip()}
+    relayed = _run_child(cpu_env,
                          first_line_deadline=cpu_end, total_deadline=cpu_end)
     if relayed == 0:
         # even the CPU child died: leave a parseable record of that
@@ -517,6 +549,7 @@ def main() -> None:
         ("density", 45, lambda: bench_density_noise(qt, env, platform)),
         ("traj", 45, lambda: bench_trajectories(qt, env, platform)),
         ("dd", 45, lambda: bench_dd(qt, env, platform)),
+        ("sharded", 45, lambda: bench_sharded_mesh(qt, platform)),
     ]
     if accel:
         # on CPU the Pallas pass is inert (circuits.py enable gate), so the
