@@ -375,7 +375,7 @@ def bench_sharded_mesh(qt, platform: str) -> dict:
     """Same 1q+CNOT workload over an 8-device amplitude-sharded mesh:
     exercises the layout planner + XLA collectives (the reference's MPI
     path analogue) end-to-end. Runs wherever 8+ devices exist — the CPU
-    child's virtual mesh here, a real pod slice in production."""
+    fallback's dedicated virtual-mesh child, a real pod slice directly."""
     import jax as _jax
     import quest_tpu as _qt
     n_dev = len(_jax.devices())
@@ -449,14 +449,25 @@ def supervise() -> None:
                         "(hang/init/config failure) — falling back to CPU",
               "value": 0.0, "unit": "gates/sec", "vs_baseline": 0.0})
     cpu_end = max(budget_end, time.perf_counter() + cpu_reserve)
-    cpu_env = {"QUEST_BENCH_FORCE_CPU": "1",
-               # 8 virtual devices so the CPU child can also exercise the
-               # sharded-mesh config (ppermute/psum path) end-to-end
-               "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
-                             + " --xla_force_host_platform_device_count=8"
-                             ).strip()}
-    relayed = _run_child(cpu_env,
+    relayed = _run_child({"QUEST_BENCH_FORCE_CPU": "1"},
                          first_line_deadline=cpu_end, total_deadline=cpu_end)
+    if relayed and os.environ.get("QUEST_BENCH_HEADLINE_ONLY", "0") != "1":
+        # the sharded-mesh config needs 8 virtual devices, which tax
+        # single-device configs ~30% (the CPU backend splits per-device)
+        # — so it gets its own short child with the flag set, bounded to
+        # 30s past the CPU window
+        mesh_end = time.perf_counter() + min(30.0, cpu_reserve)
+        mesh_rows = _run_child(
+            {"QUEST_BENCH_FORCE_CPU": "1",
+             "QUEST_BENCH_MESH_CHILD": "1",
+             "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8"
+                           ).strip()},
+            first_line_deadline=mesh_end, total_deadline=mesh_end)
+        if mesh_rows == 0:
+            emit({"metric": "sharded (mesh child produced no result "
+                            "within 30s)", "value": 0.0,
+                  "unit": "gates/sec", "vs_baseline": 0.0})
     if relayed == 0:
         # even the CPU child died: leave a parseable record of that
         emit({"metric": "1q+CNOT gate throughput (all backends failed; "
@@ -493,8 +504,16 @@ def main() -> None:
         pass                                  # cache is best-effort only
 
     import quest_tpu as qt
-    env = qt.createQuESTEnv(num_devices=1, seed=[2026])
     accel = _is_accel(platform)
+    if os.environ.get("QUEST_BENCH_MESH_CHILD", "0") == "1":
+        try:
+            emit(bench_sharded_mesh(qt, platform))
+        except Exception as e:
+            emit({"metric": "sharded (bench error)", "value": 0.0,
+                  "unit": "gates/sec", "vs_baseline": 0.0,
+                  "errors": [f"{type(e).__name__}: {e}"]})
+        return
+    env = qt.createQuESTEnv(num_devices=1, seed=[2026])
 
     # headline: small-compile config FIRST so a number always lands.
     # On CPU the native C++ executor leads when its library is ALREADY
@@ -549,8 +568,15 @@ def main() -> None:
         ("density", 45, lambda: bench_density_noise(qt, env, platform)),
         ("traj", 45, lambda: bench_trajectories(qt, env, platform)),
         ("dd", 45, lambda: bench_dd(qt, env, platform)),
-        ("sharded", 45, lambda: bench_sharded_mesh(qt, platform)),
     ]
+    if accel:
+        # on a pod slice this runs directly; on fewer than 8 chips it
+        # yields a visible "needs 8 devices" error row rather than a
+        # silently missing metric. The CPU fallback never appends it —
+        # its dedicated 8-virtual-device mesh child owns the row there
+        # (so a pre-set host-device-count flag can't duplicate it).
+        configs.append(("sharded", 45,
+                        lambda: bench_sharded_mesh(qt, platform)))
     if accel:
         # on CPU the Pallas pass is inert (circuits.py enable gate), so the
         # comparison would be XLA-vs-XLA noise — accel platforms only
