@@ -22,7 +22,8 @@ The public API mirrors the reference's function names and argument orders
 from .config import Precision, SINGLE, DOUBLE, default_precision
 from .types import (
     PauliOpType, PAULI_I, PAULI_X, PAULI_Y, PAULI_Z,
-    QuESTError, invalid_quest_input_error, set_input_error_handler,
+    QuESTError, invalid_quest_input_error, invalidQuESTInputError,
+    set_input_error_handler,
 )
 from .env import (QuESTEnv, create_quest_env, destroy_quest_env,
                   initialize_multihost)
@@ -38,7 +39,8 @@ __all__ = (
     [
         "Precision", "SINGLE", "DOUBLE", "default_precision",
         "PauliOpType", "PAULI_I", "PAULI_X", "PAULI_Y", "PAULI_Z",
-        "QuESTError", "invalid_quest_input_error", "set_input_error_handler",
+        "QuESTError", "invalid_quest_input_error",
+        "invalidQuESTInputError", "set_input_error_handler",
         "QuESTEnv", "create_quest_env", "destroy_quest_env", "Qureg",
         "Circuit", "CompiledCircuit", "Param",
         "ParsedQASM", "parse_qasm", "load_qasm_file",

@@ -18,6 +18,7 @@ __all__ = [
     "PAULI_Z",
     "QuESTError",
     "invalid_quest_input_error",
+    "invalidQuESTInputError",
     "set_input_error_handler",
 ]
 
@@ -81,3 +82,9 @@ def set_input_error_handler(handler) -> None:
     """Replace the validation-failure handler (None restores the default)."""
     global _handler
     _handler = handler if handler is not None else _default_handler
+
+
+# exact-name alias for the reference's overridable weak symbol
+# (``invalidQuESTInputError``, ``QuEST.h:3191``) so a grep-level port of a
+# reference embedder finds it under the name it knows
+invalidQuESTInputError = invalid_quest_input_error
