@@ -200,3 +200,49 @@ def test_uppercase_builtin_cx():
     psi = q.to_numpy()
     bell = np.zeros(4); bell[0] = bell[3] = 1 / np.sqrt(2.0)
     assert _phase_aligned(psi, bell.astype(complex)) < 1e-10
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_roundtrip_random_sweep(env, seed):
+    """Property sweep: random sequences from the QASM-faithful gate
+    subset (everything the recorder emits losslessly) must round-trip
+    through record -> parse -> compile -> run at 1e-10."""
+    rng = np.random.default_rng(100 + seed)
+    N = 4
+
+    def build(q):
+        for _ in range(20):
+            kind = int(rng.integers(9))
+            t = int(rng.integers(N))
+            c_ = int((t + 1 + rng.integers(N - 1)) % N)
+            ang = float(rng.uniform(0, 2 * np.pi))
+            if kind == 0:
+                getattr(qt, ["hadamard", "pauliX", "pauliY", "pauliZ",
+                             "sGate", "tGate"][int(rng.integers(6))])(q, t)
+            elif kind == 1:
+                getattr(qt, ["rotateX", "rotateY", "rotateZ"][
+                    int(rng.integers(3))])(q, t, ang)
+            elif kind == 2:
+                th, p1, p2 = rng.uniform(0, 2 * np.pi, size=3)
+                al = complex(np.cos(th) * np.cos(p1),
+                             np.cos(th) * np.sin(p1))
+                be = complex(np.sin(th) * np.cos(p2),
+                             np.sin(th) * np.sin(p2))
+                qt.compactUnitary(q, t, al, be)
+            elif kind == 3:
+                qt.controlledNot(q, c_, t)
+            elif kind == 4:
+                getattr(qt, ["controlledRotateX", "controlledRotateY",
+                             "controlledRotateZ"][int(rng.integers(3))])(
+                    q, c_, t, ang)
+            elif kind == 5:
+                qt.swapGate(q, c_, t)
+            elif kind == 6:
+                qt.sqrtSwapGate(q, c_, t)
+            elif kind == 7:
+                qt.controlledPhaseFlip(q, c_, t)
+            else:
+                qt.rotateAroundAxis(q, t, ang,
+                                    tuple(rng.normal(size=3)))
+    a, b = _record_and_reparse(env, build, N)
+    assert _phase_aligned(a, b) < 1e-10
