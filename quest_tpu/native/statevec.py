@@ -224,3 +224,31 @@ class NativeProgram:
         im = np.ascontiguousarray(psi.imag)
         self.run(re, im, params)
         return re + 1j * im
+
+    # -- observables (numpy reductions over the split planes) --------------
+
+    @staticmethod
+    def total_prob(re: np.ndarray, im: np.ndarray) -> float:
+        return float(re @ re + im @ im)
+
+    def prob_of_outcome(self, re: np.ndarray, im: np.ndarray,
+                        qubit: int, outcome: int) -> float:
+        """P(qubit = outcome) of the current planes."""
+        if not 0 <= qubit < self.num_qubits:
+            raise ValueError(f"qubit {qubit} outside register")
+        n = self.num_qubits
+        view = (re * re + im * im).reshape(
+            1 << (n - qubit - 1), 2, 1 << qubit)
+        return float(view[:, outcome & 1, :].sum())
+
+    def sample(self, re: np.ndarray, im: np.ndarray, num_samples: int,
+               rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        """Draw basis indices from |amp|^2 (no collapse; numpy RNG)."""
+        if num_samples < 1:
+            raise ValueError("num_samples must be >= 1")
+        rng = rng or np.random.default_rng()
+        probs = re * re + im * im
+        total = probs.sum()
+        if total <= 0.0:
+            raise ValueError("cannot sample a zero-probability state")
+        return rng.choice(probs.size, size=num_samples, p=probs / total)

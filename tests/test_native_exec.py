@@ -165,3 +165,22 @@ def test_native_rejects_kraus_and_bad_state():
         prog.run(np.zeros(4), np.zeros(3))
     with pytest.raises(ValueError):
         prog.run(np.zeros(4, np.float32), np.zeros(4, np.float32))
+
+
+def test_native_observables():
+    n = 4
+    c = Circuit(n)
+    c.h(0)
+    c.cnot(0, 3)
+    prog = c.compile_native()
+    re, im = prog.init_zero()
+    prog.run(re, im)
+    assert abs(prog.total_prob(re, im) - 1.0) < 1e-12
+    assert abs(prog.prob_of_outcome(re, im, 3, 1) - 0.5) < 1e-12
+    assert abs(prog.prob_of_outcome(re, im, 1, 0) - 1.0) < 1e-12
+    s = prog.sample(re, im, 500, rng=np.random.default_rng(1))
+    assert set(np.unique(s)) == {0, 0b1001}
+    with pytest.raises(ValueError):
+        prog.prob_of_outcome(re, im, 9, 0)
+    with pytest.raises(ValueError):
+        prog.sample(np.zeros(1 << n), np.zeros(1 << n), 4)
