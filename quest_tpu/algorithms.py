@@ -26,6 +26,8 @@ __all__ = [
     "modular_multiplication_unitary",
     "order_finding",
     "order_from_phase",
+    "qaoa_maxcut",
+    "qaoa_maxcut_terms",
 ]
 
 
@@ -316,3 +318,45 @@ def order_from_phase(measured: int, num_counting: int, modulus: int) -> int:
         return 1
     frac = Fraction(measured, 1 << num_counting).limit_denominator(modulus)
     return frac.denominator
+
+
+def qaoa_maxcut(num_qubits: int, edges, num_layers: int) -> Circuit:
+    """QAOA ansatz for MaxCut on the graph ``edges`` (iterable of
+    ``(u, v)`` pairs): uniform superposition, then ``num_layers`` rounds
+    of cost phases ``exp(-i gamma_l Z_u Z_v / 2)`` per edge and mixer
+    rotations ``Rx(beta_l)`` on every qubit.
+
+    Parameters are registered as ``gamma0..`` / ``beta0..`` — bind them
+    at run time and optimise with ``CompiledCircuit.expectation_fn`` +
+    ``jax.grad`` over the cut Hamiltonian (see :func:`qaoa_maxcut_terms`).
+    The cost phases ride the engine's communication-free diagonal path
+    (`multiRotateZ` machinery), so deep QAOA stays relayout-free on a
+    mesh.
+    """
+    edges = [(int(u), int(v)) for u, v in edges]
+    for u, v in edges:
+        if not (0 <= u < num_qubits and 0 <= v < num_qubits) or u == v:
+            raise ValueError(f"bad edge ({u}, {v})")
+    if num_layers < 1:
+        raise ValueError("num_layers must be >= 1")
+    c = Circuit(num_qubits)
+    for q in range(num_qubits):
+        c.h(q)
+    for layer in range(num_layers):
+        gamma = c.parameter(f"gamma{layer}")
+        beta = c.parameter(f"beta{layer}")
+        for u, v in edges:
+            c.multi_rotate_z([u, v], gamma)
+        for q in range(num_qubits):
+            c.rx(q, beta)
+    return c
+
+
+def qaoa_maxcut_terms(edges):
+    """(pauli_terms, coeffs) of the MaxCut cost ``C = sum_{(u,v)}
+    (1 - Z_u Z_v) / 2`` **dropping the constant** |E|/2 term — feed to
+    ``CompiledCircuit.expectation_fn`` and MINIMISE (the expectation is
+    then -cut_size + |E|/2, so its minimum is the maximum cut)."""
+    terms = [[(int(u), 3), (int(v), 3)] for u, v in edges]
+    coeffs = [0.5] * len(terms)
+    return terms, coeffs

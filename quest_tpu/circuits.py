@@ -961,6 +961,32 @@ class CompiledCircuit:
 
         return jax.jit(energy)
 
+    def sweep(self, param_matrix, state_f=None):
+        """Run a whole batch of parameter vectors through ONE executable.
+
+        ``param_matrix``: ``(B, len(param_names))``. ``state_f``: packed
+        planes shared by every run (default |0..0>). Returns ``(B, 2,
+        2^n)`` packed planes — ``jax.vmap`` over :meth:`apply`, so the
+        batch dimension rides the MXU instead of a Python loop (the VQE /
+        phase-diagram sweep workload; no reference counterpart)."""
+        pm = jnp.asarray(param_matrix, dtype=self.env.precision.real_dtype)
+        if pm.ndim != 2 or pm.shape[1] != len(self.param_names):
+            raise ValueError(
+                f"param_matrix must be (batch, {len(self.param_names)}); "
+                f"got {pm.shape}")
+        if state_f is None:
+            n = self.num_qubits
+            state_f = jnp.zeros((2, 1 << n),
+                                dtype=self.env.precision.real_dtype
+                                ).at[0, 0].set(1.0)
+        # the pure (non-donating) form: the shared input state cannot be
+        # donated across a vmapped batch. Cached so repeat sweeps (an
+        # optimiser loop) hit the jit cache instead of retracing.
+        if not hasattr(self, "_sweep_jitted"):
+            self._sweep_jitted = jax.jit(
+                jax.vmap(self._apply_fn, in_axes=(None, 0)))
+        return self._sweep_jitted(state_f, pm)
+
     def __repr__(self) -> str:
         return (f"CompiledCircuit(qubits={self.num_qubits}, "
                 f"gates={len(self._ops)} (recorded {self.circuit.depth}), "

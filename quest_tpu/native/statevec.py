@@ -236,10 +236,12 @@ class NativeProgram:
         """P(qubit = outcome) of the current planes."""
         if not 0 <= qubit < self.num_qubits:
             raise ValueError(f"qubit {qubit} outside register")
+        if outcome not in (0, 1):
+            raise ValueError(f"outcome must be 0 or 1, got {outcome}")
         n = self.num_qubits
         view = (re * re + im * im).reshape(
             1 << (n - qubit - 1), 2, 1 << qubit)
-        return float(view[:, outcome & 1, :].sum())
+        return float(view[:, outcome, :].sum())
 
     def sample(self, re: np.ndarray, im: np.ndarray, num_samples: int,
                rng: Optional[np.random.Generator] = None) -> np.ndarray:

@@ -145,3 +145,42 @@ def test_order_finding_shor15(env):
     assert alg.order_from_phase(0, nc, 15) == 1
     with pytest.raises(ValueError):
         alg.order_from_phase(256, nc, 15)
+
+
+def test_sweep_batches_parameters(env):
+    c = qt.Circuit(3)
+    th = c.parameter("th")
+    for q in range(3):
+        c.ry(q, th)
+    f = c.compile(env)
+    angles = np.linspace(0, np.pi, 5).reshape(5, 1)
+    batch = np.asarray(f.sweep(angles))
+    assert batch.shape == (5, 2, 8)
+    # th=0 leaves |000>; th=pi maps every qubit to |1> -> |111>
+    assert abs(batch[0, 0, 0] - 1.0) < 1e-6
+    assert abs(batch[-1, 0, 7] ** 2 + batch[-1, 1, 7] ** 2 - 1.0) < 1e-6
+    with pytest.raises(ValueError):
+        f.sweep(np.zeros((5, 2)))
+
+
+def test_qaoa_maxcut_optimises(env):
+    """2 QAOA layers on the 4-cycle: gradient descent must beat the
+    random-guess expectation and approach the known max cut (4 edges
+    all cut -> <C> = 4, i.e. energy -> -2 with the constant dropped)."""
+    import jax
+    edges = [(0, 1), (1, 2), (2, 3), (3, 0)]
+    c = alg.qaoa_maxcut(4, edges, num_layers=2)
+    f = c.compile(env)
+    terms, coeffs = alg.qaoa_maxcut_terms(edges)
+    energy = f.expectation_fn(terms, coeffs)
+    grad = jax.grad(energy)
+    params = np.array([0.5, 0.5, 0.3, 0.3])
+    for _ in range(150):
+        params = params - 0.15 * np.asarray(grad(params))
+    final = float(energy(params))
+    # p=2 QAOA solves the 4-cycle exactly: energy -> -2.0 (all 4 edges cut)
+    assert final < -1.95
+    with pytest.raises(ValueError):
+        alg.qaoa_maxcut(3, [(0, 3)], 1)
+    with pytest.raises(ValueError):
+        alg.qaoa_maxcut(3, [(0, 1)], 0)
