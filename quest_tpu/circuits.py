@@ -968,7 +968,11 @@ class CompiledCircuit:
         planes shared by every run (default |0..0>). Returns ``(B, 2,
         2^n)`` packed planes — ``jax.vmap`` over :meth:`apply`, so the
         batch dimension rides the MXU instead of a Python loop (the VQE /
-        phase-diagram sweep workload; no reference counterpart)."""
+        phase-diagram sweep workload; no reference counterpart). On a
+        mesh env, vmapped controlled gates currently draw an SPMD
+        repartition warning (XLA replicates one scatter) — results are
+        correct; prefer a single-device env for wide sweeps of small
+        circuits."""
         pm = jnp.asarray(param_matrix, dtype=self.env.precision.real_dtype)
         if pm.ndim != 2 or pm.shape[1] != len(self.param_names):
             raise ValueError(
