@@ -1,0 +1,65 @@
+"""QAOA for MaxCut: differentiable compiled circuits end to end.
+
+A 6-node ring + chords graph, 2 QAOA layers: the ansatz compiles to ONE
+XLA executable, the cut expectation is a pure function of the parameter
+vector, `jax.value_and_grad` gives exact gradients (no parameter-shift
+sampling), and a plain optax Adam loop finds the maximum cut. The final
+parameters are verified by sampling the optimised state.
+
+Run: python examples/qaoa.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from anywhere, uninstalled
+
+import numpy as np
+import jax
+import optax
+
+import quest_tpu as qt
+from quest_tpu import algorithms as alg
+
+N = 6
+EDGES = [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5), (5, 0),  # ring
+         (0, 3), (1, 4)]                                  # chords
+LAYERS = 2
+
+
+def cut_size(bits: int) -> int:
+    return sum(((bits >> u) & 1) != ((bits >> v) & 1) for u, v in EDGES)
+
+
+env = qt.createQuESTEnv(seed=[2026])
+circuit = alg.qaoa_maxcut(N, EDGES, num_layers=LAYERS)
+compiled = circuit.compile(env)
+terms, coeffs = alg.qaoa_maxcut_terms(EDGES)
+energy = jax.jit(compiled.expectation_fn(terms, coeffs))
+
+params = np.array([0.5, 0.5, 0.3, 0.3])
+opt = optax.adam(0.1)
+opt_state = opt.init(params)
+vg = jax.value_and_grad(energy)
+for step in range(120):
+    e, g = vg(params)
+    updates, opt_state = opt.update(np.asarray(g), opt_state)
+    params = optax.apply_updates(params, updates)
+    if step % 30 == 0:
+        print(f"step {step:3d}: <C> - |E|/2 = {float(e):+.4f}")
+
+best = max(cut_size(b) for b in range(1 << N))
+expect_cut = len(EDGES) / 2.0 - float(energy(params))
+print(f"optimised expected cut = {expect_cut:.3f}  (max cut = {best})")
+
+# sample the optimised state and report the best drawn cut
+q = qt.createQureg(N, env)
+qt.initZeroState(q)
+compiled.run(q, params={nm: float(params[i])
+                        for i, nm in enumerate(compiled.param_names)})
+draws = qt.sampleOutcomes(q, 256)
+best_drawn = max(cut_size(int(b)) for b in draws)
+print(f"best cut among 256 samples: {best_drawn}")
+assert expect_cut > 0.85 * best
+assert best_drawn == best

@@ -25,6 +25,7 @@ _PIN = (
     "vqe.py",
     "shor.py",
     "noisy_trajectories.py",
+    "qaoa.py",
 ])
 def test_example_runs(script):
     path = os.path.join(EXAMPLES, script)
