@@ -1,0 +1,86 @@
+"""Large-register differential: the native executor vs the reference
+binary at 28 qubits (or ``--qubits N``), full-state compare.
+
+Reproduces the figure recorded in README.md ("28-qubit spot differential
+... bit-identical"): |+>^N through low/mid/top-qubit gates including a
+3-qubit dense unitary, every one of the 2^N amplitudes compared. Needs
+the locally-built reference library (tools/build_reference.sh; ~8 GB RAM
+at 28 qubits for the two f64 states).
+
+Run: python tools/large_differential.py [--qubits 28]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ref_golden_gen import Ref, _load, ADAPTERS  # noqa: E402
+from quest_tpu.circuits import Circuit  # noqa: E402
+
+LIB = os.environ.get("QUEST_REF_LIB", "/tmp/refbuild/libquest_ref.so")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--qubits", type=int, default=28)
+    n = ap.parse_args().qubits
+
+    if not os.path.exists(LIB):
+        import subprocess
+        subprocess.run(["sh", os.path.join(os.path.dirname(__file__),
+                                           "build_reference.sh")],
+                       check=True, capture_output=True, timeout=300)
+    ref = Ref(_load(LIB))
+    rq = ref.prepare("p", n)
+
+    rng = np.random.default_rng(3)
+    c = Circuit(n)
+    moves = []
+    c.h(0)
+    moves.append(("hadamard", (0,)))
+    c.h(n - 1)
+    moves.append(("hadamard", (n - 1,)))
+    th = float(rng.uniform(0, 2 * np.pi))
+    al, be = complex(np.cos(th), 0), complex(np.sin(th), 0)
+    c.gate(np.array([[al, -np.conj(be)], [be, np.conj(al)]]), (n // 2,))
+    moves.append(("compactUnitary", (n // 2, al, be)))
+    c.cnot(2, n - 2)
+    moves.append(("controlledNot", (2, n - 2)))
+    c.phase(n - 8, 1.1)
+    moves.append(("phaseShift", (n - 8, 1.1)))
+    m = rng.normal(size=(8, 8)) + 1j * rng.normal(size=(8, 8))
+    u3, _ = np.linalg.qr(m)
+    c.gate(u3, (5, n // 2 + 1, n - 1))
+    moves.append(("multiQubitUnitary", ((5, n // 2 + 1, n - 1), u3)))
+    c.cphase(1, n - 3, 0.7)
+    moves.append(("controlledPhaseShift", (1, n - 3, 0.7)))
+
+    t0 = time.perf_counter()
+    for name, args in moves:
+        ADAPTERS[name](ref, rq, args)
+    print(f"reference: {len(moves)} ops in "
+          f"{time.perf_counter() - t0:.1f} s")
+
+    prog = c.compile_native(threads=1)
+    re, im = prog.init_plus()
+    t0 = time.perf_counter()
+    prog.run(re, im)
+    print(f"native:    {len(moves)} ops in "
+          f"{time.perf_counter() - t0:.1f} s")
+
+    err = float(np.max(np.abs((re + 1j * im) - ref.state(rq))))
+    print(f"{n}-qubit differential: worst |delta| = {err:.3e} "
+          f"over {1 << n:,} amplitudes")
+    ref.lib.destroyQureg(rq, ref.env)
+    assert err < 1e-12, err
+    print("PASS")
+
+
+if __name__ == "__main__":
+    main()
