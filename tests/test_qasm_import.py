@@ -246,3 +246,22 @@ def test_roundtrip_random_sweep(env, seed):
                                     tuple(rng.normal(size=3)))
     a, b = _record_and_reparse(env, build, N)
     assert _phase_aligned(a, b) < 1e-10
+
+
+@pytest.mark.skipif(
+    not __import__("quest_tpu.native.statevec", fromlist=["available"]
+                   ).available(),
+    reason="native executor unavailable")
+def test_parsed_circuit_runs_on_native_executor(env):
+    """Text -> Circuit -> native C++ executor: the importer's output is a
+    first-class circuit for every compile path."""
+    text = "qreg q[3];\nh q[0];\ncx q[0],q[2];\nrz(0.4) q[1];"
+    parsed = qt.parse_qasm(text)
+    prog = parsed.circuit.compile_native()
+    re, im = prog.init_zero()
+    prog.run(re, im)
+
+    q = qt.createQureg(3, env)
+    qt.initZeroState(q)
+    parsed.circuit.compile(env, pallas=False).run(q)
+    np.testing.assert_allclose(re + 1j * im, q.to_numpy(), atol=1e-12)
