@@ -362,6 +362,28 @@ class Circuit:
         val.validate_prob(prob, "Circuit.damp", 1.0)
         return self.kraus(chan.damping_kraus(prob), (q,))
 
+    def mid_measure(self, q: int) -> "Circuit":
+        """Record a mid-circuit measurement of qubit ``q`` as the
+        projector channel ``{|0><0|, |1><1|}`` — a valid Kraus set, so it
+        rides the existing channel machinery:
+
+        - on the density path (``compile(density=True)``) it is the exact
+          NON-selective measurement (coherences to/from ``q`` die, the
+          diagonal is untouched);
+        - through ``compile_trajectories`` each trajectory draws a
+          definite outcome with the physical probability and collapses —
+          genuine mid-circuit measurement statistics, per trajectory.
+
+        The reference has no mid-circuit measurement inside any recorded
+        form; its ``measure`` is imperative-only (``QuEST_common.c:360``).
+        For selective (outcome-known) collapse, use the imperative
+        ``collapseToOutcome`` between circuit runs instead."""
+        p0 = np.zeros((2, 2), dtype=np.complex128)
+        p1m = np.zeros((2, 2), dtype=np.complex128)
+        p0[0, 0] = 1.0
+        p1m[1, 1] = 1.0
+        return self.kraus([p0, p1m], (q,))
+
     def with_noise(self, p1: float = 0.0, p2: float = 0.0,
                    damping: float = 0.0) -> "Circuit":
         """Return a copy with a uniform noise model applied: after every

@@ -140,3 +140,50 @@ class TestWithNoise:
         c.h(0)
         with pytest.raises(qt.QuESTError):
             c.with_noise(p1=0.9)         # over the depolarising cap
+
+
+class TestMidMeasure:
+    def test_density_nonselective(self, env):
+        # |+> measured mid-circuit: coherences die, diagonal survives
+        c = Circuit(1)
+        c.h(0)
+        c.mid_measure(0)
+        d = qt.createDensityQureg(1, env)
+        qt.initZeroState(d)
+        c.compile(env, density=True, pallas=False).run(d)
+        rho = d.to_numpy().reshape(2, 2)
+        np.testing.assert_allclose(np.abs(rho), np.eye(2) * 0.5, atol=1e-12)
+
+    def test_trajectory_collapses_each_draw(self, env):
+        # H; measure; H  -- per trajectory the middle measurement forces
+        # |0> or |1>, so the final state is |+> or |-> (never |0> again)
+        c = Circuit(1)
+        c.h(0)
+        c.mid_measure(0)
+        c.h(0)
+        prog = c.compile_trajectories(env)
+        from quest_tpu.core.packing import pack
+        psi0 = np.zeros(2, dtype=np.complex128)
+        psi0[0] = 1.0
+        batch = np.asarray(prog.run_batch(pack(psi0), 64))
+        psis = batch[:, 0] + 1j * batch[:, 1]
+        # every trajectory: both amplitudes have magnitude 1/sqrt(2)
+        np.testing.assert_allclose(np.abs(psis),
+                                   np.full((64, 2), 1 / np.sqrt(2)),
+                                   atol=1e-6)
+        # and both signs of the relative phase appear (|+> and |->)
+        rel = np.sign(np.real(psis[:, 0] * np.conj(psis[:, 1])))
+        assert set(rel.tolist()) == {1.0, -1.0}
+
+    def test_repeated_measure_is_idempotent_on_density(self, env):
+        c1 = Circuit(2)
+        c1.h(0).cnot(0, 1).mid_measure(0)
+        c2 = Circuit(2)
+        c2.h(0).cnot(0, 1).mid_measure(0).mid_measure(0)
+        out = []
+        for c in (c1, c2):
+            d = qt.createDensityQureg(2, env)
+            qt.initZeroState(d)
+            c.compile(env, density=True, pallas=False).run(d)
+            out.append(d.to_numpy())
+        np.testing.assert_allclose(out[0], out[1], atol=1e-12)
