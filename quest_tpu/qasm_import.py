@@ -53,6 +53,15 @@ _BASES: dict = {
     "u": (1, 3, None),
     # qelib1's u3 always has the spec order (up to global phase)
     "u3": (1, 3, lambda th, ph, la: _rz(ph) @ _ry(th) @ _rz(la)),
+    # common qelib1 aliases: u1 = phase, u2 = u3(pi/2, phi, lambda),
+    # rzz = exp(-i theta/2 Z(x)Z) (the multiRotateZ two-qubit form)
+    "u1": (1, 1, lambda la: np.diag([1.0, np.exp(1j * la)])),
+    "p": (1, 1, lambda la: np.diag([1.0, np.exp(1j * la)])),  # qiskit name
+    "u2": (1, 2, lambda ph, la: _rz(ph) @ _ry(np.pi / 2.0) @ _rz(la)),
+    "rzz": (2, 1, lambda th: np.diag([np.exp(-0.5j * th),
+                                      np.exp(0.5j * th),
+                                      np.exp(0.5j * th),
+                                      np.exp(-0.5j * th)])),
     "id": (1, 0, None),
 }
 

@@ -265,3 +265,41 @@ def test_parsed_circuit_runs_on_native_executor(env):
     qt.initZeroState(q)
     parsed.circuit.compile(env, pallas=False).run(q)
     np.testing.assert_allclose(re + 1j * im, q.to_numpy(), atol=1e-12)
+
+
+def test_qelib_aliases(env):
+    """u1/p/u2/cu1/rzz qelib forms parse and match their definitions."""
+    text = """
+    qreg q[2];
+    h q[0]; h q[1];
+    u1(0.7) q[0];
+    p(0.3) q[1];
+    cu1(1.1) q[0],q[1];
+    rzz(0.9) q[0],q[1];
+    u2(0.2, 0.4) q[0];
+    """
+    parsed = qt.parse_qasm(text)
+    q = qt.createQureg(2, env)
+    qt.initZeroState(q)
+    parsed.circuit.compile(env, pallas=False).run(q)
+    got = q.to_numpy()
+
+    def u1(la):
+        return np.diag([1.0, np.exp(1j * la)])
+    H = np.array([[1, 1], [1, -1]]) / np.sqrt(2)
+    rzz = np.diag(np.exp(-0.5j * 0.9 * np.array([1, -1, -1, 1])))
+    cu1 = np.diag([1, 1, 1, np.exp(1.1j)])
+    u2 = (np.diag([np.exp(-0.1j), np.exp(0.1j)])
+          @ np.array([[np.cos(np.pi/4), -np.sin(np.pi/4)],
+                      [np.sin(np.pi/4), np.cos(np.pi/4)]])
+          @ np.diag([np.exp(-0.2j), np.exp(0.2j)]))
+    I = np.eye(2)
+    # qubit 0 = LOW bit: kron(high, low)
+    state = np.zeros(4, complex); state[0] = 1.0
+    state = np.kron(H, I) @ np.kron(I, H) @ state
+    state = np.kron(I, u1(0.7)) @ state
+    state = np.kron(u1(0.3), I) @ state
+    state = cu1 @ state          # diagonal, symmetric in control/target
+    state = rzz @ state
+    state = np.kron(I, u2) @ state
+    np.testing.assert_allclose(got, state, atol=1e-12)
