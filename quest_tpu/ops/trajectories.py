@@ -150,12 +150,35 @@ class TrajectoryProgram:
         qureg.state = self._apply(qureg.state, key)
 
     def run_batch(self, state_f, num_trajectories: int,
-                  key: Optional[jax.Array] = None):
+                  key: Optional[jax.Array] = None,
+                  shard_trajectories: bool = False):
         """``num_trajectories`` independent draws from one initial packed
-        state — a ``(T, 2, 2^n)`` batch through ONE executable."""
+        state — a ``(T, 2, 2^n)`` batch through ONE executable.
+
+        ``shard_trajectories=True`` on a mesh env shards the TRAJECTORY
+        axis over the devices (state replicated, keys split): noise
+        simulation is embarrassingly parallel across draws, so throughput
+        scales linearly with mesh size — the pod-scale noise workload the
+        reference's density path cannot touch. Results are bit-identical
+        to the unsharded batch (the key array, not the placement, decides
+        every draw); requires ``num_trajectories`` divisible by the
+        device count."""
         if key is None:
             key = self.env.next_key()
         keys = jax.random.split(key, num_trajectories)
+        if shard_trajectories:
+            mesh = self.env.mesh
+            if mesh is None or self.env.num_devices < 2:
+                raise ValueError(
+                    "shard_trajectories needs a multi-device mesh env")
+            if num_trajectories % self.env.num_devices:
+                raise ValueError(
+                    f"num_trajectories ({num_trajectories}) must divide "
+                    f"evenly over {self.env.num_devices} devices")
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            axis = mesh.axis_names[0]
+            keys = jax.device_put(keys, NamedSharding(mesh, P(axis)))
+            state_f = jax.device_put(state_f, NamedSharding(mesh, P()))
         return self._vmapped(state_f, keys)
 
     def average_density(self, state_f, num_trajectories: int,

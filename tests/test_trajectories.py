@@ -187,3 +187,39 @@ class TestMidMeasure:
             c.compile(env, density=True, pallas=False).run(d)
             out.append(d.to_numpy())
         np.testing.assert_allclose(out[0], out[1], atol=1e-12)
+
+
+def test_sharded_trajectory_batch(mesh_env):
+    """Trajectory-axis sharding over the 8-device mesh: bit-identical to
+    the unsharded batch (keys decide draws, placement doesn't), sharded
+    along the batch axis."""
+    import jax
+    n = 5
+    c = Circuit(n)
+    for q_ in range(n):
+        c.h(q_)
+    c.damp(0, 0.3)
+    c.cnot(0, 4)
+    c.dephase(4, 0.2)
+    prog = c.compile_trajectories(mesh_env)
+    psi0 = np.zeros(1 << n, dtype=np.complex128)
+    psi0[0] = 1.0
+    planes = pack(psi0)
+    key = jax.random.PRNGKey(77)
+    plain = np.asarray(prog.run_batch(planes, 16, key=key))
+    sharded = prog.run_batch(planes, 16, key=key, shard_trajectories=True)
+    assert len(sharded.sharding.device_set) == 8
+    np.testing.assert_array_equal(plain, np.asarray(sharded))
+    with pytest.raises(ValueError):
+        prog.run_batch(planes, 15, key=key, shard_trajectories=True)
+
+
+def test_sharded_trajectory_batch_needs_mesh(env):
+    c = Circuit(2)
+    c.h(0)
+    c.damp(0, 0.1)
+    prog = c.compile_trajectories(env)
+    psi0 = np.zeros(4, dtype=np.complex128)
+    psi0[0] = 1.0
+    with pytest.raises(ValueError):
+        prog.run_batch(pack(psi0), 8, shard_trajectories=True)
