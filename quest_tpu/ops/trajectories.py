@@ -163,10 +163,9 @@ class TrajectoryProgram:
         to the unsharded batch (the key array, not the placement, decides
         every draw); requires ``num_trajectories`` divisible by the
         device count."""
-        if key is None:
-            key = self.env.next_key()
-        keys = jax.random.split(key, num_trajectories)
         if shard_trajectories:
+            # validate BEFORE consuming the env key, so a rejected call
+            # leaves the RNG stream (and seed reproducibility) untouched
             mesh = self.env.mesh
             if mesh is None or self.env.num_devices < 2:
                 raise ValueError(
@@ -175,11 +174,58 @@ class TrajectoryProgram:
                 raise ValueError(
                     f"num_trajectories ({num_trajectories}) must divide "
                     f"evenly over {self.env.num_devices} devices")
+        if key is None:
+            key = self.env.next_key()
+        keys = jax.random.split(key, num_trajectories)
+        if shard_trajectories:
             from jax.sharding import NamedSharding, PartitionSpec as P
             axis = mesh.axis_names[0]
             keys = jax.device_put(keys, NamedSharding(mesh, P(axis)))
             state_f = jax.device_put(state_f, NamedSharding(mesh, P()))
         return self._vmapped(state_f, keys)
+
+    def expectation(self, pauli_terms, coeffs, state_f,
+                    num_trajectories: int,
+                    key: Optional[jax.Array] = None) -> tuple[float, float]:
+        """Monte-Carlo estimate of ``<H>`` under the noisy evolution,
+        ``H = sum_j coeffs[j] * prod Pauli`` (terms as ``(qubit, code)``
+        pairs, codes 1=X 2=Y 3=Z). Returns ``(mean, stderr)`` over the
+        trajectory ensemble — the noisy-VQE objective at statevector
+        cost."""
+        from ..core import matrices as mats
+        from .. import validation as val
+        if num_trajectories < 2:
+            raise ValueError("expectation needs >= 2 trajectories for a "
+                             "standard error")
+        n = self.num_qubits
+        terms = []
+        for t in pauli_terms:
+            term = tuple((int(q), int(code)) for q, code in t)
+            for q, code in term:
+                val.validate_target(n, q, "TrajectoryProgram.expectation")
+            val.validate_pauli_codes([code for _, code in term],
+                                     "TrajectoryProgram.expectation")
+            terms.append(term)
+        coeffs = [float(c) for c in coeffs]
+        batch = self.run_batch(state_f, num_trajectories, key)
+
+        # per-trajectory values on device (reusing the jitted Pauli path
+        # instead of hauling the (T, 2^n) batch to host)
+        def one(planes):
+            psi = unpack(planes)
+            total = jnp.zeros((), dtype=jnp.float64 if psi.dtype ==
+                              jnp.complex128 else jnp.float32)
+            for term, c in zip(terms, coeffs):
+                phi = psi
+                for q, code in term:
+                    phi = apply_unitary(phi, n, jnp.asarray(
+                        mats.PAULI_MATS[code], psi.dtype), (q,))
+                total = total + c * jnp.real(jnp.vdot(psi, phi))
+            return total
+
+        vals = np.asarray(jax.jit(jax.vmap(one))(batch), dtype=np.float64)
+        return float(vals.mean()), float(vals.std(ddof=1)
+                                         / np.sqrt(len(vals)))
 
     def average_density(self, state_f, num_trajectories: int,
                         key: Optional[jax.Array] = None) -> np.ndarray:

@@ -223,3 +223,38 @@ def test_sharded_trajectory_batch_needs_mesh(env):
     psi0[0] = 1.0
     with pytest.raises(ValueError):
         prog.run_batch(pack(psi0), 8, shard_trajectories=True)
+
+
+def test_trajectory_expectation_matches_density(env):
+    """MC <Z0> and <Z0 Z1> under damping agree with the exact density
+    path within the reported standard error (x6)."""
+    n = 2
+    c = Circuit(n)
+    c.h(0).cnot(0, 1)
+    c.damp(0, 0.4)
+    rho = _exact_density(c, n, env)
+    z = np.diag([1.0, -1.0])
+    exact_z0 = float(np.real(np.trace(np.kron(np.eye(2), z) @ rho)))
+    exact_zz = float(np.real(np.trace(np.kron(z, z) @ rho)))
+
+    prog = c.compile_trajectories(env)
+    mean, err = prog.expectation([[(0, 3)]], [1.0],
+                                 _zero_planes(n, env), 800)
+    assert abs(mean - exact_z0) < max(6 * err, 1e-3), (mean, exact_z0, err)
+    mean2, err2 = prog.expectation([[(0, 3), (1, 3)]], [1.0],
+                                   _zero_planes(n, env), 800)
+    assert abs(mean2 - exact_zz) < max(6 * err2, 1e-3), (mean2, exact_zz)
+
+
+def test_trajectory_expectation_validation(env):
+    c = Circuit(2)
+    c.h(0)
+    c.damp(0, 0.1)
+    prog = c.compile_trajectories(env)
+    planes = _zero_planes(2, env)
+    with pytest.raises(ValueError):
+        prog.expectation([[(0, 3)]], [1.0], planes, 1)
+    with pytest.raises(qt.QuESTError):
+        prog.expectation([[(5, 3)]], [1.0], planes, 8)
+    with pytest.raises(qt.QuESTError):
+        prog.expectation([[(0, 7)]], [1.0], planes, 8)
