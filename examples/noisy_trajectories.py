@@ -63,3 +63,9 @@ mc = float(np.mean(np.sum(np.abs(psis[:, mask]) ** 2, axis=1)))
 print(f"512 trajectories:   P(q{N-1}=1) = {mc:.5f}   "
       f"(vmapped batch, one executable)")
 assert abs(mc - exact) < 0.05
+
+# observables come with their own Monte-Carlo error bar
+mean, err = prog.expectation([[(N - 1, 3)]], [1.0], pack(psi0), 512)
+print(f"<Z_{N-1}> = {mean:+.4f} +/- {err:.4f}   "
+      f"(exact {1.0 - 2.0 * exact:+.4f})")
+assert abs(mean - (1.0 - 2.0 * exact)) < 6 * err + 1e-3
