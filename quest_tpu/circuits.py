@@ -477,6 +477,10 @@ class Circuit:
         for op in reversed(self.ops):
             if not op.is_static:
                 raise ValueError("cannot invert a parameterized circuit")
+            if op.kind == "kraus":
+                raise ValueError(
+                    "cannot invert a circuit containing channels "
+                    "(CPTP maps are not generally invertible)")
             if op.kind == "u":
                 inv.ops.append(dataclasses.replace(op, mat=op.mat.conj().T))
             else:

@@ -247,3 +247,11 @@ def test_apply_composes_with_vmap(env):
     single = f.apply(packed[3], {"th": float(angles[3, 0])})
     np.testing.assert_allclose(np.asarray(out[3]), np.asarray(single),
                                atol=1e-12)
+
+
+def test_inverse_rejects_channels(env):
+    c = Circuit(2)
+    c.h(0)
+    c.damp(0, 0.1)
+    with pytest.raises(ValueError, match="channels"):
+        c.inverse()
