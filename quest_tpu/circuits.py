@@ -462,6 +462,130 @@ class Circuit:
 
     # -- composition -------------------------------------------------------
 
+    def to_qasm(self, params: Optional[dict] = None) -> str:
+        """Serialise the recorded program as OpenQASM 2.0 text, using the
+        same logger (and therefore the same dialect) as the imperative
+        API's recorder — so ``parse_qasm`` reads it back. Parameterized
+        gates are bound with ``params`` first. Ops with no QASM form
+        (k>=2 dense unitaries, general diagonals, channels) are logged as
+        comments, exactly as the reference's logger handles its own
+        non-expressible ops (``QuEST.c:634-637``)."""
+        from .qasm import QASMLogger, _pair_and_phase_from_unitary
+        log = QASMLogger(self.num_qubits)
+        log.is_logging = True
+        params = params or {}
+        missing = [p for p in self.param_names if p not in params]
+        if missing:
+            raise ValueError(f"missing circuit parameters: {missing}")
+        named_u = (("sigma_x", mats.pauli_x()),
+                   ("sigma_y", mats.pauli_y()),
+                   ("sigma_z", mats.pauli_z()),
+                   ("hadamard", mats.hadamard()),
+                   ("s", mats.s_gate()),
+                   ("t", mats.t_gate()))
+        for op in self.ops:
+            if op.kind == "kraus":
+                log.record_comment(
+                    f"Kraus channel on qubits {list(op.targets)} "
+                    "(no QASM form)")
+                continue
+            if op.kind == "diag":
+                d = np.asarray(op.diag_fn(params)) \
+                    if op.diag_fn is not None else op.diag
+                if self._emit_diag_qasm(log, op.targets, d):
+                    continue
+                log.record_comment(
+                    f"{len(op.targets)}-qubit general diagonal on qubits "
+                    f"{list(op.targets)} (no QASM form)")
+                continue
+            controls = tuple(q for q in range(self.num_qubits)
+                             if (op.ctrl_mask >> q) & 1)
+            if len(op.targets) != 1:
+                log.record_comment(
+                    f"{len(op.targets)}-qubit unitary on qubits "
+                    f"{list(op.targets)}"
+                    + (f" controls {list(controls)}" if controls else "")
+                    + " (no single-qubit QASM form)")
+                continue
+            mat = np.asarray(op.mat_fn(params)) \
+                if op.mat_fn is not None else op.mat
+            named = next((label for label, ref in named_u
+                          if np.allclose(mat, ref, atol=1e-12)), None)
+            flips = tuple(c for c in controls if (op.flip_mask >> c) & 1)
+            for c in flips:              # controlled-on-0: NOT sandwich
+                log.record_gate("sigma_x", c)
+            if named is not None:
+                # exact label (cx/ccz/...), never the lossy ZYZ split
+                log.record_gate(named, op.targets[0], controls)
+            else:
+                alpha, beta, g = _pair_and_phase_from_unitary(mat)
+                log.record_compact_unitary(alpha, beta, op.targets[0],
+                                           controls)
+                if controls and abs(g) > 1e-12:
+                    # the dropped phase is PHYSICAL under controls; the
+                    # reference's Rz-on-target restore is unfaithful —
+                    # c^{n-1}u1(g) on the controls restores it exactly
+                    log.record_u1(g, controls[0], controls[1:])
+            for c in flips:
+                log.record_gate("sigma_x", c)
+        return log.text()
+
+    @staticmethod
+    def _emit_diag_qasm(log, targets, d) -> bool:
+        """Emit a recorded diagonal exactly when the dialect can express
+        it: multi-controlled Z / phase (all-ones except the last entry),
+        1q relative phases (u1), and the 2q multiRotateZ parity form
+        (rzz). Entries must be unit-modulus. Returns False otherwise."""
+        flat = np.asarray(d).reshape(-1)
+        if not np.allclose(np.abs(flat), 1.0, atol=1e-12):
+            return False
+        lo = min(targets)
+        rest = tuple(q for q in targets if q != lo)
+        if np.allclose(flat[:-1], 1.0, atol=1e-12):
+            # targets are sorted descending, so flat[-1] is the all-ones
+            # bit pattern: a (multi-controlled) phase on the joint 1-state
+            if abs(flat[-1] + 1.0) < 1e-12:
+                log.record_gate("sigma_z", lo, rest)
+            else:
+                log.record_u1(float(np.angle(flat[-1])), lo, rest)
+            return True
+        if len(targets) == 1:
+            # diag(a, b) = a * diag(1, b/a): relative phase is exact,
+            # the global factor a is dropped (as every ZYZ record does)
+            log.record_u1(float(np.angle(flat[1] / flat[0])), targets[0])
+            return True
+        if len(targets) == 2 and abs(flat[0] - flat[3]) < 1e-12 \
+                and abs(flat[1] - flat[2]) < 1e-12 \
+                and abs(flat[1] - np.conj(flat[0])) < 1e-12:
+            log.record_rzz(float(-2.0 * np.angle(flat[0])),
+                           targets[1], targets[0])
+            return True
+        if len(targets) <= 4:
+            # ANY unit-modulus diagonal factors exactly (up to the
+            # dropped global flat[0]) into one phase term per nonempty
+            # qubit subset: theta_S = angle of the Mobius-alternating
+            # product of entries over sub-patterns of S — each term is a
+            # c^{|S|-1}u1. Bit j of the flat index is qubit asc[j]
+            # (targets are recorded descending, axis 0 most significant).
+            k = len(targets)
+            asc = sorted(targets)
+            for s in range(1, 1 << k):
+                prod = 1.0 + 0.0j
+                for m in range(1 << k):
+                    if m & ~s:
+                        continue
+                    term = complex(flat[m])
+                    if (bin(s ^ m).count("1")) % 2:
+                        prod /= term
+                    else:
+                        prod *= term
+                theta = float(np.angle(prod))
+                if abs(theta) > 1e-12:
+                    qs = [asc[j] for j in range(k) if (s >> j) & 1]
+                    log.record_u1(theta, qs[0], tuple(qs[1:]))
+            return True
+        return False
+
     def extend(self, other: "Circuit") -> "Circuit":
         if other.num_qubits != self.num_qubits:
             raise ValueError("qubit count mismatch")

@@ -168,6 +168,21 @@ class QASMLogger:
         for c in flips:
             self.record_gate("sigma_x", c)
 
+    def record_u1(self, angle: float, target: int,
+                  controls: tuple = ()) -> None:
+        """qelib ``u1`` (= diag(1, e^{i angle})) with stacked ``c``
+        prefixes — EXACT under controls, unlike the phase-shift Rz form.
+        Emitted by ``Circuit.to_qasm`` (the importer reads it); not part
+        of the reference logger's own output set."""
+        label = CTRL_PREFIX * len(controls) + "u1"
+        self._add(f"{label}({_fmt(angle)}) "
+                  f"{self._qubits(*controls, target)};")
+
+    def record_rzz(self, angle: float, q1: int, q2: int) -> None:
+        """qelib ``rzz`` (= exp(-i angle/2 Z⊗Z)) — the two-qubit
+        multiRotateZ parity phase, exact. Emitted by ``Circuit.to_qasm``."""
+        self._add(f"rzz({_fmt(angle)}) {self._qubits(q1, q2)};")
+
     def record_measurement(self, qubit: int) -> None:
         self._add(f"measure {QUREG_LABEL}[{qubit}] -> {MESREG_LABEL}[{qubit}];")
 

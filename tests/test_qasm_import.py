@@ -303,3 +303,96 @@ def test_qelib_aliases(env):
     state = rzz @ state
     state = np.kron(I, u2) @ state
     np.testing.assert_allclose(got, state, atol=1e-12)
+
+
+def test_circuit_to_qasm_roundtrip(env):
+    """Circuit -> QASM text -> parse -> compile: the 1q/controlled subset
+    survives exactly (phase-aligned); the writer and importer share one
+    dialect."""
+    c = qt.Circuit(3)
+    th = c.parameter("th")
+    c.h(0)
+    c.rz(1, th)
+    c.cnot(0, 2)
+    c.gate(np.diag([1.0, 1.0j]), (1,), controls=(2,),
+           control_states=(0,))            # flipped control
+    c.phase(2, 0.4)
+    text = c.to_qasm(params={"th": 0.9})
+    assert text.startswith("OPENQASM 2.0;")
+    parsed = qt.parse_qasm(text)
+
+    q1 = qt.createQureg(3, env)
+    qt.initZeroState(q1)
+    c.compile(env, pallas=False).run(q1, params={"th": 0.9})
+    q2 = qt.createQureg(3, env)
+    qt.initZeroState(q2)
+    parsed.circuit.compile(env, pallas=False).run(q2)
+    assert _phase_aligned(q1.to_numpy(), q2.to_numpy()) < 1e-10
+
+    with pytest.raises(ValueError):
+        c.to_qasm()                         # unbound parameter
+
+
+def test_circuit_to_qasm_comments_inexpressible():
+    c = qt.Circuit(2)
+    c.h(0)
+    c.damp(0, 0.2)
+    c.gate(np.eye(4), (0, 1))
+    text = c.to_qasm()
+    assert "Kraus channel" in text
+    assert "no single-qubit QASM form" in text
+    parsed = qt.parse_qasm(text)           # comments are skipped cleanly
+    assert len(parsed.circuit.ops) == 1    # just the h
+
+
+def test_circuit_to_qasm_diagonals_and_phases(env):
+    """The forms the first draft dropped as comments: cz/cphase/crz/
+    multi_rotate_z and method-recorded z/s/t/phase all round-trip, and a
+    controlled det!=1 unitary is restored EXACTLY (c^{n-1}u1 on the
+    controls, not the reference's unfaithful Rz-on-target)."""
+    from oracle import random_unitary
+    rng = np.random.default_rng(21)
+    u = np.exp(0.65j) * random_unitary(1, rng)   # ZYZ phase g != 0
+
+    c = qt.Circuit(3)
+    c.z(0); c.s(1); c.t(2)
+    c.phase(0, 0.8)
+    c.cz(0, 1)
+    c.cphase(1, 2, 0.5)
+    c.crz(0, 2, 1.3)
+    c.multi_rotate_z([0, 2], 0.7)
+    c.gate(u, (1,), controls=(0,))               # exact-restore path
+    c.gate(u, (2,), controls=(0, 1))             # multi-controlled
+    text = c.to_qasm()
+    assert "cu1(" in text and "rzz(" in text
+    assert "no QASM form" not in text
+    parsed = qt.parse_qasm(text)
+
+    q1 = qt.createQureg(3, env)
+    qt.initPlusState(q1)
+    c.compile(env, pallas=False).run(q1)
+    q2 = qt.createQureg(3, env)
+    qt.initPlusState(q2)
+    parsed.circuit.compile(env, pallas=False).run(q2)
+    assert _phase_aligned(q1.to_numpy(), q2.to_numpy()) < 1e-10
+
+
+def test_circuit_to_qasm_general_diagonal(env):
+    """A random unit-modulus 3-qubit diagonal factors exactly into
+    u1/cu1/ccu1 phase terms (Mobius decomposition) and round-trips."""
+    rng = np.random.default_rng(4)
+    c = qt.Circuit(3)
+    c.h(0); c.h(1); c.h(2)
+    c.diagonal(np.exp(1j * rng.uniform(-np.pi, np.pi, size=(2, 2, 2))),
+               (0, 1, 2))
+    c.multi_rotate_z([0, 1, 2], 0.9)
+    text = c.to_qasm()
+    assert "no QASM form" not in text
+    parsed = qt.parse_qasm(text)
+    q1 = qt.createQureg(3, env)
+    qt.initZeroState(q1)
+    c.compile(env, pallas=False).run(q1)
+    q2 = qt.createQureg(3, env)
+    qt.initZeroState(q2)
+    parsed.circuit.compile(env, pallas=False).run(q2)
+    assert _phase_aligned(q1.to_numpy(), q2.to_numpy()) < 1e-10
