@@ -74,3 +74,28 @@ def test_fast_exit_returns_promptly(capsys):
     delivered, elapsed, _ = _run("pass", 60.0, 90.0, capsys)
     assert delivered == 0
     assert elapsed < 30.0         # EOF ends the wait, no deadline sleep
+
+
+def test_sink_captures_first_real_row_and_reemit(capsys):
+    code = ("import json\n"
+            "print(json.dumps({'metric': 'err (bench error)', 'value': 0.0}))\n"
+            "print(json.dumps({'metric': 'first', 'value': 7.0,"
+            " 'unit': 'gates/sec', 'vs_baseline': 1.5}))\n"
+            "print(json.dumps({'metric': 'second', 'value': 9.0,"
+            " 'unit': 'gates/sec', 'vs_baseline': 2.5}))\n")
+    sink = []
+    t0 = time.perf_counter()
+    delivered = bench._run_child(
+        {}, first_line_deadline=t0 + 30.0, total_deadline=t0 + 60.0,
+        argv=[sys.executable, "-u", "-c", code], sink=sink)
+    assert delivered == 2
+    # the FIRST real row (not the error row, not the best) is the headline
+    assert len(sink) == 1 and sink[0]["metric"] == "first"
+    capsys.readouterr()
+    bench._reemit_headline(sink)
+    last = json.loads(capsys.readouterr().out.strip())
+    assert last["repeat"] is True
+    assert last["metric"].startswith("headline (repeat): first")
+    assert last["value"] == 7.0
+    bench._reemit_headline([])           # empty: emits nothing
+    assert capsys.readouterr().out == ""
