@@ -216,21 +216,18 @@ void plan(Sched& s, int lookahead) {
     return;
   }
 
-  // qubits a paired op needs local: targets plus controls (a control axis
-  // indexed on a sharded position degenerates to a full-remat scatter under
-  // GSPMD, so controls are relocalised best-effort too)
+  // qubits a paired op needs local: its targets only. Controls are
+  // position-free — the shard_map executor turns a device-bit control into
+  // a lax.cond on lax.axis_index (quest_tpu/parallel/exchange.py), the
+  // distributed control-skip of QuEST_cpu_distributed.c:888-908.
   auto used_qubits = [](const Op& op) {
     std::vector<int> qs;
     if (!is_paired(op)) return qs;
-    qs = op.targets;
-    int64_t m = op.ctrl_mask;
-    for (int q = 0; m != 0; ++q, m >>= 1)
-      if (m & 1) qs.push_back(q);
-    return qs;
+    return op.targets;
   };
 
   const int64_t INF = static_cast<int64_t>(ops.size()) + 1;
-  // next use (as target or control of a paired op), next_use[i][q]
+  // next use (as a target of a paired op), next_use[i][q]
   std::vector<std::vector<int64_t>> next_use(ops.size() + 1,
                                              std::vector<int64_t>(n, INF));
   for (int64_t i = static_cast<int64_t>(ops.size()) - 1; i >= 0; --i) {
@@ -249,13 +246,10 @@ void plan(Sched& s, int lookahead) {
     for (int q : used)
       if (perm[q] >= local_top) offending = true;
     if (offending) {
-      // everything needed now: sharded targets (hard), then sharded controls
+      // everything needed now (the op's sharded targets)
       std::vector<int> need_now;
       for (int t : op.targets)
         if (perm[t] >= local_top) need_now.push_back(t);
-      for (int q : used)
-        if (!contains(op.targets, q) && perm[q] >= local_top)
-          need_now.push_back(q);
       // sharded qubits used in the lookahead window (prefetch)
       std::vector<int> window_hot;
       size_t wend = std::min(i + static_cast<size_t>(lookahead), ops.size());
@@ -283,7 +277,18 @@ void plan(Sched& s, int lookahead) {
         if (vi >= locals_.size()) break;
         auto [nu_victim, victim] = locals_[vi];
         if (!contains(need_now, q) && next_use[i][q] >= nu_victim) continue;
-        std::swap(new_perm[q], new_perm[victim]);
+        // three-way rotation landing the incoming qubit at a TOP local
+        // position (the all_to_all staging slot): q -> stage, the qubit at
+        // stage -> the victim's slot, victim -> q's device position — so
+        // the exchange's post-transpose vanishes (layout.py mirror).
+        int stage = local_top - 1 - static_cast<int>(vi);
+        int x = -1;
+        for (int l = 0; l < n; ++l)
+          if (new_perm[l] == stage) { x = l; break; }
+        int dev_pos = new_perm[q], vic_pos = new_perm[victim];
+        new_perm[q] = stage;
+        if (x != victim) new_perm[x] = vic_pos;
+        new_perm[victim] = dev_pos;
         ++vi;
       }
       Item r;

@@ -989,28 +989,72 @@ class CompiledCircuit:
         plan_items = self.plan.items
         flat_sharding = env.sharding_flat() if shard_bits else None
 
-        def run_plan(state, params):
-            for item in plan_items:
-                if item[0] == "relayout":
-                    _, before, after = item
-                    state = apply_relayout(state, n, before, after,
-                                           flat_sharding)
-                    continue
-                _, i, phys_targets, cmask, fmask, axis_order = item
-                op = ops[i]
-                if op.kind == "layer":
-                    from .ops import pallas_kernels as pk
-                    state = pk.apply_layer(state, n, op,
-                                           interpret=self._pallas_interpret)
-                elif op.kind == "u":
-                    u = op.mat_fn(params) if op.mat_fn is not None else op.mat
-                    state = apply_unitary(state, n, u, phys_targets,
-                                          cmask, fmask)
-                else:
-                    d = op.diag_fn(params) if op.diag_fn is not None else op.diag
-                    d = jnp.transpose(jnp.asarray(d), axis_order)
-                    state = apply_diagonal(state, n, phys_targets, d)
-            return state
+        if shard_bits:
+            # the distributed fast path: ONE shard_map program — local
+            # kernels on per-device chunks, relayouts as explicit
+            # all_to_all/ppermute pair exchanges (parallel/exchange.py).
+            # GSPMD never sees a transpose it could rematerialize.
+            from .parallel.exchange import (plan_exchange, run_exchange,
+                                            apply_op_local)
+            from .env import AMP_AXIS
+            from jax.sharding import PartitionSpec as P
+            lt = n - shard_bits
+            ex_plans = [plan_exchange(n, shard_bits, item[1], item[2])
+                        if item[0] == "relayout" else None
+                        for item in plan_items]
+
+            def local_body(local, params):
+                for item, expl in zip(plan_items, ex_plans):
+                    if item[0] == "relayout":
+                        local = run_exchange(local, expl, AMP_AXIS)
+                        continue
+                    _, i, phys_targets, cmask, fmask, axis_order = item
+                    op = ops[i]
+                    if op.kind == "u":
+                        u = op.mat_fn(params) if op.mat_fn is not None \
+                            else op.mat
+                        local = apply_op_local(local, "u", u, phys_targets,
+                                               cmask, fmask, lt, AMP_AXIS)
+                    else:
+                        d = op.diag_fn(params) if op.diag_fn is not None \
+                            else op.diag
+                        d = jnp.transpose(jnp.asarray(d), axis_order)
+                        local = apply_op_local(local, "diag", d, phys_targets,
+                                               0, 0, lt, AMP_AXIS)
+                return local
+
+            sharded_body = jax.shard_map(
+                local_body, mesh=env.mesh,
+                in_specs=(P(AMP_AXIS), P()), out_specs=P(AMP_AXIS),
+                check_vma=False)
+
+            def run_plan(state, params):
+                return sharded_body(state, params)
+        else:
+            def run_plan(state, params):
+                for item in plan_items:
+                    if item[0] == "relayout":
+                        _, before, after = item
+                        state = apply_relayout(state, n, before, after,
+                                               flat_sharding)
+                        continue
+                    _, i, phys_targets, cmask, fmask, axis_order = item
+                    op = ops[i]
+                    if op.kind == "layer":
+                        from .ops import pallas_kernels as pk
+                        state = pk.apply_layer(
+                            state, n, op, interpret=self._pallas_interpret)
+                    elif op.kind == "u":
+                        u = op.mat_fn(params) if op.mat_fn is not None \
+                            else op.mat
+                        state = apply_unitary(state, n, u, phys_targets,
+                                              cmask, fmask)
+                    else:
+                        d = op.diag_fn(params) if op.diag_fn is not None \
+                            else op.diag
+                        d = jnp.transpose(jnp.asarray(d), axis_order)
+                        state = apply_diagonal(state, n, phys_targets, d)
+                return state
 
         self._run_plan = run_plan
         self._flat_sharding = flat_sharding

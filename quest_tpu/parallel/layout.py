@@ -101,22 +101,16 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
             f"(2^{max_k} amplitudes per gather > local shard)")
 
     def used_qubits(op) -> tuple[int, ...]:
-        """Qubits a paired op needs local: targets, plus controls — a control
-        axis indexed on a sharded position degenerates to a scatter GSPMD can
-        only handle by full rematerialization, so controls are relocalised
-        (best-effort) too."""
+        """Qubits a paired op needs local: its targets only. Controls are
+        position-free — the shard_map executor turns a control on a
+        device-index bit into a ``lax.cond`` on ``lax.axis_index`` (zero
+        communication; ``parallel/exchange.py:apply_op_local``), the
+        distributed control-skip of ``QuEST_cpu_distributed.c:888-908``."""
         if op.kind != "u":
             return ()
-        qs = list(op.targets)
-        m, q = op.ctrl_mask, 0
-        while m:
-            if m & 1:
-                qs.append(q)
-            m >>= 1
-            q += 1
-        return tuple(qs)
+        return op.targets
 
-    # next use index (as target or control of a paired op) per logical qubit
+    # next use index (as a target of a paired op) per logical qubit
     INF = len(ops) + 1
     next_use = np.full((len(ops) + 1, n), INF, dtype=np.int64)
     for i in range(len(ops) - 1, -1, -1):
@@ -131,10 +125,8 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
     for i, op in enumerate(ops):
         used = used_qubits(op)
         if used and any(perm[q] >= local_top for q in used):
-            # everything this op needs now, targets (hard requirement) first
-            need_now = ([t for t in op.targets if perm[t] >= local_top]
-                        + [q for q in used if q not in op.targets
-                           and perm[q] >= local_top])
+            # everything this op needs now (its sharded targets)
+            need_now = [t for t in op.targets if perm[t] >= local_top]
             # plus sharded qubits used in the lookahead window (prefetch)
             window_hot = []
             for j in range(i, min(i + lookahead, len(ops))):
@@ -157,7 +149,19 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
                 # window prefetches must not evict a sooner-used qubit
                 if q not in need_now and next_use[i, q] >= nu_victim:
                     continue
-                new_perm[q], new_perm[victim] = perm[victim], perm[q]
+                # three-way rotation landing the incoming qubit at a TOP
+                # local position (the all_to_all staging slot,
+                # parallel/exchange.py): q -> stage, the qubit at stage ->
+                # the victim's slot, victim -> q's device position. Landing
+                # at the staging slot makes the exchange's post-transpose
+                # vanish — one local pass per relayout instead of two.
+                stage = local_top - 1 - vi
+                x = int(np.nonzero(new_perm == stage)[0][0])
+                dev_pos, vic_pos = new_perm[q], new_perm[victim]
+                new_perm[q] = stage
+                if x != victim:
+                    new_perm[x] = vic_pos
+                new_perm[victim] = dev_pos
                 vi += 1
             items.append(("relayout", perm.copy(), new_perm.copy()))
             n_relayouts += 1

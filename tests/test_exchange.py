@@ -1,0 +1,166 @@
+"""The explicit pair-exchange lowering (quest_tpu.parallel.exchange).
+
+Three layers of proof that the distributed fast path is a real pair
+exchange and not a GSPMD rematerialisation:
+
+1. unit: `plan_exchange`/`run_exchange` reproduce the relayout semantics
+   of the global-transpose formulation for random qubit permutations;
+2. unit: `apply_1q_cross_shard` (the role-split combine of
+   ``QuEST_cpu_distributed.c:843-878``) matches the dense local kernel;
+3. system: compiling the 8-device 18q brickwork and QFT programs emits NO
+   "Involuntary full rematerialization" SPMD warning (round-3's red flag)
+   and the compiled HLO contains genuine all-to-all collectives.
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+from quest_tpu.core.apply import apply_unitary
+from quest_tpu.env import AMP_AXIS
+from quest_tpu.parallel.exchange import (plan_exchange, run_exchange,
+                                         apply_1q_cross_shard)
+from quest_tpu.parallel.layout import apply_relayout
+
+
+def _random_relayout(rng, n, s):
+    """A random (perm_before, perm_after) pair as the planner emits them:
+    both are position assignments of the n logical qubits."""
+    before = rng.permutation(n)
+    after = rng.permutation(n)
+    return before, after
+
+
+@pytest.mark.parametrize("n,s", [(6, 3), (8, 3), (9, 2), (7, 1)])
+def test_run_exchange_matches_transpose(mesh_env, rng, n, s):
+    mesh = mesh_env.mesh
+    devs = 1 << s
+    sub = jax.sharding.Mesh(mesh.devices.reshape(-1)[:devs], (AMP_AXIS,))
+    state = rng.normal(size=(1 << n,)) + 1j * rng.normal(size=(1 << n,))
+    state = jnp.asarray(state)
+    for _ in range(6):
+        before, after = _random_relayout(rng, n, s)
+        expect = apply_relayout(state, n, before, after)
+        plan = plan_exchange(n, s, before, after)
+        got = jax.jit(jax.shard_map(
+            lambda x: run_exchange(x, plan, AMP_AXIS),
+            mesh=sub, in_specs=P(AMP_AXIS), out_specs=P(AMP_AXIS),
+            check_vma=False))(state)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   atol=1e-14)
+
+
+def test_cross_shard_1q_role_split(mesh_env, rng):
+    n, s = 9, 3
+    mesh = mesh_env.mesh
+    state = rng.normal(size=(1 << n,)) + 1j * rng.normal(size=(1 << n,))
+    state = jnp.asarray(state)
+    u = np.linalg.qr(rng.normal(size=(2, 2)) +
+                     1j * rng.normal(size=(2, 2)))[0]
+    for pos in (n - 1, n - 2, n - 3):
+        expect = apply_unitary(state, n, jnp.asarray(u), (pos,))
+        got = jax.jit(jax.shard_map(
+            lambda x: apply_1q_cross_shard(x, u, pos, n - s, s, AMP_AXIS),
+            mesh=mesh, in_specs=P(AMP_AXIS), out_specs=P(AMP_AXIS),
+            check_vma=False))(state)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   atol=1e-13)
+
+
+def test_cross_shard_1q_controlled(mesh_env, rng):
+    n, s = 9, 3
+    mesh = mesh_env.mesh
+    state = rng.normal(size=(1 << n,)) + 1j * rng.normal(size=(1 << n,))
+    state = jnp.asarray(state)
+    u = np.linalg.qr(rng.normal(size=(2, 2)) +
+                     1j * rng.normal(size=(2, 2)))[0]
+    cases = [
+        (n - 1, (1 << 2), 0),                 # local control
+        (n - 1, (1 << (n - 2)), 0),           # device control
+        (n - 2, (1 << 1) | (1 << (n - 1)), 1 << 1),  # mixed, one on-zero
+    ]
+    for pos, cmask, fmask in cases:
+        expect = apply_unitary(state, n, jnp.asarray(u), (pos,),
+                               cmask, fmask)
+        got = jax.jit(jax.shard_map(
+            lambda x: apply_1q_cross_shard(x, u, pos, n - s, s, AMP_AXIS,
+                                           cmask, fmask),
+            mesh=mesh, in_specs=P(AMP_AXIS), out_specs=P(AMP_AXIS),
+            check_vma=False))(state)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   atol=1e-13)
+
+
+def test_compiled_hlo_uses_all_to_all(mesh_env):
+    """The sharded executable's collectives are explicit: all-to-all (or
+    collective-permute) present, and no full-size all-gather of the state."""
+    n = 12
+    c = Circuit(n)
+    for q in range(n):
+        c.h(q)
+    for q in range(0, n - 1):
+        c.cnot(q, q + 1)
+    f = c.compile(mesh_env)
+    state = jnp.zeros((2, 1 << n), dtype=jnp.float64).at[0, 0].set(1.0)
+    vec = jnp.zeros((0,), dtype=jnp.float64)
+    txt = f._jitted.lower(state, vec).compile().as_text()
+    assert "all-to-all" in txt
+    # a full-state all-gather would mean replication: forbid gathers at the
+    # full 2^n amplitude size
+    full = str(1 << n)
+    for line in txt.splitlines():
+        if "all-gather" in line:
+            assert f"f64[2,{full}]" not in line and f"f64[{full}]" not in line
+
+
+REMAT_PROBE = r"""
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+from quest_tpu.algorithms import qft
+
+env = qt.createQuESTEnv(num_devices=8, seed=[7])
+n = 18
+
+brick = Circuit(n)
+for q in range(n):
+    brick.h(q)
+for layer in range(4):
+    for q in range(layer % 2, n - 1, 2):
+        brick.cnot(q, q + 1)
+    for q in range(n):
+        brick.rotate(q, 0.1 * (q + 1), (1, 1, 0))
+
+for circ, label in ((brick, "brickwork"), (qft(n), "qft")):
+    f = circ.compile(env)
+    state = jnp.zeros((2, 1 << n), dtype=jnp.float64).at[0, 0].set(1.0)
+    vec = jnp.zeros((0,), dtype=jnp.float64)
+    f._jitted.lower(state, vec).compile()
+    print(f"compiled {label} relayouts={f.plan.num_relayouts}")
+print("DONE")
+"""
+
+
+def test_no_involuntary_rematerialization():
+    """Round-3's red flag, eliminated: compiling the 18q 8-device brickwork
+    and QFT programs must not emit the SPMD involuntary-full-remat warning
+    (it is printed to stderr by the XLA partitioner, hence the subprocess)."""
+    r = subprocess.run([sys.executable, "-c", REMAT_PROBE],
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "DONE" in r.stdout
+    assert "Involuntary full rematerialization" not in r.stderr
+    assert "Involuntary full rematerialization" not in r.stdout
