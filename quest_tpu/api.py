@@ -315,33 +315,80 @@ def _shard(qureg: Qureg):
     return qureg.sharding()
 
 
+from .parallel import pergate as _pg  # noqa: E402
+
+
+def _canon(*quregs) -> None:
+    """Restore canonical qubit layout on each register (no-op off the
+    sharded per-gate path) — required before positional state reads or
+    register-to-register operations."""
+    for q in quregs:
+        q.ensure_canonical()
+
+
+def _fresh(qureg: Qureg) -> None:
+    """The register's state is being fully overwritten: drop any lazy
+    layout so the new array is read canonically."""
+    qureg.layout = None
+
+
 def _apply_gate(qureg: Qureg, u: np.ndarray, targets: Sequence[int],
                 controls: Sequence[int] = (), flips: Sequence[int] = ()) -> None:
     """Apply u (with controls) to a register; density registers get the
-    combined conj(u) (x) u on (targets, targets+n) in one pass."""
+    combined conj(u) (x) u on (targets, targets+n) in one pass.
+
+    On a mesh this routes per gate through the lazy-layout shard_map path
+    (``parallel/pergate.py``): local targets run on the chunk, a sharded
+    1q target runs as the role-split pair exchange, and multi-qubit
+    sharded targets cost ONE batched swap-to-local whose swap-back is
+    deferred — strictly less data movement than the reference's per-gate
+    exchange-or-swap routing (``QuEST_cpu_distributed.c:843-878,
+    1420-1461``)."""
     n = qureg.num_qubits_represented
     targets = tuple(int(t) for t in targets)
     ctrl_mask, flip_mask = _bitmask(controls), _bitmask(flips)
+    lazy = _pg.use_lazy(qureg)
     if qureg.is_density_matrix and not ctrl_mask:
         # fused single pass: conj(U) (x) U on (targets, targets+n)
         u2 = np.kron(np.conj(u), u)
         targets2 = targets + tuple(t + n for t in targets)
-        qureg.state = _jit_unitary(qureg.state, 2 * n, _packed(qureg, u2),
-                                   targets2, 0, 0, _shard(qureg))
+        if lazy and not _pg.fits_local(qureg, len(targets2)):
+            lazy = False
+            _canon(qureg)     # register too small for the mesh: GSPMD path
+        if lazy:
+            _pg.sharded_unitary(qureg, _packed(qureg, u2), targets2, 0, 0)
+        else:
+            qureg.state = _jit_unitary(qureg.state, 2 * n, _packed(qureg, u2),
+                                       targets2, 0, 0, _shard(qureg))
     elif qureg.is_density_matrix:
         # row- and column-side controls condition independently, so a
         # controlled gate needs the reference's two-pass form
         # (``QuEST.c:352-357``): U on (targets | controls), then conj(U) on
         # the shifted copies
-        qureg.state = _jit_unitary(qureg.state, 2 * n, _packed(qureg, u),
-                                   targets, ctrl_mask, flip_mask,
-                                   _shard(qureg))
-        qureg.state = _jit_unitary(qureg.state, 2 * n,
-                                   _packed(qureg, np.conj(u)),
-                                   tuple(t + n for t in targets),
-                                   ctrl_mask << n, flip_mask << n,
-                                   _shard(qureg))
+        if lazy and not _pg.fits_local(qureg, len(targets)):
+            lazy = False
+            _canon(qureg)
+        if lazy:
+            _pg.sharded_unitary(qureg, _packed(qureg, u), targets,
+                                ctrl_mask, flip_mask)
+            _pg.sharded_unitary(qureg, _packed(qureg, np.conj(u)),
+                                tuple(t + n for t in targets),
+                                ctrl_mask << n, flip_mask << n)
+        else:
+            qureg.state = _jit_unitary(qureg.state, 2 * n, _packed(qureg, u),
+                                       targets, ctrl_mask, flip_mask,
+                                       _shard(qureg))
+            qureg.state = _jit_unitary(qureg.state, 2 * n,
+                                       _packed(qureg, np.conj(u)),
+                                       tuple(t + n for t in targets),
+                                       ctrl_mask << n, flip_mask << n,
+                                       _shard(qureg))
+    elif lazy and _pg.fits_local(qureg, len(targets)):
+        _pg.sharded_unitary(qureg, _packed(qureg, u), targets,
+                            ctrl_mask, flip_mask)
     else:
+        if lazy:
+            _canon(qureg)
         qureg.state = _jit_unitary(qureg.state, n, _packed(qureg, u),
                                    targets, ctrl_mask, flip_mask,
                                    _shard(qureg))
@@ -350,13 +397,19 @@ def _apply_gate(qureg: Qureg, u: np.ndarray, targets: Sequence[int],
 def _apply_diag_gate(qureg: Qureg, tensor: np.ndarray,
                      qubits: Sequence[int]) -> None:
     """Apply a diagonal factor tensor (axis i = i-th qubit of ``qubits``
-    sorted descending); density registers get conj on the column side."""
+    sorted descending); density registers get conj on the column side.
+    On a mesh, diagonals run at ANY physical position with zero
+    communication (the ``statevec_phaseShiftByTerm`` no-pairing property),
+    so they never disturb the lazy layout."""
     n = qureg.num_qubits_represented
     qs = tuple(sorted((int(q) for q in qubits), reverse=True))
     tensor = np.asarray(tensor, dtype=np.complex128)
     if qureg.is_density_matrix:
         tensor = np.multiply.outer(np.conj(tensor), tensor)
         qs = tuple(q + n for q in qs) + qs
+    if _pg.use_lazy(qureg):
+        _pg.sharded_diag(qureg, tensor, qs)
+        return
     qureg.state = _jit_diag(qureg.state, qureg.num_qubits_in_state_vec,
                             _packed(qureg, tensor), qs, _shard(qureg))
 
@@ -434,6 +487,7 @@ def createCloneQureg(qureg: Qureg, env: QuESTEnv) -> Qureg:
                 is_density=qureg.is_density_matrix)
     # deep copy: gate kernels donate their input buffer, so clones must not
     # alias the source register's storage
+    _canon(qureg)
     new.state = jnp.array(qureg.state, copy=True)
     return new
 
@@ -471,6 +525,7 @@ def copyStateFromGPU(qureg: Qureg) -> None:
 # ---------------------------------------------------------------------------
 
 def initBlankState(qureg: Qureg) -> None:
+    _fresh(qureg)
     qureg.state = ist.blank(qureg.num_amps_total, qureg.real_dtype,
                             qureg.sharding())
     qureg.qasm_log.record_comment(
@@ -478,6 +533,7 @@ def initBlankState(qureg: Qureg) -> None:
 
 
 def initZeroState(qureg: Qureg) -> None:
+    _fresh(qureg)
     qureg.state = ist.zero(qureg.num_amps_total, qureg.real_dtype,
                            qureg.sharding())
     qureg.qasm_log.record_init_zero()
@@ -487,6 +543,7 @@ def initPlusState(qureg: Qureg) -> None:
     n = qureg.num_qubits_represented
     amp = (1.0 / (1 << n)) if qureg.is_density_matrix \
         else (1.0 / np.sqrt(1 << n))
+    _fresh(qureg)
     qureg.state = ist.plus(qureg.num_amps_total, qureg.real_dtype,
                            qureg.sharding(), amp)
     qureg.qasm_log.record_init_plus()
@@ -497,6 +554,7 @@ def initClassicalState(qureg: Qureg, state_ind: int) -> None:
                              "initClassicalState")
     idx = state_ind * ((1 << qureg.num_qubits_represented) + 1) \
         if qureg.is_density_matrix else state_ind
+    _fresh(qureg)
     qureg.state = ist.classical(qureg.num_amps_total, qureg.real_dtype,
                                 qureg.sharding(), idx)
     qureg.qasm_log.record_init_classical(state_ind)
@@ -506,6 +564,8 @@ def initPureState(qureg: Qureg, pure: Qureg) -> None:
     val.validate_second_qureg_state_vec(pure.is_density_matrix, "initPureState")
     val.validate_matching_dims(qureg.num_qubits_represented,
                                pure.num_qubits_represented, "initPureState")
+    _canon(pure)
+    _fresh(qureg)
     if qureg.is_density_matrix:
         qureg.state = _jit_outer(pure.state, _shard(qureg))
     else:
@@ -515,6 +575,7 @@ def initPureState(qureg: Qureg, pure: Qureg) -> None:
 
 
 def initDebugState(qureg: Qureg) -> None:
+    _fresh(qureg)
     qureg.state = ist.debug(qureg.num_amps_total, qureg.real_dtype,
                             qureg.sharding())
 
@@ -536,6 +597,7 @@ def setAmps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
     val.validate_num_amps(qureg.num_amps_total, start_ind, num_amps, "setAmps")
     vals = np.stack([np.asarray(reals, np.float64)[:num_amps],
                      np.asarray(imags, np.float64)[:num_amps]])
+    _canon(qureg)
     qureg.state = qureg.state.at[:, start_ind:start_ind + num_amps].set(
         jnp.asarray(vals, qureg.real_dtype))
     qureg.qasm_log.record_comment("amplitudes were manually edited")
@@ -556,6 +618,8 @@ def cloneQureg(target: Qureg, copy: Qureg) -> None:
                                 copy.is_density_matrix, "cloneQureg")
     val.validate_matching_dims(target.num_qubits_represented,
                                copy.num_qubits_represented, "cloneQureg")
+    _canon(copy)
+    _fresh(target)
     target.state = jnp.array(copy.state, copy=True)
 
 
@@ -570,6 +634,7 @@ def setWeightedQureg(fac1, qureg1: Qureg, fac2, qureg2: Qureg,
     val.validate_matching_dims(qureg1.num_qubits_represented,
                                out.num_qubits_represented, "setWeightedQureg")
     rd = out.real_dtype
+    _canon(qureg1, qureg2, out)
     out.state = _jit_weighted(
         jnp.asarray(pack_host(np.asarray(fac1, np.complex128), rd)),
         qureg1.state,
@@ -586,6 +651,7 @@ def initStateOfSingleQubit(qureg: Qureg, qubit: int, outcome: int) -> None:
     val.validate_target(qureg.num_qubits_represented, qubit,
                         "initStateOfSingleQubit")
     val.validate_outcome(outcome, "initStateOfSingleQubit")
+    _fresh(qureg)
     qureg.state = ist.single_qubit_outcome(
         qureg.num_amps_total, qureg.real_dtype, qureg.sharding(),
         qubit, outcome)
@@ -825,7 +891,14 @@ def multiStateControlledUnitary(qureg: Qureg, controls: Sequence[int],
 def swapGate(qureg: Qureg, q1: int, q2: int) -> None:
     val.validate_unique_targets(qureg.num_qubits_represented, q1, q2, "swapGate")
     n = qureg.num_qubits_represented
-    if qureg.is_density_matrix:
+    if _pg.use_lazy(qureg):
+        # on a mesh a SWAP is pure layout metadata — zero data movement
+        # (the reference exchanges chunks, ``statevec_swapQubitAmps``
+        # ``QuEST_cpu_distributed.c:1355-1371``)
+        _pg.metadata_swap(qureg, q1, q2)
+        if qureg.is_density_matrix:
+            _pg.metadata_swap(qureg, q1 + n, q2 + n)
+    elif qureg.is_density_matrix:
         qureg.state = _jit_swap(qureg.state, 2 * n, q1, q2, _shard(qureg))
         qureg.state = _jit_swap(qureg.state, 2 * n, q1 + n, q2 + n, _shard(qureg))
     else:
@@ -1007,6 +1080,13 @@ def calcExpecPauliProd(qureg: Qureg, targets: Sequence[int],
     val.validate_pauli_codes(codes, "calcExpecPauliProd")
     targets = tuple(int(t) for t in targets)
     codes = tuple(int(c) for c in codes)
+    if qureg.layout is not None:
+        if qureg.is_density_matrix:
+            _canon(qureg)    # row/col pairing is positional
+        else:
+            # <psi|P|psi> only cares where the TARGETS live: probe the
+            # physical positions, no exchange
+            targets = _pg.phys_targets(qureg, targets)
     if qureg.is_density_matrix:
         value = _jit_expec_pauli_dm(qureg.state, qureg.num_qubits_in_state_vec,
                                     qureg.num_qubits_represented, targets, codes)
@@ -1050,6 +1130,8 @@ def applyPauliSum(in_qureg: Qureg, all_codes: Sequence[int],
     codes_flat = tuple(int(c) for c in all_codes[:num_terms * n])
     coeffs_f = jnp.asarray(np.asarray(coeffs[:num_terms], np.float64),
                            in_qureg.real_dtype)
+    _canon(in_qureg)
+    _fresh(out_qureg)
     out_qureg.state = _jit_apply_pauli_sum(
         in_qureg.state, in_qureg.num_qubits_in_state_vec, n, codes_flat,
         coeffs_f, _shard(out_qureg))
@@ -1064,6 +1146,11 @@ def applyPauliSum(in_qureg: Qureg, all_codes: Sequence[int],
 def calcProbOfOutcome(qureg: Qureg, qubit: int, outcome: int) -> float:
     val.validate_target(qureg.num_qubits_represented, qubit, "calcProbOfOutcome")
     val.validate_outcome(outcome, "calcProbOfOutcome")
+    if qureg.layout is not None:
+        if qureg.is_density_matrix:
+            _canon(qureg)    # the diagonal view needs canonical order
+        else:
+            qubit = int(qureg.layout[qubit])   # probe the physical position
     if qureg.env.compensated:
         if qureg.is_density_matrix:
             p0 = _pair(_jit_pair_prob_zero_dm(
@@ -1083,6 +1170,11 @@ def calcProbOfOutcome(qureg: Qureg, qubit: int, outcome: int) -> float:
 
 def _collapse(qureg: Qureg, qubit: int, outcome: int, prob: float) -> None:
     prob = jnp.asarray(prob, qureg.real_dtype)
+    if qureg.layout is not None:
+        if qureg.is_density_matrix:
+            _canon(qureg)
+        else:
+            qubit = int(qureg.layout[qubit])
     if qureg.is_density_matrix:
         qureg.state = _jit_collapse_dm(
             qureg.state, qureg.num_qubits_represented, qubit, outcome, prob,
@@ -1170,6 +1262,7 @@ def sampleOutcomes(qureg: Qureg, num_samples: int, qubits=None) -> np.ndarray:
     if qubits is not None:
         qubits = [int(q) for q in qubits]
         val.validate_multi_targets(n, qubits, "sampleOutcomes")
+    _canon(qureg)
     if qureg.is_density_matrix:
         # diagonal of the flat density vector via a reshape view (no
         # index vector: a materialised arange would overflow int32 on
@@ -1220,6 +1313,9 @@ def _jit_take_amp(state_f, idx):
 
 
 def _get_amp_pair(qureg: Qureg, index: int) -> complex:
+    # under a lazy layout the logical basis index maps bit-by-bit to a
+    # physical one — a host-side remap, never a collective
+    index = _pg.phys_index(qureg, index)
     idx_dt = jnp.int64 if (index > np.iinfo(np.int32).max
                            and jax.config.jax_enable_x64) else jnp.int32
     pair = np.asarray(_jit_take_amp(qureg.state, jnp.asarray(index, idx_dt)))
@@ -1254,6 +1350,8 @@ def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
 
 
 def calcTotalProb(qureg: Qureg) -> float:
+    if qureg.is_density_matrix:
+        _canon(qureg)    # the trace pairs row/column bits positionally
     if qureg.env.compensated:
         if qureg.is_density_matrix:
             return _pair(_jit_pair_total_prob_dm(
@@ -1270,6 +1368,7 @@ def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
     val.validate_state_vec(ket.is_density_matrix, "calcInnerProduct")
     val.validate_matching_dims(bra.num_qubits_represented,
                                ket.num_qubits_represented, "calcInnerProduct")
+    _canon(bra, ket)
     if bra.env.compensated:
         re_pair, im_pair = _jit_pair_inner_product(bra.state, ket.state)
         return complex(_pair(re_pair), _pair(im_pair))
@@ -1283,6 +1382,7 @@ def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
     val.validate_matching_dims(rho1.num_qubits_represented,
                                rho2.num_qubits_represented,
                                "calcDensityInnerProduct")
+    _canon(rho1, rho2)
     if rho1.env.compensated:
         return _pair(_jit_pair_dm_inner(rho1.state, rho2.state))
     return float(_jit_dm_inner(rho1.state, rho2.state))
@@ -1301,6 +1401,7 @@ def calcFidelity(qureg: Qureg, pure_state: Qureg) -> float:
     val.validate_matching_dims(qureg.num_qubits_represented,
                                pure_state.num_qubits_represented,
                                "calcFidelity")
+    _canon(qureg, pure_state)
     if qureg.is_density_matrix:
         if qureg.env.compensated:
             return _pair(_jit_pair_fidelity_dm(
@@ -1322,6 +1423,7 @@ def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
     val.validate_matching_dims(a.num_qubits_represented,
                                b.num_qubits_represented,
                                "calcHilbertSchmidtDistance")
+    _canon(a, b)
     if a.env.compensated:
         return math.sqrt(max(0.0, _pair(_jit_pair_hs_sq(a.state, b.state))))
     return float(_jit_hs_dist(a.state, b.state))
@@ -1336,6 +1438,14 @@ def _apply_kraus(qureg: Qureg, targets: Sequence[int], ops) -> None:
     (``densmatr_applyMultiQubitKrausSuperoperator``
     ``QuEST_common.c:598-604``)."""
     superop = dm.kraus_superoperator(ops)
+    if _pg.use_lazy(qureg):
+        n = qureg.num_qubits_represented
+        t2 = tuple(int(t) for t in targets) \
+            + tuple(int(t) + n for t in targets)
+        if _pg.fits_local(qureg, len(t2)):
+            _pg.sharded_unitary(qureg, _packed(qureg, superop), t2, 0, 0)
+            return
+        _canon(qureg)
     qureg.state = _jit_kraus_superop(
         qureg.state, qureg.num_qubits_represented,
         tuple(int(t) for t in targets), _packed(qureg, superop),
@@ -1347,8 +1457,16 @@ def mixDephasing(qureg: Qureg, target: int, prob: float) -> None:
     val.validate_target(qureg.num_qubits_represented, target, "mixDephasing")
     val.validate_prob(prob, "mixDephasing", 0.5, "dephasing probability",
                       code=val.ErrorCode.E_INVALID_ONE_QUBIT_DEPHASE_PROB)
-    qureg.state = _jit_mix_dephasing(qureg.state, qureg.num_qubits_represented,
-                                     target, float(prob), _shard(qureg))
+    if _pg.use_lazy(qureg):
+        # dephasing is diagonal on (target+n, target): position-free
+        retain = 1.0 - 2.0 * float(prob)
+        fac = np.array([[1.0, retain], [retain, 1.0]], dtype=np.complex128)
+        n = qureg.num_qubits_represented
+        _pg.sharded_diag(qureg, fac, (target + n, target))
+    else:
+        qureg.state = _jit_mix_dephasing(
+            qureg.state, qureg.num_qubits_represented,
+            target, float(prob), _shard(qureg))
     qureg.qasm_log.record_comment(
         f"a phase (Z) error occurred on qubit {target} with probability {prob:g}")
 
@@ -1360,6 +1478,23 @@ def mixTwoQubitDephasing(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
     val.validate_prob(prob, "mixTwoQubitDephasing", 0.75,
                       "two-qubit dephasing probability",
                       code=val.ErrorCode.E_INVALID_TWO_QUBIT_DEPHASE_PROB)
+    if _pg.use_lazy(qureg):
+        # diagonal on (q1, q2, q1+n, q2+n): position-free, zero comm
+        n = qureg.num_qubits_represented
+        retain = 1.0 - (4.0 * float(prob)) / 3.0
+        fac = np.ones((2, 2, 2, 2), dtype=np.complex128)
+        for chi in range(2):
+            for clo in range(2):
+                for rhi in range(2):
+                    for rlo in range(2):
+                        if chi != rhi or clo != rlo:
+                            fac[chi, clo, rhi, rlo] = retain
+        hi, lo = max(q1, q2), min(q1, q2)
+        _pg.sharded_diag(qureg, fac, (hi + n, lo + n, hi, lo))
+        qureg.qasm_log.record_comment(
+            f"a phase (Z) error occurred on qubits {q1} and/or {q2} "
+            f"with total probability {prob:g}")
+        return
     qureg.state = _jit_mix_two_qubit_dephasing(
         qureg.state, qureg.num_qubits_represented, q1, q2, float(prob),
         _shard(qureg))
@@ -1417,6 +1552,7 @@ def mixDensityMatrix(qureg: Qureg, other_prob: float, other: Qureg) -> None:
                                other.num_qubits_represented,
                                "mixDensityMatrix")
     val.validate_prob(other_prob, "mixDensityMatrix")
+    _canon(qureg, other)
     qureg.state = _jit_mix_linear(
         jnp.asarray(other_prob, qureg.real_dtype), qureg.state, other.state,
         _shard(qureg))

@@ -54,6 +54,7 @@ def _check_meta(meta: dict, qureg: Qureg) -> None:
 
 def save(qureg: Qureg, path: str) -> None:
     """Checkpoint a register to ``path`` (a directory; orbax format)."""
+    qureg.ensure_canonical()     # checkpoints store canonical bit order
     try:
         import orbax.checkpoint as ocp
     except ImportError:
@@ -88,11 +89,13 @@ def load(qureg: Qureg, path: str) -> None:
     target = jax.ShapeDtypeStruct(shape, qureg.real_dtype, sharding=sharding)
     ckptr = ocp.StandardCheckpointer()
     restored = ckptr.restore(path, {"state": target})
+    qureg.layout = None
     qureg.state = restored["state"]
 
 
 def save_npz(qureg: Qureg, filename: str) -> None:
     """Single-host fallback: gather to host and save as .npz."""
+    qureg.ensure_canonical()
     np.savez(filename, state=np.asarray(qureg.state),
              meta=json.dumps(_meta(qureg)))
 

@@ -1098,6 +1098,7 @@ class CompiledCircuit:
             raise ValueError(
                 f"circuit has {self.num_qubits} qubits; register state vector "
                 f"has {qureg.num_qubits_in_state_vec}")
+        qureg.ensure_canonical()   # compiled programs address canonical bits
         qureg.state = self._jitted(qureg.state, self._param_vec(params))
 
     def apply(self, state_f: jnp.ndarray, params=None):

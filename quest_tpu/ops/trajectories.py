@@ -147,6 +147,7 @@ class TrajectoryProgram:
                 f"{qureg.num_qubits_represented}")
         if key is None:
             key = self.env.next_key()
+        qureg.ensure_canonical()   # the program addresses canonical bits
         qureg.state = self._apply(qureg.state, key)
 
     def run_batch(self, state_f, num_trajectories: int,

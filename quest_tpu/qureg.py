@@ -45,6 +45,12 @@ class Qureg:
         self.num_amps_total = 1 << self.num_qubits_in_state_vec
         self.qasm_log = QASMLogger(num_qubits)
         self._state: Optional[jax.Array] = None
+        # lazy logical->physical qubit permutation over the state-vector
+        # positions (None = identity). Maintained only by the sharded
+        # per-gate path (parallel/pergate.py): swaps become metadata and
+        # swap-to-local relayouts defer their swap-back until a reader
+        # needs canonical order (ensure_canonical).
+        self.layout: Optional[np.ndarray] = None
 
     # -- state plumbing ----------------------------------------------------
 
@@ -90,6 +96,7 @@ class Qureg:
             raise ValueError(
                 f"state array has shape {host_array.shape}; this register "
                 f"holds {self.num_amps_total} amplitudes")
+        self.layout = None       # full overwrite in canonical order
         arr = pack_host(host_array, self.real_dtype)
         sharding = self.sharding()
         if sharding is not None and self.env.is_multihost:
@@ -113,6 +120,14 @@ class Qureg:
     def num_chunks(self) -> int:
         return self.env.num_devices
 
+    def ensure_canonical(self) -> None:
+        """Restore the identity qubit layout (one batched exchange) so the
+        raw state array can be read positionally. No-op off the sharded
+        per-gate path."""
+        if self.layout is not None:
+            from .parallel.pergate import canonicalise
+            canonicalise(self)
+
     def to_numpy(self) -> np.ndarray:
         """Gather the FULL state to host as a complex vector — debug/test
         seam ONLY: this is O(2^n) host memory and tunnel bandwidth. Use
@@ -123,6 +138,7 @@ class Qureg:
         not addressable, so the state is allgathered first (every process
         must call this collectively, as with the reference's
         ``copyVecIntoMatrixPairState`` replication)."""
+        self.ensure_canonical()
         if self.env.is_multihost and self.sharding() is not None:
             # replicated (unsharded) registers are already host-local;
             # only sharded states need the cross-process gather

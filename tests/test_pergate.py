@@ -1,0 +1,208 @@
+"""Per-gate sharded execution with the lazy register layout
+(quest_tpu.parallel.pergate).
+
+The reference routes every imperative gate at run time and pays physical
+SWAPs both ways for non-local multi-qubit targets
+(``QuEST_cpu_distributed.c:1420-1461``); here swaps are metadata, sharded
+1q gates ride the role-split pair exchange, and swap-to-local relayouts
+defer their swap-back — so the relayout count must be MEASURABLY below
+the count of gates touching sharded qubits.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.parallel import pergate as pg
+
+
+def jax_key():
+    import jax
+    return jax.random.key(42)
+
+
+def _mirror_pair(n, env1, env8, seed=7):
+    q1 = qt.createQureg(n, env1)
+    q8 = qt.createQureg(n, env8)
+    qt.initDebugState(q1)
+    qt.initDebugState(q8)
+    return q1, q8
+
+
+def _rand_u(rng, k):
+    m = rng.normal(size=(1 << k, 1 << k)) + 1j * rng.normal(size=(1 << k, 1 << k))
+    return np.linalg.qr(m)[0]
+
+
+class TestLazyPerGate:
+    def test_gate_by_gate_equivalence(self, env, mesh_env, rng):
+        n = 9
+        q1, q8 = _mirror_pair(n, env, mesh_env)
+        u3 = _rand_u(rng, 3)
+        for q in (q1, q8):
+            qt.hadamard(q, n - 1)                  # sharded 1q: role-split
+            qt.rotateX(q, n - 2, 0.3)              # sharded 1q
+            qt.controlledNot(q, n - 1, 0)          # sharded control: free
+            qt.controlledNot(q, 0, n - 1)          # sharded target, local ctrl
+            qt.swapGate(q, 0, n - 1)               # metadata only
+            qt.tGate(q, n - 1)                     # diagonal: position-free
+            qt.multiControlledPhaseFlip(q, [0, n - 1, n - 2])
+            qt.multiQubitUnitary(q, (n - 1, n - 2, 1), u3)  # swap-to-local
+            qt.rotateY(q, 2, 0.8)
+            qt.sqrtSwapGate(q, 1, n - 2)
+            qt.swapGate(q, 3, n - 3)
+            qt.hadamard(q, 3)
+        np.testing.assert_allclose(q8.to_numpy(), q1.to_numpy(), atol=1e-12)
+
+    def test_swap_is_metadata(self, mesh_env):
+        n = 8
+        q = qt.createQureg(n, mesh_env)
+        qt.initDebugState(q)
+        before = pg.RELAYOUT_COUNT
+        qt.swapGate(q, 0, n - 1)
+        assert pg.RELAYOUT_COUNT == before          # no exchange ran
+        assert q.layout is not None
+        # the swap is real: amplitude of |100...0> now reads old |000...1>
+        ref = qt.createQureg(n, mesh_env)
+        qt.initDebugState(ref)
+        a = qt.getAmp(q, 1 << (n - 1))
+        b = qt.getAmp(ref, 1)
+        assert a == pytest.approx(b, abs=1e-14)
+
+    def test_fewer_relayouts_than_sharded_gates(self, env, mesh_env, rng):
+        # 20 sharded-qubit touches, far fewer physical exchanges
+        n = 9
+        q1, q8 = _mirror_pair(n, env, mesh_env)
+        sharded_touches = 0
+        for q in (q1, q8):
+            count0 = pg.RELAYOUT_COUNT
+            for layer in range(5):
+                qt.hadamard(q, n - 1)             # role-split, no relayout
+                sharded_touches += 1
+                qt.tGate(q, n - 2)                # diagonal, free
+                sharded_touches += 1
+                qt.controlledNot(q, n - 1, layer)  # control free
+                sharded_touches += 1
+                qt.swapGate(q, layer, n - 3)      # metadata
+                sharded_touches += 1
+            if q is q8:
+                relayouts = pg.RELAYOUT_COUNT - count0
+        # 20 touches of sharded positions; only the final canonicalisation
+        # (from to_numpy) may move data, plus any swap-to-local the swaps
+        # forced retroactively on later multiqubit gates (none here)
+        out8 = q8.to_numpy()
+        out1 = q1.to_numpy()
+        total_relayouts = pg.RELAYOUT_COUNT - count0
+        np.testing.assert_allclose(out8, out1, atol=1e-12)
+        assert relayouts == 0, relayouts
+        assert total_relayouts <= 1, total_relayouts   # the canonicalise
+        assert sharded_touches >= 20
+
+    def test_measure_and_prob_on_permuted_layout(self, env, mesh_env):
+        n = 8
+        outs = []
+        for e in (env, mesh_env):
+            q = qt.createQureg(n, e)
+            qt.initZeroState(q)
+            qt.hadamard(q, n - 1)
+            qt.swapGate(q, n - 1, 0)       # metadata on mesh
+            # qubit 0 now holds the superposed amplitude
+            outs.append((qt.calcProbOfOutcome(q, 0, 1),
+                         qt.calcProbOfOutcome(q, n - 1, 1)))
+        assert outs[0] == pytest.approx(outs[1], abs=1e-12)
+        assert outs[1][0] == pytest.approx(0.5, abs=1e-12)
+        assert outs[1][1] == pytest.approx(0.0, abs=1e-12)
+
+    def test_collapse_on_permuted_layout(self, mesh_env):
+        n = 8
+        q = qt.createQureg(n, mesh_env)
+        qt.initZeroState(q)
+        qt.hadamard(q, n - 1)
+        qt.swapGate(q, n - 1, 2)
+        p = qt.collapseToOutcome(q, 2, 1)
+        assert p == pytest.approx(0.5, abs=1e-12)
+        assert qt.calcTotalProb(q) == pytest.approx(1.0, abs=1e-12)
+        amps = q.to_numpy()
+        assert abs(amps[1 << 2]) == pytest.approx(1.0, abs=1e-12)
+
+    def test_density_register_lazy_path(self, env, mesh_env, rng):
+        n = 4
+        outs = []
+        for e in (env, mesh_env):
+            d = qt.createDensityQureg(n, e)
+            qt.initPlusState(d)
+            qt.hadamard(d, n - 1)
+            qt.controlledNot(d, n - 1, 0)
+            qt.swapGate(d, 0, n - 1)
+            qt.mixDephasing(d, n - 1, 0.2)
+            qt.mixDepolarising(d, 0, 0.3)
+            qt.mixDamping(d, 1, 0.1)
+            qt.tGate(d, n - 1)
+            outs.append(d.to_numpy())
+        np.testing.assert_allclose(outs[1], outs[0], atol=1e-12)
+
+    def test_getamp_under_layout(self, mesh_env, rng):
+        n = 8
+        q = qt.createQureg(n, mesh_env)
+        qt.initDebugState(q)
+        qt.swapGate(q, 1, n - 1)
+        qt.swapGate(q, 0, n - 2)
+        assert q.layout is not None
+        # compare a handful of amplitudes against the canonical gather
+        probe = [0, 1, 5, (1 << n) - 1, 0b10110010 % (1 << n)]
+        lazy_reads = [qt.getAmp(q, i) for i in probe]
+        full = q.to_numpy()      # canonicalises
+        for i, a in zip(probe, lazy_reads):
+            assert a == pytest.approx(complex(full[i]), abs=1e-14)
+
+    def test_trajectory_run_canonicalises(self, env, mesh_env):
+        # regression: TrajectoryProgram.run must not address a permuted
+        # physical state at canonical positions
+        from quest_tpu.circuits import Circuit
+        n = 6
+        outs = []
+        for e in (env, mesh_env):
+            q = qt.createQureg(n, e)
+            qt.initZeroState(q)
+            qt.hadamard(q, n - 1)
+            qt.swapGate(q, n - 1, 0)        # metadata-only on mesh
+            c = Circuit(n)
+            c.cnot(0, 1)
+            c.compile_trajectories(e).run(q, key=jax_key())
+            outs.append(q.to_numpy())
+        np.testing.assert_allclose(outs[1], outs[0], atol=1e-12)
+
+    def test_expec_pauli_prod_no_exchange(self, mesh_env):
+        n = 8
+        q = qt.createQureg(n, mesh_env)
+        qt.initZeroState(q)
+        qt.hadamard(q, n - 1)
+        qt.swapGate(q, n - 1, 0)
+        before = pg.RELAYOUT_COUNT
+        v = qt.calcExpecPauliProd(q, (0,), (int(qt.PAULI_X),))
+        assert pg.RELAYOUT_COUNT == before     # probed in place
+        assert v == pytest.approx(1.0, abs=1e-12)
+
+    def test_two_qubit_dephasing_position_free(self, env, mesh_env):
+        n = 4
+        outs = []
+        for e in (env, mesh_env):
+            d = qt.createDensityQureg(n, e)
+            qt.initPlusState(d)
+            qt.swapGate(d, 0, n - 1)
+            qt.mixTwoQubitDephasing(d, 0, n - 1, 0.3)
+            outs.append(d.to_numpy())
+        np.testing.assert_allclose(outs[1], outs[0], atol=1e-12)
+
+    def test_mixed_compiled_and_pergate(self, env, mesh_env):
+        from quest_tpu.algorithms import qft
+        n = 8
+        outs = []
+        for e in (env, mesh_env):
+            q = qt.createQureg(n, e)
+            qt.initZeroState(q)
+            qt.hadamard(q, n - 1)
+            qt.swapGate(q, n - 1, 0)      # leaves lazy layout on mesh
+            qft(n).compile(e).run(q)      # compiled path must canonicalise
+            outs.append(q.to_numpy())
+        np.testing.assert_allclose(outs[1], outs[0], atol=1e-12)
