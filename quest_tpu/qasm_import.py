@@ -62,8 +62,15 @@ _BASES: dict = {
                                       np.exp(0.5j * th),
                                       np.exp(0.5j * th),
                                       np.exp(-0.5j * th)])),
+    "sdg": (1, 0, lambda: np.diag([1.0, -1j])),
+    "tdg": (1, 0, lambda: np.diag([1.0, np.exp(-1j * np.pi / 4.0)])),
     "id": (1, 0, None),
 }
+
+# qelib1's u3/u2 (and the spec's U) carry e^{i(phi+lambda)/2} relative to
+# the phase-dropped Rz.Ry.Rz built above — physical under controls
+_PHASED_BASES = {"u3": lambda ps: (ps[1] + ps[2]) / 2.0,
+                 "u2": lambda ps: (ps[0] + ps[1]) / 2.0}
 
 _U_BUILDERS = {
     "quest": lambda a, b, c: _rz(a) @ _ry(b) @ _rz(c),
@@ -74,8 +81,8 @@ _ROT_METHODS = {"rx", "ry", "rz"}
 
 _LINE_RE = re.compile(
     r"^(?P<label>[A-Za-z_][A-Za-z0-9_]*)"
-    r"(?:\s*\(\s*(?P<params>[^)]*)\s*\))?"
-    r"\s+(?P<args>[^;]+);$")
+    r"(?:\s*\(\s*(?P<params>.*)\s*\))?"        # greedy: parens may nest
+    r"\s+(?P<args>[^;()]+);$")                 # args never contain parens
 _QUBIT_RE = re.compile(r"^(?P<reg>[A-Za-z_][A-Za-z0-9_]*)"
                        r"\[(?P<idx>\d+)\]$")
 
@@ -115,7 +122,10 @@ def _eval_param(text: str) -> float:
                 ast.Div: lambda: left / right,
                 ast.Pow: lambda: left ** right}[type(n.op)]()
 
-    return ev(tree)
+    try:
+        return ev(tree)
+    except TypeError as e:                     # e.g. float(1j)
+        raise ValueError(f"non-real parameter {text!r}") from e
 
 
 def _split_label(label: str):
@@ -169,6 +179,7 @@ def parse_qasm(text: str, dialect: str = "quest") -> ParsedQASM:
     measurements: list[tuple[int, int]] = []
     resets = 0
     seen_gate = False
+    measured_qubits: set[int] = set()
 
     for raw in text.splitlines():
         line = raw.split("//", 1)[0].strip()
@@ -205,8 +216,10 @@ def parse_qasm(text: str, dialect: str = "quest") -> ParsedQASM:
                 q = _parse_qubit(m.group(1), qreg_name, num_qubits)
                 cm = re.match(r"[A-Za-z_][A-Za-z0-9_]*\[(\d+)\]", m.group(2))
                 measurements.append((q, int(cm.group(1)) if cm else q))
+                measured_qubits.add(q)
                 continue
-            _parse_gate(stmt, circuit, qreg_name, num_qubits, dialect)
+            _parse_gate(stmt, circuit, qreg_name, num_qubits, dialect,
+                        measured_qubits)
             seen_gate = True
 
     if circuit is None:
@@ -225,7 +238,8 @@ def _parse_qubit(tok: str, qreg_name: str, num_qubits: int) -> int:
 
 
 def _parse_gate(stmt: str, circuit: Circuit, qreg_name: str,
-                num_qubits: int, dialect: str) -> None:
+                num_qubits: int, dialect: str,
+                measured_qubits: set = frozenset()) -> None:
     m = _LINE_RE.match(stmt)
     if not m:
         raise ValueError(f"malformed gate statement: {stmt!r}")
@@ -242,6 +256,16 @@ def _parse_gate(stmt: str, circuit: Circuit, qreg_name: str,
             f"got {len(params)}: {stmt!r}")
     qubits = [_parse_qubit(t, qreg_name, num_qubits)
               for t in m.group("args").split(",")]
+    touched = measured_qubits.intersection(qubits)
+    if touched:
+        # silently hoisting the gate above the deferred measure would
+        # change the program's distribution (ADVICE r3): reject, like
+        # mid-circuit reset. Gates on DISJOINT qubits commute with the
+        # projector and stay importable.
+        raise ValueError(
+            f"mid-circuit measurement: gate on already-measured "
+            f"qubit(s) {sorted(touched)} cannot be deferred (use "
+            f"Circuit.mid_measure or the imperative API instead)")
     if (base in ("swap", "sqrtswap") and n_ctrl >= 1
             and len(qubits) == n_ctrl + 1):
         # the reference logger styles the swap family's FIRST qubit as a
@@ -276,6 +300,19 @@ def _parse_gate(stmt: str, circuit: Circuit, qreg_name: str,
         return
     circuit.gate(np.asarray(builder(*params), dtype=np.complex128),
                  targets, controls)
+    if controls:
+        # restore the determinant phase the SU(2) form drops — it is
+        # physical under controls (ADVICE r3): c^{n-1}u1((phi+lambda)/2)
+        # on the controls, mirroring to_qasm's phase restoration
+        gamma = 0.0
+        if base in _PHASED_BASES:
+            gamma = _PHASED_BASES[base](params)
+        elif base == "u" and dialect == "openqasm":
+            gamma = (params[1] + params[2]) / 2.0
+        if abs(gamma) > 1e-15:
+            t = np.ones((2,) * len(controls), dtype=np.complex128)
+            t[(1,) * len(controls)] = np.exp(1j * gamma)
+            circuit.diagonal(t, controls)
 
 
 def load_qasm_file(path: str, dialect: str = "quest") -> ParsedQASM:

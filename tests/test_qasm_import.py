@@ -396,3 +396,64 @@ def test_circuit_to_qasm_general_diagonal(env):
     qt.initZeroState(q2)
     parsed.circuit.compile(env, pallas=False).run(q2)
     assert _phase_aligned(q1.to_numpy(), q2.to_numpy()) < 1e-10
+
+
+def test_mid_circuit_measure_rejected():
+    """ADVICE r3 (medium): a gate on an already-measured qubit must raise,
+    not silently reorder (H-measure-H imported as H.H = identity would
+    turn a 50/50 program into a deterministic one)."""
+    txt = ("OPENQASM 2.0;\nqreg q[1];\ncreg c[1];\n"
+           "h q[0];\nmeasure q[0] -> c[0];\nh q[0];\n")
+    with pytest.raises(ValueError, match="mid-circuit measurement"):
+        qt.parse_qasm(txt)
+
+
+def test_gate_on_unmeasured_qubit_after_measure_ok():
+    """A gate disjoint from every measured qubit commutes with the
+    deferred projector — still importable."""
+    txt = ("OPENQASM 2.0;\nqreg q[2];\ncreg c[1];\n"
+           "h q[0];\nmeasure q[0] -> c[0];\nh q[1];\n")
+    parsed = qt.parse_qasm(txt)
+    assert parsed.measurements == [(0, 0)]
+    assert parsed.circuit.depth == 2
+
+
+def test_controlled_u3_phase_compensation(env):
+    """ADVICE r3 (low): qelib1's cu3 includes the e^{i(phi+lambda)/2}
+    determinant phase — physical under controls."""
+    th, ph, la = 0.7, 0.5, 0.3
+    txt = (f"OPENQASM 2.0;\nqreg q[2];\ncu3({th},{ph},{la}) q[0],q[1];\n")
+    parsed = qt.parse_qasm(txt, dialect="openqasm")
+    # qelib1 u3 matrix (spec): [[cos, -e^{i la} sin], [e^{i ph} sin, e^{i(ph+la)} cos]]
+    c, s = np.cos(th / 2), np.sin(th / 2)
+    u3 = np.array([[c, -np.exp(1j * la) * s],
+                   [np.exp(1j * ph) * s, np.exp(1j * (ph + la)) * c]])
+    cu3 = np.eye(4, dtype=complex)
+    # our convention: control q[0] = bit 0, target q[1] = bit 1
+    cu3[1, 1], cu3[1, 3] = u3[0, 0], u3[0, 1]
+    cu3[3, 1], cu3[3, 3] = u3[1, 0], u3[1, 1]
+    q = qt.createQureg(2, env)
+    rng = np.random.default_rng(5)
+    psi = rng.normal(size=4) + 1j * rng.normal(size=4)
+    psi /= np.linalg.norm(psi)
+    q.device_put(psi)
+    parsed.circuit.compile(env).run(q)
+    np.testing.assert_allclose(q.to_numpy(), cu3 @ psi, atol=1e-12)
+
+
+def test_sdg_tdg_and_nested_parens(env):
+    txt = ("OPENQASM 2.0;\nqreg q[1];\n"
+           "s q[0];\nsdg q[0];\nt q[0];\ntdg q[0];\nu1(-(pi/2)) q[0];\n"
+           "u1(pi/2) q[0];\n")
+    parsed = qt.parse_qasm(txt, dialect="openqasm")
+    q = qt.createQureg(1, env)
+    psi = np.array([0.6, 0.8j])
+    q.device_put(psi)
+    parsed.circuit.compile(env).run(q)
+    np.testing.assert_allclose(q.to_numpy(), psi, atol=1e-12)  # all cancel
+
+
+def test_non_real_param_raises_valueerror():
+    txt = "OPENQASM 2.0;\nqreg q[1];\nu1(1j) q[0];\n"
+    with pytest.raises(ValueError, match="non-real|unknown symbol"):
+        qt.parse_qasm(txt, dialect="openqasm")
