@@ -334,6 +334,7 @@ def _shard(qureg: Qureg):
 
 
 from .parallel import pergate as _pg  # noqa: E402
+from .ops import doubledouble as ddm  # noqa: E402
 
 
 def _canon(*quregs) -> None:
@@ -365,6 +366,8 @@ def _apply_gate(qureg: Qureg, u: np.ndarray, targets: Sequence[int],
     n = qureg.num_qubits_represented
     targets = tuple(int(t) for t in targets)
     ctrl_mask, flip_mask = _bitmask(controls), _bitmask(flips)
+    if qureg.is_quad:
+        return _dd_gate(qureg, u, targets, ctrl_mask, flip_mask)
     lazy = _pg.use_lazy(qureg)
     if qureg.is_density_matrix and not ctrl_mask:
         # fused single pass: conj(U) (x) U on (targets, targets+n)
@@ -412,6 +415,27 @@ def _apply_gate(qureg: Qureg, u: np.ndarray, targets: Sequence[int],
                                    _shard(qureg))
 
 
+def _dd_gate(qureg: Qureg, u: np.ndarray, targets: tuple,
+             ctrl_mask: int, flip_mask: int) -> None:
+    """QUAD-register gate application: dense k-qubit dd kernels
+    (``ops/doubledouble.py``) with the same density-matrix dispatch shapes
+    as the native-precision path."""
+    n = qureg.num_qubits_represented
+    if qureg.is_density_matrix and not ctrl_mask:
+        u2 = np.kron(np.conj(u), u)
+        t2 = targets + tuple(t + n for t in targets)
+        qureg.state = ddm.dd_apply_kq(qureg.state, 2 * n, u2, t2)
+    elif qureg.is_density_matrix:
+        qureg.state = ddm.dd_apply_kq(qureg.state, 2 * n, u, targets,
+                                      ctrl_mask, flip_mask)
+        qureg.state = ddm.dd_apply_kq(qureg.state, 2 * n, np.conj(u),
+                                      tuple(t + n for t in targets),
+                                      ctrl_mask << n, flip_mask << n)
+    else:
+        qureg.state = ddm.dd_apply_kq(qureg.state, n, u, targets,
+                                      ctrl_mask, flip_mask)
+
+
 def _apply_diag_gate(qureg: Qureg, tensor: np.ndarray,
                      qubits: Sequence[int]) -> None:
     """Apply a diagonal factor tensor (axis i = i-th qubit of ``qubits``
@@ -425,6 +449,10 @@ def _apply_diag_gate(qureg: Qureg, tensor: np.ndarray,
     if qureg.is_density_matrix:
         tensor = np.multiply.outer(np.conj(tensor), tensor)
         qs = tuple(q + n for q in qs) + qs
+    if qureg.is_quad:
+        qureg.state = ddm.dd_apply_diag(
+            qureg.state, qureg.num_qubits_in_state_vec, tensor, qs)
+        return
     if _pg.use_lazy(qureg):
         _pg.sharded_diag(qureg, tensor, qs)
         return
@@ -545,7 +573,7 @@ def copyStateFromGPU(qureg: Qureg) -> None:
 def initBlankState(qureg: Qureg) -> None:
     _fresh(qureg)
     qureg.state = ist.blank(qureg.num_amps_total, qureg.real_dtype,
-                            qureg.sharding())
+                            qureg.sharding(), quad=qureg.is_quad)
     qureg.qasm_log.record_comment(
         "the register was set to the unphysical all-zero-amplitudes state")
 
@@ -553,7 +581,7 @@ def initBlankState(qureg: Qureg) -> None:
 def initZeroState(qureg: Qureg) -> None:
     _fresh(qureg)
     qureg.state = ist.zero(qureg.num_amps_total, qureg.real_dtype,
-                           qureg.sharding())
+                           qureg.sharding(), quad=qureg.is_quad)
     qureg.qasm_log.record_init_zero()
 
 
@@ -563,7 +591,7 @@ def initPlusState(qureg: Qureg) -> None:
         else (1.0 / np.sqrt(1 << n))
     _fresh(qureg)
     qureg.state = ist.plus(qureg.num_amps_total, qureg.real_dtype,
-                           qureg.sharding(), amp)
+                           qureg.sharding(), amp, quad=qureg.is_quad)
     qureg.qasm_log.record_init_plus()
 
 
@@ -574,7 +602,7 @@ def initClassicalState(qureg: Qureg, state_ind: int) -> None:
         if qureg.is_density_matrix else state_ind
     _fresh(qureg)
     qureg.state = ist.classical(qureg.num_amps_total, qureg.real_dtype,
-                                qureg.sharding(), idx)
+                                qureg.sharding(), idx, quad=qureg.is_quad)
     qureg.qasm_log.record_init_classical(state_ind)
 
 
@@ -584,7 +612,14 @@ def initPureState(qureg: Qureg, pure: Qureg) -> None:
                                pure.num_qubits_represented, "initPureState")
     _canon(pure)
     _fresh(qureg)
-    if qureg.is_density_matrix:
+    if qureg.is_quad:
+        if qureg.is_density_matrix:
+            # |psi><psi| as a dd outer product on device — the lo planes
+            # survive, so QUAD64 keeps its ~106-bit envelope
+            qureg.state = ddm.dd_outer(pure.state, conj_left=False)
+        else:
+            qureg.state = jnp.array(pure.state, copy=True)
+    elif qureg.is_density_matrix:
         qureg.state = _jit_outer(pure.state, _shard(qureg))
     else:
         qureg.state = jnp.array(pure.state, copy=True)
@@ -595,7 +630,7 @@ def initPureState(qureg: Qureg, pure: Qureg) -> None:
 def initDebugState(qureg: Qureg) -> None:
     _fresh(qureg)
     qureg.state = ist.debug(qureg.num_amps_total, qureg.real_dtype,
-                            qureg.sharding())
+                            qureg.sharding(), quad=qureg.is_quad)
 
 
 def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
@@ -613,9 +648,14 @@ def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
 def setAmps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
     val.validate_state_vec(qureg.is_density_matrix, "setAmps")
     val.validate_num_amps(qureg.num_amps_total, start_ind, num_amps, "setAmps")
-    vals = np.stack([np.asarray(reals, np.float64)[:num_amps],
-                     np.asarray(imags, np.float64)[:num_amps]])
+    re64 = np.asarray(reals, np.float64)[:num_amps]
+    im64 = np.asarray(imags, np.float64)[:num_amps]
     _canon(qureg)
+    if qureg.is_quad:
+        from .ops.doubledouble import _dd_split_host
+        vals = _dd_split_host(re64 + 1j * im64, qureg.real_dtype)
+    else:
+        vals = np.stack([re64, im64])
     qureg.state = qureg.state.at[:, start_ind:start_ind + num_amps].set(
         jnp.asarray(vals, qureg.real_dtype))
     qureg.qasm_log.record_comment("amplitudes were manually edited")
@@ -652,6 +692,13 @@ def setWeightedQureg(fac1, qureg1: Qureg, fac2, qureg2: Qureg,
     val.validate_matching_dims(qureg1.num_qubits_represented,
                                out.num_qubits_represented, "setWeightedQureg")
     rd = out.real_dtype
+    if out.is_quad:
+        out.state = ddm.dd_weighted(fac1, qureg1.state, fac2, qureg2.state,
+                                    fac_out, out.state)
+        out.qasm_log.record_comment(
+            "the register was set to a weighted combination "
+            "(possibly unphysical)")
+        return
     _canon(qureg1, qureg2, out)
     # donate out's buffer unless it aliases an input register's storage
     kernel = _jit_weighted if (out.state is not qureg1.state
@@ -676,7 +723,7 @@ def initStateOfSingleQubit(qureg: Qureg, qubit: int, outcome: int) -> None:
     _fresh(qureg)
     qureg.state = ist.single_qubit_outcome(
         qureg.num_amps_total, qureg.real_dtype, qureg.sharding(),
-        qubit, outcome)
+        qubit, outcome, quad=qureg.is_quad)
 
 
 # ---------------------------------------------------------------------------
@@ -913,6 +960,12 @@ def multiStateControlledUnitary(qureg: Qureg, controls: Sequence[int],
 def swapGate(qureg: Qureg, q1: int, q2: int) -> None:
     val.validate_unique_targets(qureg.num_qubits_represented, q1, q2, "swapGate")
     n = qureg.num_qubits_represented
+    if qureg.is_quad:
+        # dense dd application of the permutation matrix: multiplies by
+        # exact 0/1 entries, so it stays error-free
+        _dd_gate(qureg, mats.swap(), (int(q1), int(q2)), 0, 0)
+        qureg.qasm_log.record_gate("swap", q2, (q1,))
+        return
     if _pg.use_lazy(qureg):
         # on a mesh a SWAP is pure layout metadata — zero data movement
         # (the reference exchanges chunks, ``statevec_swapQubitAmps``
@@ -1142,6 +1195,16 @@ def calcExpecPauliProd(qureg: Qureg, targets: Sequence[int],
             # <psi|P|psi> only cares where the TARGETS live: probe the
             # physical positions, no exchange
             targets = _pg.phys_targets(qureg, targets)
+    if qureg.is_quad:
+        phi = qureg.state
+        nv = qureg.num_qubits_in_state_vec
+        for q, code in zip(targets, codes):
+            if code:
+                phi = ddm.dd_apply_kq(phi, nv, mats.PAULI_MATS[code], (q,))
+        if qureg.is_density_matrix:
+            return float(ddm.dd_total_prob_dm(
+                phi, qureg.num_qubits_represented))
+        return float(ddm.dd_vdot(qureg.state, phi).real)
     if qureg.is_density_matrix:
         value = _jit_expec_pauli_dm(qureg.state, qureg.num_qubits_in_state_vec,
                                     qureg.num_qubits_represented, targets, codes)
@@ -1163,6 +1226,22 @@ def calcExpecPauliSum(qureg: Qureg, all_codes: Sequence[int],
     val.validate_num_pauli_sum_terms(num_terms, "calcExpecPauliSum")
     val.validate_pauli_codes(all_codes, "calcExpecPauliSum")
     codes_flat = tuple(int(c) for c in all_codes[:num_terms * n])
+    if qureg.is_quad:
+        # inline dd loop: no per-term public-API re-entry or revalidation
+        nv = qureg.num_qubits_in_state_vec
+        value = 0.0
+        for t in range(num_terms):
+            phi = qureg.state
+            for q, code in enumerate(codes_flat[t * n:(t + 1) * n]):
+                if code:
+                    phi = ddm.dd_apply_kq(phi, nv, mats.PAULI_MATS[code],
+                                          (q,))
+            if qureg.is_density_matrix:
+                value += float(coeffs[t]) * ddm.dd_total_prob_dm(phi, n)
+            else:
+                value += float(coeffs[t]) * ddm.dd_vdot(qureg.state,
+                                                        phi).real
+        return value
     coeffs_f = jnp.asarray(np.asarray(coeffs[:num_terms], np.float64),
                            qureg.real_dtype)
     if qureg.layout is not None:
@@ -1201,6 +1280,24 @@ def applyPauliSum(in_qureg: Qureg, all_codes: Sequence[int],
     val.validate_pauli_codes(all_codes, "applyPauliSum")
     n = in_qureg.num_qubits_represented
     codes_flat = tuple(int(c) for c in all_codes[:num_terms * n])
+    if in_qureg.is_quad:
+        nv = in_qureg.num_qubits_in_state_vec
+        acc = None
+        for t in range(num_terms):
+            phi = in_qureg.state
+            for q, code in enumerate(codes_flat[t * n:(t + 1) * n]):
+                if code:
+                    phi = ddm.dd_apply_kq(phi, nv, mats.PAULI_MATS[code],
+                                          (q,))
+            acc = ddm.dd_weighted(float(coeffs[t]), phi, 0.0, phi, 0.0,
+                                  phi) if acc is None else \
+                ddm.dd_weighted(1.0, acc, float(coeffs[t]), phi, 0.0, acc)
+        _fresh(out_qureg)
+        out_qureg.state = acc
+        out_qureg.qasm_log.record_comment(
+            "the register was set to a Pauli-sum image "
+            "(possibly unphysical)")
+        return
     coeffs_f = jnp.asarray(np.asarray(coeffs[:num_terms], np.float64),
                            in_qureg.real_dtype)
     _canon(in_qureg)
@@ -1224,6 +1321,14 @@ def calcProbOfOutcome(qureg: Qureg, qubit: int, outcome: int) -> float:
             _canon(qureg)    # the diagonal view needs canonical order
         else:
             qubit = int(qureg.layout[qubit])   # probe the physical position
+    if qureg.is_quad:
+        if qureg.is_density_matrix:
+            p0 = ddm.dd_prob_zero_dm(qureg.state,
+                                     qureg.num_qubits_represented, qubit)
+        else:
+            p0 = ddm.dd_prob_zero_sv(qureg.state,
+                                     qureg.num_qubits_in_state_vec, qubit)
+        return p0 if outcome == 0 else 1.0 - p0
     if qureg.env.compensated:
         if qureg.is_density_matrix:
             p0 = _pair(_jit_pair_prob_zero_dm(
@@ -1242,6 +1347,11 @@ def calcProbOfOutcome(qureg: Qureg, qubit: int, outcome: int) -> float:
 
 
 def _collapse(qureg: Qureg, qubit: int, outcome: int, prob: float) -> None:
+    if qureg.is_quad:
+        qureg.state = ddm.dd_collapse(
+            qureg.state, qureg.num_qubits_in_state_vec, qubit, outcome,
+            float(prob), density=qureg.is_density_matrix)
+        return
     prob = jnp.asarray(prob, qureg.real_dtype)
     if qureg.layout is not None:
         if qureg.is_density_matrix:
@@ -1294,6 +1404,13 @@ def measure(qureg: Qureg, qubit: int) -> int:
     return outcome
 
 
+@jax.jit
+def _jit_dd_combine(planes4):
+    """(4, N) dd planes -> (2, N) hi-precision-collapsed planes (sampling
+    tolerance does not need the lo bits)."""
+    return jnp.stack([planes4[0] + planes4[1], planes4[2] + planes4[3]])
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _jit_sample(state_f, key, num_samples, density):
     """Inverse-CDF sampling of basis indices: one cumsum pass + a
@@ -1336,14 +1453,16 @@ def sampleOutcomes(qureg: Qureg, num_samples: int, qubits=None) -> np.ndarray:
         qubits = [int(q) for q in qubits]
         val.validate_multi_targets(n, qubits, "sampleOutcomes")
     _canon(qureg)
+    src_planes = _jit_dd_combine(qureg.state) if qureg.is_quad \
+        else qureg.state
     if qureg.is_density_matrix:
         # diagonal of the flat density vector via a reshape view (no
         # index vector: a materialised arange would overflow int32 on
         # x64-disabled backends once n >= 16)
-        planes = jnp.diagonal(qureg.state.reshape(2, 1 << n, 1 << n),
+        planes = jnp.diagonal(src_planes.reshape(2, 1 << n, 1 << n),
                               axis1=1, axis2=2)
     else:
-        planes = qureg.state
+        planes = src_planes
     idx_dev, total = _jit_sample(planes, qureg.env.next_key(),
                                  int(num_samples), qureg.is_density_matrix)
     if float(total) < qureg.env.precision.eps:
@@ -1391,7 +1510,10 @@ def _get_amp_pair(qureg: Qureg, index: int) -> complex:
     index = _pg.phys_index(qureg, index)
     idx_dt = jnp.int64 if (index > np.iinfo(np.int32).max
                            and jax.config.jax_enable_x64) else jnp.int32
-    pair = np.asarray(_jit_take_amp(qureg.state, jnp.asarray(index, idx_dt)))
+    pair = np.asarray(_jit_take_amp(qureg.state, jnp.asarray(index, idx_dt)),
+                      dtype=np.float64)
+    if qureg.is_quad:
+        return complex(pair[0] + pair[1], pair[2] + pair[3])
     return complex(pair[0], pair[1])
 
 
@@ -1425,6 +1547,11 @@ def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
 def calcTotalProb(qureg: Qureg) -> float:
     if qureg.is_density_matrix:
         _canon(qureg)    # the trace pairs row/column bits positionally
+    if qureg.is_quad:
+        if qureg.is_density_matrix:
+            return ddm.dd_total_prob_dm(qureg.state,
+                                        qureg.num_qubits_represented)
+        return ddm.dd_total_prob(qureg.state)
     if qureg.env.compensated:
         if qureg.is_density_matrix:
             return _pair(_jit_pair_total_prob_dm(
@@ -1442,6 +1569,8 @@ def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
     val.validate_matching_dims(bra.num_qubits_represented,
                                ket.num_qubits_represented, "calcInnerProduct")
     _canon(bra, ket)
+    if bra.is_quad:
+        return ddm.dd_vdot(bra.state, ket.state)
     if bra.env.compensated:
         re_pair, im_pair = _jit_pair_inner_product(bra.state, ket.state)
         return complex(_pair(re_pair), _pair(im_pair))
@@ -1456,6 +1585,8 @@ def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
                                rho2.num_qubits_represented,
                                "calcDensityInnerProduct")
     _canon(rho1, rho2)
+    if rho1.is_quad:
+        return ddm.dd_vdot(rho1.state, rho2.state).real
     if rho1.env.compensated:
         return _pair(_jit_pair_dm_inner(rho1.state, rho2.state))
     return float(_jit_dm_inner(rho1.state, rho2.state))
@@ -1463,6 +1594,8 @@ def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
 
 def calcPurity(qureg: Qureg) -> float:
     val.validate_density_matr(qureg.is_density_matrix, "calcPurity")
+    if qureg.is_quad:
+        return ddm.dd_total_prob(qureg.state)
     if qureg.env.compensated:
         return _pair(_jit_pair_sum_sq(qureg.state))
     return float(_jit_purity(qureg.state))
@@ -1475,6 +1608,13 @@ def calcFidelity(qureg: Qureg, pure_state: Qureg) -> float:
                                pure_state.num_qubits_represented,
                                "calcFidelity")
     _canon(qureg, pure_state)
+    if qureg.is_quad:
+        if qureg.is_density_matrix:
+            # <psi|rho|psi> = sum_rc rho[r,c] conj(psi_r) psi_c: a plain
+            # dd dot with the dd outer-product weights (lo planes kept)
+            w_planes = ddm.dd_outer(pure_state.state, conj_left=True)
+            return ddm.dd_vdot(w_planes, qureg.state, conj_a=False).real
+        return abs(ddm.dd_vdot(qureg.state, pure_state.state)) ** 2
     if qureg.is_density_matrix:
         if qureg.env.compensated:
             return _pair(_jit_pair_fidelity_dm(
@@ -1497,6 +1637,9 @@ def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
                                b.num_qubits_represented,
                                "calcHilbertSchmidtDistance")
     _canon(a, b)
+    if a.is_quad:
+        diff = ddm.dd_weighted(1.0, a.state, -1.0, b.state, 0.0, a.state)
+        return math.sqrt(max(0.0, ddm.dd_total_prob(diff)))
     if a.env.compensated:
         return math.sqrt(max(0.0, _pair(_jit_pair_hs_sq(a.state, b.state))))
     return float(_jit_hs_dist(a.state, b.state))
@@ -1511,6 +1654,12 @@ def _apply_kraus(qureg: Qureg, targets: Sequence[int], ops) -> None:
     (``densmatr_applyMultiQubitKrausSuperoperator``
     ``QuEST_common.c:598-604``)."""
     superop = dm.kraus_superoperator(ops)
+    if qureg.is_quad:
+        n = qureg.num_qubits_represented
+        t2 = tuple(int(t) for t in targets) \
+            + tuple(int(t) + n for t in targets)
+        qureg.state = ddm.dd_apply_kq(qureg.state, 2 * n, superop, t2)
+        return
     if _pg.use_lazy(qureg):
         n = qureg.num_qubits_represented
         t2 = tuple(int(t) for t in targets) \
@@ -1530,6 +1679,16 @@ def mixDephasing(qureg: Qureg, target: int, prob: float) -> None:
     val.validate_target(qureg.num_qubits_represented, target, "mixDephasing")
     val.validate_prob(prob, "mixDephasing", 0.5, "dephasing probability",
                       code=val.ErrorCode.E_INVALID_ONE_QUBIT_DEPHASE_PROB)
+    if qureg.is_quad:
+        retain = 1.0 - 2.0 * float(prob)
+        fac = np.array([[1.0, retain], [retain, 1.0]], dtype=np.complex128)
+        n = qureg.num_qubits_represented
+        qureg.state = ddm.dd_apply_diag(qureg.state, 2 * n, fac,
+                                        (target + n, target))
+        qureg.qasm_log.record_comment(
+            f"a phase (Z) error occurred on qubit {target} "
+            f"with probability {prob:g}")
+        return
     if _pg.use_lazy(qureg):
         # dephasing is diagonal on (target+n, target): position-free
         retain = 1.0 - 2.0 * float(prob)
@@ -1551,7 +1710,7 @@ def mixTwoQubitDephasing(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
     val.validate_prob(prob, "mixTwoQubitDephasing", 0.75,
                       "two-qubit dephasing probability",
                       code=val.ErrorCode.E_INVALID_TWO_QUBIT_DEPHASE_PROB)
-    if _pg.use_lazy(qureg):
+    if qureg.is_quad or _pg.use_lazy(qureg):
         # diagonal on (q1, q2, q1+n, q2+n): position-free, zero comm
         n = qureg.num_qubits_represented
         retain = 1.0 - (4.0 * float(prob)) / 3.0
@@ -1563,7 +1722,11 @@ def mixTwoQubitDephasing(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
                         if chi != rhi or clo != rlo:
                             fac[chi, clo, rhi, rlo] = retain
         hi, lo = max(q1, q2), min(q1, q2)
-        _pg.sharded_diag(qureg, fac, (hi + n, lo + n, hi, lo))
+        if qureg.is_quad:
+            qureg.state = ddm.dd_apply_diag(qureg.state, 2 * n, fac,
+                                            (hi + n, lo + n, hi, lo))
+        else:
+            _pg.sharded_diag(qureg, fac, (hi + n, lo + n, hi, lo))
         qureg.qasm_log.record_comment(
             f"a phase (Z) error occurred on qubits {q1} and/or {q2} "
             f"with total probability {prob:g}")
@@ -1625,6 +1788,11 @@ def mixDensityMatrix(qureg: Qureg, other_prob: float, other: Qureg) -> None:
                                other.num_qubits_represented,
                                "mixDensityMatrix")
     val.validate_prob(other_prob, "mixDensityMatrix")
+    if qureg.is_quad:
+        qureg.state = ddm.dd_weighted(1.0 - float(other_prob), qureg.state,
+                                      float(other_prob), other.state,
+                                      0.0, qureg.state)
+        return
     _canon(qureg, other)
     kernel = _jit_mix_linear if qureg.state is not other.state \
         else _jit_mix_linear_nodonate

@@ -80,7 +80,7 @@ def load(qureg: Qureg, path: str) -> None:
     import orbax.checkpoint as ocp
     with open(os.path.join(path, _META_NAME)) as f:
         _check_meta(json.load(f), qureg)
-    shape = (2, qureg.num_amps_total)
+    shape = (4 if qureg.is_quad else 2, qureg.num_amps_total)
     # the register's own sharding decision (falls back to replicated for
     # registers smaller than the mesh — mirrors Qureg.device_put)
     sharding = qureg.sharding()
@@ -104,4 +104,17 @@ def load_npz(qureg: Qureg, filename: str) -> None:
     with np.load(filename, allow_pickle=False) as data:
         _check_meta(json.loads(str(data["meta"])), qureg)
         host = data["state"].astype(qureg.real_dtype)
+    if qureg.is_quad:
+        # restore the (4, 2^n) dd planes verbatim — recombining through a
+        # complex vector would misread re_lo as the imaginary part
+        if host.shape[0] != 4:
+            raise ValueError(
+                "checkpoint holds 2-plane state but the register is a "
+                "quad (4-plane) register")
+        qureg.layout = None
+        sharding = qureg.sharding()
+        arr = jax.numpy.asarray(host)
+        qureg.state = jax.device_put(arr, sharding) \
+            if sharding is not None else arr
+        return
     qureg.device_put((host[0] + 1j * host[1]).astype(qureg.dtype))

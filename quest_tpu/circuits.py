@@ -1098,6 +1098,11 @@ class CompiledCircuit:
             raise ValueError(
                 f"circuit has {self.num_qubits} qubits; register state vector "
                 f"has {qureg.num_qubits_in_state_vec}")
+        if getattr(qureg, "is_quad", False):
+            raise ValueError(
+                "QUAD registers hold double-double planes; compile with "
+                "Circuit.compile_dd and run on its packed planes, or use "
+                "the imperative API (which routes to dd kernels)")
         qureg.ensure_canonical()   # compiled programs address canonical bits
         qureg.state = self._jitted(qureg.state, self._param_vec(params))
 

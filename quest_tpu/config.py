@@ -16,26 +16,42 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Precision", "SINGLE", "DOUBLE", "default_precision"]
+__all__ = ["Precision", "SINGLE", "DOUBLE", "QUAD", "QUAD64",
+           "default_precision"]
 
 
 @dataclasses.dataclass(frozen=True)
 class Precision:
     """Numeric precision bundle (mirrors qreal/REAL_EPS of the reference)."""
 
-    quest_prec: int  # 1 = single, 2 = double (reference QuEST_PREC values)
+    quest_prec: int  # 1=single, 2=double, 4=quad (reference QuEST_PREC)
     real_dtype: jnp.dtype
     complex_dtype: jnp.dtype
-    # REAL_EPS analogue (QuEST_precision.h: 1e-5 single / 1e-13 double)
+    # REAL_EPS analogue (QuEST_precision.h: 1e-5 single / 1e-13 double /
+    # 1e-14 quad)
     eps: float
 
     @property
     def name(self) -> str:
+        if self.quest_prec == 4:
+            # the two dd tiers have incompatible on-disk plane formats
+            return "quad" if self.real_dtype == jnp.dtype("float32") \
+                else "quad64"
         return {1: "single", 2: "double"}[self.quest_prec]
 
 
 SINGLE = Precision(1, jnp.dtype("float32"), jnp.dtype("complex64"), 1e-5)
 DOUBLE = Precision(2, jnp.dtype("float64"), jnp.dtype("complex128"), 1e-13)
+# QUAD: the ``QuEST_PREC=4`` analogue for hardware without an f64 ALU —
+# registers hold DOUBLE-DOUBLE amplitudes, four float planes
+# ``(4, 2^n) = [re_hi, re_lo, im_hi, im_lo]`` (~48-bit significand from
+# pure-f32 arithmetic; ops/doubledouble.py). ``real_dtype`` is the plane
+# dtype; host-visible amplitudes combine to complex128.
+QUAD = Precision(4, jnp.dtype("float32"), jnp.dtype("complex128"), 1e-13)
+# QUAD64: dd over float64 planes (~106-bit significand) — the full
+# quad-precision tier on x64-capable backends, REAL_EPS-class 1e-14
+# (``QuEST_precision.h:53-65``). Requires jax_enable_x64.
+QUAD64 = Precision(4, jnp.dtype("float64"), jnp.dtype("complex128"), 1e-14)
 
 
 def default_precision() -> Precision:

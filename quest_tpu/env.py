@@ -140,6 +140,12 @@ def create_quest_env(
     them for double.
     """
     precision = precision or default_precision()
+    if (precision.quest_prec == 4 and precision.real_dtype == "float64"
+            and not jax.config.jax_enable_x64):
+        raise ValueError(
+            "QUAD64 needs jax_enable_x64; without it JAX silently "
+            "downcasts the f64 planes and the quad tier quietly "
+            "degrades — use QUAD (f32 planes) on x64-less backends")
     if compensated is None:
         compensated = precision.quest_prec == 1
     devices = jax.devices()
@@ -172,9 +178,10 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     ``jax.devices()`` spans every host's chips, ``create_quest_env()``
     meshes over all of them, and the amplitude axis shards across the pod
     with XLA collectives riding ICI/DCN — no further code changes; the
-    same SPMD program runs on every process. Untestable on this
-    single-host rig; the mesh/collective path it feeds is exercised by
-    the 8-device tests and the driver's multichip dryrun."""
+    same SPMD program runs on every process. Exercised end-to-end by
+    ``tests/test_multihost.py``: 2- and 4-process coordinator-connected
+    CPU runs building one global mesh (sharded circuit, psum reductions,
+    broadcast seed agreement, allgathered reads)."""
     jax.distributed.initialize(coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

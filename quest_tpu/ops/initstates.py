@@ -48,24 +48,42 @@ def _index_bit(num_amps: int, qubit: int) -> jnp.ndarray:
     return bits
 
 
+def _dd_const(x: float, dt) -> tuple[float, float]:
+    from .doubledouble import _dd_scalar
+    return _dd_scalar(x, dt)
+
+
 @functools.lru_cache(maxsize=None)
 def _compiled(kind: str, num_amps: int, real_dtype: str, sharding,
-              extra: tuple = ()):
-    """One cached executable per (init kind, register geometry, mesh)."""
+              extra: tuple = (), quad: bool = False):
+    """One cached executable per (init kind, register geometry, mesh).
+
+    ``quad=True`` builds (4, 2^n) double-double planes instead — the
+    QUAD-tier register form (ops/doubledouble.py) — still device-side and
+    sharded, with dd-split constants so the lo planes carry the part of
+    each amplitude the hi dtype cannot."""
     dt = jnp.dtype(real_dtype)
+    n_planes = 4 if quad else 2
 
     def build(*dyn):
         if kind == "blank":
-            return jnp.zeros((2, num_amps), dt)
+            return jnp.zeros((n_planes, num_amps), dt)
         if kind == "zero":
-            return jnp.zeros((2, num_amps), dt).at[0, 0].set(1.0)
+            return jnp.zeros((n_planes, num_amps), dt).at[0, 0].set(1.0)
         if kind == "plus":
+            if quad:
+                amp_hi, amp_lo = extra
+                return jnp.stack(
+                    [jnp.full((num_amps,), amp_hi, dt),
+                     jnp.full((num_amps,), amp_lo, dt),
+                     jnp.zeros((num_amps,), dt),
+                     jnp.zeros((num_amps,), dt)])
             (amp,) = extra
             re = jnp.full((num_amps,), amp, dt)
             return jnp.stack([re, jnp.zeros((num_amps,), dt)])
         if kind == "classical":
             (idx,) = dyn
-            return jnp.zeros((2, num_amps), dt).at[0, idx].set(1.0)
+            return jnp.zeros((n_planes, num_amps), dt).at[0, idx].set(1.0)
         if kind == "debug":
             # amp[k] = (2k + i(2k+1))/10 (QuEST_cpu.c:1591-1593); k is
             # recombined from the split iotas in the target float dtype
@@ -73,12 +91,31 @@ def _compiled(kind: str, num_amps: int, real_dtype: str, sharding,
             hi = lax.broadcasted_iota(jnp.int32, (nhi, nlo), 0).astype(dt)
             lo = lax.broadcasted_iota(jnp.int32, (nhi, nlo), 1).astype(dt)
             k = (hi * nlo + lo).reshape(num_amps)
+            if quad:
+                # dd: re = k * dd(0.2); im = k * dd(0.2) + dd(0.1) — the
+                # constants carry the bits 1/10 loses in the hi dtype
+                from .doubledouble import _dd_add, _dd_mul
+                c2h, c2l, c1h, c1l = extra
+                zero = jnp.zeros_like(k)
+                re_h, re_l = _dd_mul(k, zero, jnp.full_like(k, c2h),
+                                     jnp.full_like(k, c2l))
+                im_h, im_l = _dd_add(re_h, re_l, jnp.full_like(k, c1h),
+                                     jnp.full_like(k, c1l))
+                return jnp.stack([re_h, re_l, im_h, im_l])
             return jnp.stack([(2.0 * k) / 10.0, (2.0 * k + 1.0) / 10.0])
         if kind == "single_qubit_outcome":
-            qubit, outcome = extra
-            amp = 1.0 / np.sqrt(num_amps // 2)
-            re = jnp.where(_index_bit(num_amps, qubit) == outcome, amp,
-                           0.0).astype(dt).reshape(num_amps)
+            if quad:
+                qubit, outcome, amp_hi, amp_lo = extra
+            else:
+                qubit, outcome = extra
+                amp_hi = 1.0 / np.sqrt(num_amps // 2)
+            cond = _index_bit(num_amps, qubit) == outcome
+            re = jnp.where(cond, amp_hi, 0.0).astype(dt).reshape(num_amps)
+            if quad:
+                re_l = jnp.where(cond, amp_lo,
+                                 0.0).astype(dt).reshape(num_amps)
+                z = jnp.zeros((num_amps,), dt)
+                return jnp.stack([re, re_l, z, z])
             return jnp.stack([re, jnp.zeros((num_amps,), dt)])
         raise ValueError(kind)
 
@@ -91,31 +128,41 @@ def _dt_name(real_dtype) -> str:
     return np.dtype(real_dtype).name
 
 
-def blank(num_amps, real_dtype, sharding):
-    return _compiled("blank", num_amps, _dt_name(real_dtype), sharding)()
+def blank(num_amps, real_dtype, sharding, quad: bool = False):
+    return _compiled("blank", num_amps, _dt_name(real_dtype), sharding,
+                     quad=quad)()
 
 
-def zero(num_amps, real_dtype, sharding):
-    return _compiled("zero", num_amps, _dt_name(real_dtype), sharding)()
+def zero(num_amps, real_dtype, sharding, quad: bool = False):
+    return _compiled("zero", num_amps, _dt_name(real_dtype), sharding,
+                     quad=quad)()
 
 
-def plus(num_amps, real_dtype, sharding, amp: float):
+def plus(num_amps, real_dtype, sharding, amp: float, quad: bool = False):
+    extra = _dd_const(amp, real_dtype) if quad else (float(amp),)
     return _compiled("plus", num_amps, _dt_name(real_dtype), sharding,
-                     (float(amp),))()
+                     extra, quad=quad)()
 
 
-def classical(num_amps, real_dtype, sharding, index: int):
+def classical(num_amps, real_dtype, sharding, index: int,
+              quad: bool = False):
     idx_dt = jnp.int64 if (index > np.iinfo(np.int32).max
                            and jax.config.jax_enable_x64) else jnp.int32
     return _compiled("classical", num_amps, _dt_name(real_dtype),
-                     sharding)(jnp.asarray(index, idx_dt))
+                     sharding, quad=quad)(jnp.asarray(index, idx_dt))
 
 
-def debug(num_amps, real_dtype, sharding):
-    return _compiled("debug", num_amps, _dt_name(real_dtype), sharding)()
+def debug(num_amps, real_dtype, sharding, quad: bool = False):
+    extra = (_dd_const(0.2, real_dtype) + _dd_const(0.1, real_dtype)) \
+        if quad else ()
+    return _compiled("debug", num_amps, _dt_name(real_dtype), sharding,
+                     extra, quad=quad)()
 
 
 def single_qubit_outcome(num_amps, real_dtype, sharding, qubit: int,
-                         outcome: int):
+                         outcome: int, quad: bool = False):
+    amp = 1.0 / np.sqrt(num_amps // 2)
+    extra = (int(qubit), int(outcome)) + (_dd_const(amp, real_dtype)
+                                          if quad else ())
     return _compiled("single_qubit_outcome", num_amps, _dt_name(real_dtype),
-                     sharding, (int(qubit), int(outcome)))()
+                     sharding, extra, quad=quad)()

@@ -47,8 +47,11 @@ RELAYOUT_COUNT = 0
 
 
 def use_lazy(qureg) -> bool:
-    """True when the register runs the sharded per-gate path."""
-    return qureg.env.mesh is not None and qureg.sharding() is not None
+    """True when the register runs the sharded per-gate path. QUAD
+    registers are excluded: their (4, 2^n) dd planes run the dedicated
+    dd kernels (GSPMD-sharded), not the lazy-layout machinery."""
+    return (qureg.env.mesh is not None and qureg.sharding() is not None
+            and not qureg.is_quad)
 
 
 def fits_local(qureg, k: int) -> bool:

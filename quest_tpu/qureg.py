@@ -72,6 +72,12 @@ class Qureg:
         """Storage dtype of the split re/im planes."""
         return self.env.precision.real_dtype
 
+    @property
+    def is_quad(self) -> bool:
+        """True for QUAD registers: (4, 2^N) double-double planes
+        (``ops/doubledouble.py``), the QuEST_PREC=4 analogue."""
+        return self.env.precision.quest_prec == 4
+
     def sharding(self):
         """Amplitude sharding for this register: the env mesh sharding, or
         None when the register has fewer amplitudes than the mesh has devices
@@ -97,7 +103,11 @@ class Qureg:
                 f"state array has shape {host_array.shape}; this register "
                 f"holds {self.num_amps_total} amplitudes")
         self.layout = None       # full overwrite in canonical order
-        arr = pack_host(host_array, self.real_dtype)
+        if self.is_quad:
+            from .ops.doubledouble import _dd_split_host
+            arr = _dd_split_host(host_array, self.real_dtype)
+        else:
+            arr = pack_host(host_array, self.real_dtype)
         sharding = self.sharding()
         if sharding is not None and self.env.is_multihost:
             # multi-host: each process materialises only ITS addressable
@@ -145,8 +155,13 @@ class Qureg:
             from jax.experimental import multihost_utils
             gathered = multihost_utils.process_allgather(self._state,
                                                          tiled=True)
-            return unpack_host(np.asarray(gathered))
-        return unpack_host(np.asarray(self._state))
+            host = np.asarray(gathered)
+        else:
+            host = np.asarray(self._state)
+        if self.is_quad:
+            from .ops.doubledouble import dd_unpack
+            return dd_unpack(host)
+        return unpack_host(host)
 
     def density_matrix_numpy(self) -> np.ndarray:
         """rho[r, c] view of a density register (host-side)."""

@@ -134,3 +134,29 @@ class TestProfiling:
             qt.hadamard(q, 0)
             q.state.block_until_ready()
         assert any(p for p in os.listdir(tmp_path / "trace"))
+
+
+def test_checkpoint_roundtrip_quad(tmp_path):
+    """Regression: quad (4-plane) registers must round-trip verbatim —
+    recombining planes through a complex vector would misread re_lo as
+    the imaginary part."""
+    import quest_tpu as qt
+    from quest_tpu.config import QUAD
+    from quest_tpu import checkpoint as ckpt
+    env = qt.createQuESTEnv(num_devices=1, precision=QUAD, seed=[5])
+    q = qt.createQureg(4, env)
+    qt.initPlusState(q)
+    qt.rotateY(q, 2, 0.3)
+    qt.tGate(q, 1)
+    before = q.to_numpy()
+    path = str(tmp_path / "quad_ck")
+    ckpt.save_npz(q, path + ".npz")
+    r = qt.createQureg(4, env)
+    qt.initZeroState(r)
+    ckpt.load_npz(r, path + ".npz")
+    np.testing.assert_array_equal(np.asarray(r.state), np.asarray(q.state))
+    np.testing.assert_allclose(r.to_numpy(), before, atol=0)
+    # plane-count mismatch is loud, not silent
+    d = qt.createQureg(4, qt.createQuESTEnv(num_devices=1, seed=[5]))
+    with pytest.raises(Exception):
+        ckpt.load_npz(d, path + ".npz")
