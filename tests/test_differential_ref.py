@@ -225,22 +225,24 @@ def ref():
     return Ref(_load(LIB))
 
 
-@pytest.mark.parametrize("seed", [11, 22, 33])
-@pytest.mark.parametrize("density", [False, True],
-                         ids=["statevec", "density"])
-def test_differential_random_sequence(env, ref, seed, density):
+def _diff_sequence(envx, ref, seed, density, check_every=True):
     rng = np.random.default_rng(seed)
     moves = _build_moves(rng, density)
 
-    q = qt.createDensityQureg(N, env) if density else qt.createQureg(N, env)
+    q = qt.createDensityQureg(N, envx) if density else qt.createQureg(N, envx)
     qt.initPlusState(q)
     rq = ref.prepare("P" if density else "p", N)
     try:
         for i, (name, fw, ref_name, args) in enumerate(moves):
             fw(q)
             ADAPTERS[ref_name](ref, rq, args)
+            if check_every:
+                err = np.max(np.abs(q.to_numpy() - ref.state(rq)))
+                assert err < 1e-10, \
+                    f"seed {seed} op {i} ({name}): |Δ|={err:.2e}"
+        if not check_every:
             err = np.max(np.abs(q.to_numpy() - ref.state(rq)))
-            assert err < 1e-10, f"seed {seed} op {i} ({name}): |Δ|={err:.2e}"
+            assert err < 1e-10, f"seed {seed} final: |Δ|={err:.2e}"
         # scalar cross-checks at the end
         assert abs(qt.calcTotalProb(q)
                    - ref.lib.calcTotalProb(rq)) < 1e-10
@@ -249,6 +251,36 @@ def test_differential_random_sequence(env, ref, seed, density):
                        - ref.lib.calcProbOfOutcome(rq, t, 1)) < 1e-10
     finally:
         ref.lib.destroyQureg(rq, ref.env)
+
+
+@pytest.mark.parametrize("seed", [11, 22, 33])
+@pytest.mark.parametrize("density", [False, True],
+                         ids=["statevec", "density"])
+def test_differential_random_sequence(env, ref, seed, density):
+    _diff_sequence(env, ref, seed, density)
+
+
+@pytest.mark.parametrize("seed", [44, 66])
+@pytest.mark.parametrize("density", [False, True],
+                         ids=["statevec", "density"])
+def test_differential_mesh_lazy_path(mesh_env, ref, seed, density):
+    """The lazy per-gate layout (parallel/pergate.py) vs the reference
+    binary: N=4 on 8 devices leaves ONE local position, so the sequence
+    mixes role-split cross-shard 1q gates, GSPMD fallbacks for k>=2, and
+    a canonicalising to_numpy after EVERY op — the densest possible
+    exercise of layout bookkeeping."""
+    _diff_sequence(mesh_env, ref, seed, density)
+
+
+@pytest.mark.parametrize("density", [False, True],
+                         ids=["statevec", "density"])
+def test_differential_quad_tier(ref, density):
+    """QUAD (dd-f32) registers vs the reference f64 binary at the
+    reference's own 1e-10 tolerance — pure-f32 hardware arithmetic
+    matching an f64 implementation op-for-op."""
+    from quest_tpu.config import QUAD
+    envq = qt.createQuESTEnv(num_devices=1, precision=QUAD, seed=[9])
+    _diff_sequence(envq, ref, 88, density, check_every=False)
 
 
 @pytest.mark.parametrize("density", [False, True],

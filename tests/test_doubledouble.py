@@ -373,3 +373,25 @@ class TestQuadTier:
         qt.initPureState(d, a)
         f = qt.calcFidelity(d, b)
         assert abs(f - abs(np.vdot(va, vb)) ** 2) < 1e-12
+
+    def test_quad_register_on_mesh(self, mesh_env, rng):
+        """QUAD registers shard their (4, 2^n) planes over the mesh via
+        GSPMD; results must match the single-device quad path."""
+        import quest_tpu as qt
+        from quest_tpu.config import QUAD
+        env1 = qt.createQuESTEnv(num_devices=1, precision=QUAD, seed=[3])
+        env8 = qt.createQuESTEnv(num_devices=8, precision=QUAD, seed=[3])
+        n = 7
+        u = np.linalg.qr(rng.normal(size=(4, 4))
+                         + 1j * rng.normal(size=(4, 4)))[0]
+        outs = []
+        for e in (env1, env8):
+            q = qt.createQureg(n, e)
+            qt.initPlusState(q)
+            qt.hadamard(q, n - 1)
+            qt.twoQubitUnitary(q, n - 1, 0, u)
+            qt.controlledNot(q, n - 1, 1)
+            qt.tGate(q, n - 2)
+            outs.append((q.to_numpy(), qt.calcTotalProb(q)))
+        np.testing.assert_allclose(outs[1][0], outs[0][0], atol=1e-13)
+        assert outs[1][1] == pytest.approx(outs[0][1], abs=1e-13)

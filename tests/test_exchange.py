@@ -150,6 +150,20 @@ for circ, label in ((brick, "brickwork"), (qft(n), "qft")):
     vec = jnp.zeros((0,), dtype=jnp.float64)
     f._jitted.lower(state, vec).compile()
     print(f"compiled {label} relayouts={f.plan.num_relayouts}")
+
+# the variational energy path (run_plan + Pauli products + vdot) must
+# also stay remat-free on the mesh
+c2 = Circuit(n)
+t = c2.parameter("t")
+for q in range(n):
+    c2.ry(q, t)
+for q in range(n - 1):
+    c2.cnot(q, q + 1)
+terms = [[(q, 3)] for q in range(n)] + [[(n - 1, 1), (0, 2)]]
+efn = c2.compile(env).expectation_fn(terms, [1.0] * len(terms))
+import numpy as np
+float(efn(np.array([0.3])))
+print("compiled expectation")
 print("DONE")
 """
 
