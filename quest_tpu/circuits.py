@@ -989,6 +989,38 @@ class CompiledCircuit:
         plan_items = self.plan.items
         flat_sharding = env.sharding_flat() if shard_bits else None
 
+        def run_plan_seq(state, params):
+            """Sequential (single-trace) form: relayouts as plain
+            transposes, no collectives. The compiled path on a mesh uses
+            the shard_map program instead; this form serves vmapped uses
+            (sweep), where the BATCH axis is the parallel axis and
+            collectives inside the per-element program cannot be
+            vmapped."""
+            for item in plan_items:
+                if item[0] == "relayout":
+                    _, before, after = item
+                    state = apply_relayout(state, n, before, after, None)
+                    continue
+                _, i, phys_targets, cmask, fmask, axis_order = item
+                op = ops[i]
+                if op.kind == "layer":
+                    from .ops import pallas_kernels as pk
+                    state = pk.apply_layer(
+                        state, n, op, interpret=self._pallas_interpret)
+                elif op.kind == "u":
+                    u = op.mat_fn(params) if op.mat_fn is not None \
+                        else op.mat
+                    state = apply_unitary(state, n, u, phys_targets,
+                                          cmask, fmask)
+                else:
+                    d = op.diag_fn(params) if op.diag_fn is not None \
+                        else op.diag
+                    d = jnp.transpose(jnp.asarray(d), axis_order)
+                    state = apply_diagonal(state, n, phys_targets, d)
+            return state
+
+        self._run_plan_seq = run_plan_seq
+
         if shard_bits:
             # the distributed fast path: ONE shard_map program — local
             # kernels on per-device chunks, relayouts as explicit
@@ -1031,30 +1063,7 @@ class CompiledCircuit:
             def run_plan(state, params):
                 return sharded_body(state, params)
         else:
-            def run_plan(state, params):
-                for item in plan_items:
-                    if item[0] == "relayout":
-                        _, before, after = item
-                        state = apply_relayout(state, n, before, after,
-                                               flat_sharding)
-                        continue
-                    _, i, phys_targets, cmask, fmask, axis_order = item
-                    op = ops[i]
-                    if op.kind == "layer":
-                        from .ops import pallas_kernels as pk
-                        state = pk.apply_layer(
-                            state, n, op, interpret=self._pallas_interpret)
-                    elif op.kind == "u":
-                        u = op.mat_fn(params) if op.mat_fn is not None \
-                            else op.mat
-                        state = apply_unitary(state, n, u, phys_targets,
-                                              cmask, fmask)
-                    else:
-                        d = op.diag_fn(params) if op.diag_fn is not None \
-                            else op.diag
-                        d = jnp.transpose(jnp.asarray(d), axis_order)
-                        state = apply_diagonal(state, n, phys_targets, d)
-                return state
+            run_plan = run_plan_seq
 
         self._run_plan = run_plan
         self._flat_sharding = flat_sharding
@@ -1166,13 +1175,13 @@ class CompiledCircuit:
 
         ``param_matrix``: ``(B, len(param_names))``. ``state_f``: packed
         planes shared by every run (default |0..0>). Returns ``(B, 2,
-        2^n)`` packed planes — ``jax.vmap`` over :meth:`apply`, so the
-        batch dimension rides the MXU instead of a Python loop (the VQE /
-        phase-diagram sweep workload; no reference counterpart). On a
-        mesh env, vmapped controlled gates currently draw an SPMD
-        repartition warning (XLA replicates one scatter) — results are
-        correct; prefer a single-device env for wide sweeps of small
-        circuits."""
+        2^n)`` packed planes — ``jax.vmap`` over the sequential program
+        form, so the batch dimension rides the MXU instead of a Python
+        loop (the VQE / phase-diagram sweep workload; no reference
+        counterpart). On a mesh env the BATCH axis shards over the
+        devices when divisible (sweeps are embarrassingly parallel — the
+        amplitude-sharded shard_map program cannot be vmapped and would
+        be the wrong layout anyway)."""
         pm = jnp.asarray(param_matrix, dtype=self.env.precision.real_dtype)
         if pm.ndim != 2 or pm.shape[1] != len(self.param_names):
             raise ValueError(
@@ -1187,8 +1196,19 @@ class CompiledCircuit:
         # donated across a vmapped batch. Cached so repeat sweeps (an
         # optimiser loop) hit the jit cache instead of retracing.
         if not hasattr(self, "_sweep_jitted"):
+            def seq_apply(sf, vec):
+                params = {nm: vec[i]
+                          for i, nm in enumerate(self.param_names)}
+                return pack(self._run_plan_seq(unpack(sf), params))
+
             self._sweep_jitted = jax.jit(
-                jax.vmap(self._apply_fn, in_axes=(None, 0)))
+                jax.vmap(seq_apply, in_axes=(None, 0)))
+        if (self.env.mesh is not None
+                and pm.shape[0] % self.env.num_devices == 0):
+            from jax.sharding import NamedSharding, PartitionSpec
+            from .env import AMP_AXIS
+            pm = jax.device_put(pm, NamedSharding(
+                self.env.mesh, PartitionSpec(AMP_AXIS, None)))
         return self._sweep_jitted(state_f, pm)
 
     def __repr__(self) -> str:

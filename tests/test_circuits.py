@@ -255,3 +255,23 @@ def test_inverse_rejects_channels(env):
     c.damp(0, 0.1)
     with pytest.raises(ValueError, match="channels"):
         c.inverse()
+
+
+def test_sweep_on_mesh_with_relayouts(env, mesh_env):
+    """Regression: sweep on a mesh env must not vmap the shard_map
+    program (lax.all_to_all has no batching rule) — it runs the
+    sequential form with the BATCH axis sharded over the devices."""
+    from quest_tpu.circuits import Circuit
+    n = 7
+    c = Circuit(n)
+    t = c.parameter("t")
+    for q in range(n):
+        c.ry(q, t)
+    c.cnot(n - 1, 0)          # sharded target: the compiled plan relayouts
+    c.h(n - 1)
+    pm = np.linspace(0.0, 2.0, 16)[:, None]
+    outs = [np.asarray(c.compile(e).sweep(pm)) for e in (env, mesh_env)]
+    np.testing.assert_allclose(outs[1], outs[0], atol=1e-12)
+    # non-divisible batches stay correct (replicated fallback)
+    odd = np.asarray(c.compile(mesh_env).sweep(pm[:13]))
+    np.testing.assert_allclose(odd, outs[0][:13], atol=1e-12)

@@ -26,6 +26,7 @@ _PIN = (
     "shor.py",
     "noisy_trajectories.py",
     "qaoa.py",
+    "quad_precision.py",
 ])
 def test_example_runs(script):
     path = os.path.join(EXAMPLES, script)

@@ -70,7 +70,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(nprocs: int, devices_per_proc: int) -> list[dict]:
+def _launch(nprocs: int, devices_per_proc: int, worker: str = None,
+            extra_argv: tuple = ()) -> list[dict]:
+    """Start ``nprocs`` coordinator-connected workers and collect one
+    RESULT line from each. On ANY failure (timeout, nonzero exit, missing
+    RESULT) every remaining worker is killed — a crashed rank must not
+    leave its peers blocked in the jax.distributed barrier."""
     port = _free_port()
     env = dict(
         __import__("os").environ,
@@ -78,21 +83,65 @@ def _launch(nprocs: int, devices_per_proc: int) -> list[dict]:
         JAX_PLATFORMS="cpu",
     )
     procs = [subprocess.Popen(
-        [sys.executable, "-c", WORKER, str(i), str(nprocs), str(port)],
+        [sys.executable, "-c", worker or WORKER, str(i), str(nprocs),
+         str(port), *map(str, extra_argv)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for i in range(nprocs)]
     results = []
-    for p in procs:
-        try:
+    try:
+        for p in procs:
             out, err = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for pp in procs:
+            assert p.returncode == 0, err[-3000:]
+            line = next(l for l in out.splitlines()
+                        if l.startswith("RESULT "))
+            results.append(json.loads(line[len("RESULT "):]))
+    finally:
+        for pp in procs:
+            if pp.poll() is None:
                 pp.kill()
-            raise
-        assert p.returncode == 0, err[-3000:]
-        line = next(l for l in out.splitlines() if l.startswith("RESULT "))
-        results.append(json.loads(line[len("RESULT "):]))
     return results
+
+
+GOLDEN_WORKER = r"""
+import glob, json, os, sys
+proc_id = int(sys.argv[1]); nprocs = int(sys.argv[2]); port = sys.argv[3]
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import quest_tpu as qt
+from quest_tpu.testing import run_file
+
+qt.initialize_multihost(f"localhost:{port}", num_processes=nprocs,
+                        process_id=proc_id)
+env = qt.createQuESTEnv(num_devices=len(jax.devices()), seed=[12345])
+assert env.is_multihost
+here = os.path.dirname(os.path.abspath(sys.argv[4]))
+files = sorted(glob.glob(os.path.join(here, "golden", "*.test")))
+# a representative slice: 1q + controlled + multiqubit + measurement +
+# channel + reduction coverage without replaying all 65 files per process
+names = {"hadamard", "controlledNot", "multiQubitUnitary", "swapGate",
+         "collapseToOutcome", "mixDepolarising", "calcTotalProb",
+         "calcFidelity"}
+picked = [f for f in files
+          if os.path.splitext(os.path.basename(f))[0] in names]
+assert len(picked) == len(names), picked
+fails = []
+for path in picked:
+    fails.extend(run_file(path, env))
+print("RESULT " + json.dumps({"rank": proc_id, "failures": len(fails),
+                              "files": len(picked)}), flush=True)
+"""
+
+
+def test_multihost_golden_replay():
+    """The reference tests its distributed build by replaying the SAME
+    golden suite under mpiexec (`utilities/CMakeLists.txt:40-42`); here a
+    representative golden slice replays under a genuine 2-process
+    jax.distributed run against files generated single-device."""
+    results = _launch(2, 2, worker=GOLDEN_WORKER, extra_argv=(__file__,))
+    for r in results:
+        assert r["failures"] == 0, r
+        assert r["files"] == 8
 
 
 @pytest.mark.parametrize("nprocs,devs", [(2, 1), (2, 2), (4, 1)])
