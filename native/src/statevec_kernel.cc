@@ -74,8 +74,13 @@ void dense_range(double* re, double* im, const DenseOp& op,
 }
 
 // 1-qubit fast path: the whole simulator's hot loop. Pair (i, i+2^q),
-// iterated as j over 2^(n-1) with one shift to re-insert the target bit.
-void dense1_range(double* re, double* im, const DenseOp& op, int target,
+// iterated as j over 2^(n-1). Runs of j sharing the same high bits give
+// CONTIGUOUS i0/i1 ranges, so the inner loop is written over those runs
+// with restrict-qualified pointers — the compiler auto-vectorizes it
+// (AVX-512 on this host), which the old computed-index single loop
+// defeated.
+void dense1_range(double* __restrict re, double* __restrict im,
+                  const DenseOp& op, int target,
                   int64_t j_lo, int64_t j_hi) {
   const int64_t stride = int64_t(1) << target;
   const int64_t lo_mask = stride - 1;
@@ -84,16 +89,37 @@ void dense1_range(double* re, double* im, const DenseOp& op, int target,
   const double u10r = op.mat[4], u10i = op.mat[5];
   const double u11r = op.mat[6], u11i = op.mat[7];
   const bool ctrl = op.ctrl_mask != 0;
-  for (int64_t j = j_lo; j < j_hi; ++j) {
-    const int64_t i0 = ((j & ~lo_mask) << 1) | (j & lo_mask);
-    if (ctrl && (i0 & op.ctrl_mask) != op.ctrl_want) continue;
-    const int64_t i1 = i0 | stride;
-    const double xr = re[i0], xi = im[i0];
-    const double yr = re[i1], yi = im[i1];
-    re[i0] = u00r * xr - u00i * xi + u01r * yr - u01i * yi;
-    im[i0] = u00r * xi + u00i * xr + u01r * yi + u01i * yr;
-    re[i1] = u10r * xr - u10i * xi + u11r * yr - u11i * yi;
-    im[i1] = u10r * xi + u10i * xr + u11r * yi + u11i * yr;
+  int64_t j = j_lo;
+  while (j < j_hi) {
+    const int64_t t0 = j & lo_mask;
+    int64_t run = stride - t0;
+    if (run > j_hi - j) run = j_hi - j;
+    const int64_t i0base = ((j & ~lo_mask) << 1) | t0;
+    double* __restrict re0 = re + i0base;
+    double* __restrict im0 = im + i0base;
+    double* __restrict re1 = re + (i0base | stride);
+    double* __restrict im1 = im + (i0base | stride);
+    if (!ctrl) {
+      for (int64_t t = 0; t < run; ++t) {
+        const double xr = re0[t], xi = im0[t];
+        const double yr = re1[t], yi = im1[t];
+        re0[t] = u00r * xr - u00i * xi + u01r * yr - u01i * yi;
+        im0[t] = u00r * xi + u00i * xr + u01r * yi + u01i * yr;
+        re1[t] = u10r * xr - u10i * xi + u11r * yr - u11i * yi;
+        im1[t] = u10r * xi + u10i * xr + u11r * yi + u11i * yr;
+      }
+    } else {
+      for (int64_t t = 0; t < run; ++t) {
+        if (((i0base + t) & op.ctrl_mask) != op.ctrl_want) continue;
+        const double xr = re0[t], xi = im0[t];
+        const double yr = re1[t], yi = im1[t];
+        re0[t] = u00r * xr - u00i * xi + u01r * yr - u01i * yi;
+        im0[t] = u00r * xi + u00i * xr + u01r * yi + u01i * yr;
+        re1[t] = u10r * xr - u10i * xi + u11r * yr - u11i * yi;
+        im1[t] = u10r * xi + u10i * xr + u11r * yi + u11i * yr;
+      }
+    }
+    j += run;
   }
 }
 

@@ -37,10 +37,14 @@ def build_and_load(src_name: str, lib_path: str,
     (so clearing the variable re-enables native in-process) — this
     function only builds and loads.
     """
-    if not os.path.exists(lib_path):
-        src = os.path.abspath(os.path.join(
-            os.path.dirname(__file__), os.pardir, os.pardir,
-            "native", "src", src_name))
+    src = os.path.abspath(os.path.join(
+        os.path.dirname(__file__), os.pardir, os.pardir,
+        "native", "src", src_name))
+    stale = (os.path.exists(lib_path) and os.path.exists(src)
+             and os.path.getmtime(src) > os.path.getmtime(lib_path))
+    if not os.path.exists(lib_path) or stale:
+        # mtime invalidation: a cached .so from before a kernel change
+        # would otherwise be dlopened silently forever
         if not os.path.exists(src):
             return None
         cmd = [os.environ.get("CXX", "g++"), "-O2", "-std=c++17", "-fPIC",

@@ -50,8 +50,11 @@ def load() -> Optional[ctypes.CDLL]:
         return _lib
     if _load_failed:
         return None
+    # -march=native is safe here: the library is JIT-built by g++ on the
+    # machine it runs on (never shipped), and the pair loop's contiguous
+    # inner runs are written to auto-vectorize (AVX-512 on this host)
     lib = build_and_load("statevec_kernel.cc", _LIB_PATH,
-                         extra_flags=("-O3", "-pthread"))
+                         extra_flags=("-O3", "-pthread", "-march=native"))
     if lib is None:
         _load_failed = True
         return None
