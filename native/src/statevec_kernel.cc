@@ -130,16 +130,35 @@ struct DiagOp {
   const double* table;              // interleaved re,im, 2^k entries
 };
 
-void diag_range(double* re, double* im, const DiagOp& op,
-                int64_t i_lo, int64_t i_hi) {
-  for (int64_t i = i_lo; i < i_hi; ++i) {
-    if ((i & op.ctrl_mask) != op.ctrl_want) continue;
-    int m = 0;
-    for (int b = 0; b < op.k; ++b) m |= int((i >> op.targets[b]) & 1) << b;
-    const double dr = op.table[2 * m], di = op.table[2 * m + 1];
-    const double xr = re[i], xi = im[i];
-    re[i] = dr * xr - di * xi;
-    im[i] = dr * xi + di * xr;
+void diag_range(double* __restrict re, double* __restrict im,
+                const DiagOp& op, int64_t i_lo, int64_t i_hi) {
+  // All indices sharing the bits above the LOWEST target/control bit see
+  // the same table entry and control verdict, so the multiply runs over
+  // contiguous blocks with a constant factor — auto-vectorizable (the
+  // per-element bit-gather of the old loop was not).
+  int64_t relevant = op.ctrl_mask;
+  for (int b = 0; b < op.k; ++b) relevant |= int64_t(1) << op.targets[b];
+  const int min_bit = relevant ? __builtin_ctzll(uint64_t(relevant)) : 62;
+  const int64_t blk = int64_t(1) << min_bit;
+  int64_t i = i_lo;
+  while (i < i_hi) {
+    const int64_t off = i & (blk - 1);
+    int64_t run = blk - off;
+    if (run > i_hi - i) run = i_hi - i;
+    if ((i & op.ctrl_mask) == op.ctrl_want) {
+      int m = 0;
+      for (int b = 0; b < op.k; ++b)
+        m |= int((i >> op.targets[b]) & 1) << b;
+      const double dr = op.table[2 * m], di = op.table[2 * m + 1];
+      double* __restrict r = re + i;
+      double* __restrict x = im + i;
+      for (int64_t t = 0; t < run; ++t) {
+        const double xr = r[t], xi = x[t];
+        r[t] = dr * xr - di * xi;
+        x[t] = dr * xi + di * xr;
+      }
+    }
+    i += run;
   }
 }
 
