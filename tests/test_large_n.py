@@ -280,3 +280,37 @@ def test_large_n_density_gate_by_gate(mesh_env):
     # purity decreased under the channels, physical bounds hold
     pur = qt.calcPurity(q)
     assert 1.0 / (1 << n) - 1e-10 <= pur < 1.0
+
+
+@pytest.mark.slow
+def test_large_n_lazy_layout_economy(mesh_env):
+    """VERDICT r4 #6 done-criterion at full width: a 20-qubit gate-by-gate
+    burst touching sharded positions pays MEASURABLY fewer relayout
+    exchanges than it has sharded-qubit touches (swaps are metadata, 1q
+    gates ride the role-split exchange, diagonals are free; only the
+    final canonicalising read moves data wholesale)."""
+    from quest_tpu.parallel import pergate as pg
+    rng = np.random.default_rng(7)
+    q = qt.createQureg(N, mesh_env)
+    qt.initPlusState(q)
+    count0 = pg.RELAYOUT_COUNT
+    sharded_touches = 0
+    for layer in range(4):
+        for t in (17, 18, 19):                     # sharded 1q rotations
+            qt.rotateAroundAxis(q, t, float(rng.uniform(0, 6)),
+                                rng.normal(size=3))
+            sharded_touches += 1
+        qt.controlledNot(q, 19, layer)             # sharded control: free
+        qt.tGate(q, 18)                            # diagonal: free
+        qt.swapGate(q, layer, 17 + (layer % 3))    # metadata only
+        sharded_touches += 3
+    gate_relayouts = pg.RELAYOUT_COUNT - count0
+    assert sharded_touches == 24
+    assert gate_relayouts == 0, gate_relayouts
+    # one exchange total: the canonicalising read
+    tot = qt.calcTotalProb(q)
+    amps_ok = abs(tot - 1.0) < 1e-10
+    q.ensure_canonical()
+    total_relayouts = pg.RELAYOUT_COUNT - count0
+    assert amps_ok
+    assert total_relayouts <= 1, total_relayouts
