@@ -294,18 +294,26 @@ def test_large_n_lazy_layout_economy(mesh_env):
     q = qt.createQureg(N, mesh_env)
     qt.initPlusState(q)
     count0 = pg.RELAYOUT_COUNT
+    lt = N - 3
+
+    def phys(t):
+        return int(q.layout[t]) if q.layout is not None else t
+
     sharded_touches = 0
     for layer in range(4):
-        for t in (17, 18, 19):                     # sharded 1q rotations
+        for t in (17, 18, 19):                     # 1q rotations
+            sharded_touches += phys(t) >= lt       # count at ISSUE time
             qt.rotateAroundAxis(q, t, float(rng.uniform(0, 6)),
                                 rng.normal(size=3))
-            sharded_touches += 1
-        qt.controlledNot(q, 19, layer)             # sharded control: free
-        qt.tGate(q, 18)                            # diagonal: free
-        qt.swapGate(q, layer, 17 + (layer % 3))    # metadata only
-        sharded_touches += 3
+        sharded_touches += phys(19) >= lt          # control: free anywhere
+        qt.controlledNot(q, 19, layer)
+        sharded_touches += phys(18) >= lt          # diagonal: free anywhere
+        qt.tGate(q, 18)
+        hi = 17 + (layer % 3)
+        sharded_touches += phys(hi) >= lt          # swap: metadata only
+        qt.swapGate(q, layer, hi)
     gate_relayouts = pg.RELAYOUT_COUNT - count0
-    assert sharded_touches == 24
+    assert sharded_touches >= 12, sharded_touches  # genuinely cross-shard
     assert gate_relayouts == 0, gate_relayouts
     # one exchange total: the canonicalising read
     tot = qt.calcTotalProb(q)
@@ -314,3 +322,5 @@ def test_large_n_lazy_layout_economy(mesh_env):
     total_relayouts = pg.RELAYOUT_COUNT - count0
     assert amps_ok
     assert total_relayouts <= 1, total_relayouts
+    # the economy claim: many genuinely-sharded touches, at most one
+    # physical exchange for the whole burst
