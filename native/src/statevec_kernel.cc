@@ -123,6 +123,59 @@ void dense1_range(double* __restrict re, double* __restrict im,
   }
 }
 
+// 2-qubit fast path (t1 < t2, uncontrolled-or-controlled): every density
+// register's 1q gate lowers to a fused 2q superoperator, so this loop is
+// the density path's hot kernel. Runs of consecutive base indices below
+// t1 give four CONTIGUOUS amplitude streams; the 4x4 combine over them
+// auto-vectorizes like dense1_range.
+void dense2_range(double* __restrict re, double* __restrict im,
+                  const DenseOp& op, int t1, int t2,
+                  int64_t j_lo, int64_t j_hi) {
+  const int64_t s1 = int64_t(1) << t1, s2 = int64_t(1) << t2;
+  const int64_t lo_mask = s1 - 1;
+  const int64_t mid_mask = ((s2 >> 1) - 1) & ~lo_mask;
+  double ur[16], ui[16];
+  for (int m = 0; m < 16; ++m) {
+    ur[m] = op.mat[2 * m];
+    ui[m] = op.mat[2 * m + 1];
+  }
+  const bool ctrl = op.ctrl_mask != 0;
+  int64_t j = j_lo;
+  while (j < j_hi) {
+    const int64_t t0 = j & lo_mask;
+    int64_t run = s1 - t0;
+    if (run > j_hi - j) run = j_hi - j;
+    // expand j (bits below t1 | bits t1..t2-2 | rest) into the base index
+    const int64_t mid = j & mid_mask;
+    const int64_t hi = j & ~(mid_mask | lo_mask);
+    const int64_t base = (hi << 2) | (mid << 1) | t0;
+    double* __restrict p[4][2];
+    for (int m = 0; m < 4; ++m) {
+      const int64_t off = op.offsets[m];
+      p[m][0] = re + base + off;
+      p[m][1] = im + base + off;
+    }
+    for (int64_t t = 0; t < run; ++t) {
+      if (ctrl && ((base + t) & op.ctrl_mask) != op.ctrl_want) continue;
+      double ar[4], ai[4];
+      for (int m = 0; m < 4; ++m) {
+        ar[m] = p[m][0][t];
+        ai[m] = p[m][1][t];
+      }
+      for (int m2 = 0; m2 < 4; ++m2) {
+        double sr = 0.0, si = 0.0;
+        for (int m = 0; m < 4; ++m) {
+          sr += ur[4 * m2 + m] * ar[m] - ui[4 * m2 + m] * ai[m];
+          si += ur[4 * m2 + m] * ai[m] + ui[4 * m2 + m] * ar[m];
+        }
+        p[m2][0][t] = sr;
+        p[m2][1][t] = si;
+      }
+    }
+    j += run;
+  }
+}
+
 struct DiagOp {
   int k;
   int64_t ctrl_mask, ctrl_want;
@@ -221,6 +274,12 @@ int qtk_run_f64(double* re, double* im, int n_qubits, int n_ops,
         const int target = targets[0];
         parallel_for(size >> 1, threads, [&](int64_t lo, int64_t hi) {
           dense1_range(re, im, op, target, lo, hi);
+        });
+      } else if (k == 2) {
+        const int t1 = targets[0] < targets[1] ? targets[0] : targets[1];
+        const int t2 = targets[0] < targets[1] ? targets[1] : targets[0];
+        parallel_for(size >> 2, threads, [&](int64_t lo, int64_t hi) {
+          dense2_range(re, im, op, t1, t2, lo, hi);
         });
       } else {
         int pos_asc[kMaxDenseQubits];
