@@ -1680,10 +1680,9 @@ def mixDephasing(qureg: Qureg, target: int, prob: float) -> None:
     val.validate_prob(prob, "mixDephasing", 0.5, "dephasing probability",
                       code=val.ErrorCode.E_INVALID_ONE_QUBIT_DEPHASE_PROB)
     if qureg.is_quad:
-        retain = 1.0 - 2.0 * float(prob)
-        fac = np.array([[1.0, retain], [retain, 1.0]], dtype=np.complex128)
         n = qureg.num_qubits_represented
-        qureg.state = ddm.dd_apply_diag(qureg.state, 2 * n, fac,
+        qureg.state = ddm.dd_apply_diag(qureg.state, 2 * n,
+                                        dm.dephasing_factors(float(prob)),
                                         (target + n, target))
         qureg.qasm_log.record_comment(
             f"a phase (Z) error occurred on qubit {target} "
@@ -1691,10 +1690,9 @@ def mixDephasing(qureg: Qureg, target: int, prob: float) -> None:
         return
     if _pg.use_lazy(qureg):
         # dephasing is diagonal on (target+n, target): position-free
-        retain = 1.0 - 2.0 * float(prob)
-        fac = np.array([[1.0, retain], [retain, 1.0]], dtype=np.complex128)
         n = qureg.num_qubits_represented
-        _pg.sharded_diag(qureg, fac, (target + n, target))
+        _pg.sharded_diag(qureg, dm.dephasing_factors(float(prob)),
+                         (target + n, target))
     else:
         qureg.state = _jit_mix_dephasing(
             qureg.state, qureg.num_qubits_represented,
@@ -1713,14 +1711,7 @@ def mixTwoQubitDephasing(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
     if qureg.is_quad or _pg.use_lazy(qureg):
         # diagonal on (q1, q2, q1+n, q2+n): position-free, zero comm
         n = qureg.num_qubits_represented
-        retain = 1.0 - (4.0 * float(prob)) / 3.0
-        fac = np.ones((2, 2, 2, 2), dtype=np.complex128)
-        for chi in range(2):
-            for clo in range(2):
-                for rhi in range(2):
-                    for rlo in range(2):
-                        if chi != rhi or clo != rlo:
-                            fac[chi, clo, rhi, rlo] = retain
+        fac = dm.two_qubit_dephasing_factors(float(prob))
         hi, lo = max(q1, q2), min(q1, q2)
         if qureg.is_quad:
             qureg.state = ddm.dd_apply_diag(qureg.state, 2 * n, fac,

@@ -28,6 +28,8 @@ __all__ = [
     "mix_density_matrix",
     "mix_dephasing",
     "mix_two_qubit_dephasing",
+    "dephasing_factors",
+    "two_qubit_dephasing_factors",
     "apply_kraus_superoperator",
     "kraus_superoperator",
 ]
@@ -136,27 +138,40 @@ def apply_kraus_superoperator(flat, num_qubits, targets, superop):
     return apply_unitary(flat, 2 * num_qubits, superop, all_targets)
 
 
-def mix_dephasing(flat, num_qubits, target, prob):
-    """rho -> (1-p) rho + p Z rho Z: off-diagonals (in ``target``) scaled by
-    1-2p (``densmatr_mixDephasing`` with dephase=2p, ``QuEST.c:907``)."""
+def dephasing_factors(prob: float) -> np.ndarray:
+    """(2, 2) off-diagonal retain tensor of 1q dephasing, axes
+    (column bit, row bit) — shared by the GSPMD, lazy-sharded and dd
+    paths."""
     retain = 1.0 - 2.0 * prob
-    fac = np.array([[1.0, retain], [retain, 1.0]], dtype=np.complex128)
-    return apply_diagonal(flat, 2 * num_qubits, (target + num_qubits, target), fac)
+    return np.array([[1.0, retain], [retain, 1.0]], dtype=np.complex128)
 
 
-def mix_two_qubit_dephasing(flat, num_qubits, q1, q2, prob):
-    """Z error on either/both qubits, total prob p: any row/col mismatch in
-    q1 or q2 scales by 1-4p/3 (``densmatr_mixTwoQubitDephasing``)."""
+def two_qubit_dephasing_factors(prob: float) -> np.ndarray:
+    """(2, 2, 2, 2) retain tensor of 2q dephasing, axes
+    (c_hi, c_lo, r_hi, r_lo): any row/column mismatch scales by 1-4p/3."""
     retain = 1.0 - (4.0 * prob) / 3.0
-    qs = sorted((q1 + num_qubits, q2 + num_qubits, q2, q1), reverse=True)
-    # tensor indexed by bits of sorted-desc positions: (c2, c1, r2, r1) when
-    # q2 > q1; mismatch on either qubit -> retain
     fac = np.ones((2, 2, 2, 2), dtype=np.complex128)
-    hi, lo = max(q1, q2), min(q1, q2)
     for chi in range(2):
         for clo in range(2):
             for rhi in range(2):
                 for rlo in range(2):
                     if chi != rhi or clo != rlo:
                         fac[chi, clo, rhi, rlo] = retain
-    return apply_diagonal(flat, 2 * num_qubits, qs, fac)
+    return fac
+
+
+def mix_dephasing(flat, num_qubits, target, prob):
+    """rho -> (1-p) rho + p Z rho Z: off-diagonals (in ``target``) scaled by
+    1-2p (``densmatr_mixDephasing`` with dephase=2p, ``QuEST.c:907``)."""
+    fac = dephasing_factors(prob)
+    return apply_diagonal(flat, 2 * num_qubits, (target + num_qubits, target), fac)
+
+
+def mix_two_qubit_dephasing(flat, num_qubits, q1, q2, prob):
+    """Z error on either/both qubits, total prob p: any row/col mismatch in
+    q1 or q2 scales by 1-4p/3 (``densmatr_mixTwoQubitDephasing``)."""
+    qs = sorted((q1 + num_qubits, q2 + num_qubits, q2, q1), reverse=True)
+    # tensor indexed by bits of sorted-desc positions: (c2, c1, r2, r1)
+    # when q2 > q1
+    return apply_diagonal(flat, 2 * num_qubits, qs,
+                          two_qubit_dephasing_factors(prob))

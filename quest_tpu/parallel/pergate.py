@@ -91,6 +91,18 @@ def phys_index(qureg, index: int) -> int:
 # cached shard_map kernels (packed (2, 2^n) planes in and out)
 # ---------------------------------------------------------------------------
 
+def _shard_jit(mesh, body, n_extra_args: int):
+    """shard_map + jit boilerplate shared by every per-gate kernel: the
+    packed planes shard on the amplitude axis (donated), trailing
+    operand arrays are replicated."""
+    sm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, AMP_AXIS),) + (P(),) * n_extra_args
+        if n_extra_args else P(None, AMP_AXIS),
+        out_specs=P(None, AMP_AXIS), check_vma=False)
+    return jax.jit(sm, donate_argnums=(0,))
+
+
 @functools.lru_cache(maxsize=1024)
 def _gate_fn(mesh, n, s, targets, cmask, fmask):
     lt = n - s
@@ -100,9 +112,7 @@ def _gate_fn(mesh, n, s, targets, cmask, fmask):
                            cmask, fmask, lt, AMP_AXIS)
         return pack(z)
 
-    sm = jax.shard_map(body, mesh=mesh, in_specs=(P(None, AMP_AXIS), P()),
-                       out_specs=P(None, AMP_AXIS), check_vma=False)
-    return jax.jit(sm, donate_argnums=(0,))
+    return _shard_jit(mesh, body, 1)
 
 
 @functools.lru_cache(maxsize=1024)
@@ -114,9 +124,7 @@ def _cross_1q_fn(mesh, n, s, position, cmask, fmask):
                                  lt, s, AMP_AXIS, cmask, fmask)
         return pack(z)
 
-    sm = jax.shard_map(body, mesh=mesh, in_specs=(P(None, AMP_AXIS), P()),
-                       out_specs=P(None, AMP_AXIS), check_vma=False)
-    return jax.jit(sm, donate_argnums=(0,))
+    return _shard_jit(mesh, body, 1)
 
 
 @functools.lru_cache(maxsize=1024)
@@ -128,9 +136,7 @@ def _diag_fn(mesh, n, s, phys_desc):
                            0, 0, lt, AMP_AXIS)
         return pack(z)
 
-    sm = jax.shard_map(body, mesh=mesh, in_specs=(P(None, AMP_AXIS), P()),
-                       out_specs=P(None, AMP_AXIS), check_vma=False)
-    return jax.jit(sm, donate_argnums=(0,))
+    return _shard_jit(mesh, body, 1)
 
 
 @functools.lru_cache(maxsize=1024)
@@ -140,9 +146,7 @@ def _relayout_fn(mesh, n, s, before, after):
     def body(local_f):
         return pack(run_exchange(unpack(local_f), plan, AMP_AXIS))
 
-    sm = jax.shard_map(body, mesh=mesh, in_specs=P(None, AMP_AXIS),
-                       out_specs=P(None, AMP_AXIS), check_vma=False)
-    return jax.jit(sm, donate_argnums=(0,))
+    return _shard_jit(mesh, body, 0)
 
 
 # ---------------------------------------------------------------------------
