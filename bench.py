@@ -374,6 +374,46 @@ def bench_native_cpu() -> dict:
     }
 
 
+def bench_native_density() -> dict:
+    """Native executor on a density register + channels: every 1q gate is
+    a fused 2q superoperator, riding the vectorized dense2 fast path
+    (measured ~2x the generic gather, ~4x the XLA density path at 12q)."""
+    num_qubits = int(os.environ.get("QUEST_BENCH_NATIVE_DENSITY_QUBITS",
+                                    "12"))
+    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 3)
+    from quest_tpu.circuits import Circuit
+    rng = np.random.default_rng(2026)
+    c = Circuit(num_qubits)
+    n_ops = 0
+    for q_ in range(num_qubits):
+        c.rotate(q_, float(rng.uniform(0, 2 * np.pi)), rng.normal(size=3))
+        n_ops += 1
+    for q_ in range(0, num_qubits - 1, 2):
+        c.cnot(q_, q_ + 1)
+        n_ops += 1
+    for q_ in range(num_qubits):
+        c.dephase(q_, 0.05)
+        c.damp(q_, 0.02)
+        n_ops += 2
+    prog = c.compile_native(threads=1, density=True)
+    re, im = prog.init_zero()
+    prog.run(re, im)
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        prog.run(re, im)
+    dt = time.perf_counter() - t0
+    ops_per_sec = n_ops * trials / dt
+    baseline = _roofline_baseline(2 * num_qubits, 8)
+    return {
+        "metric": f"native C++ executor, density-{num_qubits}+noise, "
+                  "f64, 1 thread",
+        "value": round(ops_per_sec, 2),
+        "unit": "ops/sec",
+        "platform": "cpu",
+        "vs_baseline": round(ops_per_sec / baseline, 4),
+    }
+
+
 def bench_qft(qt, env, platform: str) -> dict:
     from quest_tpu.algorithms import qft
     num_qubits = int(os.environ.get(
@@ -837,6 +877,9 @@ def main() -> None:
     if not accel and not native_led:
         # library wasn't prebuilt: run native gated, absorbing the g++ step
         configs.insert(0, ("native", 30, lambda: bench_native_cpu()))
+    if not accel:
+        configs.append(("native_density", 30,
+                        lambda: bench_native_density()))
     for name, min_time_s, fn in configs:
         if not accel:
             min_time_s /= 4  # CPU compiles are fast (and cache-warmed)

@@ -97,8 +97,7 @@ def _shard_jit(mesh, body, n_extra_args: int):
     operand arrays are replicated."""
     sm = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(None, AMP_AXIS),) + (P(),) * n_extra_args
-        if n_extra_args else P(None, AMP_AXIS),
+        in_specs=(P(None, AMP_AXIS),) + (P(),) * n_extra_args,
         out_specs=P(None, AMP_AXIS), check_vma=False)
     return jax.jit(sm, donate_argnums=(0,))
 
