@@ -178,3 +178,67 @@ def test_no_involuntary_rematerialization():
     assert "DONE" in r.stdout
     assert "Involuntary full rematerialization" not in r.stderr
     assert "Involuntary full rematerialization" not in r.stdout
+
+
+def test_plan_exchange_algebra_at_pod_scale(rng):
+    """The decomposition's index algebra, verified symbolically for mesh
+    sizes the CPU rig cannot instantiate (up to 2^6 devices, 30 qubits):
+    composing pre-transpose -> k-bit device/local exchange -> residual
+    device permutation -> post-transpose must reproduce the requested
+    position permutation exactly, for every amplitude index bit."""
+    def bit(x, p):
+        return (x >> p) & 1
+
+    for n, s in ((12, 4), (16, 5), (20, 6), (30, 6)):
+        lt = n - s
+        for _ in range(4):
+            before = rng.permutation(n)
+            after = rng.permutation(n)
+            sigma = np.empty(n, dtype=np.int64)
+            sigma[before] = after
+            plan = plan_exchange(n, s, before, after)
+
+            def apply_axes(idx_bits, axes):
+                """Transpose of the (2,)*lt local view as a bit shuffle:
+                out bit at position q = in bit at position given by axes
+                (axes[i] is the SOURCE axis of dst axis i; axis of
+                position q is lt-1-q)."""
+                if axes is None:
+                    return idx_bits
+                out = list(idx_bits)
+                for dst_axis, src_axis in enumerate(axes):
+                    out[lt - 1 - dst_axis] = idx_bits[lt - 1 - src_axis]
+                return out
+
+            # a sample of amplitude indices, each tracked bit-by-bit
+            for _ in range(20):
+                amp = int(rng.integers(0, 1 << min(n, 62)))
+                local = [bit(amp, p) for p in range(lt)]
+                dev = [bit(amp, lt + j) for j in range(s)]
+                # pre-transpose
+                local = apply_axes(local, plan.pre_axes)
+                # exchange: top-k local bits trade with the k device bits
+                # of the all_to_all groups (ascending group bit order)
+                if plan.k:
+                    # group member at rank 2^i differs from rank 0 in
+                    # exactly the device bit paired with staging slot i
+                    g0 = plan.groups[0]
+                    jbits = [int(np.log2(g0[1 << i] ^ g0[0]))
+                             for i in range(plan.k)]
+                    for i, j in enumerate(jbits):
+                        stage = lt - plan.k + i
+                        local[stage], dev[j] = dev[j], local[stage]
+                # residual device permutation
+                if plan.device_perm is not None:
+                    v = sum(b << j for j, b in enumerate(dev))
+                    w = dict(plan.device_perm)[v]
+                    dev = [bit(w, j) for j in range(s)]
+                # post-transpose
+                local = apply_axes(local, plan.post_axes)
+                got = sum(b << p for p, b in enumerate(local)) \
+                    + sum(b << (lt + j) for j, b in enumerate(dev))
+                want = 0
+                for l in range(n):
+                    if bit(amp, before[l]):
+                        want |= 1 << int(after[l])
+                assert got == want, (n, s, amp, got, want)
