@@ -52,6 +52,25 @@ class Qureg:
         # needs canonical order (ensure_canonical).
         self.layout: Optional[np.ndarray] = None
 
+    # -- reference struct-field aliases (QuEST.h:161-192 spellings, used
+    #    by the reference's own test drivers, e.g. createQureg.test) ------
+
+    @property
+    def isDensityMatrix(self) -> bool:
+        return self.is_density_matrix
+
+    @property
+    def numQubitsRepresented(self) -> int:
+        return self.num_qubits_represented
+
+    @property
+    def numQubitsInStateVec(self) -> int:
+        return self.num_qubits_in_state_vec
+
+    @property
+    def numAmpsTotal(self) -> int:
+        return self.num_amps_total
+
     # -- state plumbing ----------------------------------------------------
 
     @property

@@ -1,0 +1,367 @@
+"""Reader/runner for the reference's SHIPPED golden corpus.
+
+The reference ships ~87 ``.test`` fixture files under
+``/root/reference/tests/{essential,unit,algor}`` whose format is defined by
+its Python harness (``utilities/QuESTTest/QuESTCore.py:380-496``):
+
+    # <functionName>
+    <nTests>
+    <quregType>[-<checks>] <numQubits> <arg> <arg> ...
+    ... expected lines per check letter ...
+
+- ``quregType``: z=zero p=plus d=debug c=custom b=bitstring; lowercase =
+  state-vector, uppercase = density matrix (``QuESTCore.py:382-403``).
+- ``checks``: P total probability (1 line), M per-qubit outcome
+  probabilities (n lines of ``P(q=0) P(q=1)``), S full state (2^n or 4^n
+  complex lines).  Omitted for value-returning functions, which instead
+  read ONE expected-value line (``QuESTCore.py:473-489``).
+- argument tokenisation deletes the characters ``[{()}]_|><`` and splits
+  on whitespace (``QuESTCore.py:214-217``), so arrays/matrices arrive as
+  single comma-joined tokens.
+
+This module replays those files through quest_tpu's public API — the
+last oracle seam VERDICT r4 flagged: the corpus the reference itself
+ships, consumed unmodified.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+import quest_tpu as qt
+
+__all__ = ["run_shipped_file", "shipped_standard_files", "SHIPPED_ROOT",
+           "ShippedFailure"]
+
+SHIPPED_ROOT = "/root/reference/tests"
+
+# exact analogue of QuESTCore.py:214-217 (maketrans with a deletion set)
+_DELETE = str.maketrans("", "", "[{()}]_|><")
+
+
+class ShippedFailure(AssertionError):
+    pass
+
+
+class _TestFile:
+    """Line reader with the reference's comment/blank-skipping semantics
+    (``QuESTCore.py:190-207``)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path) as f:
+            self._lines = f.readlines()
+        self.n_line = 0
+
+    def readline(self) -> str:
+        while self.n_line < len(self._lines):
+            line = self._lines[self.n_line]
+            self.n_line += 1
+            cut = line.find("#")
+            if cut > -1:
+                line = line[:cut]
+            line = line.strip()
+            if line:
+                return line
+        raise ShippedFailure(f"{self.path}: unexpected end of file")
+
+    def parse_args(self, line: str) -> list[str]:
+        return line.translate(_DELETE).split()
+
+    def title(self) -> str:
+        # first comment line names the function (QuESTCore.py:246-252)
+        for line in self._lines:
+            t = line.lstrip("# ").strip()
+            if t:
+                return t
+        raise ShippedFailure(f"{self.path}: empty file")
+
+
+def _floats(token: str) -> list[float]:
+    return [float(x) for x in token.strip(",").split(",") if x]
+
+
+def _complex(token: str) -> complex:
+    re, im = _floats(token)
+    return complex(re, im)
+
+
+def _matrix2(token: str) -> np.ndarray:
+    v = _floats(token)
+    if len(v) != 8:
+        raise ShippedFailure(f"matrix token has {len(v)} floats, want 8")
+    amps = [complex(v[i], v[i + 1]) for i in range(0, 8, 2)]
+    return np.array([[amps[0], amps[1]], [amps[2], amps[3]]],
+                    dtype=np.complex128)
+
+
+def _init_qureg(env, n_bits: int, qubit_type: str, den_mat: bool,
+                custom_token: Optional[str]):
+    """``argQureg`` analogue (``QuESTCore.py:762-860``)."""
+    q = (qt.createDensityQureg(n_bits, env) if den_mat
+         else qt.createQureg(n_bits, env))
+    kind = qubit_type.upper()
+    if kind == "Z":
+        qt.initZeroState(q)
+    elif kind == "P":
+        qt.initPlusState(q)
+    elif kind == "D":
+        qt.initDebugState(q)
+    elif kind == "B":
+        qt.initClassicalState(q, int(custom_token, 2))
+    elif kind == "C":
+        v = _floats(custom_token)
+        reals, imags = v[0::2], v[1::2]
+        if den_mat:
+            qt.setDensityAmps(q, reals, imags)
+        else:
+            qt.setAmps(q, 0, reals, imags, len(reals))
+    else:
+        raise ShippedFailure(f"unknown qureg type {qubit_type!r}")
+    return q
+
+
+# ---------------------------------------------------------------------------
+# per-function adapters: (tokens) -> API call.  ``ret`` is None for void
+# functions (P/M/S checked) or the kind of the single expected value line.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Adapter:
+    call: Callable            # (qureg, tokens) -> result
+    ret: Optional[str] = None  # None | "real" | "complex" | "int"
+
+
+def _a(fn, *kinds, ret=None):
+    """Build an adapter whose positional args are parsed per ``kinds``:
+    i=int r=real c=complex m=ComplexMatrix2 v=real-list l=int-list
+    n=consume a count token (validated against the preceding list)."""
+    def call(q, tokens):
+        args = []
+        it = iter(tokens)
+        for k in kinds:
+            tok = next(it)
+            if k == "i":
+                args.append(int(tok))
+            elif k == "r":
+                args.append(float(tok))
+            elif k == "c":
+                args.append(_complex(tok))
+            elif k == "m":
+                args.append(_matrix2(tok))
+            elif k == "v":
+                args.append(_floats(tok))
+            elif k == "l":
+                args.append([int(x) for x in tok.strip(",").split(",")])
+            elif k == "n":
+                if int(tok) != len(args[-1]):
+                    raise ShippedFailure(
+                        f"count {tok} != list len {len(args[-1])}")
+            else:
+                raise ValueError(k)
+        return fn(q, *args)
+    return _Adapter(call, ret)
+
+
+def _setamps(q, tokens):
+    # setAmps.test: startInd, one real, one imag, numAmps (essential tier)
+    start, re, im, n = int(tokens[0]), _floats(tokens[1]), \
+        _floats(tokens[2]), int(tokens[3])
+    qt.setAmps(q, start, re, im, n)
+
+
+_ADAPTERS: dict[str, _Adapter] = {
+    # --- 1q gates -----------------------------------------------------
+    "hadamard": _a(qt.hadamard, "i"),
+    "pauliX": _a(qt.pauliX, "i"),
+    "pauliY": _a(qt.pauliY, "i"),
+    "pauliZ": _a(qt.pauliZ, "i"),
+    "sGate": _a(qt.sGate, "i"),
+    "tGate": _a(qt.tGate, "i"),
+    "phaseShift": _a(qt.phaseShift, "i", "r"),
+    "rotateX": _a(qt.rotateX, "i", "r"),
+    "rotateY": _a(qt.rotateY, "i", "r"),
+    "rotateZ": _a(qt.rotateZ, "i", "r"),
+    "rotateAroundAxis": _a(qt.rotateAroundAxis, "i", "r", "v"),
+    "compactUnitary": _a(qt.compactUnitary, "i", "c", "c"),
+    "unitary": _a(qt.unitary, "i", "m"),
+    # --- controlled ---------------------------------------------------
+    "controlledNot": _a(qt.controlledNot, "i", "i"),
+    "controlledPauliY": _a(qt.controlledPauliY, "i", "i"),
+    "controlledPhaseFlip": _a(qt.controlledPhaseFlip, "i", "i"),
+    "controlledPhaseShift": _a(qt.controlledPhaseShift, "i", "i", "r"),
+    "controlledRotateX": _a(qt.controlledRotateX, "i", "i", "r"),
+    "controlledRotateY": _a(qt.controlledRotateY, "i", "i", "r"),
+    "controlledRotateZ": _a(qt.controlledRotateZ, "i", "i", "r"),
+    "controlledRotateAroundAxis": _a(
+        qt.controlledRotateAroundAxis, "i", "i", "r", "v"),
+    "controlledCompactUnitary": _a(
+        qt.controlledCompactUnitary, "i", "i", "c", "c"),
+    "controlledUnitary": _a(qt.controlledUnitary, "i", "i", "m"),
+    "multiControlledPhaseFlip": _a(qt.multiControlledPhaseFlip, "l", "n"),
+    "multiControlledPhaseShift": _a(
+        qt.multiControlledPhaseShift, "l", "n", "r"),
+    "multiControlledUnitary": _a(qt.multiControlledUnitary, "l", "n",
+                                 "i", "m"),
+    # --- collapse / noise --------------------------------------------
+    "collapseToOutcome": _a(qt.collapseToOutcome, "i", "i"),
+    "mixDamping": _a(qt.mixDamping, "i", "r"),
+    "mixDephasing": _a(qt.mixDephasing, "i", "r"),
+    "mixDepolarising": _a(qt.mixDepolarising, "i", "r"),
+    "mixTwoQubitDephasing": _a(qt.mixTwoQubitDephasing, "i", "i", "r"),
+    "mixTwoQubitDepolarising": _a(qt.mixTwoQubitDepolarising,
+                                  "i", "i", "r"),
+    # --- value-returning ---------------------------------------------
+    "calcTotalProb": _a(qt.calcTotalProb, ret="real"),
+    "calcPurity": _a(qt.calcPurity, ret="real"),
+    "calcProbOfOutcome": _a(qt.calcProbOfOutcome, "i", "i", ret="real"),
+    "getAmp": _a(qt.getAmp, "i", ret="complex"),
+    "getDensityAmp": _a(qt.getDensityAmp, "i", "i", ret="complex"),
+    "getRealAmp": _a(qt.getRealAmp, "i", ret="real"),
+    "getImagAmp": _a(qt.getImagAmp, "i", ret="real"),
+    "getProbAmp": _a(qt.getProbAmp, "i", ret="real"),
+    "getNumAmps": _a(qt.getNumAmps, ret="int"),
+    "getNumQubits": _a(qt.getNumQubits, ret="int"),
+    # --- init (argQureg already pre-initialises; the call re-applies,
+    #     matching the harness which calls the function on top) --------
+    "initZeroState": _a(qt.initZeroState),
+    "initPlusState": _a(qt.initPlusState),
+    "initStateDebug": _a(qt.initDebugState),
+    "initClassicalState": _a(qt.initClassicalState, "i"),
+    "setAmps": _Adapter(_setamps),
+}
+
+
+# ---------------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------------
+
+def _check_P(tf: _TestFile, q, tol: float, errs: list[str]) -> None:
+    expect = float(tf.readline())
+    got = qt.calcTotalProb(q)
+    if abs(got - expect) > tol:
+        errs.append(f"P: got {got!r}, want {expect!r}")
+
+
+def _check_M(tf: _TestFile, q, n_bits: int, tol: float,
+             errs: list[str]) -> None:
+    for qubit in range(n_bits):
+        p0, p1 = (float(x) for x in tf.readline().split())
+        g0 = qt.calcProbOfOutcome(q, qubit, 0)
+        g1 = qt.calcProbOfOutcome(q, qubit, 1)
+        if abs(g0 - p0) > tol or abs(g1 - p1) > tol:
+            errs.append(f"M q{qubit}: got ({g0!r},{g1!r}), "
+                        f"want ({p0!r},{p1!r})")
+
+
+def _check_S(tf: _TestFile, q, n_bits: int, den_mat: bool, tol: float,
+             errs: list[str]) -> None:
+    dim = 1 << n_bits
+    n_states = dim * dim if den_mat else dim
+    expect = [_complex(tf.readline().translate(_DELETE))
+              for _ in range(n_states)]
+    if den_mat:
+        # flat order = row + col*dim, the reference's column-major
+        # density flattening (QuEST.c:8-10 via read_state_vec)
+        for col in range(dim):
+            for row in range(dim):
+                g = qt.getDensityAmp(q, row, col)
+                e = expect[row + col * dim]
+                if abs(g - e) > tol:
+                    errs.append(f"S [{row},{col}]: got {g!r}, want {e!r}")
+                    if len(errs) > 8:
+                        return
+    else:
+        for i in range(dim):
+            g = qt.getAmp(q, i)
+            if abs(g - expect[i]) > tol:
+                errs.append(f"S [{i}]: got {g!r}, want {expect[i]!r}")
+                if len(errs) > 8:
+                    return
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def run_shipped_file(path: str, tol: float = 1e-10) -> int:
+    """Replay one shipped standard-format ``.test`` file; raises
+    ``ShippedFailure`` on any mismatch, returns the number of test
+    vectors exercised."""
+    tf = _TestFile(path)
+    title = tf.title()
+    adapter = _ADAPTERS.get(title)
+    if adapter is None:
+        raise ShippedFailure(f"{path}: no adapter for {title!r}")
+    n_tests = int(tf.readline())
+    env = qt.createQuESTEnv()
+    ran = 0
+    try:
+        for case in range(n_tests):
+            line = tf.readline()
+            tokens = tf.parse_args(line)
+            test_string, n_bits_s, *args = tokens
+            qubit_type, *test_type = test_string.split("-")
+            n_bits = int(n_bits_s)
+            if n_bits == 0:
+                continue
+            den_mat = qubit_type.isupper()
+            custom = None
+            if qubit_type in "CBcb":
+                custom = args.pop(0)
+            q = _init_qureg(env, n_bits, qubit_type, den_mat, custom)
+            errs: list[str] = []
+            if adapter.ret is None:
+                adapter.call(q, args)
+                checks = test_type[0] if test_type else "S"
+                for c in checks:
+                    if c in "Pp":
+                        _check_P(tf, q, tol, errs)
+                    elif c in "Mm":
+                        _check_M(tf, q, n_bits, tol, errs)
+                    elif c in "Ss":
+                        _check_S(tf, q, n_bits, den_mat, tol, errs)
+                    else:
+                        raise ShippedFailure(
+                            f"{path}: unknown check {c!r}")
+            else:
+                result = adapter.call(q, args)
+                if adapter.ret == "complex":
+                    expect = _complex(tf.readline().translate(_DELETE))
+                    if abs(result - expect) > tol:
+                        errs.append(f"ret: got {result!r}, want {expect!r}")
+                elif adapter.ret == "real":
+                    expect = float(tf.readline())
+                    if abs(result - expect) > tol:
+                        errs.append(f"ret: got {result!r}, want {expect!r}")
+                else:
+                    expect = int(tf.readline())
+                    if int(result) != expect:
+                        errs.append(f"ret: got {result!r}, want {expect!r}")
+            if errs:
+                raise ShippedFailure(
+                    f"{path} case {case + 1}/{n_tests} "
+                    f"({line}): " + "; ".join(errs))
+            ran += 1
+    finally:
+        qt.destroyQuESTEnv(env)
+    return ran
+
+
+def shipped_standard_files(root: str = SHIPPED_ROOT) -> list[str]:
+    """All shipped ``.test`` files in the standard (non-Python-driver)
+    format, discovered the same way the reference harness does."""
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".test"):
+                continue
+            path = os.path.join(dirpath, name)
+            if _TestFile(path).title() != "Python":
+                out.append(path)
+    return sorted(out)
