@@ -608,6 +608,9 @@ def initClassicalState(qureg: Qureg, state_ind: int) -> None:
 
 def initPureState(qureg: Qureg, pure: Qureg) -> None:
     val.validate_second_qureg_state_vec(pure.is_density_matrix, "initPureState")
+    val.validate_matching_precision(qureg.env.precision.quest_prec,
+                                    pure.env.precision.quest_prec,
+                                    "initPureState")
     val.validate_matching_dims(qureg.num_qubits_represented,
                                pure.num_qubits_represented, "initPureState")
     _canon(pure)
@@ -674,6 +677,9 @@ def setDensityAmps(qureg: Qureg, reals, imags) -> None:
 def cloneQureg(target: Qureg, copy: Qureg) -> None:
     val.validate_matching_types(target.is_density_matrix,
                                 copy.is_density_matrix, "cloneQureg")
+    val.validate_matching_precision(target.env.precision.quest_prec,
+                                    copy.env.precision.quest_prec,
+                                    "cloneQureg")
     val.validate_matching_dims(target.num_qubits_represented,
                                copy.num_qubits_represented, "cloneQureg")
     _canon(copy)
@@ -685,6 +691,12 @@ def setWeightedQureg(fac1, qureg1: Qureg, fac2, qureg2: Qureg,
                      fac_out, out: Qureg) -> None:
     val.validate_matching_types(qureg1.is_density_matrix,
                                 qureg2.is_density_matrix, "setWeightedQureg")
+    val.validate_matching_precision(qureg1.env.precision.quest_prec,
+                                    qureg2.env.precision.quest_prec,
+                                    "setWeightedQureg")
+    val.validate_matching_precision(qureg1.env.precision.quest_prec,
+                                    out.env.precision.quest_prec,
+                                    "setWeightedQureg")
     val.validate_matching_types(qureg1.is_density_matrix,
                                 out.is_density_matrix, "setWeightedQureg")
     val.validate_matching_dims(qureg1.num_qubits_represented,
@@ -1214,6 +1226,14 @@ def calcExpecPauliProd(qureg: Qureg, targets: Sequence[int],
     return float(value)
 
 
+# unroll/remap guards for the fused Pauli-sum executables (advisor r4):
+# above _PAULI_SUM_CHUNK terms the program is compiled in chunks; above
+# _PAULI_REMAP_TERMS_MAX terms a lazy layout is canonicalised rather than
+# remapped into the (static, hence recompiling) codes argument
+_PAULI_SUM_CHUNK = 48
+_PAULI_REMAP_TERMS_MAX = 8
+
+
 def calcExpecPauliSum(qureg: Qureg, all_codes: Sequence[int],
                       coeffs: Sequence[float], num_sum_terms: int = None,
                       workspace: Qureg = None) -> float:
@@ -1245,8 +1265,11 @@ def calcExpecPauliSum(qureg: Qureg, all_codes: Sequence[int],
     coeffs_f = jnp.asarray(np.asarray(coeffs[:num_terms], np.float64),
                            qureg.real_dtype)
     if qureg.layout is not None:
-        if qureg.is_density_matrix:
-            _canon(qureg)      # row/col pairing is positional
+        if qureg.is_density_matrix or num_terms > _PAULI_REMAP_TERMS_MAX:
+            # large sums: one relayout beats recompiling the whole
+            # Hamiltonian program per layout permutation (codes are a
+            # static arg — every distinct remap is a fresh executable)
+            _canon(qureg)
         else:
             # permute each term's codes to the physical positions — the
             # expectation probes targets in place, no exchange
@@ -1256,6 +1279,25 @@ def calcExpecPauliSum(qureg: Qureg, all_codes: Sequence[int],
                 for q_l in range(n):
                     remapped[t * n + int(lay[q_l])] = codes_flat[t * n + q_l]
             codes_flat = tuple(remapped)
+    if num_terms > _PAULI_SUM_CHUNK:
+        # cap the unrolled program length: XLA compile time grows
+        # superlinearly with trace size, so a many-hundred-term
+        # Hamiltonian compiles as ceil(T/chunk) mid-size executables
+        # (each cached) instead of one enormous one
+        total = 0.0
+        for start in range(0, num_terms, _PAULI_SUM_CHUNK):
+            stop = min(start + _PAULI_SUM_CHUNK, num_terms)
+            chunk_codes = codes_flat[start * n:stop * n]
+            chunk_coeffs = coeffs_f[start:stop]
+            if qureg.is_density_matrix:
+                total += float(_jit_expec_pauli_sum_dm(
+                    qureg.state, qureg.num_qubits_in_state_vec, n,
+                    chunk_codes, chunk_coeffs))
+            else:
+                total += float(_jit_expec_pauli_sum_sv(
+                    qureg.state, qureg.num_qubits_in_state_vec, n,
+                    chunk_codes, chunk_coeffs))
+        return total
     if qureg.is_density_matrix:
         value = _jit_expec_pauli_sum_dm(
             qureg.state, qureg.num_qubits_in_state_vec, n, codes_flat,
@@ -1274,6 +1316,9 @@ def applyPauliSum(in_qureg: Qureg, all_codes: Sequence[int],
     ``QuEST_common.c:494-514``)."""
     val.validate_matching_types(in_qureg.is_density_matrix,
                                 out_qureg.is_density_matrix, "applyPauliSum")
+    val.validate_matching_precision(in_qureg.env.precision.quest_prec,
+                                    out_qureg.env.precision.quest_prec,
+                                    "applyPauliSum")
     val.validate_matching_dims(in_qureg.num_qubits_represented,
                                out_qureg.num_qubits_represented, "applyPauliSum")
     val.validate_num_pauli_sum_terms(num_terms, "applyPauliSum")
@@ -1568,6 +1613,9 @@ def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
     val.validate_state_vec(ket.is_density_matrix, "calcInnerProduct")
     val.validate_matching_dims(bra.num_qubits_represented,
                                ket.num_qubits_represented, "calcInnerProduct")
+    val.validate_matching_precision(bra.env.precision.quest_prec,
+                                    ket.env.precision.quest_prec,
+                                    "calcInnerProduct")
     _canon(bra, ket)
     if bra.is_quad:
         return ddm.dd_vdot(bra.state, ket.state)
@@ -1584,6 +1632,9 @@ def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
     val.validate_matching_dims(rho1.num_qubits_represented,
                                rho2.num_qubits_represented,
                                "calcDensityInnerProduct")
+    val.validate_matching_precision(rho1.env.precision.quest_prec,
+                                    rho2.env.precision.quest_prec,
+                                    "calcDensityInnerProduct")
     _canon(rho1, rho2)
     if rho1.is_quad:
         return ddm.dd_vdot(rho1.state, rho2.state).real
@@ -1607,6 +1658,9 @@ def calcFidelity(qureg: Qureg, pure_state: Qureg) -> float:
     val.validate_matching_dims(qureg.num_qubits_represented,
                                pure_state.num_qubits_represented,
                                "calcFidelity")
+    val.validate_matching_precision(qureg.env.precision.quest_prec,
+                                    pure_state.env.precision.quest_prec,
+                                    "calcFidelity")
     _canon(qureg, pure_state)
     if qureg.is_quad:
         if qureg.is_density_matrix:
@@ -1636,6 +1690,9 @@ def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
     val.validate_matching_dims(a.num_qubits_represented,
                                b.num_qubits_represented,
                                "calcHilbertSchmidtDistance")
+    val.validate_matching_precision(a.env.precision.quest_prec,
+                                    b.env.precision.quest_prec,
+                                    "calcHilbertSchmidtDistance")
     _canon(a, b)
     if a.is_quad:
         diff = ddm.dd_weighted(1.0, a.state, -1.0, b.state, 0.0, a.state)
@@ -1779,6 +1836,9 @@ def mixDensityMatrix(qureg: Qureg, other_prob: float, other: Qureg) -> None:
                                other.num_qubits_represented,
                                "mixDensityMatrix")
     val.validate_prob(other_prob, "mixDensityMatrix")
+    val.validate_matching_precision(qureg.env.precision.quest_prec,
+                                    other.env.precision.quest_prec,
+                                    "mixDensityMatrix")
     if qureg.is_quad:
         qureg.state = ddm.dd_weighted(1.0 - float(other_prob), qureg.state,
                                       float(other_prob), other.state,

@@ -17,7 +17,16 @@ import numpy as np
 
 __all__ = ["available", "load", "build_and_load", "NativeScheduler"]
 
-_LIB_PATH = os.path.join(os.path.dirname(__file__), "libquest_sched.so")
+from .hosttag import HOST_TAG
+
+
+def tagged_lib_path(base_name: str) -> str:
+    """Cache path for a native library, keyed by host/ISA fingerprint."""
+    return os.path.join(os.path.dirname(__file__),
+                        f"{base_name}.{HOST_TAG}.so")
+
+
+_LIB_PATH = tagged_lib_path("libquest_sched")
 _SRC_PATH = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
                          "native", "src", "scheduler.cc")
 _lib: Optional[ctypes.CDLL] = None

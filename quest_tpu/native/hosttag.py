@@ -1,0 +1,38 @@
+"""Host/ISA fingerprint for native-library cache filenames.
+
+Standalone and dependency-free ON PURPOSE: setup.py's build hook and
+native/Makefile execute this file directly (no package import), so it must
+not pull in quest_tpu/__init__ (which imports jax/numpy — unavailable in
+an isolated pip build env).
+
+Why the tag exists (advisor r4): the executor library is built with
+-march=native; a package tree copied to a host with a different ISA
+(container image, NFS) must not dlopen a stale AVX-512 binary and SIGILL.
+Machine arch + a hash of the CPU feature flags keys the cache per host
+class; mtime invalidation (native/__init__.build_and_load) keys it per
+source version.
+"""
+
+import hashlib
+import platform
+
+
+def _host_tag() -> str:
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    digest = hashlib.sha1(
+        (platform.machine() + ":" + flags).encode()).hexdigest()[:8]
+    return f"{platform.machine()}-{digest}"
+
+
+HOST_TAG = _host_tag()
+
+if __name__ == "__main__":
+    print(HOST_TAG)

@@ -26,11 +26,11 @@ from typing import Optional
 
 import numpy as np
 
-from . import build_and_load
+from . import build_and_load, tagged_lib_path
 
 __all__ = ["available", "load", "NativeProgram"]
 
-_LIB_PATH = os.path.join(os.path.dirname(__file__), "libquest_statevec.so")
+_LIB_PATH = tagged_lib_path("libquest_statevec")
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 

@@ -12,17 +12,23 @@ in SURVEY.md §7.2.
 Qubit classes, with the state viewed as ``(rows, 128)`` float planes:
 
 - **lane qubits** (0..6): bits inside the 128-lane dimension. ANY static
-  gate — controlled and multi-qubit included — whose targets and controls
-  all live here is a 128x128 matrix on the lane axis (kron-embedded
-  host-side); a whole run of them multiplies into ONE matrix applied by MXU
-  matmuls. Diagonal (phase-family) ops embed as diagonal matrices.
-- **mid qubits** (7..7+log2(R)-1): bits inside the per-block row dimension.
-  Uncontrolled 1q gates pair rows at stride 2^(q-7); applied in-VMEM by
-  leading-axis reshape + broadcasted 2x2 combine (VPU).
-- **high qubits** (>= 7+log2(R)): pair across grid blocks; left to the
-  XLA/collective path (they are the few top qubits only).
+  gate — controlled and multi-qubit included — whose targets all live here
+  is a 128x128 matrix on the lane axis (kron-embedded host-side); runs of
+  them multiply into ONE matrix applied by MXU matmuls. Diagonal
+  (phase-family) ops embed as diagonal matrices.
+- **row qubits** (>= 7): bits of the row index. Dense 1q gates whose target
+  bit lies inside the kernel block pair rows at stride 2^(q-7) (VPU 2x2
+  combine); diagonal factors over up to two row bits become per-row
+  multiplicative tables; and gates CONTROLLED on row bits apply under an
+  iota-derived row mask — the global row index (grid block base + local
+  row) makes any row-bit control addressable, not just in-block ones.
 
-Complex arithmetic runs on split re/im planes (4 real matmuls per lane
+A layer is an ordered list of STAGES (see :class:`LayerOp`); adjacent
+compatible stages are merged by the collector (`circuits._collect_layers`),
+and the whole list executes inside one ``pallas_call`` — one read + one
+write of the state regardless of stage count.
+
+Complex arithmetic runs on split re/im planes (4 real MXU matmuls per lane
 matrix; see `core/packing.py` for why planes are the storage format anyway).
 """
 
@@ -39,8 +45,8 @@ LANE_QUBITS = 7          # 2^7 = 128 lanes
 DEFAULT_BLOCK_ROWS = 1024
 
 __all__ = ["LANE_QUBITS", "DEFAULT_BLOCK_ROWS", "LayerOp",
-           "embed_lane_matrix", "lane_diag_matrix", "max_mid_qubit",
-           "apply_layer"]
+           "embed_lane_matrix", "lane_diag_matrix", "lane_diag_vector",
+           "max_mid_qubit", "apply_layer"]
 
 
 def embed_lane_matrix(u: np.ndarray, targets: Sequence[int],
@@ -73,31 +79,54 @@ def embed_lane_matrix(u: np.ndarray, targets: Sequence[int],
     return full
 
 
-def lane_diag_matrix(tensor: np.ndarray,
+def lane_diag_vector(tensor: np.ndarray,
                      qubits_desc: Sequence[int]) -> np.ndarray:
-    """Embed a diagonal factor tensor ((2,)*k, axes = qubits sorted desc)
-    over lane qubits as a diagonal 128x128 operator."""
+    """Evaluate a diagonal factor tensor ((2,)*k, axes = lane qubits sorted
+    desc) into a per-lane factor vector of length 128."""
     dim = 1 << LANE_QUBITS
     d = np.ones(dim, dtype=np.complex128)
     k = len(qubits_desc)
     for lane in range(dim):
         idx = tuple((lane >> q) & 1 for q in qubits_desc)
-        d[lane] = tensor[idx] if k else 1.0
-    return np.diag(d)
+        d[lane] = tensor[idx] if k else tensor[()] if tensor.ndim == 0 else 1.0
+    return d
+
+
+def lane_diag_matrix(tensor: np.ndarray,
+                     qubits_desc: Sequence[int]) -> np.ndarray:
+    """Embed a diagonal factor tensor ((2,)*k, axes = qubits sorted desc)
+    over lane qubits as a diagonal 128x128 operator."""
+    return np.diag(lane_diag_vector(tensor, qubits_desc))
 
 
 def max_mid_qubit(block_rows: int) -> int:
-    """Highest qubit index the kernel handles for a given block size."""
+    """Highest qubit index a dense (row-pairing) gate can target for a
+    given block size. Controls and diagonal factors address ANY row bit
+    (they read the global row index), so this bounds targets only."""
     return LANE_QUBITS + int(np.log2(block_rows)) - 1
 
 
 class LayerOp:
-    """A fused layer: one lane matrix + an ordered list of mid-qubit gates.
+    """A fused layer: an ordered list of stages applied in one HBM pass.
 
-    ``mid_gates`` holds ``(qubit, u2x2)``; lane and mid sets act on disjoint
-    qubits, so the kernel applies the lane matmul first regardless of the
-    recorded interleaving. Quacks enough like circuits._Op for the layout
-    planner (kind/targets/masks/is_static).
+    Stage forms (``q``/mask bit positions are the KERNEL's physical qubit
+    positions — the collector has already mapped logical->physical):
+
+    - ``("lane", M)`` — unconditional 128x128 complex matrix on the lane
+      axis (a merged run of lane-qubit gates, dense and diagonal).
+    - ``("clane", M, row_mask, row_want)`` — lane matrix applied only to
+      rows whose global row index matches ``(row & row_mask) == row_want``
+      (masks in row-bit coordinates: bit ``p`` = qubit ``p+7``).
+    - ``("row", q, u2x2, lane_mask, lane_want, row_mask, row_want)`` —
+      dense 2x2 on row-bit target ``q`` (>= 7), conditioned on lane
+      controls (mask over the 128-lane index) and/or row controls.
+    - ``("rowdiag", table, row_bits)`` — multiplicative per-amplitude
+      factor: ``table`` is complex ``(2^k, 128)``; the factor row is
+      selected by the bits of the global row index at ``row_bits``
+      (ascending positions, in row-bit coordinates).
+
+    Quacks enough like circuits._Op for the executors (kind/targets/
+    masks/is_static).
     """
 
     kind = "layer"
@@ -107,47 +136,123 @@ class LayerOp:
     mat_fn = None
     diag_fn = None
 
-    def __init__(self, num_qubits: int, members: int,
-                 lane_matrix: Optional[np.ndarray],
-                 mid_gates: list[tuple[int, np.ndarray]]):
+    def __init__(self, num_qubits: int, members: int, stages: list,
+                 support: Optional[set] = None):
         self.num_qubits = num_qubits
         self.members = members            # how many recorded ops were fused
-        self.lane_matrix = lane_matrix    # 128x128 complex or None
-        self.mid_gates = mid_gates
-        self.targets = tuple(sorted(
-            {q for q, _ in mid_gates}
-            | (set(range(min(LANE_QUBITS, num_qubits)))
-               if lane_matrix is not None else set())))
+        self.stages = stages
+        if support is None:
+            support = set()
+            for st in stages:
+                if st[0] in ("lane", "clane"):
+                    support |= set(range(min(LANE_QUBITS, num_qubits)))
+                elif st[0] == "row":
+                    support.add(st[1])
+                else:
+                    support |= {b + LANE_QUBITS for b in st[2]}
+        self.targets = tuple(sorted(support))
+
+    # -- legacy views (round-4 shape: one lane matrix + uncontrolled mids) --
+
+    @property
+    def lane_matrix(self):
+        for st in self.stages:
+            if st[0] == "lane":
+                return st[1]
+        return None
+
+    @property
+    def mid_gates(self):
+        return [(st[1], st[2]) for st in self.stages
+                if st[0] == "row" and st[3] == 0 and st[5] == 0]
 
 
-def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, ore_ref, oim_ref,
-                  *, mid_static, use_lane):
+def _global_row(base, shape, axis):
+    """Global row index, broadcast over ``shape`` along ``axis``."""
+    return base + jax.lax.broadcasted_iota(jnp.int32, shape, axis)
+
+
+def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, tre_ref, tim_ref,
+                  ore_ref, oim_ref, *, stages, block_rows):
+    from jax.experimental import pallas as pl
+
     re = re_ref[:]
     im = im_ref[:]
-    if use_lane:
-        mre_t = mre_ref[:].T
-        mim_t = mim_ref[:].T
-        acc = re.dtype  # f32 accumulate on TPU; f64 under x64 interpret
-        # out = v @ M^T (columns of M index the input lane), complex via 4
-        # real MXU matmuls on (rows,128)x(128,128)
-        new_re = (jnp.dot(re, mre_t, preferred_element_type=acc)
-                  - jnp.dot(im, mim_t, preferred_element_type=acc))
-        new_im = (jnp.dot(re, mim_t, preferred_element_type=acc)
-                  + jnp.dot(im, mre_t, preferred_element_type=acc))
-        re, im = new_re.astype(re.dtype), new_im.astype(im.dtype)
-    rows = re.shape[0]
-    for stride, (ar, ai, br, bi, cr, ci, dr, di) in mid_static:
-        blocks = rows // (2 * stride)
-        sre = re.reshape(blocks, 2, stride, 128)
-        sim = im.reshape(blocks, 2, stride, 128)
-        up_re, lo_re = sre[:, 0], sre[:, 1]
-        up_im, lo_im = sim[:, 0], sim[:, 1]
-        nu_re = ar * up_re - ai * up_im + br * lo_re - bi * lo_im
-        nu_im = ar * up_im + ai * up_re + br * lo_im + bi * lo_re
-        nl_re = cr * up_re - ci * up_im + dr * lo_re - di * lo_im
-        nl_im = cr * up_im + ci * up_re + dr * lo_im + di * lo_re
-        re = jnp.stack([nu_re, nl_re], axis=1).reshape(rows, 128)
-        im = jnp.stack([nu_im, nl_im], axis=1).reshape(rows, 128)
+    rows = block_rows
+    base = pl.program_id(0) * rows
+    acc = re.dtype  # f32 accumulate on TPU; f64 under x64 interpret
+    for st in stages:
+        tag = st[0]
+        if tag in ("lane", "clane"):
+            _, mi, row_mask, row_want = st
+            mre_t = mre_ref[mi, :, :].T
+            mim_t = mim_ref[mi, :, :].T
+            # out = v @ M^T (columns of M index the input lane), complex
+            # via 4 real MXU matmuls on (rows,128)x(128,128)
+            new_re = (jnp.dot(re, mre_t, preferred_element_type=acc)
+                      - jnp.dot(im, mim_t, preferred_element_type=acc))
+            new_im = (jnp.dot(re, mim_t, preferred_element_type=acc)
+                      + jnp.dot(im, mre_t, preferred_element_type=acc))
+            new_re = new_re.astype(re.dtype)
+            new_im = new_im.astype(im.dtype)
+            if row_mask:
+                # the row index is already in row-bit coordinates (bit p
+                # of the row index = qubit p+7); masks were shifted down
+                # by LANE_QUBITS at collection time
+                g = _global_row(base, (rows, 1), 0)
+                cond = (g & row_mask) == row_want
+                re = jnp.where(cond, new_re, re)
+                im = jnp.where(cond, new_im, im)
+            else:
+                re, im = new_re, new_im
+        elif tag == "row":
+            (_, stride, (ar, ai, br, bi, cr, ci, dr, di),
+             lane_mask, lane_want, row_mask, row_want) = st
+            blocks = rows // (2 * stride)
+            sre = re.reshape(blocks, 2, stride, 128)
+            sim = im.reshape(blocks, 2, stride, 128)
+            up_re, lo_re = sre[:, 0], sre[:, 1]
+            up_im, lo_im = sim[:, 0], sim[:, 1]
+            nu_re = ar * up_re - ai * up_im + br * lo_re - bi * lo_im
+            nu_im = ar * up_im + ai * up_re + br * lo_im + bi * lo_re
+            nl_re = cr * up_re - ci * up_im + dr * lo_re - di * lo_im
+            nl_im = cr * up_im + ci * up_re + dr * lo_im + di * lo_re
+            if lane_mask or row_mask:
+                shape = (blocks, stride, 128)
+                cond = None
+                if row_mask:
+                    # row index of the UP half; the target bit is 0 there
+                    # and control masks never include the target bit, so
+                    # the condition holds for both halves of the pair
+                    blk = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+                    s = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+                    g_up = base + blk * (2 * stride) + s
+                    cond = (g_up & row_mask) == row_want
+                if lane_mask:
+                    lane = jax.lax.broadcasted_iota(jnp.int32, shape, 2)
+                    lcond = (lane & lane_mask) == lane_want
+                    cond = lcond if cond is None else cond & lcond
+                nu_re = jnp.where(cond, nu_re, up_re)
+                nu_im = jnp.where(cond, nu_im, up_im)
+                nl_re = jnp.where(cond, nl_re, lo_re)
+                nl_im = jnp.where(cond, nl_im, lo_im)
+            re = jnp.stack([nu_re, nl_re], axis=1).reshape(rows, 128)
+            im = jnp.stack([nu_im, nl_im], axis=1).reshape(rows, 128)
+        else:  # rowdiag
+            _, toff, bits = st
+            g = _global_row(base, (rows, 1), 0)
+            cfg = jnp.zeros((rows, 1), jnp.int32)
+            for j, b in enumerate(bits):
+                cfg = cfg | (((g >> b) & 1) << j)
+            fre = jnp.zeros((rows, 128), re.dtype)
+            fim = jnp.zeros((rows, 128), im.dtype)
+            for c in range(1 << len(bits)):
+                sel = cfg == c
+                fre = jnp.where(sel, tre_ref[toff + c, :][None, :], fre)
+                fim = jnp.where(sel, tim_ref[toff + c, :][None, :], fim)
+            new_re = re * fre - im * fim
+            new_im = re * fim + im * fre
+            re, im = new_re, new_im
     ore_ref[:] = re
     oim_ref[:] = im
 
@@ -164,38 +269,64 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
         raise ValueError("fused layers need at least 7 qubits")
     block_rows = min(block_rows, total_rows)
     hi = max_mid_qubit(block_rows)
-    mid_static = []
-    for q, u in layer.mid_gates:
-        if not LANE_QUBITS <= q <= hi:
-            raise ValueError(f"mid gate qubit {q} outside [{LANE_QUBITS}, {hi}]")
-        mid_static.append((1 << (q - LANE_QUBITS),
-                           (float(u[0, 0].real), float(u[0, 0].imag),
-                            float(u[0, 1].real), float(u[0, 1].imag),
-                            float(u[1, 0].real), float(u[1, 0].imag),
-                            float(u[1, 1].real), float(u[1, 1].imag))))
+
+    # static stage plan + stacked matrix/table operands
+    mats: list[np.ndarray] = []
+    tables: list[np.ndarray] = []
+    kstages: list[tuple] = []
+    for st in layer.stages:
+        if st[0] in ("lane", "clane"):
+            if st[0] == "lane":
+                m, row_mask, row_want = st[1], 0, 0
+            else:
+                _, m, row_mask, row_want = st
+            kstages.append(("lane", len(mats), int(row_mask), int(row_want)))
+            mats.append(np.ascontiguousarray(m))
+        elif st[0] == "row":
+            _, q, u, lane_mask, lane_want, row_mask, row_want = st
+            if not LANE_QUBITS <= q <= hi:
+                raise ValueError(
+                    f"row-gate target {q} outside [{LANE_QUBITS}, {hi}]")
+            u = np.asarray(u)
+            kstages.append((
+                "row", 1 << (q - LANE_QUBITS),
+                (float(u[0, 0].real), float(u[0, 0].imag),
+                 float(u[0, 1].real), float(u[0, 1].imag),
+                 float(u[1, 0].real), float(u[1, 0].imag),
+                 float(u[1, 1].real), float(u[1, 1].imag)),
+                int(lane_mask), int(lane_want),
+                int(row_mask), int(row_want)))
+        else:
+            _, table, bits = st
+            kstages.append(("rowdiag", len(tables), tuple(int(b)
+                                                          for b in bits)))
+            tables.extend(np.asarray(table))
 
     rdtype = jnp.float32 if state.dtype == jnp.complex64 else jnp.float64
     re = jnp.real(state).astype(rdtype).reshape(total_rows, 128)
     im = jnp.imag(state).astype(rdtype).reshape(total_rows, 128)
-    use_lane = layer.lane_matrix is not None
-    if use_lane:
-        mre = jnp.asarray(np.ascontiguousarray(layer.lane_matrix.real), rdtype)
-        mim = jnp.asarray(np.ascontiguousarray(layer.lane_matrix.imag), rdtype)
-    else:
-        mre = jnp.zeros((128, 128), rdtype)
-        mim = jnp.zeros((128, 128), rdtype)
+    mstack = (np.stack(mats) if mats
+              else np.zeros((1, 128, 128), np.complex128))
+    tstack = (np.stack(tables) if tables
+              else np.zeros((1, 128), np.complex128))
+    mre = jnp.asarray(mstack.real, rdtype)
+    mim = jnp.asarray(mstack.imag, rdtype)
+    tre = jnp.asarray(tstack.real, rdtype)
+    tim = jnp.asarray(tstack.imag, rdtype)
 
-    kernel = functools.partial(_layer_kernel, mid_static=tuple(mid_static),
-                               use_lane=use_lane)
+    kernel = functools.partial(_layer_kernel, stages=tuple(kstages),
+                               block_rows=block_rows)
     state_spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
-    mat_spec = pl.BlockSpec((128, 128), lambda i: (0, 0))
+    mat_spec = pl.BlockSpec(mstack.shape, lambda i: (0, 0, 0))
+    tab_spec = pl.BlockSpec(tstack.shape, lambda i: (0, 0))
     with jax.named_scope(f"pallas_layer_{layer.members}gates"):
         out_re, out_im = pl.pallas_call(
             kernel,
             grid=(total_rows // block_rows,),
-            in_specs=[state_spec, state_spec, mat_spec, mat_spec],
+            in_specs=[state_spec, state_spec, mat_spec, mat_spec,
+                      tab_spec, tab_spec],
             out_specs=[state_spec, state_spec],
             out_shape=[jax.ShapeDtypeStruct((total_rows, 128), rdtype)] * 2,
             interpret=interpret,
-        )(re, im, mre, mim)
+        )(re, im, mre, mim, tre, tim)
     return jax.lax.complex(out_re, out_im).reshape(-1).astype(state.dtype)

@@ -454,6 +454,18 @@ def validate_matching_dims(a_qubits: int, b_qubits: int, func: str) -> None:
               ErrorCode.E_MISMATCHING_QUREG_DIMENSIONS)
 
 
+def validate_matching_precision(a_prec: int, b_prec: int, func: str) -> None:
+    """Framework extension (no reference analogue — a QuEST build is one
+    precision throughout, `QuEST_precision.h:28-65`): register-pair
+    kernels assume both operands share a plane layout, and a (2,N)
+    native-tier partner inside a (4,N) quad-tier op would fail only later
+    with an unrelated shape error (advisor r4)."""
+    if a_prec != b_prec:
+        _fail("the registers must share a precision tier (QUEST_PREC "
+              f"{a_prec} vs {b_prec})", func,
+              ErrorCode.E_MISMATCHING_QUREG_TYPES)
+
+
 def validate_sys_printable(num_qubits: int, func: str) -> None:
     """``E_SYS_TOO_BIG_TO_PRINT`` (``QuEST_validation.c:97``): terminal
     report functions refuse registers above 5 qubits."""

@@ -18,7 +18,14 @@ class BuildWithNative(build_py):
     def run(self):
         root = Path(__file__).parent
         src = root / "native" / "src" / "scheduler.cc"
-        out = root / "quest_tpu" / "native" / "libquest_sched.so"
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "quest_tpu_hosttag",
+            root / "quest_tpu" / "native" / "hosttag.py")
+        hosttag = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(hosttag)
+        out = (root / "quest_tpu" / "native"
+               / f"libquest_sched.{hosttag.HOST_TAG}.so")
         if src.exists():
             try:
                 subprocess.run(

@@ -249,6 +249,16 @@ def _diff_sequence(envx, ref, seed, density, check_every=True):
         for t in range(N):
             assert abs(qt.calcProbOfOutcome(q, t, 1)
                        - ref.lib.calcProbOfOutcome(rq, t, 1)) < 1e-10
+        # fused Pauli-sum vs the reference's per-term workspace loop
+        # (advisor r4: the fused path must be cross-checked against the
+        # reference, not only its own regenerated corpus). 50 terms also
+        # exercises the chunked-unroll path (_PAULI_SUM_CHUNK=48).
+        num_terms = 50
+        codes = tuple(int(c) for c in rng.integers(0, 4, num_terms * N))
+        coeffs = tuple(float(c) for c in rng.uniform(-1, 1, num_terms))
+        got = qt.calcExpecPauliSum(q, codes, coeffs)
+        want = ADAPTERS["calcExpecPauliSum"](ref, rq, (codes, coeffs))
+        assert abs(got - want) < 1e-9, f"pauli sum: {got} vs {want}"
     finally:
         ref.lib.destroyQureg(rq, ref.env)
 

@@ -48,14 +48,19 @@ class TestCollection:
         assert kinds.count("layer") == 1
         assert kinds.count("u") == 1
 
-    def test_controlled_on_mid_not_fused(self):
+    def test_controlled_on_mid_fuses_as_clane(self):
+        # round-5 widening (VERDICT r4 item 5): a lane-target gate with a
+        # row-qubit control becomes a conditional-lane stage instead of
+        # breaking the run
         c = Circuit(10)
         c.h(0).h(1)
-        c.cnot(8, 0)       # control on mid qubit: ineligible
+        c.cnot(8, 0)       # control on row qubit: "clane" stage
         c.h(2).h(3)
         ops = _collect_layers(c._fused_ops(), 10)
-        kinds = [getattr(o, "kind", None) for o in ops]
-        assert kinds.count("layer") == 2 and kinds.count("u") == 1
+        (layer,) = [o for o in ops if getattr(o, "kind", None) == "layer"]
+        assert layer.members == 5
+        tags = [st[0] for st in layer.stages]
+        assert "clane" in tags
 
     def test_embed_matches_oracle(self):
         import sys, os
@@ -113,3 +118,119 @@ class TestExecution:
                       if getattr(o, "kind", None) == "layer")
         assert n_layer >= 1
         assert len(cc_p._ops) < len(cc_x._ops)
+
+
+class TestWidenedEligibility:
+    """Round-5 widening (VERDICT r4 item 5): mid-qubit controlled gates,
+    row-controlled lane gates, and high-qubit diagonals all fuse."""
+
+    def test_cz_on_high_qubits_fuses(self, env):
+        c = Circuit(12)
+        c.h(0).h(1)
+        c.cz(10, 11).cz(3, 9)     # diagonals on/through row bits
+        c.rz(8, 0.4)
+        got = run(c, env, pallas="interpret")
+        want = run(c, env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+        # collection check on the raw stream (the diag-fusion pass would
+        # first merge the three phases into one 4-row-bit diagonal)
+        ops = _collect_layers(list(c.ops), 12)
+        (layer,) = [o for o in ops if getattr(o, "kind", None) == "layer"]
+        assert layer.members == 5
+
+    def test_cnot_row_control_lane_target(self, env):
+        c = Circuit(10)
+        c.h(0).h(9)
+        c.cnot(9, 0)              # row control, lane target: clane
+        c.cnot(0, 9)              # lane control, row target: masked row
+        c.cnot(8, 9)              # row control, row target: masked row
+        got = run(c, env, pallas="interpret")
+        want = run(c, env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_brickwork_fuses_2x(self, env):
+        """The bench brickwork must collapse into >= 2x fewer passes than
+        gates recorded (VERDICT r4 item 5 'Done' criterion)."""
+        import sys, os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+        from bench import build_bench_circuit
+        c, n_gates = build_bench_circuit(10, layers=4)
+        cc = c.compile(env, pallas="interpret")
+        passes = sum(1 for it in cc.plan.items if it[0] == "op")
+        assert passes * 2 <= n_gates, (passes, n_gates)
+        got = run(c, env, pallas="interpret")
+        want = run(c, env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_random_dense_controlled_circuit(self, env):
+        rng = np.random.default_rng(11)
+        c = Circuit(10)
+        for _ in range(40):
+            kind = rng.integers(0, 4)
+            q = int(rng.integers(0, 10))
+            other = int(rng.integers(0, 10))
+            if other == q:
+                other = (q + 1) % 10
+            if kind == 0:
+                c.rotate(q, float(rng.uniform(0, 6)), rng.normal(size=3))
+            elif kind == 1:
+                c.cnot(other, q)
+            elif kind == 2:
+                c.cz(other, q)
+            else:
+                c.crz(other, q, float(rng.uniform(0, 6)))
+        got = run(c, env, pallas="interpret")
+        want = run(c, env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+
+class TestShardedLayers:
+    """Round-5 (VERDICT r4 item 2): layers inside the shard_map local
+    body — per-chip local gates ride the fused kernel on a mesh."""
+
+    def _ops_by_kind(self, cc):
+        kinds = {}
+        for it in cc.plan.items:
+            k = cc._ops[it[1]].kind if it[0] == "op" else "relayout"
+            kinds[k] = kinds.get(k, 0) + 1
+        return kinds
+
+    def test_sharded_brickwork_has_layers_and_matches(self, env, mesh_env):
+        import sys, os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+        from bench import build_bench_circuit
+        c, _ = build_bench_circuit(12, layers=3)
+        cc = c.compile(mesh_env, pallas="interpret")
+        kinds = self._ops_by_kind(cc)
+        assert kinds.get("layer", 0) >= 1, kinds
+
+        q8 = qt.createQureg(12, mesh_env)
+        qt.initDebugState(q8)
+        cc.run(q8)
+        q1 = qt.createQureg(12, env)
+        qt.initDebugState(q1)
+        c.compile(env, pallas=False).run(q1)
+        np.testing.assert_allclose(q8.to_numpy(), q1.to_numpy(),
+                                   atol=1e-10)
+
+    def test_sharded_qft_with_layers_matches(self, env, mesh_env):
+        c = alg.qft(11)
+        q8 = qt.createQureg(11, mesh_env)
+        qt.initPlusState(q8)
+        c.compile(mesh_env, pallas="interpret").run(q8)
+        q1 = qt.createQureg(11, env)
+        qt.initPlusState(q1)
+        c.compile(env, pallas=False).run(q1)
+        np.testing.assert_allclose(q8.to_numpy(), q1.to_numpy(),
+                                   atol=1e-10)
+
+    def test_sharded_random_with_layers_matches(self, env, mesh_env):
+        c = alg.random_circuit(11, depth=6, seed=4)
+        q8 = qt.createQureg(11, mesh_env)
+        qt.initDebugState(q8)
+        c.compile(mesh_env, pallas="interpret").run(q8)
+        q1 = qt.createQureg(11, env)
+        qt.initDebugState(q1)
+        c.compile(env, pallas=False).run(q1)
+        np.testing.assert_allclose(q8.to_numpy(), q1.to_numpy(),
+                                   atol=1e-10)

@@ -212,3 +212,22 @@ def test_taxonomy_complete():
 def test_error_carries_func_name(sv, dm, env):
     with pytest.raises(qt.QuESTError, match="hadamard"):
         qt.hadamard(sv, 9)
+
+
+def test_mismatched_precision_tier_rejected():
+    """Advisor r4: register-pair ops must reject partners from a
+    different precision tier up front, not fail later with a shape
+    error inside an unrelated kernel."""
+    import quest_tpu as qt
+    from quest_tpu.config import QUAD64
+    env2 = qt.createQuESTEnv(seed=[1])                    # native f64 tier
+    env4 = qt.createQuESTEnv(seed=[1], precision=QUAD64)  # quad (dd) tier
+    a = qt.createQureg(3, env4)
+    b = qt.createQureg(3, env2)
+    for fn in (lambda: qt.initPureState(a, b),
+               lambda: qt.cloneQureg(a, b),
+               lambda: qt.setWeightedQureg(0.5, a, 0.5, b, 0.0, a),
+               lambda: qt.calcInnerProduct(a, b),
+               lambda: qt.calcFidelity(a, b)):
+        with pytest.raises(qt.QuESTError, match="precision tier"):
+            fn()
