@@ -24,10 +24,26 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+# The "fast" tier (VERDICT r4 item 8): essential + golden + exchange core,
+# guaranteed to finish inside any bounded driver budget (`pytest -m fast`
+# < 2 min on this box; README "Testing").
+FAST_MODULES = {
+    "test_essential", "test_golden", "test_golden_ref", "test_exchange",
+    "test_validation_taxonomy",
+}
+
+
 def pytest_collection_modifyitems(config, items):
     """Run the essential tier first (the reference runs tests/essential/
-    before everything and aborts on failure — `QuESTTest/__main__.py`)."""
+    before everything and aborts on failure — `QuESTTest/__main__.py`),
+    and mark the fast tier."""
     items.sort(key=lambda it: 0 if "test_essential" in it.nodeid else 1)
+    for it in items:
+        mod = it.nodeid.split("::")[0].rsplit("/", 1)[-1]
+        if mod.endswith(".py"):
+            mod = mod[:-3]
+        if mod in FAST_MODULES:
+            it.add_marker(pytest.mark.fast)
 
 
 @pytest.fixture
