@@ -1,0 +1,168 @@
+/** quest_tpu C-ABI shim header.
+ *
+ * Lets reference user programs (e.g.
+ * /root/reference/examples/tutorial_example.c) compile UNMODIFIED against
+ * the TPU framework: same function names/signatures and struct FIELD
+ * names as the reference's public API (declared at QuEST.h:104-3191),
+ * re-declared here from scratch for a recompile-from-source ABI — struct
+ * layouts are this shim's own (user code is recompiled, so only source
+ * compatibility is required; registers live Python-side behind integer
+ * handles).
+ *
+ * Coverage: the environment/register lifecycle, the init family, the
+ * full 1q/controlled/multi-controlled gate set, compact/general/multi-
+ * qubit unitaries, rotations, measurement, and the common calc_*
+ * queries — everything the shipped examples use, see
+ * native/src/c_shim.cc for the function-by-function list. Backend
+ * selection: QUEST_TPU_C_PLATFORM env var ("cpu" default, "tpu" for a
+ * real chip).
+ */
+
+#ifndef QUEST_TPU_C_SHIM_H
+#define QUEST_TPU_C_SHIM_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* double precision throughout: the reference's QUEST_PREC=2 default
+ * (QuEST_precision.h:39-47) */
+typedef double qreal;
+
+typedef struct Complex {
+    qreal real;
+    qreal imag;
+} Complex;
+
+typedef struct ComplexMatrix2 {
+    qreal real[2][2];
+    qreal imag[2][2];
+} ComplexMatrix2;
+
+typedef struct ComplexMatrix4 {
+    qreal real[4][4];
+    qreal imag[4][4];
+} ComplexMatrix4;
+
+typedef struct ComplexMatrixN {
+    int numQubits;
+    qreal **real;
+    qreal **imag;
+} ComplexMatrixN;
+
+typedef struct Vector {
+    qreal x, y, z;
+} Vector;
+
+typedef struct QuESTEnv {
+    int handle;
+    int numRanks;
+} QuESTEnv;
+
+typedef struct Qureg {
+    int handle;
+    int numQubitsRepresented;
+    int numQubitsInStateVec;
+    long long int numAmpsTotal;
+    int isDensityMatrix;
+} Qureg;
+
+/* environment */
+QuESTEnv createQuESTEnv(void);
+void destroyQuESTEnv(QuESTEnv env);
+void reportQuESTEnv(QuESTEnv env);
+void seedQuEST(unsigned long int *seedArray, int numSeeds);
+
+/* registers */
+Qureg createQureg(int numQubits, QuESTEnv env);
+Qureg createDensityQureg(int numQubits, QuESTEnv env);
+void destroyQureg(Qureg qureg, QuESTEnv env);
+void reportQuregParams(Qureg qureg);
+void reportStateToScreen(Qureg qureg, QuESTEnv env, int reportRank);
+
+/* matrices */
+ComplexMatrixN createComplexMatrixN(int numQubits);
+void destroyComplexMatrixN(ComplexMatrixN matr);
+
+/* init */
+void initZeroState(Qureg qureg);
+void initPlusState(Qureg qureg);
+void initClassicalState(Qureg qureg, long long int stateInd);
+void initDebugState(Qureg qureg);
+void initPureState(Qureg qureg, Qureg pure);
+
+/* 1q gates */
+void hadamard(Qureg qureg, int targetQubit);
+void pauliX(Qureg qureg, int targetQubit);
+void pauliY(Qureg qureg, int targetQubit);
+void pauliZ(Qureg qureg, int targetQubit);
+void sGate(Qureg qureg, int targetQubit);
+void tGate(Qureg qureg, int targetQubit);
+void phaseShift(Qureg qureg, int targetQubit, qreal angle);
+void rotateX(Qureg qureg, int rotQubit, qreal angle);
+void rotateY(Qureg qureg, int rotQubit, qreal angle);
+void rotateZ(Qureg qureg, int rotQubit, qreal angle);
+void rotateAroundAxis(Qureg qureg, int rotQubit, qreal angle, Vector axis);
+void compactUnitary(Qureg qureg, int targetQubit, Complex alpha, Complex beta);
+void unitary(Qureg qureg, int targetQubit, ComplexMatrix2 u);
+
+/* controlled */
+void controlledNot(Qureg qureg, int controlQubit, int targetQubit);
+void controlledPauliY(Qureg qureg, int controlQubit, int targetQubit);
+void controlledPhaseFlip(Qureg qureg, int idQubit1, int idQubit2);
+void controlledPhaseShift(Qureg qureg, int idQubit1, int idQubit2,
+                          qreal angle);
+void controlledRotateX(Qureg qureg, int controlQubit, int targetQubit,
+                       qreal angle);
+void controlledRotateY(Qureg qureg, int controlQubit, int targetQubit,
+                       qreal angle);
+void controlledRotateZ(Qureg qureg, int controlQubit, int targetQubit,
+                       qreal angle);
+void controlledRotateAroundAxis(Qureg qureg, int controlQubit,
+                                int targetQubit, qreal angle, Vector axis);
+void controlledCompactUnitary(Qureg qureg, int controlQubit, int targetQubit,
+                              Complex alpha, Complex beta);
+void controlledUnitary(Qureg qureg, int controlQubit, int targetQubit,
+                       ComplexMatrix2 u);
+void multiControlledPhaseFlip(Qureg qureg, int *controlQubits,
+                              int numControlQubits);
+void multiControlledPhaseShift(Qureg qureg, int *controlQubits,
+                               int numControlQubits, qreal angle);
+void multiControlledUnitary(Qureg qureg, int *controlQubits,
+                            int numControlQubits, int targetQubit,
+                            ComplexMatrix2 u);
+void swapGate(Qureg qureg, int qubit1, int qubit2);
+
+/* multi-qubit unitaries */
+void twoQubitUnitary(Qureg qureg, int targetQubit1, int targetQubit2,
+                     ComplexMatrix4 u);
+void multiQubitUnitary(Qureg qureg, int *targs, int numTargs,
+                       ComplexMatrixN u);
+
+/* noise (density registers) */
+void mixDephasing(Qureg qureg, int targetQubit, qreal prob);
+void mixDepolarising(Qureg qureg, int targetQubit, qreal prob);
+void mixDamping(Qureg qureg, int targetQubit, qreal prob);
+
+/* measurement + queries */
+int measure(Qureg qureg, int measureQubit);
+int measureWithStats(Qureg qureg, int measureQubit, qreal *outcomeProb);
+qreal collapseToOutcome(Qureg qureg, int measureQubit, int outcome);
+qreal calcTotalProb(Qureg qureg);
+qreal calcProbOfOutcome(Qureg qureg, int measureQubit, int outcome);
+qreal calcPurity(Qureg qureg);
+qreal calcFidelity(Qureg qureg, Qureg pureState);
+Complex calcInnerProduct(Qureg bra, Qureg ket);
+Complex getAmp(Qureg qureg, long long int index);
+Complex getDensityAmp(Qureg qureg, long long int row, long long int col);
+qreal getRealAmp(Qureg qureg, long long int index);
+qreal getImagAmp(Qureg qureg, long long int index);
+qreal getProbAmp(Qureg qureg, long long int index);
+int getNumQubits(Qureg qureg);
+long long int getNumAmps(Qureg qureg);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* QUEST_TPU_C_SHIM_H */
