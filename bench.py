@@ -38,6 +38,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -53,14 +54,23 @@ def _remaining() -> float:
 _PLATFORM = None   # set by main() in measurement children
 
 
+_EMIT_LOCK = threading.Lock()
+
+
 def emit(line: dict) -> None:
     """Print one result line immediately — never buffer (VERDICT r2 W1).
     Every row carries the child's backend platform so the supervisor can
-    classify grant attempts regardless of which config delivered first."""
+    classify grant attempts regardless of which config delivered first.
+    Single atomic write under a lock: heartbeat threads emit concurrently
+    with the config being timed, and print()'s separate payload/newline
+    writes can interleave across threads, corrupting the line protocol
+    the parent watchdog parses."""
     line.setdefault("elapsed_s", round(time.perf_counter() - T0, 1))
     if _PLATFORM is not None:
         line.setdefault("platform", _PLATFORM)
-    print(json.dumps(line), flush=True)
+    with _EMIT_LOCK:
+        sys.stdout.write(json.dumps(line) + "\n")
+        sys.stdout.flush()
 
 
 def _run_child(extra_env: dict, first_line_deadline: float,
@@ -137,6 +147,45 @@ def _run_child(extra_env: dict, first_line_deadline: float,
 def _is_accel(platform: str) -> bool:
     """axon is the tunneled TPU plugin; treat it as the TPU class."""
     return platform in ("tpu", "axon")
+
+
+class _Heartbeat:
+    """Emit bounded liveness rows while a slow compile runs.
+
+    The r5 live tunnel measured XLA compiles scaling ~ops x 2^n (408 s for
+    a 71-op program at 24q) — far past the parent's per-line progress
+    watchdog, which killed the whole child mid-compile and lost every
+    later config. A heartbeat row every ``interval`` keeps a LEGITIMATE
+    compile alive; ``max_beats`` bounds it so a genuinely hung tunnel
+    still dies by watchdog ``interval * max_beats + progress_s`` after
+    entering the config. Rows carry value 0.0: they never count as
+    delivered results."""
+
+    def __init__(self, name: str, interval: float = 60.0,
+                 max_beats: int = 9):
+        self._name = name
+        self._interval = interval
+        self._max = max_beats
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        for i in range(self._max):
+            if self._stop.wait(self._interval):
+                return
+            emit({"metric": f"{self._name} in progress (heartbeat "
+                            f"{i + 1}/{self._max})",
+                  "value": 0.0, "unit": "s", "vs_baseline": 0.0,
+                  "unix_ts": round(time.time(), 1)})
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+        return False
 
 
 def build_bench_circuit(num_qubits: int, layers: int):
@@ -454,8 +503,11 @@ def bench_native_density() -> dict:
 
 def bench_qft(qt, env, platform: str) -> dict:
     from quest_tpu.algorithms import qft
+    # accel size bounded by the tunnel's measured compile scaling
+    # (~3.3e-7 s per op-amp: QFT-26's 351 ops at 2^26 would compile for
+    # ~2 h; QFT-22 lands in ~6 min once, then the persistent cache owns it)
     num_qubits = int(os.environ.get(
-        "QUEST_BENCH_QFT_QUBITS", "26" if _is_accel(platform) else "18"))
+        "QUEST_BENCH_QFT_QUBITS", "22" if _is_accel(platform) else "18"))
     trials = int(os.environ.get("QUEST_BENCH_TRIALS", "10"))
     q = qt.createQureg(num_qubits, env)
     qt.initPlusState(q)
@@ -470,7 +522,7 @@ def bench_qft(qt, env, platform: str) -> dict:
 def bench_grover(qt, env, platform: str) -> dict:
     from quest_tpu.algorithms import grover
     num_qubits = int(os.environ.get(
-        "QUEST_BENCH_GROVER_QUBITS", "24" if _is_accel(platform) else "16"))
+        "QUEST_BENCH_GROVER_QUBITS", "20" if _is_accel(platform) else "16"))
     trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 2)
     q = qt.createQureg(num_qubits, env)
     qt.initZeroState(q)
@@ -868,7 +920,9 @@ def main() -> None:
         # compiled executable is timed directly by the headline (one
         # compile, not two)
         try:
-            aot_row, aot = bench_aot_compile(qt, env, platform, nq_small)
+            with _Heartbeat("aot compile"):
+                aot_row, aot = bench_aot_compile(qt, env, platform,
+                                                 nq_small)
             emit(aot_row)
         except Exception as e:
             emit({"metric": "aot compile (error)", "value": 0.0,
@@ -911,13 +965,13 @@ def main() -> None:
 
     # remaining configs, cheapest-risk first; each gated on remaining budget
     nq_big = int(os.environ.get(
-        "QUEST_BENCH_BIG_QUBITS", "26" if accel else "20"))
+        "QUEST_BENCH_BIG_QUBITS", "24" if accel else "20"))
+    full_cfg = ("full", 90, lambda: bench_gate_throughput(
+        qt, env, platform, nq_big,
+        layers=int(os.environ.get("QUEST_BENCH_LAYERS", "2")),
+        trials=max(1, trials // 2),
+        metric="1q+CNOT sustained gate throughput"))
     configs = [
-        ("full", 90, lambda: bench_gate_throughput(
-            qt, env, platform, nq_big,
-            layers=int(os.environ.get("QUEST_BENCH_LAYERS", "2")),
-            trials=max(1, trials // 2),
-            metric="1q+CNOT sustained gate throughput")),
         ("qft", 60, lambda: bench_qft(qt, env, platform)),
         ("grover", 45, lambda: bench_grover(qt, env, platform)),
         ("density", 45, lambda: bench_density_noise(qt, env, platform)),
@@ -926,6 +980,11 @@ def main() -> None:
         ("paulisum", 45, lambda: bench_pauli_sum(qt, env, platform)),
     ]
     if accel:
+        # heavyweight compiles last on the tunnel (the heartbeat keeps a
+        # slow one alive, but cheap rows should land first), and the
+        # Pallas compare very last: a remote-compile-helper 500 has been
+        # observed to wedge the CLIENT runtime for every later compile
+        configs.append(full_cfg)
         # on a pod slice this runs directly; on fewer than 8 chips it
         # yields a visible "needs 8 devices" error row rather than a
         # silently missing metric. The CPU fallback never appends it —
@@ -933,11 +992,12 @@ def main() -> None:
         # (so a pre-set host-device-count flag can't duplicate it).
         configs.append(("sharded", 45,
                         lambda: bench_sharded_mesh(qt, platform)))
-    if accel:
         # on CPU the Pallas pass is inert (circuits.py enable gate), so the
         # comparison would be XLA-vs-XLA noise — accel platforms only
-        configs.insert(1, ("pallas", 60, lambda: bench_pallas_compare(
+        configs.append(("pallas", 60, lambda: bench_pallas_compare(
             qt, env, platform, nq_small, trials=max(1, trials // 3))))
+    else:
+        configs.insert(0, full_cfg)
     if not accel and not native_led:
         # library wasn't prebuilt: run native gated, absorbing the g++ step
         configs.insert(0, ("native", 30, lambda: bench_native_cpu()))
@@ -953,7 +1013,9 @@ def main() -> None:
                   "value": 0.0, "unit": "gates/sec", "vs_baseline": 0.0})
             continue
         try:
-            emit(fn())
+            with _Heartbeat(name):
+                row = fn()
+            emit(row)
         except Exception as e:
             emit({"metric": f"{name} (bench error)", "value": 0.0,
                   "unit": "gates/sec", "vs_baseline": 0.0,

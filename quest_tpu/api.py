@@ -1500,16 +1500,28 @@ def sampleOutcomes(qureg: Qureg, num_samples: int, qubits=None) -> np.ndarray:
     _canon(qureg)
     src_planes = _jit_dd_combine(qureg.state) if qureg.is_quad \
         else qureg.state
-    if qureg.is_density_matrix:
+    if _shard(qureg) is not None and (1 << n) >= qureg.env.num_devices:
+        # sharded registers: shard-local two-stage inverse CDF — the
+        # GSPMD lowering of the full-vector cumsum all-gathers the state
+        # (measured 2x-state buffers at 20q/8dev), which cannot scale.
+        # Needs >=1 OUTCOME per shard (2^n >= D): a density register can
+        # be amp-sharded (2^2n >= D) while its 2^n-entry diagonal is
+        # still thinner than the mesh — those fall through to GSPMD
+        from .parallel.sampling import sample_sharded
+        idx_dev, total = sample_sharded(
+            src_planes, qureg.env.next_key(), int(num_samples),
+            qureg.is_density_matrix, n, qureg.env.mesh)
+    elif qureg.is_density_matrix:
         # diagonal of the flat density vector via a reshape view (no
         # index vector: a materialised arange would overflow int32 on
         # x64-disabled backends once n >= 16)
         planes = jnp.diagonal(src_planes.reshape(2, 1 << n, 1 << n),
                               axis1=1, axis2=2)
+        idx_dev, total = _jit_sample(planes, qureg.env.next_key(),
+                                     int(num_samples), True)
     else:
-        planes = src_planes
-    idx_dev, total = _jit_sample(planes, qureg.env.next_key(),
-                                 int(num_samples), qureg.is_density_matrix)
+        idx_dev, total = _jit_sample(src_planes, qureg.env.next_key(),
+                                     int(num_samples), False)
     if float(total) < qureg.env.precision.eps:
         # an (unnormalised) zero-norm register has no distribution to
         # sample; without this the clamp would return the last basis

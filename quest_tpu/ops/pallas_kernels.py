@@ -35,6 +35,7 @@ matrix; see `core/packing.py` for why planes are the storage format anyway).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -322,11 +323,36 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
     tre = jnp.asarray(tstack.real, rdtype)
     tim = jnp.asarray(tstack.imag, rdtype)
 
+    # Mosaic scoped-VMEM budget: the stage chain keeps ~2 live (rows,128)
+    # plane pairs per stage (Mosaic does not fully reuse buffers across
+    # stage boundaries); a 15-stage 22q brickwork layer measured 21.8 MB
+    # against the 16 MB default limit on real v5e silicon (r5 tunnel,
+    # HTTP-500 from the compile helper). Raise the limit toward the
+    # chip's real VMEM and, if the estimate still exceeds it, halve the
+    # block until it fits — smaller blocks trade grid steps for VMEM.
+    itemsize = np.dtype(rdtype).itemsize
+    vmem_limit = int(os.environ.get("QUEST_PALLAS_VMEM_LIMIT",
+                                    100 * 1024 * 1024))
+    # floor: a row stage pairing rows at `stride` needs its whole
+    # 2*stride pair group inside one block — never shrink below that
+    # (the collector validated targets against the PRE-shrink hi)
+    min_block = max([2 * st[1] for st in kstages if st[0] == "row"],
+                    default=8)
+    est = _vmem_estimate(block_rows, len(kstages), mstack, tstack, itemsize)
+    while block_rows > max(8, min_block) and est > vmem_limit:
+        block_rows //= 2
+        est = _vmem_estimate(block_rows, len(kstages), mstack, tstack,
+                             itemsize)
     kernel = functools.partial(_layer_kernel, stages=tuple(kstages),
                                block_rows=block_rows)
     state_spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
     mat_spec = pl.BlockSpec(mstack.shape, lambda i: (0, 0, 0))
     tab_spec = pl.BlockSpec(tstack.shape, lambda i: (0, 0))
+    kwargs = {}
+    if not interpret:
+        from jax.experimental.pallas import tpu as pltpu
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_limit)
     with jax.named_scope(f"pallas_layer_{layer.members}gates"):
         out_re, out_im = pl.pallas_call(
             kernel,
@@ -336,5 +362,17 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
             out_specs=[state_spec, state_spec],
             out_shape=[jax.ShapeDtypeStruct((total_rows, 128), rdtype)] * 2,
             interpret=interpret,
+            **kwargs,
         )(re, im, mre, mim, tre, tim)
     return jax.lax.complex(out_re, out_im).reshape(-1).astype(state.dtype)
+
+
+def _vmem_estimate(block_rows: int, num_stages: int, mstack, tstack,
+                   itemsize: int) -> int:
+    """Conservative Mosaic working-set model for one grid step: in + out
+    plane pairs with double-buffering (x2), ~2 extra live plane pairs per
+    stage, plus the stacked operand buffers."""
+    plane_pair = 2 * block_rows * 128 * itemsize
+    return (4 * plane_pair + 2 * num_stages * plane_pair
+            + 2 * int(np.prod(mstack.shape)) * itemsize
+            + 2 * int(np.prod(tstack.shape)) * itemsize)

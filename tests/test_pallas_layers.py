@@ -183,6 +183,19 @@ class TestWidenedEligibility:
         want = run(c, env, pallas=False)
         np.testing.assert_allclose(got, want, atol=1e-10)
 
+    def test_vmem_shrink_respects_row_stride_floor(self, env, monkeypatch):
+        # a tiny VMEM budget forces the block-halving loop; a row gate at
+        # the top of the mid range (stride = block_rows/2) must pin the
+        # floor at 2*stride — shrinking past it would reshape to 0 blocks
+        monkeypatch.setenv("QUEST_PALLAS_VMEM_LIMIT", "1")
+        c = Circuit(12)
+        c.h(0).h(1)
+        hi = pk.max_mid_qubit(1 << (12 - 7))     # stride spans half the rows
+        c.h(hi).h(hi - 1)
+        got = run(c, env, pallas="interpret")
+        want = run(c, env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
 
 class TestShardedLayers:
     """Round-5 (VERDICT r4 item 2): layers inside the shard_map local

@@ -1,0 +1,79 @@
+"""Bisect the real-TPU Pallas layer compile boundary.
+
+The round-5 live tunnel compiled + executed the fused layer kernel at 8-14q
+and 10q (parity PASS, bench smoke), but the 22q compile crashed the tunnel's
+remote compile helper (HTTP 500, `tpu_compile_helper subprocess exit 1`).
+This walks qubit counts upward, compiling ONE layer program per size in a
+fresh row, recording compile_s or the error, so the eligible-size gate in
+`circuits.py` can be set from measured silicon instead of guesswork.
+
+Run each size in a SUBPROCESS: a helper-500 can wedge the client runtime
+(observed: the next compile after a 500 hung >6 min), so isolation is what
+makes one failure not poison the rest of the sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, sys, time
+sys.path.insert(0, %r)
+import numpy as np, jax, jax.numpy as jnp
+try:
+    jax.config.update("jax_compilation_cache_dir", %r)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+nq = int(sys.argv[1])
+from quest_tpu.ops import pallas_kernels as pk
+u = np.eye(128, dtype=np.complex128)
+hi = pk.max_mid_qubit(min(pk.DEFAULT_BLOCK_ROWS, max((1 << nq) // 128, 1)))
+stages = [("lane", u)]
+if nq - 1 >= pk.LANE_QUBITS:
+    g = np.array([[0.6, 0.8], [-0.8, 0.6]], dtype=np.complex128)
+    stages.append(("row", min(nq - 1, hi), g, 0, 0, 0, 0))
+layer = pk.LayerOp(nq, 2, stages)
+fn = jax.jit(lambda s: pk.apply_layer(s, nq, layer))
+t0 = time.perf_counter()
+ex = fn.lower(jax.ShapeDtypeStruct((1 << nq,), jnp.complex64)).compile()
+compile_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+s = jnp.zeros((1 << nq,), jnp.complex64).at[0].set(1.0)
+out = ex(s)
+out.block_until_ready()
+print(json.dumps({"nq": nq, "ok": True,
+                  "compile_s": round(compile_s, 2),
+                  "exec_s": round(time.perf_counter() - t0, 3)}), flush=True)
+"""
+
+
+def main() -> None:
+    cache = os.path.join(REPO, ".jax_cache")
+    sizes = [int(a) for a in sys.argv[1:]] or [16, 18, 20, 21, 22]
+    for nq in sizes:
+        t0 = time.time()
+        r = subprocess.run(
+            [sys.executable, "-c", CHILD % (REPO, cache), str(nq)],
+            capture_output=True, text=True, timeout=420)
+        row = {"nq": nq, "wall_s": round(time.time() - t0, 1)}
+        if r.returncode == 0 and r.stdout.strip():
+            row.update(json.loads(r.stdout.strip().splitlines()[-1]))
+        else:
+            tail = (r.stderr or "")[-400:]
+            row.update({"ok": False, "rc": r.returncode, "stderr_tail": tail})
+        print(json.dumps(row), flush=True)
+        if not row.get("ok"):
+            # keep walking: a helper crash at size N does not predict N+1,
+            # and each child is isolated anyway
+            continue
+
+
+if __name__ == "__main__":
+    main()

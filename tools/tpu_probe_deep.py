@@ -1,0 +1,141 @@
+"""Opportunistic live-TPU deep probe: more evidence than bench.py's sweep.
+
+bench.py is budget-shaped for the driver's bounded grant window; this tool
+assumes a LIVE tunnel and digs: large-state HBM-bound throughput (where the
+roofline argument actually bites), Pallas-vs-XLA at sizes past VMEM
+residency, and a real-silicon replay of the Pallas layer parity oracle that
+`tests/test_pallas_layers.py` can only run in interpret mode on CPU.
+
+Each probe emits one JSON row (same schema as bench.py) and flushes, so a
+tunnel death mid-run still leaves every completed row on stdout.
+
+Usage:  python tools/tpu_probe_deep.py [probe ...]
+        probes: big pallas_scale parity density  (default: all)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def emit(row: dict) -> None:
+    row.setdefault("unix_ts", round(time.time(), 1))
+    print(json.dumps(row), flush=True)
+
+
+def probe_big(qt, platform: str) -> None:
+    """Large statevectors: 24..29 qubits. Past ~24q the state exceeds
+    VMEM, so gates pay real HBM passes — this is the regime where the
+    reference's A100 numbers live (BASELINE.json 38q is multi-GPU; the
+    per-device slice is what one chip sees)."""
+    import bench
+    env = qt.createQuESTEnv(num_devices=1, seed=[2026])
+    for nq in (24, 26, 28, 29):
+        try:
+            t0 = time.perf_counter()
+            row = bench.bench_gate_throughput(
+                qt, env, platform, nq, layers=2, trials=3,
+                metric="1q+CNOT sustained gate throughput", pallas="off")
+            row["compile_plus_run_s"] = round(time.perf_counter() - t0, 1)
+            emit(row)
+        except Exception as e:
+            emit({"metric": f"big {nq}q (error)", "value": 0.0,
+                  "unit": "gates/sec", "vs_baseline": 0.0,
+                  "errors": [f"{type(e).__name__}: {e}"[:300]]})
+            break    # OOM at nq likely implies OOM at nq+1 too
+
+
+def probe_pallas_scale(qt, platform: str) -> None:
+    """Pallas fused layers vs per-gate XLA at sizes where the state no
+    longer sits in VMEM: the fusion's 1-pass-per-layer economy should
+    show as a bandwidth multiple, not just dispatch-overhead removal."""
+    import bench
+    env = qt.createQuESTEnv(num_devices=1, seed=[2026])
+    for nq in (22, 24, 26):
+        try:
+            emit(bench.bench_pallas_compare(qt, env, platform, nq, trials=3))
+        except Exception as e:
+            emit({"metric": f"pallas scale {nq}q (error)", "value": 0.0,
+                  "unit": "gates/sec", "vs_baseline": 0.0,
+                  "errors": [f"{type(e).__name__}: {e}"[:300]]})
+            break
+
+
+def probe_parity(qt, platform: str) -> None:
+    """Real-silicon replay of the interpret-mode Pallas oracle: random
+    brickwork through the layer collector with pallas on vs off, compared
+    at complex64 tolerance. This is `tests/test_pallas_layers.py`'s oracle
+    executed through Mosaic instead of interpret mode."""
+    from quest_tpu.circuits import Circuit
+    rng = np.random.default_rng(7)
+    worst = 0.0
+    cases = 0
+    for nq in (8, 10, 12, 14):
+        env = qt.createQuESTEnv(num_devices=1, seed=[11])
+        c = Circuit(nq)
+        for layer in range(4):
+            for q in range(nq):
+                c.rotate(q, float(rng.uniform(0, 2 * np.pi)),
+                         rng.normal(size=3))
+            for q in range(layer % 2, nq - 1, 2):
+                c.cnot(q, q + 1)
+            c.phase(nq - 1, float(rng.uniform(0, np.pi)))
+        ref = qt.createQureg(nq, env)
+        c.compile(env, pallas=False).run(ref)
+        got = qt.createQureg(nq, env)
+        c.compile(env, pallas=True).run(got)
+        dev = float(np.max(np.abs(ref.to_numpy() - got.to_numpy())))
+        worst = max(worst, dev)
+        cases += 1
+    emit({"metric": f"pallas real-silicon parity, {cases} brickwork "
+                    f"circuits 8-14q ({platform})",
+          "value": worst, "unit": "max-amp-deviation",
+          "vs_baseline": 0.0, "pass": bool(worst < 1e-5)})
+
+
+def probe_density(qt, platform: str) -> None:
+    """Density-matrix + channel throughput — the mixed-state path's
+    behavior on silicon."""
+    import bench
+    env = qt.createQuESTEnv(num_devices=1, seed=[2026])
+    try:
+        emit(bench.bench_density_noise(qt, env, platform))
+    except Exception as e:
+        emit({"metric": "density probe (error)", "value": 0.0,
+              "unit": "gates/sec", "vs_baseline": 0.0,
+              "errors": [f"{type(e).__name__}: {e}"[:300]]})
+
+
+PROBES = {"big": probe_big, "pallas_scale": probe_pallas_scale,
+          "parity": probe_parity, "density": probe_density}
+
+
+def main() -> None:
+    import jax
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    platform = jax.devices()[0].platform
+    emit({"metric": "tpu_probe_deep start", "value": 1.0, "unit": "session",
+          "vs_baseline": 0.0, "platform": platform,
+          "device": str(jax.devices()[0])})
+    import quest_tpu as qt
+    names = sys.argv[1:] or list(PROBES)
+    for name in names:
+        PROBES[name](qt, platform)
+
+
+if __name__ == "__main__":
+    main()
