@@ -667,12 +667,17 @@ def bench_pauli_sum(qt, env, platform: str) -> dict:
 
 
 def bench_density_noise(qt, env, platform: str) -> dict:
-    """Density register with dephasing/damping channels (BASELINE.json
-    config 4: 15 qubits on TPU; width-reduced on CPU where the 2^30 flat
-    vector is too slow). A density gate streams the 2^(2n) flat vector once;
-    the roofline baseline accounts for the doubled qubit count."""
+    """Density register with dephasing/damping channels (the BASELINE.json
+    config-4 workload, width-reduced to 12 qubits everywhere — see the
+    compile-scaling note below). A density gate streams the 2^(2n) flat
+    vector once; the roofline baseline accounts for the doubled qubit
+    count."""
+    # accel width bounded by the tunnel's compile scaling (~ops x 2^2n):
+    # 14q density (2^28 flat amps) measured >14 min of compile on the r5
+    # tunnel and starved the rest of the sweep; 12q lands in ~4 min cold
+    # and seconds warm
     num_qubits = int(os.environ.get(
-        "QUEST_BENCH_DENSITY_QUBITS", "14" if _is_accel(platform) else "12"))
+        "QUEST_BENCH_DENSITY_QUBITS", "12"))
     trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 2)
     from quest_tpu.circuits import Circuit
     rng = np.random.default_rng(2026)

@@ -119,7 +119,7 @@ std::vector<cplx> expand_diag(const std::vector<cplx>& t,
   return out;
 }
 
-void fuse(Sched& s) {
+void fuse(Sched& s, int diag_row_cap) {
   s.fused.clear();
   for (const Op& op : s.ops) {
     bool merged = false;
@@ -138,7 +138,11 @@ void fuse(Sched& s) {
           if (std::find(uni.begin(), uni.end(), q) == uni.end())
             uni.push_back(q);
         std::sort(uni.begin(), uni.end(), std::greater<int>());
-        if (static_cast<int>(uni.size()) <= MAX_DIAG_FUSE_QUBITS) {
+        int row_bits = 0;
+        for (int q : uni)
+          if (q >= 7) ++row_bits;  // lane/row split of the layer kernel
+        if (static_cast<int>(uni.size()) <= MAX_DIAG_FUSE_QUBITS &&
+            (diag_row_cap < 0 || row_bits <= diag_row_cap)) {
           std::vector<cplx> a = expand_diag(prev.data, prev.targets, uni);
           std::vector<cplx> b = expand_diag(op.data, op.targets, uni);
           for (size_t i = 0; i < a.size(); ++i) a[i] *= b[i];
@@ -357,13 +361,13 @@ int qsched_add_op(void* h, int kind, int num_targets, const int* targets,
 
 // run fusion + planning; returns 0 on success, nonzero on error
 int qsched_compile(void* h, int num_qubits, int shard_bits, int lookahead,
-                   int enable_fusion) {
+                   int enable_fusion, int diag_row_cap) {
   Sched& s = *static_cast<Sched*>(h);
   s.num_qubits = num_qubits;
   s.shard_bits = shard_bits;
   s.error.clear();
   if (enable_fusion) {
-    fuse(s);
+    fuse(s, diag_row_cap);
   } else {
     s.fused = s.ops;
   }

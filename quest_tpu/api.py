@@ -1511,17 +1511,18 @@ def sampleOutcomes(qureg: Qureg, num_samples: int, qubits=None) -> np.ndarray:
         idx_dev, total = sample_sharded(
             src_planes, qureg.env.next_key(), int(num_samples),
             qureg.is_density_matrix, n, qureg.env.mesh)
-    elif qureg.is_density_matrix:
-        # diagonal of the flat density vector via a reshape view (no
-        # index vector: a materialised arange would overflow int32 on
-        # x64-disabled backends once n >= 16)
-        planes = jnp.diagonal(src_planes.reshape(2, 1 << n, 1 << n),
-                              axis1=1, axis2=2)
-        idx_dev, total = _jit_sample(planes, qureg.env.next_key(),
-                                     int(num_samples), True)
     else:
-        idx_dev, total = _jit_sample(src_planes, qureg.env.next_key(),
-                                     int(num_samples), False)
+        if qureg.is_density_matrix:
+            # diagonal of the flat density vector via a reshape view (no
+            # index vector: a materialised arange would overflow int32 on
+            # x64-disabled backends once n >= 16)
+            planes = jnp.diagonal(src_planes.reshape(2, 1 << n, 1 << n),
+                                  axis1=1, axis2=2)
+        else:
+            planes = src_planes
+        idx_dev, total = _jit_sample(planes, qureg.env.next_key(),
+                                     int(num_samples),
+                                     qureg.is_density_matrix)
     if float(total) < qureg.env.precision.eps:
         # an (unnormalised) zero-norm register has no distribution to
         # sample; without this the clamp would return the last basis

@@ -617,7 +617,7 @@ class Circuit:
 
     # -- compilation -------------------------------------------------------
 
-    def _fused_ops(self) -> list[_Op]:
+    def _fused_ops(self, diag_row_cap: int = -1) -> list[_Op]:
         """Host-side peephole fusion over static gates.
 
         1. consecutive static diagonal ops on any qubits merge (union of qubit
@@ -626,6 +626,13 @@ class Circuit:
            merge by matrix product.
         XLA would fuse the arithmetic anyway, but merging *before* tracing
         shrinks the program and halves memory passes.
+
+        ``diag_row_cap`` (>= 0) additionally caps merged diagonals at that
+        many row qubits (>= 7): the Pallas layer kernel only fuses
+        diagonals with <= 3 row bits, so unbounded merging here would
+        weld layer-eligible cphase ladders (QFT's bulk) into 5-6-row-bit
+        diagonals that fall off the fused path — measured on the r5
+        silicon as 22 standalone full passes in QFT-22.
         """
         fused: list[_Op] = []
         for op in self.ops:
@@ -640,7 +647,9 @@ class Circuit:
                 if op.kind == "diag" and prev.kind == "diag":
                     union = tuple(sorted(set(op.targets) | set(prev.targets),
                                          reverse=True))
-                    if len(union) <= 6:
+                    if len(union) <= 6 and (
+                            diag_row_cap < 0
+                            or sum(q >= 7 for q in union) <= diag_row_cap):
                         def expand(o):
                             shape = tuple(2 if q in o.targets else 1
                                           for q in union)
@@ -837,8 +846,8 @@ class _LayerAccum:
             if st[0] == "lane":
                 self.stages[i] = ("lane", m @ st[1])
                 return
-            if st[0] == "row" and st[3] == 0:
-                i -= 1
+            if st[0] in ("row", "rowk") and st[3] == 0:
+                i -= 1               # lane-blind row stage: commutes
                 continue
             break
         self.stages.append(("lane", m))
@@ -884,6 +893,23 @@ class _LayerAccum:
                     and pk.LANE_QUBITS <= phys_targets[0] <= self.hi):
                 self._append_row(phys_targets[0], op.mat, lane_cm,
                                  lane_want, row_cm, row_want)
+            elif (2 <= len(phys_targets) <= 3
+                    and all(pk.LANE_QUBITS <= t <= self.hi
+                            for t in phys_targets)):
+                # k-qubit dense gate entirely on row bits: "rowk" stage
+                # (the multiControlledMultiQubitUnitaryLocal analogue).
+                # Normalise to ascending bit order, permuting the matrix
+                # (gate-index bit j addresses targets[j])
+                k = len(phys_targets)
+                order = sorted(range(k), key=lambda j: phys_targets[j])
+                bits_asc = tuple(phys_targets[j] - pk.LANE_QUBITS
+                                 for j in order)
+                u = np.asarray(op.mat)
+                omap = [sum(((a >> m) & 1) << order[m] for m in range(k))
+                        for a in range(1 << k)]
+                u_asc = u[np.ix_(omap, omap)]
+                self.stages.append(("rowk", bits_asc, u_asc, lane_cm,
+                                    lane_want, row_cm, row_want))
             else:
                 return False
             self.members += 1
@@ -976,7 +1002,10 @@ def _layer_eligible(op, num_local: int, hi: int) -> bool:
             return False
         return (all(t < pk.LANE_QUBITS for t in op.targets)
                 or (len(op.targets) == 1
-                    and pk.LANE_QUBITS <= op.targets[0] <= hi))
+                    and pk.LANE_QUBITS <= op.targets[0] <= hi)
+                or (2 <= len(op.targets) <= 3
+                    and all(pk.LANE_QUBITS <= t <= hi
+                            for t in op.targets)))
     if any(p >= num_local for p in op.targets):
         return False
     return sum(p >= pk.LANE_QUBITS for p in op.targets) <= 3
@@ -1014,7 +1043,8 @@ def _collect_layers(ops: list, num_qubits: int,
 
 
 def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
-              lookahead: int, fuse_flag: bool, circuit: "Circuit"):
+              lookahead: int, fuse_flag: bool, circuit: "Circuit",
+              diag_row_cap: int = -1):
     """Fuse + layout-plan the op stream.
 
     Prefers the native C++ scheduler (quest_tpu.native / native/src/
@@ -1043,7 +1073,8 @@ def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
                 data = op.diag
             sch.add_op(kind, op.targets, op.ctrl_mask, op.flip_mask,
                        data, i)
-        sch.compile(num_qubits, shard_bits, lookahead, fuse_flag)
+        sch.compile(num_qubits, shard_bits, lookahead, fuse_flag,
+                    diag_row_cap)
         ops_table: list[_Op] = []
         for kind, targets, cm, fm, data, si in sch.fused_ops():
             if kind == nat.KIND_U:
@@ -1057,7 +1088,8 @@ def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
         return ops_table, plan
 
     from .parallel import plan_layout
-    ops_table = circuit._fused_ops() if fuse_flag else list(recorded)
+    ops_table = circuit._fused_ops(diag_row_cap) if fuse_flag \
+        else list(recorded)
     return ops_table, plan_layout(ops_table, num_qubits, shard_bits,
                                   lookahead=lookahead)
 
@@ -1086,19 +1118,14 @@ class CompiledCircuit:
             sharding = env.sharding()
             shard_bits = env.num_devices.bit_length() - 1
 
-        # fuse + schedule gate positions over the mesh: lazy logical->
-        # physical permutation with batched relayouts (native scheduler when
-        # built, else quest_tpu.parallel.layout)
-        from .parallel import apply_relayout
-        ops, self.plan = _schedule(list(circuit.ops), n, shard_bits,
-                                   lookahead, fuse, circuit)
-
         # Pallas fused-layer pass. pallas=None -> auto (TPU backend only);
         # "interpret" -> run kernels interpreted (tests); False -> off.
         # Runs as a POST-PLAN peephole over the item stream (physical
         # coordinates), so it fuses on the shard_map local body too —
         # VERDICT r4 item 2: per-chip local gates ride the fused kernel
-        # instead of paying one XLA pass each.
+        # instead of paying one XLA pass each. Resolved BEFORE scheduling:
+        # the fusion pass needs to know whether merged diagonals must stay
+        # within the layer kernel's 3-row-bit budget.
         if pallas is None:
             pallas = os.environ.get("QUEST_TPU_PALLAS", "auto")
         interpret = pallas == "interpret"
@@ -1107,6 +1134,14 @@ class CompiledCircuit:
             interpret or jax.default_backend() in ("tpu", "axon"))
         self._pallas_interpret = interpret
         use_layers = enabled and (n - shard_bits) >= 7
+
+        # fuse + schedule gate positions over the mesh: lazy logical->
+        # physical permutation with batched relayouts (native scheduler when
+        # built, else quest_tpu.parallel.layout)
+        from .parallel import apply_relayout
+        ops, self.plan = _schedule(list(circuit.ops), n, shard_bits,
+                                   lookahead, fuse, circuit,
+                                   diag_row_cap=3 if use_layers else -1)
 
         # super-gate grouping: consecutive static gates collapse into one
         # k-qubit pass. Layer-eligible gates are fenced off (barrier) when
@@ -1250,8 +1285,17 @@ class CompiledCircuit:
         if missing:
             raise ValueError(f"missing circuit parameters: {missing}")
         vals = [params[nm] for nm in self.param_names]
-        return jnp.asarray(vals, dtype=self.env.precision.real_dtype) \
-            if vals else jnp.zeros((0,), dtype=self.env.precision.real_dtype)
+        if not vals:
+            # cache the empty vector: building it per run() is a fresh
+            # device dispatch, which on a tunneled backend costs a full
+            # round trip (measured ~60-90 ms — it dominated QFT-22 timing
+            # on the r5 live TPU, 2.8k gates/s instead of the compute
+            # rate) — per call, for a constant
+            if getattr(self, "_empty_vec", None) is None:
+                self._empty_vec = jnp.zeros(
+                    (0,), dtype=self.env.precision.real_dtype)
+            return self._empty_vec
+        return jnp.asarray(vals, dtype=self.env.precision.real_dtype)
 
     # -- execution ---------------------------------------------------------
 

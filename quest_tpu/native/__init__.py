@@ -90,7 +90,7 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int), ctypes.c_int64, ctypes.c_int64,
         ctypes.POINTER(ctypes.c_double), ctypes.c_int]
     lib.qsched_compile.restype = ctypes.c_int
-    lib.qsched_compile.argtypes = [ctypes.c_void_p] + [ctypes.c_int] * 4
+    lib.qsched_compile.argtypes = [ctypes.c_void_p] + [ctypes.c_int] * 5
     lib.qsched_error.restype = ctypes.c_char_p
     lib.qsched_error.argtypes = [ctypes.c_void_p]
     lib.qsched_num_fused.restype = ctypes.c_int
@@ -161,9 +161,10 @@ class NativeScheduler:
             source_index)
 
     def compile(self, num_qubits: int, shard_bits: int, lookahead: int,
-                fusion: bool) -> None:
+                fusion: bool, diag_row_cap: int = -1) -> None:
         rc = self._lib.qsched_compile(self._h, num_qubits, shard_bits,
-                                      lookahead, int(fusion))
+                                      lookahead, int(fusion),
+                                      int(diag_row_cap))
         if rc != 0:
             raise ValueError(self._lib.qsched_error(self._h).decode())
 

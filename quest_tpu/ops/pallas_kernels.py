@@ -149,6 +149,8 @@ class LayerOp:
                     support |= set(range(min(LANE_QUBITS, num_qubits)))
                 elif st[0] == "row":
                     support.add(st[1])
+                elif st[0] == "rowk":
+                    support |= {b + LANE_QUBITS for b in st[1]}
                 else:
                     support |= {b + LANE_QUBITS for b in st[2]}
         self.targets = tuple(sorted(support))
@@ -247,6 +249,85 @@ def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, tre_ref, tim_ref,
                 nl_im = jnp.where(cond, nl_im, lo_im)
             re = jnp.stack([nu_re, nl_re], axis=1).reshape(rows, 128)
             im = jnp.stack([nu_im, nl_im], axis=1).reshape(rows, 128)
+        elif tag == "rowk":
+            # k-qubit dense gate on row bits (the
+            # multiControlledMultiQubitUnitaryLocal analogue,
+            # QuEST_cpu.c:1820-1901): static reshape/transpose brings the
+            # k target bits adjacent, then 2^k x 2^k unrolled complex
+            # MACs mix the groups. bits ascend; gate-index bit j = bits[j].
+            (_, bits, uflat, lane_mask, lane_want,
+             row_mask, row_want) = st
+            k = len(bits)
+            dim = 1 << k
+            rlog = int(np.log2(rows))
+            # split rows at the target bits: dims MSB->LSB, '2' axes at
+            # positions 1, 3, ... (for bits[k-1], bits[k-2], ...)
+            dims = []
+            prev = rlog
+            for b in reversed(bits):
+                dims += [1 << (prev - b - 1), 2]
+                prev = b
+            dims.append(1 << prev)
+            two_axes = [2 * i + 1 for i in range(k)]   # bits[k-1]..bits[0]
+            other_axes = [a for a in range(len(dims)) if a not in two_axes]
+            perm = other_axes + two_axes
+            groups = rows // dim
+
+            def regroup(x):
+                x = x.reshape(*dims, 128)
+                x = jnp.transpose(x, tuple(perm) + (len(dims),))
+                return x.reshape(groups, dim, 128)
+
+            def ungroup(x):
+                inv = [0] * len(dims)
+                for pos, a in enumerate(perm):
+                    inv[a] = pos
+                x = x.reshape(*[dims[a] for a in perm], 128)
+                x = jnp.transpose(x, tuple(inv) + (len(dims),))
+                return x.reshape(rows, 128)
+
+            gre, gim = regroup(re), regroup(im)
+            slre = [gre[:, g, :] for g in range(dim)]
+            slim = [gim[:, g, :] for g in range(dim)]
+            nre, nim = [], []
+            for gp in range(dim):
+                ar = ai = None
+                for g in range(dim):
+                    ur, ui = uflat[gp * dim + g]
+                    if ur == 0.0 and ui == 0.0:
+                        continue
+                    tr = ur * slre[g] - ui * slim[g]
+                    ti = ur * slim[g] + ui * slre[g]
+                    ar = tr if ar is None else ar + tr
+                    ai = ti if ai is None else ai + ti
+                z = jnp.zeros((groups, 128), re.dtype)
+                nre.append(z if ar is None else ar)
+                nim.append(z if ai is None else ai)
+            if lane_mask or row_mask:
+                cond = None
+                if row_mask:
+                    # reconstruct the row index with target bits zeroed
+                    # (controls never include targets) from the group
+                    # index: bit m of the group enumerates the m-th
+                    # non-target row bit, ascending
+                    gidx = jax.lax.broadcasted_iota(
+                        jnp.int32, (groups, 128), 0)
+                    nontgt = [p for p in range(rlog) if p not in bits]
+                    row0 = jnp.zeros((groups, 128), jnp.int32)
+                    for m, p in enumerate(nontgt):
+                        row0 = row0 | (((gidx >> m) & 1) << p)
+                    cond = ((base + row0) & row_mask) == row_want
+                if lane_mask:
+                    lane = jax.lax.broadcasted_iota(
+                        jnp.int32, (groups, 128), 1)
+                    lcond = (lane & lane_mask) == lane_want
+                    cond = lcond if cond is None else cond & lcond
+                nre = [jnp.where(cond, nre[g], slre[g])
+                       for g in range(dim)]
+                nim = [jnp.where(cond, nim[g], slim[g])
+                       for g in range(dim)]
+            re = ungroup(jnp.stack(nre, axis=1))
+            im = ungroup(jnp.stack(nim, axis=1))
         else:  # rowdiag
             _, toff, bits = st
             g = _global_row(base, (rows, 1), 0)
@@ -305,6 +386,18 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
                  float(u[1, 1].real), float(u[1, 1].imag)),
                 int(lane_mask), int(lane_want),
                 int(row_mask), int(row_want)))
+        elif st[0] == "rowk":
+            _, bits, u, lane_mask, lane_want, row_mask, row_want = st
+            bits = tuple(int(b) for b in bits)
+            if bits and bits[-1] + LANE_QUBITS > hi:
+                raise ValueError(
+                    f"rowk bit {bits[-1]} outside block row range")
+            u = np.asarray(u)
+            kstages.append((
+                "rowk", bits,
+                tuple((float(z.real), float(z.imag)) for z in u.reshape(-1)),
+                int(lane_mask), int(lane_want),
+                int(row_mask), int(row_want)))
         else:
             _, table, bits = st
             kstages.append(("rowdiag", len(tables), tuple(int(b)
@@ -336,12 +429,14 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
     # floor: a row stage pairing rows at `stride` needs its whole
     # 2*stride pair group inside one block — never shrink below that
     # (the collector validated targets against the PRE-shrink hi)
-    min_block = max([2 * st[1] for st in kstages if st[0] == "row"],
+    min_block = max([2 * st[1] for st in kstages if st[0] == "row"]
+                    + [2 << st[1][-1] for st in kstages
+                       if st[0] == "rowk" and st[1]],
                     default=8)
-    est = _vmem_estimate(block_rows, len(kstages), mstack, tstack, itemsize)
+    est = _vmem_estimate(block_rows, kstages, mstack, tstack, itemsize)
     while block_rows > max(8, min_block) and est > vmem_limit:
         block_rows //= 2
-        est = _vmem_estimate(block_rows, len(kstages), mstack, tstack,
+        est = _vmem_estimate(block_rows, kstages, mstack, tstack,
                              itemsize)
     kernel = functools.partial(_layer_kernel, stages=tuple(kstages),
                                block_rows=block_rows)
@@ -367,12 +462,15 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
     return jax.lax.complex(out_re, out_im).reshape(-1).astype(state.dtype)
 
 
-def _vmem_estimate(block_rows: int, num_stages: int, mstack, tstack,
+def _vmem_estimate(block_rows: int, kstages, mstack, tstack,
                    itemsize: int) -> int:
     """Conservative Mosaic working-set model for one grid step: in + out
     plane pairs with double-buffering (x2), ~2 extra live plane pairs per
-    stage, plus the stacked operand buffers."""
+    stage (a rowk stage keeps its 2^k group slices live, so it weighs
+    2^(k-1) plain stages), plus the stacked operand buffers."""
     plane_pair = 2 * block_rows * 128 * itemsize
-    return (4 * plane_pair + 2 * num_stages * plane_pair
+    weight = sum((1 << len(st[1])) // 2 if st[0] == "rowk" else 1
+                 for st in kstages)
+    return (4 * plane_pair + 2 * weight * plane_pair
             + 2 * int(np.prod(mstack.shape)) * itemsize
             + 2 * int(np.prod(tstack.shape)) * itemsize)

@@ -183,6 +183,59 @@ class TestWidenedEligibility:
         want = run(c, env, pallas=False)
         np.testing.assert_allclose(got, want, atol=1e-10)
 
+    def test_rowk_dense_2q_on_row_bits(self, env, rng):
+        # swap / sqrt_swap / random dense 2q entirely on row qubits fuse
+        # as a "rowk" stage (QuEST_cpu.c:1820-1901 analogue) and match XLA
+        c = Circuit(12)
+        c.h(0).h(1)                       # lane stage so a layer forms
+        c.swap(8, 10)
+        c.sqrt_swap(7, 11)
+        q, _ = np.linalg.qr(rng.normal(size=(4, 4))
+                            + 1j * rng.normal(size=(4, 4)))
+        c.gate(q, (10, 8))                # unsorted targets: permutation map
+        got = run(c, env, pallas="interpret")
+        want = run(c, env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_rowk_dense_3q_and_controls(self, env, rng):
+        c = Circuit(12)
+        c.h(0)
+        q8, _ = np.linalg.qr(rng.normal(size=(8, 8))
+                             + 1j * rng.normal(size=(8, 8)))
+        c.gate(q8, (7, 9, 11))            # 3 row targets
+        q4, _ = np.linalg.qr(rng.normal(size=(4, 4))
+                             + 1j * rng.normal(size=(4, 4)))
+        c.gate(q4, (8, 10), controls=(3,))            # lane control
+        c.gate(q4, (7, 10), controls=(9,))            # row control
+        c.gate(q4, (8, 11), controls=(2, 9),          # mixed, one flipped
+               control_states=(0, 1))
+        got = run(c, env, pallas="interpret")
+        want = run(c, env, pallas=False)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_rowk_collects_into_layer(self):
+        c = Circuit(12)
+        c.h(0).h(1)
+        c.swap(8, 10)
+        c.h(2)
+        ops = _collect_layers(c._fused_ops(), 12)
+        (layer,) = [o for o in ops if getattr(o, "kind", None) == "layer"]
+        assert any(st[0] == "rowk" for st in layer.stages)
+        assert layer.members == 4
+
+    def test_qft_fusion_cap_keeps_ladders_on_fused_path(self, env):
+        # the diag-fusion row-bit cap (diag_row_cap=3 when layers are on)
+        # must keep QFT's cphase ladders layer-eligible: without it the
+        # fusion welds them into 5-6-row-bit diagonals and the plan pays
+        # 83 full passes at 22q instead of 57 (r5 measurement)
+        from quest_tpu.algorithms import qft
+        cc = qft(22).compile(env, pallas="interpret")
+        layers = [o for o in cc._ops if getattr(o, "kind", None) == "layer"]
+        members = sum(l.members for l in layers)
+        passes = sum(1 for it in cc.plan.items)
+        assert members >= 50, members
+        assert passes <= 65, passes
+
     def test_vmem_shrink_respects_row_stride_floor(self, env, monkeypatch):
         # a tiny VMEM budget forces the block-halving loop; a row gate at
         # the top of the mid range (stride = block_rows/2) must pin the

@@ -59,20 +59,22 @@ def main() -> None:
     sizes = [int(a) for a in sys.argv[1:]] or [16, 18, 20, 21, 22]
     for nq in sizes:
         t0 = time.time()
-        r = subprocess.run(
-            [sys.executable, "-c", CHILD % (REPO, cache), str(nq)],
-            capture_output=True, text=True, timeout=420)
-        row = {"nq": nq, "wall_s": round(time.time() - t0, 1)}
-        if r.returncode == 0 and r.stdout.strip():
-            row.update(json.loads(r.stdout.strip().splitlines()[-1]))
-        else:
-            tail = (r.stderr or "")[-400:]
-            row.update({"ok": False, "rc": r.returncode, "stderr_tail": tail})
+        row = {"nq": nq}
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", CHILD % (REPO, cache), str(nq)],
+                capture_output=True, text=True, timeout=420)
+            if r.returncode == 0 and r.stdout.strip():
+                row.update(json.loads(r.stdout.strip().splitlines()[-1]))
+            else:
+                row.update({"ok": False, "rc": r.returncode,
+                            "stderr_tail": (r.stderr or "")[-400:]})
+        except subprocess.TimeoutExpired:
+            # a hang at size N must not poison N+1 — that isolation is
+            # the whole point of the per-size children
+            row.update({"ok": False, "timeout_s": 420})
+        row["wall_s"] = round(time.time() - t0, 1)
         print(json.dumps(row), flush=True)
-        if not row.get("ok"):
-            # keep walking: a helper crash at size N does not predict N+1,
-            # and each child is isolated anyway
-            continue
 
 
 if __name__ == "__main__":
