@@ -1277,6 +1277,7 @@ class CompiledCircuit:
         self._apply_fn = apply_fn
         self._jitted = jax.jit(apply_fn, donate_argnums=(0,) if donate else ())
         self._donate = donate
+        self._in_sharding = sharding   # the run()/precompile() input layout
 
     def _param_vec(self, params: Optional[dict]) -> jnp.ndarray:
         if params is None:
@@ -1300,6 +1301,23 @@ class CompiledCircuit:
     # -- execution ---------------------------------------------------------
 
     is_density = False   # set by Circuit.compile(density=True)
+    _aot = None          # set by precompile()
+
+    def precompile(self) -> "CompiledCircuit":
+        """Ahead-of-time compile (lower + compile), no execution.
+
+        ``jit`` otherwise compiles on the first :meth:`run` dispatch —
+        on a high-dispatch-latency backend (tunneled TPU: 10-400 s
+        compiles, docs/tpu.md) that buries the compile inside the first
+        timed call. After ``precompile()``, :meth:`run` dispatches the
+        compiled executable directly. Returns ``self`` for chaining:
+        ``cc = circ.compile(env).precompile()``."""
+        dt = self.env.precision.real_dtype
+        state = jax.ShapeDtypeStruct((2, 1 << self.num_qubits), dt,
+                                     sharding=self._in_sharding)
+        vec = jax.ShapeDtypeStruct((len(self.param_names),), dt)
+        self._aot = self._jitted.lower(state, vec).compile()
+        return self
 
     def run(self, qureg: Qureg, params: Optional[dict] = None) -> None:
         """Apply in place (the donated buffer is reused by XLA)."""
@@ -1320,7 +1338,8 @@ class CompiledCircuit:
                 "Circuit.compile_dd and run on its packed planes, or use "
                 "the imperative API (which routes to dd kernels)")
         qureg.ensure_canonical()   # compiled programs address canonical bits
-        qureg.state = self._jitted(qureg.state, self._param_vec(params))
+        fn = self._aot if self._aot is not None else self._jitted
+        qureg.state = fn(qureg.state, self._param_vec(params))
 
     def apply(self, state_f: jnp.ndarray, params=None):
         """Pure form: packed planes in -> packed planes out.
@@ -1343,6 +1362,16 @@ class CompiledCircuit:
                     f"parameter vector has shape {vec.shape}; expected "
                     f"({len(self.param_names)},) ordered like "
                     f"{list(self.param_names)} (use jax.vmap for batches)")
+        if (self._aot is not None
+                and not isinstance(state_f, jax.core.Tracer)
+                and not isinstance(vec, jax.core.Tracer)
+                and getattr(state_f, "shape", None)
+                == (2, 1 << self.num_qubits)):
+            # concrete inputs ride the precompiled executable — the jit
+            # cache is NOT populated by precompile(), so _jitted here
+            # would silently recompile. Traced inputs (vmap/scan/grad)
+            # must still trace through the jit path.
+            return self._aot(state_f, vec)
         return self._jitted(state_f, vec)
 
     # -- analysis / autodiff ----------------------------------------------

@@ -275,3 +275,85 @@ def test_sweep_on_mesh_with_relayouts(env, mesh_env):
     # non-divisible batches stay correct (replicated fallback)
     odd = np.asarray(c.compile(mesh_env).sweep(pm[:13]))
     np.testing.assert_allclose(odd, outs[0][:13], atol=1e-12)
+
+
+class TestPrecompile:
+    """precompile(): AOT lower+compile so run() dispatches the compiled
+    executable directly (no hidden first-run compile — docs/tpu.md)."""
+
+    def test_matches_jit_path(self, env):
+        c = Circuit(8)
+        for q in range(8):
+            c.h(q)
+        c.cnot(0, 7).cz(3, 4)
+        q1 = qt.createQureg(8, env)
+        qt.initDebugState(q1)
+        cc = c.compile(env).precompile()
+        assert cc._aot is not None
+        cc.run(q1)
+        q2 = qt.createQureg(8, env)
+        qt.initDebugState(q2)
+        c.compile(env).run(q2)
+        np.testing.assert_allclose(q1.to_numpy(), q2.to_numpy(), atol=1e-12)
+
+    def test_parameterized_and_repeat_runs(self, env):
+        c = Circuit(6)
+        th = c.parameter("th")
+        c.h(0).rz(0, th).cnot(0, 5)
+        cc = c.compile(env).precompile()
+        q1 = qt.createQureg(6, env)
+        qt.initZeroState(q1)
+        cc.run(q1, params={"th": 0.3})
+        cc.run(q1, params={"th": 0.9})      # donated buffer chains
+        q2 = qt.createQureg(6, env)
+        qt.initZeroState(q2)
+        c2 = c.compile(env)
+        c2.run(q2, params={"th": 0.3})
+        c2.run(q2, params={"th": 0.9})
+        np.testing.assert_allclose(q1.to_numpy(), q2.to_numpy(), atol=1e-12)
+
+    def test_sharded(self, env, mesh_env):
+        c = Circuit(10)
+        for q in range(10):
+            c.rotate(q, 0.2 + q * 0.1, (0.0, 1.0, 0.0))
+        c.cnot(0, 9)
+        qm = qt.createQureg(10, mesh_env)
+        qt.initZeroState(qm)
+        c.compile(mesh_env).precompile().run(qm)
+        q1 = qt.createQureg(10, env)
+        qt.initZeroState(q1)
+        c.compile(env).run(q1)
+        np.testing.assert_allclose(qm.to_numpy(), q1.to_numpy(), atol=1e-12)
+
+    def test_density(self, env):
+        c = Circuit(3)
+        c.h(0).dephase(0, 0.3)
+        d1 = qt.createDensityQureg(3, env)
+        qt.initZeroState(d1)
+        c.compile(env, density=True).precompile().run(d1)
+        d2 = qt.createDensityQureg(3, env)
+        qt.initZeroState(d2)
+        c.compile(env, density=True).run(d2)
+        np.testing.assert_allclose(d1.to_numpy(), d2.to_numpy(), atol=1e-12)
+
+    def test_apply_uses_aot_and_vmap_still_works(self, env):
+        import jax
+        import jax.numpy as jnp
+        from quest_tpu.core.packing import pack
+        c = Circuit(6)
+        th = c.parameter("th")
+        c.h(0).rz(0, th)
+        cc = c.compile(env, donate=False).precompile()
+        psi = np.zeros(64, dtype=env.precision.complex_dtype)
+        psi[0] = 1.0
+        planes = pack(psi)
+        out_aot = cc.apply(planes, params={"th": 0.4})       # concrete: AOT
+        out_jit = cc._jitted(planes, cc._param_vec({"th": 0.4}))
+        np.testing.assert_allclose(np.asarray(out_aot),
+                                   np.asarray(out_jit), atol=1e-12)
+        # traced params must still route through jit (vmap over apply)
+        batch = jnp.asarray([[0.1], [0.2], [0.3]])
+        outs = jax.vmap(lambda v: cc.apply(planes, v))(batch)
+        np.testing.assert_allclose(
+            np.asarray(outs[1]), np.asarray(cc.apply(planes, batch[1])),
+            atol=1e-12)

@@ -355,6 +355,19 @@ class TestSampleOutcomes:
         sizes = {int(s) for s in re.findall(r"f32\[(\d+)\]", hlo)}
         assert all(sz < full for sz in sizes), sorted(sizes, reverse=True)[:4]
 
+    def test_quad_sharded_register(self):
+        # QUAD planes combine to ordinary (2, N) planes before sampling;
+        # the combined array must still route through the shard-local path
+        from quest_tpu.config import QUAD
+        e = qt.createQuESTEnv(num_devices=8, precision=QUAD, seed=[5])
+        q = qt.createQureg(9, e)
+        qt.initZeroState(q)
+        qt.hadamard(q, 8)
+        qt.pauliX(q, 0)
+        s = qt.sampleOutcomes(q, 2000)
+        assert set(np.unique(s)) <= {1, 257}, np.unique(s)
+        assert abs(float(np.mean(s == 257)) - 0.5) < 0.06
+
     def test_zero_norm_register_rejected(self, env):
         q = qt.createQureg(3, env)
         qt.initBlankState(q)
