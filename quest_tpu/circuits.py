@@ -1366,7 +1366,9 @@ class CompiledCircuit:
                 and not isinstance(state_f, jax.core.Tracer)
                 and not isinstance(vec, jax.core.Tracer)
                 and getattr(state_f, "shape", None)
-                == (2, 1 << self.num_qubits)):
+                == (2, 1 << self.num_qubits)
+                and getattr(state_f, "dtype", None)
+                == self.env.precision.real_dtype):
             # concrete inputs ride the precompiled executable — the jit
             # cache is NOT populated by precompile(), so _jitted here
             # would silently recompile. Traced inputs (vmap/scan/grad)

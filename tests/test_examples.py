@@ -27,6 +27,7 @@ _PIN = (
     "noisy_trajectories.py",
     "qaoa.py",
     "quad_precision.py",
+    "production_workflow.py",
 ])
 def test_example_runs(script):
     path = os.path.join(EXAMPLES, script)
