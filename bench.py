@@ -377,13 +377,15 @@ def bench_pallas_compare(qt, env, platform: str, num_qubits: int,
     def run_mode(pallas):
         q = qt.createQureg(num_qubits, env)
         qt.initPlusState(q)
-        cc = circ.compile(env, pallas=pallas)
+        t0 = time.perf_counter()
+        cc = circ.compile(env, pallas=pallas).precompile()
+        compile_s = time.perf_counter() - t0
         dt = _time_compiled(cc, q, trials)
         amps = [qt.getAmp(q, i) for i in probes]
-        return n_gates * trials / dt, amps
+        return n_gates * trials / dt, amps, compile_s
 
-    on_rate, on_amps = run_mode("on")
-    off_rate, off_amps = run_mode("off")
+    on_rate, on_amps, on_compile = run_mode("on")
+    off_rate, off_amps, off_compile = run_mode("off")
     dev = max(abs(a - b) for a, b in zip(on_amps, off_amps))
     baseline = _roofline_baseline(
         num_qubits, np.dtype(env.precision.real_dtype).itemsize)
@@ -395,6 +397,10 @@ def bench_pallas_compare(qt, env, platform: str, num_qubits: int,
         "vs_baseline": round(on_rate / baseline, 4),
         "xla_path_gates_per_sec": round(off_rate, 2),
         "max_amp_deviation": float(dev),
+        # the fused program also has far fewer XLA ops, which matters as
+        # much as runtime on a remote-compile tunnel (docs/tpu.md)
+        "pallas_compile_s": round(on_compile, 1),
+        "xla_compile_s": round(off_compile, 1),
     }
 
 
@@ -674,10 +680,10 @@ def bench_density_noise(qt, env, platform: str) -> dict:
     count."""
     # accel width bounded by the tunnel's compile scaling (~ops x 2^2n):
     # 14q density (2^28 flat amps) measured >14 min of compile on the r5
-    # tunnel and starved the rest of the sweep; 12q lands in ~4 min cold
-    # and seconds warm
+    # tunnel and starved the rest of the sweep; 11q lands in ~1 min cold
+    # so even a 240 s cold-cache grant can deliver the row
     num_qubits = int(os.environ.get(
-        "QUEST_BENCH_DENSITY_QUBITS", "12"))
+        "QUEST_BENCH_DENSITY_QUBITS", "11" if _is_accel(platform) else "12"))
     trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 2)
     from quest_tpu.circuits import Circuit
     rng = np.random.default_rng(2026)
