@@ -1380,16 +1380,44 @@ class CompiledCircuit:
 
     def expectation_fn(self, pauli_terms: Sequence[Sequence[tuple[int, int]]],
                        coeffs: Sequence[float]) -> Callable:
-        """Return jitted ``param_vec -> <psi(params)| H |psi(params)>`` where
-        ``H = sum_j coeffs[j] * prod Pauli`` and ``psi`` starts from |0…0>.
+        """Return jitted ``param_vec -> <H>`` for ``H = sum_j coeffs[j] *
+        prod Pauli``, starting from |0…0>.
 
         A pure real-valued function of the parameter vector — feed it to
         ``jax.grad`` / ``jax.value_and_grad`` for variational optimisation.
+
+        On a density-compiled circuit (``compile(density=True)``) the
+        value is ``Tr(H rho(params))`` with rho evolved through the
+        lifted program INCLUDING its noise channels — exact gradients
+        THROUGH decoherence, which neither the statevector form (noise
+        is not a unitary) nor the reference (no autodiff at all) can
+        provide. Channel probabilities are static; the differentiable
+        inputs are the gate parameters.
         """
         n = self.num_qubits
         cdtype = self.env.precision.complex_dtype
         terms = [tuple((int(q), int(c)) for q, c in t) for t in pauli_terms]
         coeffs = np.asarray(coeffs, dtype=np.float64)
+        nq = n // 2 if self.is_density else n
+        for t in terms:
+            for q, code in t:
+                if not 0 <= q < nq:
+                    raise ValueError(
+                        f"pauli qubit {q} out of range [0, {nq})")
+                if code not in (0, 1, 2, 3):
+                    raise ValueError(f"invalid pauli code {code}")
+
+        if self.is_density:
+            # Tr(P rho): P applied on the KET half (low positions — the
+            # bra half carries conj(U), verified by the Y-term sign),
+            # then the real diagonal sum (the densmatr trace helper)
+            from .ops.densmatr import calc_total_prob
+
+            def reduce_term(state, phi):
+                return calc_total_prob(phi, nq)
+        else:
+            def reduce_term(state, phi):
+                return jnp.real(jnp.vdot(state, phi))
 
         def energy(param_vec):
             params = {nm: param_vec[i] for i, nm in enumerate(self.param_names)}
@@ -1403,7 +1431,7 @@ class CompiledCircuit:
                 phi = state
                 for q, code in term:
                     phi = apply_unitary(phi, n, mats.PAULI_MATS[code], (q,))
-                total = total + c * jnp.real(jnp.vdot(state, phi))
+                total = total + c * reduce_term(state, phi)
             return total
 
         return jax.jit(energy)
