@@ -406,3 +406,33 @@ class TestDensityExpectation:
         cc = c.compile(env, density=True)
         with pytest.raises(ValueError):
             cc.expectation_fn([[(2, 3)]], [1.0])   # qubit 2 of 2 (lifted 4)
+        with pytest.raises(ValueError):
+            cc.expectation_fn([[(0, 9)]], [1.0])   # bad pauli code
+        with pytest.raises(ValueError):
+            cc.expectation_fn([[(0, 3)], [(1, 1)]], [1.0])  # coeff count
+
+    def test_sharded_grad_stays_shard_local(self, mesh_env):
+        # the diagonal-trace reduction and its gradient must not
+        # materialise the full flat density vector on any device
+        import re
+        import jax
+        import jax.numpy as jnp
+        n = 8
+        c = Circuit(n)
+        a = c.parameter("a")
+        c.ry(0, a).cnot(0, 1).dephase(0, 0.1)
+        f = c.compile(mesh_env, density=True).expectation_fn(
+            [[(0, 3)], [(4, 1)]], [1.0, 0.5])
+        hlo = jax.jit(jax.grad(f)).lower(
+            jnp.asarray([0.3])).compile().as_text()
+        full = 1 << (2 * n)
+        # match any-rank shapes (c128[256,256] included): a full-size 2-D
+        # rematerialisation must not slip past a 1-D-only pattern
+        sizes = set()
+        for dims in re.findall(r"(?:c128|f64)\[([\d,]+)\]", hlo):
+            prod = 1
+            for d in dims.split(","):
+                prod *= int(d)
+            sizes.add(prod)
+        assert all(s < full for s in sizes), sorted(sizes, reverse=True)[:4]
+        assert "all-gather" not in hlo
