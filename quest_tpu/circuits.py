@@ -1396,21 +1396,22 @@ class CompiledCircuit:
         """
         n = self.num_qubits
         cdtype = self.env.precision.complex_dtype
-        terms = [tuple((int(q), int(c)) for q, c in t
-                       if int(c) != 0)              # identities are free
+        nq = n // 2 if self.is_density else n
+        for t in pauli_terms:
+            for q, code in t:
+                if not 0 <= int(q) < nq:
+                    raise ValueError(
+                        f"pauli qubit {q} out of range [0, {nq})")
+                if int(code) not in (0, 1, 2, 3):
+                    raise ValueError(f"invalid pauli code {code}")
+        # identity factors are free: drop them AFTER validation so a
+        # malformed (qubit, 0) pair still errors instead of vanishing
+        terms = [tuple((int(q), int(c)) for q, c in t if int(c) != 0)
                  for t in pauli_terms]
         coeffs = np.asarray(coeffs, dtype=np.float64)
         if len(coeffs) != len(terms):
             raise ValueError(f"{len(terms)} pauli terms but "
                              f"{len(coeffs)} coefficients")
-        nq = n // 2 if self.is_density else n
-        for t in terms:
-            for q, code in t:
-                if not 0 <= q < nq:
-                    raise ValueError(
-                        f"pauli qubit {q} out of range [0, {nq})")
-                if code not in (1, 2, 3):
-                    raise ValueError(f"invalid pauli code {code}")
 
         if self.is_density:
             # Tr(P rho): P applied on the KET half (low positions — the

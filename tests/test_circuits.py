@@ -429,10 +429,11 @@ class TestDensityExpectation:
         # match any-rank shapes (c128[256,256] included): a full-size 2-D
         # rematerialisation must not slip past a 1-D-only pattern
         sizes = set()
-        for dims in re.findall(r"(?:c128|f64)\[([\d,]+)\]", hlo):
+        for dims in re.findall(r"(?:c128|c64|f64|f32)\[([\d,]+)\]", hlo):
             prod = 1
             for d in dims.split(","):
                 prod *= int(d)
             sizes.add(prod)
+        assert sizes, "no tensor shapes matched — pattern defanged"
         assert all(s < full for s in sizes), sorted(sizes, reverse=True)[:4]
         assert "all-gather" not in hlo
