@@ -437,3 +437,18 @@ class TestDensityExpectation:
         assert sizes, "no tensor shapes matched — pattern defanged"
         assert all(s < full for s in sizes), sorted(sizes, reverse=True)[:4]
         assert "all-gather" not in hlo
+
+    def test_density_sweep(self, env):
+        # sweep() on a density-compiled circuit: the lifted program vmaps
+        # like any other; default initial state is |0..0><0..0| flattened
+        import jax.numpy as jnp
+        c = Circuit(3)
+        a = c.parameter("a")
+        c.ry(0, a).cnot(0, 1).dephase(1, 0.2)
+        cc = c.compile(env, density=True)
+        out = cc.sweep(np.asarray([[0.3], [0.7], [1.1]]))
+        assert out.shape == (3, 2, 1 << 6)
+        d = qt.createDensityQureg(3, env)
+        qt.initZeroState(d)
+        cc.run(d, params={"a": 0.7})
+        assert float(jnp.max(jnp.abs(out[1] - d.state))) < 1e-14
