@@ -1110,6 +1110,10 @@ class CompiledCircuit:
         self.env = env
         self.num_qubits = circuit.num_qubits
         self.param_names = circuit.param_names
+        # recorded for the layer-free twin (_xla_only): it must differ
+        # from this program ONLY in the Pallas pass
+        self._compile_opts = {"fuse": fuse, "lookahead": lookahead,
+                              "supergate_k": supergate_k}
         n = circuit.num_qubits
         if (1 << n) < env.num_devices:   # register smaller than the mesh
             sharding = None
@@ -1378,6 +1382,22 @@ class CompiledCircuit:
 
     # -- analysis / autodiff ----------------------------------------------
 
+    def _xla_only(self) -> "CompiledCircuit":
+        """This program with the Pallas layer pass off (cached twin).
+
+        ``jax.grad`` and ``jax.vmap`` have no rules for a compiled
+        ``pallas_call``, so the transform-composable consumers
+        (:meth:`expectation_fn`, :meth:`sweep`) trace the twin's
+        layer-free plan — identical math, XLA ops only. Execution paths
+        (:meth:`run`, :meth:`apply`) keep the fused kernels."""
+        if not any(getattr(op, "kind", None) == "layer" for op in self._ops):
+            return self
+        if getattr(self, "_xla_twin", None) is None:
+            self._xla_twin = CompiledCircuit(
+                self.circuit, self.env, donate=False, pallas=False,
+                **self._compile_opts)
+        return self._xla_twin
+
     def expectation_fn(self, pauli_terms: Sequence[Sequence[tuple[int, int]]],
                        coeffs: Sequence[float]) -> Callable:
         """Return jitted ``param_vec -> <H>`` for ``H = sum_j coeffs[j] *
@@ -1425,13 +1445,15 @@ class CompiledCircuit:
             def reduce_term(state, phi):
                 return jnp.real(jnp.vdot(state, phi))
 
+        run_plan = self._xla_only()._run_plan
+
         def energy(param_vec):
             params = {nm: param_vec[i] for i, nm in enumerate(self.param_names)}
             state = jnp.zeros(1 << n, dtype=cdtype).at[0].set(1.0)
             if self._flat_sharding is not None:
                 state = jax.lax.with_sharding_constraint(
                     state, self._flat_sharding)
-            state = self._run_plan(state, params)
+            state = run_plan(state, params)
             total = jnp.zeros((), dtype=jnp.float64)
             for term, c in zip(terms, coeffs):
                 phi = state
@@ -1468,10 +1490,12 @@ class CompiledCircuit:
         # donated across a vmapped batch. Cached so repeat sweeps (an
         # optimiser loop) hit the jit cache instead of retracing.
         if not hasattr(self, "_sweep_jitted"):
+            run_plan_seq = self._xla_only()._run_plan_seq
+
             def seq_apply(sf, vec):
                 params = {nm: vec[i]
                           for i, nm in enumerate(self.param_names)}
-                return pack(self._run_plan_seq(unpack(sf), params))
+                return pack(run_plan_seq(unpack(sf), params))
 
             self._sweep_jitted = jax.jit(
                 jax.vmap(seq_apply, in_axes=(None, 0)))

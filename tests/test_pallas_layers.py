@@ -380,3 +380,46 @@ class TestShardedLayers:
         c.compile(env, pallas=False).run(q1)
         np.testing.assert_allclose(q8.to_numpy(), q1.to_numpy(),
                                    atol=1e-10)
+
+
+class TestTransformsOnLayeredCircuits:
+    """jax.grad / jax.vmap have no rules for a compiled pallas_call; the
+    transform consumers (expectation_fn, sweep) must trace the layer-free
+    twin while run()/apply() keep the fused kernels."""
+
+    def _layered(self, env):
+        c = Circuit(8)
+        a = c.parameter("a")
+        for i in range(8):
+            c.h(i)
+        c.ry(0, a)
+        for i in range(7):
+            c.cnot(i, i + 1)
+        cc = c.compile(env, pallas="interpret")
+        assert any(getattr(o, "kind", None) == "layer" for o in cc._ops)
+        return cc
+
+    def test_grad_and_value(self, env):
+        import jax
+        import jax.numpy as jnp
+        cc = self._layered(env)
+        f = cc.expectation_fn([[(0, 3)]], [1.0])
+        g = float(jax.grad(f)(jnp.asarray([0.4]))[0])
+        q = qt.createQureg(8, env)
+        qt.initZeroState(q)
+        cc.run(q, params={"a": 0.4})
+        want = qt.calcExpecPauliSum(q, [3] + [0] * 7, [1.0])
+        assert abs(float(f(jnp.asarray([0.4]))) - want) < 1e-12
+        eps = 1e-6
+        fd = (float(f(jnp.asarray([0.4 + eps])))
+              - float(f(jnp.asarray([0.4 - eps])))) / (2 * eps)
+        assert abs(g - fd) < 1e-6
+
+    def test_sweep(self, env):
+        import jax.numpy as jnp
+        cc = self._layered(env)
+        out = cc.sweep(np.asarray([[0.1], [0.4]]))
+        q = qt.createQureg(8, env)
+        qt.initZeroState(q)
+        cc.run(q, params={"a": 0.4})
+        assert float(jnp.max(jnp.abs(out[1] - q.state))) < 1e-12
