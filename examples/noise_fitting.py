@@ -1,0 +1,83 @@
+"""Fit a device's noise model by gradient descent.
+
+Channel strengths can be circuit Parameters: the density path binds them
+at run time and ``jax.grad`` differentiates straight through the Kraus
+superoperators. Given measured expectation values from a noisy "device",
+the fit recovers the hidden damping and dephasing rates exactly — a
+noise-characterisation workflow that is impossible in the reference
+(no autodiff) and unavailable to statevector simulators (no channels).
+
+Run:  python examples/noise_fitting.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from anywhere, uninstalled
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    import optax
+except ImportError:                      # pragma: no cover
+    optax = None
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+
+TRUE_DAMP, TRUE_DEPHASE = 0.23, 0.17
+
+
+def main():
+    env = qt.createQuESTEnv(num_devices=1, seed=[11])
+
+    # --- the "device": a Bell-pair circuit with hidden noise rates -------
+    device = Circuit(2)
+    device.h(0).cnot(0, 1)
+    device.damp(0, TRUE_DAMP).dephase(1, TRUE_DEPHASE)
+    d = qt.createDensityQureg(2, env)
+    qt.initZeroState(d)
+    device.compile(env, density=True).run(d)
+
+    # "experiment": measure a few observables on the device state
+    observables = [[3, 0], [0, 3], [1, 1], [2, 2]]     # Z0, Z1, X0X1, Y0Y1
+    data = [qt.calcExpecPauliSum(d, codes, [1.0]) for codes in observables]
+    print("device expectations:", [round(x, 4) for x in data])
+
+    # --- the model: same circuit, channel strengths as Parameters --------
+    model = Circuit(2)
+    g = model.parameter("damp")
+    p = model.parameter("dephase")
+    model.h(0).cnot(0, 1).damp(0, g).dephase(1, p)
+    cc = model.compile(env, density=True)
+    fns = [cc.expectation_fn(
+        [[(q, c) for q, c in enumerate(codes) if c]], [1.0])
+        for codes in observables]
+
+    def loss(pv):
+        return sum((f(pv) - t) ** 2 for f, t in zip(fns, data))
+
+    vg = jax.jit(jax.value_and_grad(loss))
+    pv = jnp.asarray([0.5, 0.5])                       # bad initial guess
+    if optax is None:
+        print("optax unavailable; single gradient:", np.asarray(vg(pv)[1]))
+        return
+    opt = optax.adam(0.05)
+    st = opt.init(pv)
+    for step in range(300):
+        val, grad = vg(pv)
+        updates, st = opt.update(grad, st)
+        pv = jnp.clip(optax.apply_updates(pv, updates), 1e-4, 0.49)
+    fitted = [round(float(x), 4) for x in pv]
+    print(f"fitted rates: damp={fitted[0]}, dephase={fitted[1]} "
+          f"(true: {TRUE_DAMP}, {TRUE_DEPHASE})")
+    assert abs(fitted[0] - TRUE_DAMP) < 0.01
+    assert abs(fitted[1] - TRUE_DEPHASE) < 0.01
+    print("noise model recovered by gradient descent")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,7 +79,8 @@ class _Op:
 
     @property
     def is_static(self) -> bool:
-        return self.mat_fn is None and self.diag_fn is None
+        return (self.mat_fn is None and self.diag_fn is None
+                and not callable(self.kraus))
 
 
 def _angle(params: dict, a: Angle):
@@ -331,32 +332,63 @@ class Circuit:
         the flattened density vector, ``QuEST_common.c:540-604``) and by
         ``compile_trajectories`` (stochastic statevector unraveling).
         CPTP validation happens at compile time, at the environment's
-        precision tolerance."""
+        precision tolerance.
+
+        ``ops`` may be a callable ``params_dict -> [K_k]`` (traceable, jnp)
+        for a PARAMETERIZED channel — the density path then differentiates
+        straight through the channel strength (noise-model fitting by
+        gradient; no CPTP validation is possible for a function, and the
+        trajectory/native paths reject it)."""
         targets = tuple(int(t) for t in targets)
         self._check(targets)
+        if callable(ops):
+            self.ops.append(_Op("kraus", targets, kraus=ops))
+            return self
         mats_l = [np.asarray(m, dtype=np.complex128) for m in ops]
         self.ops.append(_Op("kraus", targets, kraus=mats_l))
         return self
 
-    def dephase(self, q: int, prob: float) -> "Circuit":
+    def dephase(self, q: int, prob: Angle) -> "Circuit":
         """rho -> (1-p) rho + p Z rho Z (mixDephasing semantics; max prob
-        1/2, ``QuEST_validation.c:108``)."""
+        1/2, ``QuEST_validation.c:108``). ``prob`` may be a Param: the
+        channel strength then binds (and differentiates) at run time on
+        the density path."""
+        if isinstance(prob, Param):
+            from .ops import channels as chan
+            nm = self._register_angle(prob).name
+            return self.kraus(
+                lambda p, nm=nm: chan.dephasing_kraus_traceable(p[nm]),
+                (q,))
         from . import validation as val
         val.validate_prob(prob, "Circuit.dephase", 0.5,
                           code=val.ErrorCode.E_INVALID_ONE_QUBIT_DEPHASE_PROB)
         return self.kraus([np.sqrt(1 - prob) * np.eye(2),
                            np.sqrt(prob) * mats.pauli_z()], (q,))
 
-    def depolarise(self, q: int, prob: float) -> "Circuit":
-        """Homogeneous depolarising (mixDepolarising semantics; max 3/4)."""
+    def depolarise(self, q: int, prob: Angle) -> "Circuit":
+        """Homogeneous depolarising (mixDepolarising semantics; max 3/4).
+        ``prob`` may be a Param (see :meth:`dephase`)."""
+        if isinstance(prob, Param):
+            from .ops import channels as chan
+            nm = self._register_angle(prob).name
+            return self.kraus(
+                lambda p, nm=nm: chan.depolarising_kraus_traceable(p[nm]),
+                (q,))
         from . import validation as val
         from .ops import channels as chan
         val.validate_prob(prob, "Circuit.depolarise", 0.75,
                           code=val.ErrorCode.E_INVALID_ONE_QUBIT_DEPOL_PROB)
         return self.kraus(chan.depolarising_kraus(prob), (q,))
 
-    def damp(self, q: int, prob: float) -> "Circuit":
-        """Amplitude damping at rate ``prob`` (mixDamping semantics)."""
+    def damp(self, q: int, prob: Angle) -> "Circuit":
+        """Amplitude damping at rate ``prob`` (mixDamping semantics).
+        ``prob`` may be a Param (see :meth:`dephase`)."""
+        if isinstance(prob, Param):
+            from .ops import channels as chan
+            nm = self._register_angle(prob).name
+            return self.kraus(
+                lambda p, nm=nm: chan.damping_kraus_traceable(p[nm]),
+                (q,))
         from . import validation as val
         from .ops import channels as chan
         val.validate_prob(prob, "Circuit.damp", 1.0)
@@ -428,9 +460,17 @@ class Circuit:
         out._params = list(self._params)
         for op in self.ops:
             if op.kind == "kraus":
-                from .ops.densmatr import kraus_superoperator
+                from .ops.densmatr import (kraus_superoperator,
+                                           kraus_superoperator_traceable)
                 t2 = op.targets + tuple(t + n for t in op.targets)
-                out.ops.append(_Op("u", t2, mat=kraus_superoperator(op.kraus)))
+                if callable(op.kraus):
+                    out.ops.append(_Op(
+                        "u", t2,
+                        mat_fn=lambda p, f=op.kraus:
+                        kraus_superoperator_traceable(f(p))))
+                else:
+                    out.ops.append(_Op("u", t2,
+                                       mat=kraus_superoperator(op.kraus)))
             elif op.kind == "u":
                 shifted = tuple(t + n for t in op.targets)
                 if op.ctrl_mask == 0 and op.mat_fn is None:
@@ -673,7 +713,7 @@ class Circuit:
         if density:
             from . import validation as val
             for op in self.ops:
-                if op.kind == "kraus":
+                if op.kind == "kraus" and not callable(op.kraus):
                     val.validate_kraus_ops(op.kraus, len(op.targets),
                                            "Circuit.kraus",
                                            env.precision.eps)
@@ -707,6 +747,10 @@ class Circuit:
             from .config import default_precision
             for op in self.ops:
                 if op.kind == "kraus":
+                    if callable(op.kraus):
+                        raise ValueError(
+                            "parameterized channels are density-XLA-path "
+                            "only; the native executor needs static ops")
                     val.validate_kraus_ops(op.kraus, len(op.targets),
                                            "Circuit.kraus",
                                            default_precision().eps)

@@ -17,7 +17,10 @@ from ..core.matrices import PAULI_MATS
 
 __all__ = [
     "damping_kraus",
+    "damping_kraus_traceable",
+    "dephasing_kraus_traceable",
     "depolarising_kraus",
+    "depolarising_kraus_traceable",
     "pauli_kraus",
     "two_qubit_depolarising_kraus",
 ]
@@ -53,3 +56,30 @@ def two_qubit_depolarising_kraus(prob: float) -> list[np.ndarray]:
         w = (1.0 - prob) if (i == 0 and j == 0) else prob / 15.0
         ops.append(np.sqrt(w) * np.kron(PAULI_MATS[j], PAULI_MATS[i]))
     return ops
+
+
+# -- traceable (jnp) variants: Kraus sets whose probability is a tracer ----
+# (Circuit.dephase/damp/depolarise with a Param strength). Same math as
+# the static builders above — keep the pairs in sync.
+
+def damping_kraus_traceable(prob) -> list:
+    import jax.numpy as jnp
+    k0 = (jnp.asarray([[1.0, 0.0], [0.0, 0.0]], dtype=complex)
+          + jnp.sqrt(1.0 - prob)
+          * jnp.asarray([[0.0, 0.0], [0.0, 1.0]], dtype=complex))
+    k1 = jnp.sqrt(prob) * jnp.asarray([[0.0, 1.0], [0.0, 0.0]],
+                                      dtype=complex)
+    return [k0, k1]
+
+
+def dephasing_kraus_traceable(prob) -> list:
+    import jax.numpy as jnp
+    return [jnp.sqrt(1.0 - prob) * jnp.eye(2, dtype=complex),
+            jnp.sqrt(prob) * jnp.asarray(PAULI_MATS[3])]
+
+
+def depolarising_kraus_traceable(prob) -> list:
+    import jax.numpy as jnp
+    return [jnp.sqrt(1.0 - prob) * jnp.eye(2, dtype=complex)] + [
+        jnp.sqrt(prob / 3.0) * jnp.asarray(PAULI_MATS[c])
+        for c in (1, 2, 3)]

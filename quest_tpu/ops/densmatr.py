@@ -128,6 +128,17 @@ def kraus_superoperator(ops) -> np.ndarray:
     return s
 
 
+def kraus_superoperator_traceable(ops) -> jnp.ndarray:
+    """Traceable (jnp) form of :func:`kraus_superoperator`, for
+    PARAMETERIZED channels whose Kraus operators are built from tracers
+    (``Circuit.kraus`` with a callable)."""
+    s = None
+    for op in ops:
+        term = jnp.kron(jnp.conj(op), op)
+        s = term if s is None else s + term
+    return s
+
+
 def apply_kraus_superoperator(flat, num_qubits, targets, superop):
     """Apply a superoperator to targets of the flat density vector.
 

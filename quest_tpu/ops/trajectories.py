@@ -49,6 +49,12 @@ class TrajectoryProgram:
     def __init__(self, circuit, env):
         self.env = env
         self.num_qubits = circuit.num_qubits
+        if any(op.kind == "kraus" and callable(op.kraus)
+               for op in circuit.ops):
+            raise ValueError(
+                "parameterized channels (Circuit.kraus with a callable) "
+                "are density-path only; trajectory unraveling precomputes "
+                "static jump probabilities")
         if circuit.param_names or any(not op.is_static
                                       for op in circuit.ops):
             raise ValueError(

@@ -28,6 +28,7 @@ _PIN = (
     "qaoa.py",
     "quad_precision.py",
     "production_workflow.py",
+    "noise_fitting.py",
 ])
 def test_example_runs(script):
     path = os.path.join(EXAMPLES, script)
