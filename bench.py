@@ -511,9 +511,12 @@ def bench_qft(qt, env, platform: str) -> dict:
     from quest_tpu.algorithms import qft
     # accel size bounded by the tunnel's measured compile scaling
     # (~3.3e-7 s per op-amp: QFT-26's 351 ops at 2^26 would compile for
-    # ~2 h; QFT-22 lands in ~6 min once, then the persistent cache owns it)
+    # ~2 h). 20q keeps the cold compile — XLA ops plus the fused plan's
+    # ~13 separate Mosaic kernels — inside the heartbeat ceiling, so one
+    # cold grant cannot burn the whole child on this config (a 22q row
+    # exists in TPU_EVIDENCE_r05.jsonl)
     num_qubits = int(os.environ.get(
-        "QUEST_BENCH_QFT_QUBITS", "22" if _is_accel(platform) else "18"))
+        "QUEST_BENCH_QFT_QUBITS", "20" if _is_accel(platform) else "18"))
     trials = int(os.environ.get("QUEST_BENCH_TRIALS", "10"))
     q = qt.createQureg(num_qubits, env)
     qt.initPlusState(q)
