@@ -394,6 +394,60 @@ class Circuit:
         val.validate_prob(prob, "Circuit.damp", 1.0)
         return self.kraus(chan.damping_kraus(prob), (q,))
 
+    def pauli_channel(self, q: int, prob_x: Angle, prob_y: Angle,
+                      prob_z: Angle) -> "Circuit":
+        """rho -> (1-px-py-pz) rho + px X rho X + py Y rho Y + pz Z rho Z
+        (mixPauli semantics). Any probability may be a Param (see
+        :meth:`dephase`)."""
+        if any(isinstance(p, Param) for p in (prob_x, prob_y, prob_z)):
+            from . import validation as val
+            from .ops import channels as chan
+            vals = []
+            static_sum = 0.0
+            for p in (prob_x, prob_y, prob_z):
+                if isinstance(p, Param):
+                    nm = self._register_angle(p).name
+                    vals.append(lambda pd, nm=nm: pd[nm])
+                else:
+                    # static components still validate at record time
+                    # (a Param component's share only binds at run time —
+                    # out-of-range bound values surface as NaN planes)
+                    val.validate_prob(float(p), "Circuit.pauli_channel",
+                                      1.0)
+                    static_sum += float(p)
+                    vals.append(lambda pd, v=float(p): v)
+            if static_sum > 1.0:
+                val._fail(
+                    f"static pauli error probabilities sum to "
+                    f"{static_sum:g} > 1", "Circuit.pauli_channel",
+                    val.ErrorCode.E_INVALID_PROB)
+            return self.kraus(
+                lambda pd, vs=tuple(vals): chan.pauli_kraus_traceable(
+                    vs[0](pd), vs[1](pd), vs[2](pd)), (q,))
+        from . import validation as val
+        from .ops import channels as chan
+        val.validate_one_qubit_pauli_probs(prob_x, prob_y, prob_z,
+                                           "Circuit.pauli_channel")
+        return self.kraus(chan.pauli_kraus(prob_x, prob_y, prob_z), (q,))
+
+    def two_qubit_dephase(self, q1: int, q2: int, prob: float) -> "Circuit":
+        """rho -> (1-p) rho + p/3 (Z1 rho Z1 + Z2 rho Z2 + Z1 Z2 rho Z1 Z2)
+        (mixTwoQubitDephasing semantics; max 3/4)."""
+        from . import validation as val
+        from .ops import channels as chan
+        val.validate_prob(prob, "Circuit.two_qubit_dephase", 0.75,
+                          code=val.ErrorCode.E_INVALID_TWO_QUBIT_DEPHASE_PROB)
+        return self.kraus(chan.two_qubit_dephasing_kraus(prob), (q1, q2))
+
+    def two_qubit_depolarise(self, q1: int, q2: int, prob: float) -> "Circuit":
+        """Homogeneous two-qubit depolarising (mixTwoQubitDepolarising
+        semantics; max 15/16)."""
+        from . import validation as val
+        from .ops import channels as chan
+        val.validate_prob(prob, "Circuit.two_qubit_depolarise", 15.0 / 16.0,
+                          code=val.ErrorCode.E_INVALID_TWO_QUBIT_DEPOL_PROB)
+        return self.kraus(chan.two_qubit_depolarising_kraus(prob), (q1, q2))
+
     def mid_measure(self, q: int) -> "Circuit":
         """Record a mid-circuit measurement of qubit ``q`` as the
         projector channel ``{|0><0|, |1><1|}`` — a valid Kraus set, so it

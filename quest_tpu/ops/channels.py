@@ -17,11 +17,13 @@ from ..core.matrices import PAULI_MATS
 
 __all__ = [
     "damping_kraus",
+    "pauli_kraus_traceable",
     "damping_kraus_traceable",
     "dephasing_kraus_traceable",
     "depolarising_kraus",
     "depolarising_kraus_traceable",
     "pauli_kraus",
+    "two_qubit_dephasing_kraus",
     "two_qubit_depolarising_kraus",
 ]
 
@@ -42,6 +44,19 @@ def pauli_kraus(prob_x: float, prob_y: float, prob_z: float) -> list[np.ndarray]
 def depolarising_kraus(prob: float) -> list[np.ndarray]:
     """Homogeneous single-qubit depolarising: px=py=pz=p/3."""
     return pauli_kraus(prob / 3.0, prob / 3.0, prob / 3.0)
+
+
+def two_qubit_dephasing_kraus(prob: float) -> list[np.ndarray]:
+    """rho -> (1-p) rho + p/3 (Z1 rho Z1 + Z2 rho Z2 + Z1Z2 rho Z1Z2)
+    (``mixTwoQubitDephasing`` semantics). Kraus index bit 0 addresses the
+    first target, so Z on the first target is kron(I, Z)."""
+    z = PAULI_MATS[3]
+    i2 = PAULI_MATS[0]
+    w = np.sqrt(prob / 3.0)
+    return [np.sqrt(1.0 - prob) * np.eye(4, dtype=np.complex128),
+            w * np.kron(i2, z),
+            w * np.kron(z, i2),
+            w * np.kron(z, z)]
 
 
 def two_qubit_depolarising_kraus(prob: float) -> list[np.ndarray]:
@@ -83,3 +98,10 @@ def depolarising_kraus_traceable(prob) -> list:
     return [jnp.sqrt(1.0 - prob) * jnp.eye(2, dtype=complex)] + [
         jnp.sqrt(prob / 3.0) * jnp.asarray(PAULI_MATS[c])
         for c in (1, 2, 3)]
+
+
+def pauli_kraus_traceable(prob_x, prob_y, prob_z) -> list:
+    import jax.numpy as jnp
+    probs = (1.0 - prob_x - prob_y - prob_z, prob_x, prob_y, prob_z)
+    return [jnp.sqrt(p) * jnp.asarray(m)
+            for p, m in zip(probs, PAULI_MATS)]

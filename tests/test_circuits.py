@@ -507,3 +507,45 @@ class TestParameterizedChannels:
                             np.sqrt(0.1) * np.diag([1.0, -1.0])], (0,))
         with pytest.raises(ValueError, match="density-path only"):
             c2.compile_trajectories(env)
+
+    def test_pauli_and_two_qubit_channel_builders(self, env):
+        # new builders match the imperative register channels op-for-op
+        c = Circuit(3)
+        c.h(0).cnot(0, 1)
+        c.pauli_channel(0, 0.05, 0.02, 0.1)
+        c.two_qubit_dephase(0, 1, 0.2)
+        c.two_qubit_depolarise(1, 2, 0.3)
+        d1 = qt.createDensityQureg(3, env)
+        qt.initZeroState(d1)
+        c.compile(env, density=True).run(d1)
+        d2 = qt.createDensityQureg(3, env)
+        qt.initZeroState(d2)
+        qt.hadamard(d2, 0)
+        qt.controlledNot(d2, 0, 1)
+        qt.mixPauli(d2, 0, 0.05, 0.02, 0.1)
+        qt.mixTwoQubitDephasing(d2, 0, 1, 0.2)
+        qt.mixTwoQubitDepolarising(d2, 1, 2, 0.3)
+        np.testing.assert_allclose(d1.to_numpy(), d2.to_numpy(), atol=1e-12)
+        assert abs(float(qt.calcTotalProb(d1)) - 1.0) < 1e-10
+
+    def test_param_pauli_channel_gradient(self, env):
+        # <Z> on |+> under pauli_channel(px, 0, 0): X errors keep |+>
+        # invariant in X but <Z>=0 stays 0; use <X> = 1 - 2(py+pz):
+        # with only pz as Param, d<X>/dpz = -2
+        import jax
+        import jax.numpy as jnp
+        c = Circuit(1)
+        pz = c.parameter("pz")
+        c.h(0).pauli_channel(0, 0.05, 0.0, pz)
+        f = c.compile(env, density=True).expectation_fn([[(0, 1)]], [1.0])
+        pv = jnp.asarray([0.1])
+        assert abs(float(f(pv)) - (1 - 2 * (0.0 + 0.1))) < 1e-12
+        assert abs(float(jax.grad(f)(pv)[0]) + 2.0) < 1e-9
+
+    def test_param_pauli_channel_static_validation(self, env):
+        from quest_tpu.circuits import Param
+        c = Circuit(1)
+        with pytest.raises(qt.QuESTError):
+            c.pauli_channel(0, 1.3, 0.0, Param("pz"))     # component > 1
+        with pytest.raises(qt.QuESTError):
+            c.pauli_channel(0, 0.9, 0.9, Param("pz"))     # static sum > 1
