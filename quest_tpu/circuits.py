@@ -398,34 +398,30 @@ class Circuit:
                       prob_z: Angle) -> "Circuit":
         """rho -> (1-px-py-pz) rho + px X rho X + py Y rho Y + pz Z rho Z
         (mixPauli semantics). Any probability may be a Param (see
-        :meth:`dephase`)."""
-        if any(isinstance(p, Param) for p in (prob_x, prob_y, prob_z)):
-            from . import validation as val
-            from .ops import channels as chan
+        :meth:`dephase`); Param components bind at run time, so only the
+        static components (and their sum) validate at record time —
+        out-of-range bound values surface as NaN planes."""
+        from . import validation as val
+        from .ops import channels as chan
+        probs = (prob_x, prob_y, prob_z)
+        if any(isinstance(p, Param) for p in probs):
+            # validate every static piece BEFORE registering any Param:
+            # a rejected call must not leave orphan parameter names on
+            # the circuit
+            statics = [float(p) for p in probs if not isinstance(p, Param)]
+            for v in statics:
+                val.validate_prob(v, "Circuit.pauli_channel", 1.0)
+            val.validate_prob_sum(sum(statics), "Circuit.pauli_channel")
             vals = []
-            static_sum = 0.0
-            for p in (prob_x, prob_y, prob_z):
+            for p in probs:
                 if isinstance(p, Param):
                     nm = self._register_angle(p).name
                     vals.append(lambda pd, nm=nm: pd[nm])
                 else:
-                    # static components still validate at record time
-                    # (a Param component's share only binds at run time —
-                    # out-of-range bound values surface as NaN planes)
-                    val.validate_prob(float(p), "Circuit.pauli_channel",
-                                      1.0)
-                    static_sum += float(p)
                     vals.append(lambda pd, v=float(p): v)
-            if static_sum > 1.0:
-                val._fail(
-                    f"static pauli error probabilities sum to "
-                    f"{static_sum:g} > 1", "Circuit.pauli_channel",
-                    val.ErrorCode.E_INVALID_PROB)
             return self.kraus(
                 lambda pd, vs=tuple(vals): chan.pauli_kraus_traceable(
                     vs[0](pd), vs[1](pd), vs[2](pd)), (q,))
-        from . import validation as val
-        from .ops import channels as chan
         val.validate_one_qubit_pauli_probs(prob_x, prob_y, prob_z,
                                            "Circuit.pauli_channel")
         return self.kraus(chan.pauli_kraus(prob_x, prob_y, prob_z), (q,))
@@ -470,8 +466,8 @@ class Circuit:
         p1m[1, 1] = 1.0
         return self.kraus([p0, p1m], (q,))
 
-    def with_noise(self, p1: float = 0.0, p2: float = 0.0,
-                   damping: float = 0.0) -> "Circuit":
+    def with_noise(self, p1: Angle = 0.0, p2: Angle = 0.0,
+                   damping: Angle = 0.0) -> "Circuit":
         """Return a copy with a uniform noise model applied: after every
         gate, each touched qubit (targets and controls) gets depolarising
         noise — ``p1`` for single-qubit gates, ``p2`` for multi-qubit —
@@ -479,13 +475,23 @@ class Circuit:
         way to make any clean algorithm noisy without hand-inserting
         channels; run the result on a density register or through
         ``compile_trajectories``. Existing channels are preserved and not
-        re-noised."""
+        re-noised. Rates may be Params: every inserted channel shares the
+        named strength, so a THREE-parameter uniform device model can be
+        fit by gradient on the density path (`examples/noise_fitting.py`
+        shows the per-channel version) — Param rates are density-path
+        only (``compile_trajectories`` needs static jump probabilities
+        and rejects them)."""
         from . import validation as val
         for name, p, cap in (("p1", p1, 0.75), ("p2", p2, 0.75),
                              ("damping", damping, 1.0)):
-            val.validate_prob(p, f"Circuit.with_noise({name})", cap)
+            if not isinstance(p, Param):
+                val.validate_prob(p, f"Circuit.with_noise({name})", cap)
         out = Circuit(self.num_qubits)
         out._params = list(self._params)
+
+        def on(p):
+            return isinstance(p, Param) or p > 0.0
+
         for op in self.ops:
             out.ops.append(op)
             if op.kind == "kraus":
@@ -496,9 +502,9 @@ class Circuit:
                    if (op.ctrl_mask >> q) & 1})
             p = p1 if len(touched) == 1 else p2
             for q in touched:
-                if p > 0.0:
+                if on(p):
                     out.depolarise(q, p)
-                if damping > 0.0:
+                if on(damping):
                     out.damp(q, damping)
         return out
 

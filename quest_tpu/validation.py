@@ -477,3 +477,11 @@ def validate_sys_printable(num_qubits: int, func: str) -> None:
 def validate_file_opened(opened: bool, func: str) -> None:
     if not opened:
         _fail("could not open file", func, ErrorCode.E_CANNOT_OPEN_FILE)
+
+
+def validate_prob_sum(total: float, context: str) -> None:
+    """The statically-known error probabilities of a channel must not
+    already exceed 1 (the per-component checks cannot see their sum)."""
+    if total > 1.0:
+        _fail(f"static error probabilities sum to {total:g} > 1",
+              context, ErrorCode.E_INVALID_PROB)

@@ -549,3 +549,37 @@ class TestParameterizedChannels:
             c.pauli_channel(0, 1.3, 0.0, Param("pz"))     # component > 1
         with pytest.raises(qt.QuESTError):
             c.pauli_channel(0, 0.9, 0.9, Param("pz"))     # static sum > 1
+
+    def test_with_noise_param_rates(self, env):
+        # Param rates flow through with_noise: every inserted channel
+        # shares the named strength, and the 2-param uniform model matches
+        # the same circuit with static rates at the bound values
+        import jax.numpy as jnp
+        from quest_tpu.circuits import Param
+        base = Circuit(3)
+        base.h(0).cnot(0, 1).ry(2, 0.5)
+        noisy_p = base.with_noise(p1=Param("p1"), damping=Param("g"))
+        noisy_s = base.with_noise(p1=0.04, damping=0.1)
+        d1 = qt.createDensityQureg(3, env)
+        qt.initZeroState(d1)
+        noisy_p.compile(env, density=True).run(
+            d1, params={"p1": 0.04, "g": 0.1})
+        d2 = qt.createDensityQureg(3, env)
+        qt.initZeroState(d2)
+        noisy_s.compile(env, density=True).run(d2)
+        np.testing.assert_allclose(d1.to_numpy(), d2.to_numpy(), atol=1e-12)
+        # and the model is differentiable in the shared rates
+        import jax
+        f = noisy_p.compile(env, density=True).expectation_fn(
+            [[(0, 3)]], [1.0])
+        g = jax.grad(f)(jnp.asarray([0.04, 0.1]))
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_rejected_pauli_channel_leaves_no_orphan_params(self, env):
+        from quest_tpu.circuits import Param
+        c = Circuit(1)
+        with pytest.raises(qt.QuESTError):
+            c.pauli_channel(0, 0.9, 0.9, Param("pz"))
+        assert c.param_names == ()        # rejection must not register pz
+        c.h(0)
+        c.compile(env).run(qt.createQureg(1, env))   # circuit still usable
