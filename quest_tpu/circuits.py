@@ -488,6 +488,12 @@ class Circuit:
                 val.validate_prob(p, f"Circuit.with_noise({name})", cap)
         out = Circuit(self.num_qubits)
         out._params = list(self._params)
+        for p in (p1, p2, damping):
+            if isinstance(p, Param):
+                # register up front: a rate whose trigger never fires
+                # (e.g. p1 on a circuit with no 1q gates) must still be a
+                # declared parameter, not silently absent from the model
+                out.parameter(p.name)
 
         def on(p):
             return isinstance(p, Param) or p > 0.0

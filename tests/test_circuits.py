@@ -583,3 +583,25 @@ class TestParameterizedChannels:
         assert c.param_names == ()        # rejection must not register pz
         c.h(0)
         c.compile(env).run(qt.createQureg(1, env))   # circuit still usable
+
+    def test_param_channels_on_mesh(self, env, mesh_env):
+        # mat_fn superoperators ride the shard_map local body too
+        from quest_tpu.circuits import Param
+        c = Circuit(4)
+        c.h(0).cnot(0, 3).damp(3, Param("g")).dephase(0, Param("p"))
+        outs = []
+        for e in (env, mesh_env):
+            d = qt.createDensityQureg(4, e)
+            qt.initZeroState(d)
+            c.compile(e, density=True).run(d, params={"g": 0.2, "p": 0.1})
+            outs.append(d.to_numpy())
+        np.testing.assert_allclose(outs[0], outs[1], atol=1e-12)
+
+    def test_with_noise_param_registered_even_if_unused(self, env):
+        # a Param rate whose trigger never fires (p1 on a 2q-gate-only
+        # circuit) must still be declared, not silently dropped
+        from quest_tpu.circuits import Param
+        c = Circuit(2)
+        c.cnot(0, 1)
+        noisy = c.with_noise(p1=Param("p1"), p2=0.01)
+        assert "p1" in noisy.param_names
