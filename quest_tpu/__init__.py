@@ -16,12 +16,15 @@ cross-backend testing) — designed TPU-first rather than ported:
   eliminating the per-gate dispatch the reference pays.
 
 Beyond the reference's surface: parameterized + differentiable compiled
-circuits, batched/vmapped sweeps, quantum-trajectory noise unraveling
+circuits — including exact gradients of NOISY circuits and of channel
+strengths themselves (noise-model fitting on the density path),
+batched/vmapped sweeps, quantum-trajectory noise unraveling
 (statevector-cost noise, mesh-shardable), uniform noise models and
-mid-circuit measurement, one-pass multi-shot sampling, an OpenQASM 2.0
-importer, double-double high-precision programs, a native C++ CPU
-executor (1.75x the reference serial build), and an algorithms library
-(QFT/Grover/QPE/Trotter/Shor/QAOA). See ``docs/api.md``.
+mid-circuit measurement, one-pass multi-shot sampling (shard-local on a
+mesh), ahead-of-time compilation (``CompiledCircuit.precompile``), an
+OpenQASM 2.0 importer, double-double high-precision programs, a native
+C++ CPU executor (~3x the reference serial build), and an algorithms
+library (QFT/Grover/QPE/Trotter/Shor/QAOA). See ``docs/api.md``.
 
 The public API mirrors the reference's function names and argument orders
 (``QuEST.h``); C count-parameters are inferred from Python sequence lengths.
