@@ -131,3 +131,58 @@ class TestDensityCompilation:
             c.depolarise(0, 0.8)               # cap 3/4
         with pytest.raises(qt.QuESTError):
             c.damp(0, 1.2)                     # cap 1
+
+
+class TestMixedChannelFuzz:
+    """Randomized compiled-vs-imperative differential over every channel
+    builder the circuit recorder offers, interleaved with gates."""
+
+    @pytest.mark.parametrize("seed", [5, 19, 83])
+    def test_random_noisy_program(self, env, seed):
+        rng = np.random.default_rng(seed)
+        n = 4
+        c = Circuit(n)
+        d2 = qt.createDensityQureg(n, env)
+        qt.initZeroState(d2)
+        for _ in range(20):
+            k = rng.integers(0, 8)
+            if k == 0:
+                q, a = int(rng.integers(0, n)), float(rng.uniform(0, 6))
+                c.ry(q, a)
+                qt.rotateY(d2, q, a)
+            elif k == 1:
+                a, b = (int(x) for x in rng.choice(n, 2, replace=False))
+                c.cnot(a, b)
+                qt.controlledNot(d2, a, b)
+            elif k == 2:
+                q, p = int(rng.integers(0, n)), float(rng.uniform(0, 0.4))
+                c.dephase(q, p)
+                qt.mixDephasing(d2, q, p)
+            elif k == 3:
+                q, p = int(rng.integers(0, n)), float(rng.uniform(0, 0.6))
+                c.depolarise(q, p)
+                qt.mixDepolarising(d2, q, p)
+            elif k == 4:
+                q, p = int(rng.integers(0, n)), float(rng.uniform(0, 0.8))
+                c.damp(q, p)
+                qt.mixDamping(d2, q, p)
+            elif k == 5:
+                q = int(rng.integers(0, n))
+                px, py, pz = (float(x) for x in rng.uniform(0, 0.2, 3))
+                c.pauli_channel(q, px, py, pz)
+                qt.mixPauli(d2, q, px, py, pz)
+            elif k == 6:
+                a, b = (int(x) for x in rng.choice(n, 2, replace=False))
+                p = float(rng.uniform(0, 0.6))
+                c.two_qubit_dephase(a, b, p)
+                qt.mixTwoQubitDephasing(d2, a, b, p)
+            else:
+                a, b = (int(x) for x in rng.choice(n, 2, replace=False))
+                p = float(rng.uniform(0, 0.8))
+                c.two_qubit_depolarise(a, b, p)
+                qt.mixTwoQubitDepolarising(d2, a, b, p)
+        d1 = qt.createDensityQureg(n, env)
+        qt.initZeroState(d1)
+        c.compile(env, density=True).run(d1)
+        np.testing.assert_allclose(d1.to_numpy(), d2.to_numpy(),
+                                   atol=1e-12)
