@@ -80,6 +80,8 @@ __all__ = [
     "mixDephasing", "mixTwoQubitDephasing", "mixDepolarising", "mixDamping",
     "mixTwoQubitDepolarising", "mixPauli", "mixDensityMatrix", "mixKrausMap",
     "mixTwoQubitKrausMap", "mixMultiQubitKrausMap",
+    # imperative gate fusion (TPU-native addition, no ref counterpart)
+    "startGateFusion", "stopGateFusion", "fusedGates",
     # QASM
     "startRecordingQASM", "stopRecordingQASM", "clearRecordedQASM",
     "printRecordedQASM", "writeRecordedQASMToFile",
@@ -368,6 +370,12 @@ def _apply_gate(qureg: Qureg, u: np.ndarray, targets: Sequence[int],
     ctrl_mask, flip_mask = _bitmask(controls), _bitmask(flips)
     if qureg.is_quad:
         return _dd_gate(qureg, u, targets, ctrl_mask, flip_mask)
+    buf = qureg._fusion_buffer
+    if buf is not None and not buf.flushing:
+        # opt-in imperative fusion (startGateFusion): record the LOGICAL
+        # gate; the buffer contracts and dispatches at the next state read
+        buf.add_gate(u, targets, ctrl_mask, flip_mask)
+        return
     lazy = _pg.use_lazy(qureg)
     if qureg.is_density_matrix and not ctrl_mask:
         # fused single pass: conj(U) (x) U on (targets, targets+n)
@@ -446,6 +454,11 @@ def _apply_diag_gate(qureg: Qureg, tensor: np.ndarray,
     n = qureg.num_qubits_represented
     qs = tuple(sorted((int(q) for q in qubits), reverse=True))
     tensor = np.asarray(tensor, dtype=np.complex128)
+    if not qureg.is_quad:
+        buf = qureg._fusion_buffer
+        if buf is not None and not buf.flushing:
+            buf.add_diag(tensor, qs)
+            return
     if qureg.is_density_matrix:
         tensor = np.multiply.outer(np.conj(tensor), tensor)
         qs = tuple(q + n for q in qs) + qs
@@ -458,6 +471,75 @@ def _apply_diag_gate(qureg: Qureg, tensor: np.ndarray,
         return
     qureg.state = _jit_diag(qureg.state, qureg.num_qubits_in_state_vec,
                             _packed(qureg, tensor), qs, _shard(qureg))
+
+
+def _dispatch_fused_op(qureg: Qureg, op) -> None:
+    """Apply one fused-group record from the imperative fusion buffer
+    through the regular per-gate dispatch (called with the buffer's
+    ``flushing`` flag set, so the recursion bottoms out)."""
+    if op.kind == "u":
+        controls = tuple(q for q in range(qureg.num_qubits_represented)
+                         if (op.ctrl_mask >> q) & 1)
+        flips = tuple(c for c in controls if (op.flip_mask >> c) & 1)
+        _apply_gate(qureg, op.mat, op.targets, controls, flips)
+    else:
+        _apply_diag_gate(qureg, op.diag, op.targets)
+
+
+def startGateFusion(qureg: Qureg, max_qubits: int = 3) -> None:
+    """Buffer subsequent imperative gate calls and dispatch them as fused
+    groups of combined support <= ``max_qubits`` (the compiled pipeline's
+    gate-fusion engine, :mod:`quest_tpu.core.fusion`, applied to the
+    per-gate path). Flushing is automatic at any state read (measure,
+    calc*, get*, compiled run, host copy) and at :func:`stopGateFusion`.
+    No reference counterpart; QUAD registers are unsupported (their
+    double-double kernels dispatch eagerly)."""
+    if qureg.is_quad:
+        raise QuESTError("gate fusion is not supported on QUAD registers")
+    new = _pg.GateFusionBuffer(qureg, max_qubits)
+    buf = qureg._fusion_buffer
+    if buf is not None:
+        if buf.max_k == new.max_k:
+            return                      # already active at this budget
+        buf.flush()                     # re-arm at the new support cap
+    qureg._fusion_buffer = new
+
+
+def stopGateFusion(qureg: Qureg) -> None:
+    """Flush any buffered gates and return to eager per-gate dispatch."""
+    buf = qureg._fusion_buffer
+    if buf is not None:
+        buf.flush()
+        qureg._fusion_buffer = None
+
+
+class fusedGates:
+    """Context manager form of :func:`startGateFusion` ::
+
+        with qt.fusedGates(qureg, max_qubits=3):
+            for q in range(n):
+                qt.hadamard(qureg, q)      # buffered, dispatched fused
+
+    Contexts nest: the inner block flushes on exit and the outer
+    buffer resumes (where a bare ``stopGateFusion`` turns fusion off
+    entirely).
+    """
+
+    def __init__(self, qureg: Qureg, max_qubits: int = 3):
+        self.qureg = qureg
+        self.max_qubits = max_qubits
+
+    def __enter__(self):
+        self._prev = self.qureg._fusion_buffer
+        startGateFusion(self.qureg, self.max_qubits)
+        return self.qureg
+
+    def __exit__(self, *exc):
+        buf = self.qureg._fusion_buffer
+        if buf is not None:
+            buf.flush()
+        self.qureg._fusion_buffer = self._prev
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -978,7 +1060,13 @@ def swapGate(qureg: Qureg, q1: int, q2: int) -> None:
         _dd_gate(qureg, mats.swap(), (int(q1), int(q2)), 0, 0)
         qureg.qasm_log.record_gate("swap", q2, (q1,))
         return
-    if _pg.use_lazy(qureg):
+    buf = qureg._fusion_buffer
+    if buf is not None and not buf.flushing:
+        # fusion active: the swap must keep program order with buffered
+        # gates, so it rides the buffer as a dense 2q member (and fuses)
+        # rather than mutating layout metadata underneath them
+        _apply_gate(qureg, mats.swap(), (int(q1), int(q2)))
+    elif _pg.use_lazy(qureg):
         # on a mesh a SWAP is pure layout metadata — zero data movement
         # (the reference exchanges chunks, ``statevec_swapQubitAmps``
         # ``QuEST_cpu_distributed.c:1355-1371``)

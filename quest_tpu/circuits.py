@@ -352,7 +352,14 @@ class Circuit:
         """rho -> (1-p) rho + p Z rho Z (mixDephasing semantics; max prob
         1/2, ``QuEST_validation.c:108``). ``prob`` may be a Param: the
         channel strength then binds (and differentiates) at run time on
-        the density path."""
+        the density path.
+
+        .. note:: a Param-bound rate BYPASSES the reference's cap
+           entirely — a bound value in (1/2, 1] still yields a valid
+           CPTP channel here (the Kraus square roots stay real), where
+           the reference rejects it; values outside [0, 1] surface as
+           NaN planes at run time. Validate bound rates yourself when
+           reference parity matters."""
         if isinstance(prob, Param):
             from .ops import channels as chan
             nm = self._register_angle(prob).name
@@ -367,7 +374,11 @@ class Circuit:
 
     def depolarise(self, q: int, prob: Angle) -> "Circuit":
         """Homogeneous depolarising (mixDepolarising semantics; max 3/4).
-        ``prob`` may be a Param (see :meth:`dephase`)."""
+        ``prob`` may be a Param (see :meth:`dephase`) — bound values skip
+        the reference's 3/4 cap entirely: in (3/4, 1] the channel is
+        still CPTP (over-depolarisation past the maximally mixed point),
+        outside [0, 1] it NaNs at run time (no record-time check is
+        possible for a run-time value)."""
         if isinstance(prob, Param):
             from .ops import channels as chan
             nm = self._register_angle(prob).name
@@ -382,7 +393,10 @@ class Circuit:
 
     def damp(self, q: int, prob: Angle) -> "Circuit":
         """Amplitude damping at rate ``prob`` (mixDamping semantics).
-        ``prob`` may be a Param (see :meth:`dephase`)."""
+        ``prob`` may be a Param (see :meth:`dephase`) — bound rates are
+        uncapped at record time: any value in [0, 1] is valid (as in the
+        reference), but out-of-range bound values only surface as NaN
+        planes when the program runs."""
         if isinstance(prob, Param):
             from .ops import channels as chan
             nm = self._register_angle(prob).name
@@ -412,6 +426,12 @@ class Circuit:
             for v in statics:
                 val.validate_prob(v, "Circuit.pauli_channel", 1.0)
             val.validate_prob_sum(sum(statics), "Circuit.pauli_channel")
+            # the reference's pairwise bound (QuEST_validation.c:447),
+            # restricted to what record time can decide: e.g.
+            # pauli_channel(q, 0.6, Param, 0.3) can never be CPTP-valid
+            # for any bound value and must reject here, not NaN later
+            val.validate_partial_pauli_probs(statics,
+                                             "Circuit.pauli_channel")
             vals = []
             for p in probs:
                 if isinstance(p, Param):
@@ -724,58 +744,25 @@ class Circuit:
     # -- compilation -------------------------------------------------------
 
     def _fused_ops(self, diag_row_cap: int = -1) -> list[_Op]:
-        """Host-side peephole fusion over static gates.
-
-        1. consecutive static diagonal ops on any qubits merge (union of qubit
-           sets, outer-broadcast product) while the union stays small;
-        2. consecutive static unitaries with identical (targets, controls)
-           merge by matrix product.
-        XLA would fuse the arithmetic anyway, but merging *before* tracing
-        shrinks the program and halves memory passes.
-
-        ``diag_row_cap`` (>= 0) additionally caps merged diagonals at that
-        many row qubits (>= 7): the Pallas layer kernel only fuses
-        diagonals with <= 3 row bits, so unbounded merging here would
-        weld layer-eligible cphase ladders (QFT's bulk) into 5-6-row-bit
-        diagonals that fall off the fused path — measured on the r5
-        silicon as 22 standalone full passes in QFT-22.
-        """
-        fused: list[_Op] = []
-        for op in self.ops:
-            if fused and op.is_static and fused[-1].is_static:
-                prev = fused[-1]
-                if (op.kind == "u" and prev.kind == "u"
-                        and op.targets == prev.targets
-                        and op.ctrl_mask == prev.ctrl_mask
-                        and op.flip_mask == prev.flip_mask):
-                    fused[-1] = dataclasses.replace(prev, mat=op.mat @ prev.mat)
-                    continue
-                if op.kind == "diag" and prev.kind == "diag":
-                    union = tuple(sorted(set(op.targets) | set(prev.targets),
-                                         reverse=True))
-                    if len(union) <= 6 and (
-                            diag_row_cap < 0
-                            or sum(q >= 7 for q in union) <= diag_row_cap):
-                        def expand(o):
-                            shape = tuple(2 if q in o.targets else 1
-                                          for q in union)
-                            return o.diag.reshape(shape)
-                        fused[-1] = _Op("diag", union,
-                                        diag=expand(prev) * expand(op))
-                        continue
-            fused.append(op)
-        return fused
+        """Host-side peephole fusion over this circuit's static gates
+        (delegates to :func:`_peephole_fused`)."""
+        return _peephole_fused(self.ops, diag_row_cap)
 
     def compile(self, env: QuESTEnv, donate: bool = True, fuse: bool = True,
                 lookahead: int = 32, pallas: Optional[object] = None,
-                supergate_k: int = 4,
+                supergate_k: int = 4, fusion: Optional[object] = None,
                 density: bool = False) -> "CompiledCircuit":
         """Compile to one XLA program; ``lookahead`` is the layout planner's
         relayout-batching window (quest_tpu.parallel.layout); ``pallas``
         controls the fused-layer kernel pass (None=auto on TPU,
-        "interpret"=interpreted kernels, False=off); ``density=True``
-        compiles the program for density registers (gates lift to
-        superoperator form; Kraus channels allowed)."""
+        "interpret"=interpreted kernels, False=off); ``fusion`` is the
+        gate-fusion support cap k (None=default 3, 0/False=off, int=that
+        k — see :mod:`quest_tpu.core.fusion`): runs of adjacent gates
+        whose combined support fits in k qubits contract into single
+        dense kernels BEFORE layout planning, so relayouts are planned
+        per fused group; ``density=True`` compiles the program for
+        density registers (gates lift to superoperator form; Kraus
+        channels allowed)."""
         if density:
             from . import validation as val
             for op in self.ops:
@@ -792,7 +779,7 @@ class Circuit:
             circ = self
         cc = CompiledCircuit(circ, env, donate=donate, fuse=fuse,
                              lookahead=lookahead, pallas=pallas,
-                             supergate_k=supergate_k)
+                             supergate_k=supergate_k, fusion=fusion)
         cc.is_density = density
         return cc
 
@@ -858,6 +845,50 @@ class Circuit:
                          dtype=np.dtype(dtype or env.precision.real_dtype))
 
 
+def _peephole_fused(ops: Sequence[_Op], diag_row_cap: int = -1) -> list[_Op]:
+    """Host-side peephole fusion over static gates.
+
+    1. consecutive static diagonal ops on any qubits merge (union of qubit
+       sets, outer-broadcast product) while the union stays small;
+    2. consecutive static unitaries with identical (targets, controls)
+       merge by matrix product.
+    XLA would fuse the arithmetic anyway, but merging *before* tracing
+    shrinks the program and halves memory passes.
+
+    ``diag_row_cap`` (>= 0) additionally caps merged diagonals at that
+    many row qubits (>= 7): the Pallas layer kernel only fuses
+    diagonals with <= 3 row bits, so unbounded merging here would
+    weld layer-eligible cphase ladders (QFT's bulk) into 5-6-row-bit
+    diagonals that fall off the fused path — measured on the r5
+    silicon as 22 standalone full passes in QFT-22.
+    """
+    fused: list[_Op] = []
+    for op in ops:
+        if fused and op.is_static and fused[-1].is_static:
+            prev = fused[-1]
+            if (op.kind == "u" and prev.kind == "u"
+                    and op.targets == prev.targets
+                    and op.ctrl_mask == prev.ctrl_mask
+                    and op.flip_mask == prev.flip_mask):
+                fused[-1] = dataclasses.replace(prev, mat=op.mat @ prev.mat)
+                continue
+            if op.kind == "diag" and prev.kind == "diag":
+                union = tuple(sorted(set(op.targets) | set(prev.targets),
+                                     reverse=True))
+                if len(union) <= 6 and (
+                        diag_row_cap < 0
+                        or sum(q >= 7 for q in union) <= diag_row_cap):
+                    def expand(o):
+                        shape = tuple(2 if q in o.targets else 1
+                                      for q in union)
+                        return o.diag.reshape(shape)
+                    fused[-1] = _Op("diag", union,
+                                    diag=expand(prev) * expand(op))
+                    continue
+        fused.append(op)
+    return fused
+
+
 def _group_supergates(ops: list, max_k: int = 4,
                       fold_diags: bool = True,
                       barrier=None) -> list:
@@ -894,17 +925,10 @@ def _group_supergates(ops: list, max_k: int = 4,
         if len(group) <= 1:
             out.extend(group)
         else:
+            from .core.fusion import compose_in_support
             sup = tuple(sorted(support))
-            m = np.eye(1 << len(sup), dtype=np.complex128)
-            for op in group:
-                if op.kind == "u":
-                    e = mats.embed_in_support(op.mat, op.targets, sup,
-                                              op.ctrl_mask, op.flip_mask)
-                else:
-                    e = mats.diag_in_support(np.asarray(op.diag),
-                                             op.targets, sup)
-                m = e @ m
-            out.append(_Op("u", sup, 0, 0, mat=m))
+            out.append(_Op("u", sup, 0, 0,
+                           mat=compose_in_support(group, sup)))
         group.clear()
         support = set()
 
@@ -1153,12 +1177,13 @@ def _collect_layers(ops: list, num_qubits: int,
 
 
 def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
-              lookahead: int, fuse_flag: bool, circuit: "Circuit",
+              lookahead: int, fuse_flag: bool,
               diag_row_cap: int = -1):
-    """Fuse + layout-plan the op stream.
+    """Peephole-fuse + layout-plan the op stream (which the gate-fusion
+    pass of :mod:`quest_tpu.core.fusion` has usually already contracted).
 
     Prefers the native C++ scheduler (quest_tpu.native / native/src/
-    scheduler.cc); falls back to the pure-Python passes (Circuit._fused_ops +
+    scheduler.cc); falls back to the pure-Python passes (_peephole_fused +
     quest_tpu.parallel.plan_layout). Both produce identical schedules.
 
     Returns (ops_table, LayoutPlan).
@@ -1198,7 +1223,7 @@ def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
         return ops_table, plan
 
     from .parallel import plan_layout
-    ops_table = circuit._fused_ops(diag_row_cap) if fuse_flag \
+    ops_table = _peephole_fused(recorded, diag_row_cap) if fuse_flag \
         else list(recorded)
     return ops_table, plan_layout(ops_table, num_qubits, shard_bits,
                                   lookahead=lookahead)
@@ -1215,7 +1240,7 @@ class CompiledCircuit:
     def __init__(self, circuit: Circuit, env: QuESTEnv,
                  donate: bool = True, fuse: bool = True,
                  lookahead: int = 32, pallas: Optional[object] = None,
-                 supergate_k: int = 4):
+                 supergate_k: int = 4, fusion: Optional[object] = None):
         self.circuit = circuit
         self.env = env
         self.num_qubits = circuit.num_qubits
@@ -1223,7 +1248,7 @@ class CompiledCircuit:
         # recorded for the layer-free twin (_xla_only): it must differ
         # from this program ONLY in the Pallas pass
         self._compile_opts = {"fuse": fuse, "lookahead": lookahead,
-                              "supergate_k": supergate_k}
+                              "supergate_k": supergate_k, "fusion": fusion}
         n = circuit.num_qubits
         if (1 << n) < env.num_devices:   # register smaller than the mesh
             sharding = None
@@ -1249,12 +1274,31 @@ class CompiledCircuit:
         self._pallas_interpret = interpret
         use_layers = enabled and (n - shard_bits) >= 7
 
-        # fuse + schedule gate positions over the mesh: lazy logical->
-        # physical permutation with batched relayouts (native scheduler when
+        # gate-fusion pass (core/fusion.py): record -> FUSE -> plan ->
+        # lower. Runs of adjacent gates contract into single dense
+        # kernels / folded diagonal factors BEFORE layout planning, so
+        # the planner's relayout decisions are made per fused group and
+        # XLA dispatches one kernel where it used to dispatch a ladder.
+        # Clamped local-fit-aware (a fused gate must stay gatherable on
+        # one chunk); layer-eligible runs are fenced when the Pallas
+        # pass will claim them more cheaply.
+        from .core.fusion import fuse_ops, resolve_fusion_k
+        recorded = list(circuit.ops)
+        self.fusion_stats = None
+        k_fuse = resolve_fusion_k(fusion, n - shard_bits)
+        if k_fuse >= 2:
+            recorded, self.fusion_stats = fuse_ops(
+                recorded, max_k=k_fuse,
+                diag_row_cap=3 if use_layers else -1,
+                barrier=_layer_barrier(recorded, n, shard_bits)
+                if use_layers else None)
+
+        # schedule gate positions over the mesh: lazy logical->physical
+        # permutation with batched relayouts (native scheduler when
         # built, else quest_tpu.parallel.layout)
         from .parallel import apply_relayout
-        ops, self.plan = _schedule(list(circuit.ops), n, shard_bits,
-                                   lookahead, fuse, circuit,
+        ops, self.plan = _schedule(recorded, n, shard_bits,
+                                   lookahead, fuse,
                                    diag_row_cap=3 if use_layers else -1)
 
         # super-gate grouping: consecutive static gates collapse into one
@@ -1367,7 +1411,8 @@ class CompiledCircuit:
                                                0, 0, lt, AMP_AXIS)
                 return local
 
-            sharded_body = jax.shard_map(
+            from .compat import shard_map
+            sharded_body = shard_map(
                 local_body, mesh=env.mesh,
                 in_specs=(P(AMP_AXIS), P()), out_specs=P(AMP_AXIS),
                 check_vma=False)
@@ -1452,8 +1497,10 @@ class CompiledCircuit:
                 "Circuit.compile_dd and run on its packed planes, or use "
                 "the imperative API (which routes to dd kernels)")
         qureg.ensure_canonical()   # compiled programs address canonical bits
-        fn = self._aot if self._aot is not None else self._jitted
-        qureg.state = fn(qureg.state, self._param_vec(params))
+        state = qureg.state
+        fn = self._aot if (self._aot is not None
+                           and self._aot_accepts(state)) else self._jitted
+        qureg.state = fn(state, self._param_vec(params))
 
     def apply(self, state_f: jnp.ndarray, params=None):
         """Pure form: packed planes in -> packed planes out.
@@ -1482,7 +1529,8 @@ class CompiledCircuit:
                 and getattr(state_f, "shape", None)
                 == (2, 1 << self.num_qubits)
                 and getattr(state_f, "dtype", None)
-                == self.env.precision.real_dtype):
+                == self.env.precision.real_dtype
+                and self._aot_accepts(state_f)):
             # concrete inputs ride the precompiled executable — the jit
             # cache is NOT populated by precompile(), so _jitted here
             # would silently recompile. Traced inputs (vmap/scan/grad)
@@ -1490,7 +1538,42 @@ class CompiledCircuit:
             return self._aot(state_f, vec)
         return self._jitted(state_f, vec)
 
+    def _aot_accepts(self, state_f) -> bool:
+        """True when the precompiled executable can take this input as
+        is. AOT executables hard-error on inputs ``jit`` would silently
+        reshard — a host numpy array, or an array laid out differently
+        from the sharding the program was lowered for (ADVICE r5) — so
+        those fall back to the jit path instead of raising."""
+        if not isinstance(state_f, jax.Array):
+            return False
+        if self._in_sharding is None:
+            return True
+        sh = getattr(state_f, "sharding", None)
+        if sh is None:
+            return False
+        try:
+            return sh.is_equivalent_to(self._in_sharding, state_f.ndim)
+        except (AttributeError, TypeError):
+            return sh == self._in_sharding
+
     # -- analysis / autodiff ----------------------------------------------
+
+    def dispatch_stats(self):
+        """Compile-time dispatch accounting (:class:`quest_tpu.profiling.
+        DispatchStats`): recorded gates in, kernels out, planned
+        relayouts, and the gate-fusion pass's per-group counters. The
+        observable the fusion engine optimises — ``bench.py`` emits these
+        fields next to gates/sec."""
+        from .profiling import DispatchStats
+        fs = self.fusion_stats
+        return DispatchStats(
+            gates_in=self.circuit.depth,
+            kernels_out=self.plan.num_kernels,
+            relayouts=self.plan.num_relayouts,
+            fused_groups=fs.fused_groups if fs else 0,
+            diag_folds=fs.diag_folds if fs else 0,
+            commuted_diagonals=fs.commuted_diagonals if fs else 0,
+            max_group_gates=fs.max_group_gates if fs else 0)
 
     def _xla_only(self) -> "CompiledCircuit":
         """This program with the Pallas layer pass off (cached twin).

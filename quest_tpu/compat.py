@@ -1,0 +1,53 @@
+"""JAX version compatibility shims.
+
+The distributed executor is written against the modern spelling
+``jax.shard_map(..., check_vma=False)``; older installed JAX versions
+(e.g. 0.4.x, the version this image bakes in) only ship
+``jax.experimental.shard_map.shard_map`` and call the replication-check
+kwarg ``check_rep``.  This module resolves ONE ``shard_map`` callable at
+import time — signature-sniffed, not version-string-matched, so
+intermediate releases that renamed the kwarg before promoting the API
+still resolve correctly — and every quest_tpu call site imports it from
+here instead of from ``jax``.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def _resolve():
+    if hasattr(jax, "shard_map"):
+        fn = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as fn
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):      # C-accelerated wrapper, no sig
+        params = {}
+    if "check_vma" in params:
+        kwarg = "check_vma"
+    elif "check_rep" in params:
+        kwarg = "check_rep"
+    else:
+        kwarg = None
+    return fn, kwarg
+
+
+_SHARD_MAP, _CHECK_KWARG = _resolve()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the replication-check kwarg mapped to
+    whatever the installed JAX calls it (``check_vma`` on current
+    releases, ``check_rep`` on 0.4.x experimental). ``check_vma=None``
+    omits the kwarg entirely (the version default)."""
+    kw = {}
+    if check_vma is not None and _CHECK_KWARG is not None:
+        kw[_CHECK_KWARG] = check_vma
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -59,6 +59,20 @@ class LayoutPlan:
     shard_bits: int
     num_relayouts: int
 
+    @property
+    def num_kernels(self) -> int:
+        """Op kernels the plan dispatches per execution. With the
+        gate-fusion pass on (core/fusion.py) each op item is a fused
+        GROUP, so this — not the recorded gate count — is the unit the
+        planner batches relayouts against (and what
+        ``CompiledCircuit.dispatch_stats`` reports as kernels_out)."""
+        return sum(1 for it in self.items if it[0] == "op")
+
+    @property
+    def num_dispatches(self) -> int:
+        """Kernels plus relayout exchanges — total device dispatches."""
+        return self.num_kernels + self.num_relayouts
+
 
 def _phys_diag_order(op_targets_desc_logical: tuple[int, ...],
                      perm: np.ndarray):
@@ -83,6 +97,12 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
     Paired ("u") ops must have all targets below ``num_qubits - shard_bits``;
     the planner guarantees it by emitting relayouts. Controls and diagonal
     ops are position-indifferent.
+
+    The op stream is whatever the compile pipeline hands over — after the
+    gate-fusion pass (core/fusion.py) each op is a fused GROUP, so
+    relayout decisions (and the ``lookahead`` window) are group-granular:
+    one all-to-all serves every source gate inside the groups it
+    localises.
     """
     n = num_qubits
     local_top = n - shard_bits  # phys positions >= local_top are sharded

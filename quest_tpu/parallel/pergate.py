@@ -32,13 +32,15 @@ import numpy as np
 import jax
 from jax.sharding import PartitionSpec as P
 
+from ..compat import shard_map
 from ..core.packing import pack, unpack
 from ..env import AMP_AXIS
 from .exchange import (plan_exchange, run_exchange, apply_op_local,
                        apply_1q_cross_shard)
 
 __all__ = ["use_lazy", "phys_targets", "localise_targets", "canonicalise",
-           "sharded_unitary", "sharded_diag", "metadata_swap", "phys_index"]
+           "sharded_unitary", "sharded_diag", "metadata_swap", "phys_index",
+           "GateFusionBuffer"]
 
 # number of relayout exchanges actually executed (observability/testing:
 # the lazy layout exists to keep this far below the count of gates that
@@ -95,7 +97,7 @@ def _shard_jit(mesh, body, n_extra_args: int):
     """shard_map + jit boilerplate shared by every per-gate kernel: the
     packed planes shard on the amplitude axis (donated), trailing
     operand arrays are replicated."""
-    sm = jax.shard_map(
+    sm = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, AMP_AXIS),) + (P(),) * n_extra_args,
         out_specs=P(None, AMP_AXIS), check_vma=False)
@@ -272,6 +274,87 @@ def metadata_swap(qureg, q1: int, q2: int) -> None:
     perm = _perm(qureg).copy()
     perm[q1], perm[q2] = perm[q2], perm[q1]
     qureg.layout = perm
+
+
+# ---------------------------------------------------------------------------
+# opt-in imperative gate fusion
+# ---------------------------------------------------------------------------
+
+class GateFusionBuffer:
+    """Opt-in gate fusion for the imperative per-gate path.
+
+    Activated by ``api.startGateFusion`` (or the ``fusedGates`` context
+    manager): gate calls append LOGICAL op records here instead of
+    dispatching, and :meth:`flush` contracts them through the same fusion
+    engine as the compiled pipeline (:mod:`quest_tpu.core.fusion`) before
+    dispatching each fused group once — group-granular dispatch, so a run
+    of L adjacent small gates costs one kernel (and, on a mesh, at most
+    one relayout) instead of L.
+
+    Flushing is automatic at every state read: ``Qureg.state`` and
+    ``Qureg.ensure_canonical`` drain the buffer first, so measurements,
+    reductions, channels, compiled-circuit runs and host reads always see
+    the up-to-date state. A full state overwrite (``init*``) discards
+    pending gates — exactly what applying them first would have produced.
+    """
+
+    def __init__(self, qureg, max_k: int = 3):
+        from ..core.fusion import resolve_fusion_k
+        lt = qureg.num_qubits_in_state_vec - (
+            _shard_bits(qureg) if use_lazy(qureg) else 0)
+        # density registers lift a k-qubit gate to 2k state-vector
+        # targets; halving the local budget keeps every fused group on
+        # the one-pass lifted path. The same halving bounds folded
+        # diagonals: a u-qubit folded factor lifts to a 2^(2u)-entry
+        # superfactor at dispatch, so the fold cap must stay well below
+        # register size on the density path
+        local = lt // 2 if qureg.is_density_matrix else lt
+        self.qureg = qureg
+        self.max_k = resolve_fusion_k(max_k, max(local, 1))
+        self.diag_max = min(12, max(local, 1))
+        self.ops: list = []
+        self.flushing = False
+        self.gates_in = 0
+        self.kernels_out = 0
+
+    @property
+    def pending(self) -> bool:
+        return bool(self.ops)
+
+    def add_gate(self, u, targets: tuple, ctrl_mask: int,
+                 flip_mask: int) -> None:
+        from ..circuits import _Op
+        self.ops.append(_Op("u", tuple(int(t) for t in targets),
+                            ctrl_mask, flip_mask,
+                            mat=np.asarray(u, dtype=np.complex128)))
+
+    def add_diag(self, tensor, qs_desc: tuple) -> None:
+        from ..circuits import _Op
+        self.ops.append(_Op("diag", tuple(int(q) for q in qs_desc),
+                            diag=np.asarray(tensor, dtype=np.complex128)))
+
+    def flush(self) -> None:
+        """Contract and dispatch everything pending (reentrancy-safe:
+        the dispatched kernels read and write ``qureg.state`` themselves)."""
+        if not self.ops or self.flushing:
+            return
+        ops, self.ops = self.ops, []
+        self.flushing = True
+        try:
+            from ..core.fusion import fuse_ops
+            from .. import api
+            fused, stats = fuse_ops(ops, max_k=self.max_k,
+                                    diag_max=self.diag_max)
+            self.gates_in += stats.gates_in
+            self.kernels_out += stats.kernels_out
+            for op in fused:
+                api._dispatch_fused_op(self.qureg, op)
+        finally:
+            self.flushing = False
+
+    def discard(self) -> None:
+        """Drop pending gates (the register state was fully overwritten)."""
+        self.ops.clear()
 
 
 def _phys_masks(perm, ctrl_mask: int, flip_mask: int) -> tuple[int, int]:

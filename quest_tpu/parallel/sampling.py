@@ -32,12 +32,19 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..compat import shard_map
 from ..env import AMP_AXIS
 
 __all__ = ["sample_sharded"]
 
 
-@functools.lru_cache(maxsize=None)
+# Bounded: an unbounded cache keyed on raw shot counts compiles and pins
+# a fresh shard_map executable (plus its mesh object) FOREVER per
+# distinct num_samples — a shot-count sweep leaks compilations without
+# limit (ADVICE r5). Shot counts are bucketed to the next power of two
+# at or above, so the practical key space is ~log2(max shots) per mesh
+# and 32 entries cover every realistic mix of meshes and widths.
+@functools.lru_cache(maxsize=32)
 def _sampler(mesh, num_samples: int, density: bool, num_qubits: int):
     def body(planes, key):
         if density:
@@ -68,9 +75,21 @@ def _sampler(mesh, num_samples: int, density: bool, num_qubits: int):
                 lax.psum(jnp.where(mine, loc, 0), AMP_AXIS),
                 total)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh, in_specs=(P(None, AMP_AXIS), P()),
         out_specs=(P(), P(), P()), check_vma=False))
+
+
+def _shot_bucket(num_samples: int) -> int:
+    """Static shot-count bucket: the next power of two at or above
+    ``num_samples`` (floor 16). One compiled program then serves every
+    shot count in (bucket/2, bucket]; surplus draws are discarded
+    host-side — they are iid, so the kept prefix is an exact
+    ``num_samples``-shot draw."""
+    b = 16
+    while b < num_samples:
+        b <<= 1
+    return b
 
 
 def sample_sharded(planes: jax.Array, key, num_samples: int, density: bool,
@@ -80,11 +99,14 @@ def sample_sharded(planes: jax.Array, key, num_samples: int, density: bool,
     density vector for mixed registers — the diagonal is extracted
     shard-locally). Returns ``(indices int64 ndarray, total)`` with the
     shard/local split recombined in host int64, so the device program
-    never needs 64-bit indices even at pod widths."""
-    shard, loc, total = _sampler(mesh, int(num_samples), bool(density),
+    never needs 64-bit indices even at pod widths. Shot counts are
+    bucketed (``_shot_bucket``) so a sweep over counts reuses one
+    compiled program per power-of-two band."""
+    bucket = _shot_bucket(int(num_samples))
+    shard, loc, total = _sampler(mesh, bucket, bool(density),
                                  int(num_qubits))(planes, key)
     n_dev = int(np.prod(mesh.devices.shape))
     per_shard = (1 << num_qubits) // n_dev
-    idx = (np.asarray(shard, dtype=np.int64) * per_shard
-           + np.asarray(loc, dtype=np.int64))
+    idx = (np.asarray(shard, dtype=np.int64)[:num_samples] * per_shard
+           + np.asarray(loc, dtype=np.int64)[:num_samples])
     return idx, float(total)

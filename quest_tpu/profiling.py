@@ -24,7 +24,41 @@ from typing import Callable, Optional
 
 import jax
 
-__all__ = ["trace", "GateStats", "probe_gate"]
+__all__ = ["trace", "GateStats", "DispatchStats", "probe_gate"]
+
+
+@dataclasses.dataclass
+class DispatchStats:
+    """Compile-time dispatch accounting for one compiled program: how
+    many recorded gates went in, how many kernels (fused groups, folded
+    diagonals, layers, relayouts) the final plan dispatches. Produced by
+    :meth:`CompiledCircuit.dispatch_stats`; ``bench.py`` machine-emits
+    these fields next to gates/sec so the fusion win is parseable."""
+
+    gates_in: int            # ops recorded on the circuit
+    kernels_out: int         # op items in the final plan
+    relayouts: int           # planned all-to-all relayouts
+    fused_groups: int = 0    # dense fusion groups of >= 2 gates
+    diag_folds: int = 0      # diagonal gates folded into shared factors
+    commuted_diagonals: int = 0  # diagonals deferred past a dense run
+    max_group_gates: int = 0     # largest gates-per-group count
+
+    @property
+    def dispatches(self) -> int:
+        """Kernels the device runs per program execution (op passes plus
+        relayout exchanges) — the number the fusion pass exists to
+        shrink."""
+        return self.kernels_out + self.relayouts
+
+    def as_dict(self) -> dict:
+        return {"gates_in": self.gates_in,
+                "kernels_out": self.kernels_out,
+                "relayouts": self.relayouts,
+                "dispatches": self.dispatches,
+                "fused_groups": self.fused_groups,
+                "diag_folds": self.diag_folds,
+                "commuted_diagonals": self.commuted_diagonals,
+                "max_group_gates": self.max_group_gates}
 
 
 @contextlib.contextmanager

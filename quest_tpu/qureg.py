@@ -51,6 +51,10 @@ class Qureg:
         # swap-to-local relayouts defer their swap-back until a reader
         # needs canonical order (ensure_canonical).
         self.layout: Optional[np.ndarray] = None
+        # opt-in imperative gate fusion (api.startGateFusion): while
+        # active, gate calls buffer here and flush — contracted through
+        # core/fusion.py — at the first state read
+        self._fusion_buffer = None
 
     # -- reference struct-field aliases (QuEST.h:161-192 spellings, used
     #    by the reference's own test drivers, e.g. createQureg.test) ------
@@ -75,11 +79,27 @@ class Qureg:
 
     @property
     def state(self) -> jax.Array:
+        buf = self._fusion_buffer
+        if buf is not None and buf.pending and not buf.flushing:
+            buf.flush()     # every reader sees buffered gates applied
         return self._state
 
     @state.setter
     def state(self, new_state: jax.Array) -> None:
+        buf = self._fusion_buffer
+        if buf is not None and buf.pending and not buf.flushing:
+            # a full overwrite supersedes pending gates (writers that
+            # read-modify-write flushed at the read; the flush's own
+            # writes are fenced by buf.flushing)
+            buf.discard()
         self._state = new_state
+
+    def flush_gates(self) -> None:
+        """Apply any gates buffered by the opt-in imperative fusion path
+        (``api.startGateFusion``). No-op otherwise."""
+        buf = self._fusion_buffer
+        if buf is not None:
+            buf.flush()
 
     @property
     def dtype(self):
@@ -121,7 +141,11 @@ class Qureg:
             raise ValueError(
                 f"state array has shape {host_array.shape}; this register "
                 f"holds {self.num_amps_total} amplitudes")
-        self.layout = None       # full overwrite in canonical order
+        buf = self._fusion_buffer
+        if buf is not None and buf.pending and not buf.flushing:
+            buf.discard()        # overwrite supersedes buffered gates,
+        self.layout = None       # exactly like the state setter
+        # full overwrite in canonical order
         if self.is_quad:
             from .ops.doubledouble import _dd_split_host
             arr = _dd_split_host(host_array, self.real_dtype)
@@ -152,7 +176,9 @@ class Qureg:
     def ensure_canonical(self) -> None:
         """Restore the identity qubit layout (one batched exchange) so the
         raw state array can be read positionally. No-op off the sharded
-        per-gate path."""
+        per-gate path. Drains the imperative fusion buffer first, so a
+        compiled run or host read never races buffered gates."""
+        self.flush_gates()
         if self.layout is not None:
             from .parallel.pergate import canonicalise
             canonicalise(self)

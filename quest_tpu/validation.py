@@ -322,6 +322,24 @@ def validate_one_qubit_pauli_probs(prob_x: float, prob_y: float, prob_z: float,
               ErrorCode.E_INVALID_ONE_QUBIT_PAULI_PROBS)
 
 
+def validate_partial_pauli_probs(statics, func: str) -> None:
+    """The record-time-enforceable piece of the reference's pairwise
+    bound (each prob <= 1-px-py-pz, ``QuEST_validation.c:447``) when some
+    channel components are run-time Params: a bound component can only
+    LOWER the no-error probability, so any static prob already exceeding
+    ``1 - sum(statics)`` (the Param-at-zero best case) can never satisfy
+    the reference for any bound value and is rejected now instead of
+    surfacing as NaN planes at run time."""
+    total = sum(statics)
+    for v in statics:
+        if v > 1.0 - total:
+            _fail("a static Pauli error probability exceeds the best-case "
+                  "no-error probability 1-(sum of static probabilities); "
+                  "no run-time value of the bound component(s) can make "
+                  "this channel valid", func,
+                  ErrorCode.E_INVALID_ONE_QUBIT_PAULI_PROBS)
+
+
 # --------------------------------------------------------------------------
 # matrices / operators (numeric, env-precision tolerance)
 # --------------------------------------------------------------------------

@@ -92,8 +92,10 @@ class TestCircuitVsApi:
         # consecutive diagonals on different qubits (fused into one pass)
         c.z(1).s(2).t(1).phase(2, 0.3)
         c.cnot(0, 1)
-        fused = c.compile(env, fuse=True, supergate_k=0)
-        plain = c.compile(env, fuse=False, supergate_k=0)
+        # fusion=0 pins the gate-fusion pass off: this test isolates the
+        # legacy peephole (fuse=) — core/fusion.py has its own suite
+        fused = c.compile(env, fuse=True, supergate_k=0, fusion=0)
+        plain = c.compile(env, fuse=False, supergate_k=0, fusion=0)
         assert len(fused._ops) < len(plain._ops)
         q1 = qt.createQureg(3, env)
         q2 = qt.createQureg(3, env)

@@ -23,6 +23,7 @@ import pytest
 
 import quest_tpu as qt
 from quest_tpu.circuits import Circuit
+from quest_tpu.compat import shard_map
 from quest_tpu.core.apply import apply_unitary
 from quest_tpu.env import AMP_AXIS
 from quest_tpu.parallel.exchange import (plan_exchange, run_exchange,
@@ -49,7 +50,7 @@ def test_run_exchange_matches_transpose(mesh_env, rng, n, s):
         before, after = _random_relayout(rng, n, s)
         expect = apply_relayout(state, n, before, after)
         plan = plan_exchange(n, s, before, after)
-        got = jax.jit(jax.shard_map(
+        got = jax.jit(shard_map(
             lambda x: run_exchange(x, plan, AMP_AXIS),
             mesh=sub, in_specs=P(AMP_AXIS), out_specs=P(AMP_AXIS),
             check_vma=False))(state)
@@ -66,7 +67,7 @@ def test_cross_shard_1q_role_split(mesh_env, rng):
                      1j * rng.normal(size=(2, 2)))[0]
     for pos in (n - 1, n - 2, n - 3):
         expect = apply_unitary(state, n, jnp.asarray(u), (pos,))
-        got = jax.jit(jax.shard_map(
+        got = jax.jit(shard_map(
             lambda x: apply_1q_cross_shard(x, u, pos, n - s, s, AMP_AXIS),
             mesh=mesh, in_specs=P(AMP_AXIS), out_specs=P(AMP_AXIS),
             check_vma=False))(state)
@@ -89,7 +90,7 @@ def test_cross_shard_1q_controlled(mesh_env, rng):
     for pos, cmask, fmask in cases:
         expect = apply_unitary(state, n, jnp.asarray(u), (pos,),
                                cmask, fmask)
-        got = jax.jit(jax.shard_map(
+        got = jax.jit(shard_map(
             lambda x: apply_1q_cross_shard(x, u, pos, n - s, s, AMP_AXIS,
                                            cmask, fmask),
             mesh=mesh, in_specs=P(AMP_AXIS), out_specs=P(AMP_AXIS),
@@ -130,6 +131,7 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp
 import quest_tpu as qt
 from quest_tpu.circuits import Circuit
+from quest_tpu.compat import shard_map
 from quest_tpu.algorithms import qft
 
 env = qt.createQuESTEnv(num_devices=8, seed=[7])
@@ -255,6 +257,7 @@ import jax.numpy as jnp
 import numpy as np
 import quest_tpu as qt
 from quest_tpu.circuits import Circuit
+from quest_tpu.compat import shard_map
 
 env = qt.createQuESTEnv(num_devices=8, seed=[7])
 

@@ -1,0 +1,359 @@
+"""Gate-fusion engine tests (quest_tpu/core/fusion.py).
+
+Three layers of proof:
+
+1. unit — the fused op stream's dense operator product equals the
+   unfused stream's, against the independent numpy oracle, over random
+   1q/2q/diagonal/controlled mixes and every knob combination;
+2. system — fused execution matches unfused execution (and the oracle)
+   at the golden 1e-10 double-precision tolerance, on a single device
+   and on the 8-device mesh, for static, parameterized, and density
+   (channel-bearing) circuits, and through the opt-in imperative buffer;
+3. guardrail — kernel-dispatch count and relayout counts for QFT stay
+   at/below fixed budgets, so a planner or fusion regression that
+   re-inflates dispatch shows up as a hard failure, not a silent
+   slowdown.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import algorithms as alg
+from quest_tpu.circuits import Circuit, Param
+from quest_tpu.core.fusion import FusionStats, fuse_ops, resolve_fusion_k
+
+sys.path.insert(0, os.path.dirname(__file__))
+from oracle import full_operator  # noqa: E402
+
+
+def op_matrix(n, op):
+    """Dense 2^n operator of one recorded op (oracle-side)."""
+    if op.kind == "u":
+        controls = [q for q in range(n) if (op.ctrl_mask >> q) & 1]
+        states = [0 if (op.flip_mask >> c) & 1 else 1 for c in controls]
+        return full_operator(n, op.mat, op.targets, controls, states)
+    d = np.ones(1 << n, dtype=np.complex128)
+    t = np.asarray(op.diag)
+    for i in range(1 << n):
+        d[i] = t[tuple((i >> q) & 1 for q in op.targets)]
+    return np.diag(d)
+
+
+def circuit_matrix(n, ops):
+    m = np.eye(1 << n, dtype=np.complex128)
+    for op in ops:
+        m = op_matrix(n, op) @ m
+    return m
+
+
+def random_mixed_circuit(n, depth, seed):
+    """1q/2q dense, multi-controlled, and diagonal-family mix — the gate
+    classes the fusion rewrites (absorb, fold, commute) all act on."""
+    rng = np.random.default_rng(seed)
+
+    def rand_u(k):
+        d = 1 << k
+        return np.linalg.qr(rng.normal(size=(d, d))
+                            + 1j * rng.normal(size=(d, d)))[0]
+
+    c = Circuit(n)
+    for _ in range(depth):
+        r = rng.integers(0, 8)
+        qs = [int(q) for q in rng.permutation(n)]
+        if r == 0:
+            c.gate(rand_u(1), (qs[0],))
+        elif r == 1:
+            c.gate(rand_u(2), (qs[0], qs[1]))
+        elif r == 2:
+            c.gate(rand_u(1), (qs[0],), controls=(qs[1], qs[2]),
+                   control_states=(int(rng.integers(0, 2)), 1))
+        elif r == 3:
+            c.z(qs[0])
+            c.t(qs[1])
+        elif r == 4:
+            c.cz(qs[0], qs[1])
+        elif r == 5:
+            c.cphase(qs[0], qs[1], float(rng.uniform(0, 2)))
+        elif r == 6:
+            c.multi_rotate_z(tuple(qs[:4]), float(rng.uniform(0, 2)))
+        else:
+            c.swap(qs[0], qs[1])
+    return c
+
+
+class TestFusePass:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    @pytest.mark.parametrize("knobs", [(2, 4), (3, 6), (3, 10), (4, 10)])
+    def test_operator_identity_vs_oracle(self, seed, knobs):
+        n = 6
+        c = random_mixed_circuit(n, depth=24, seed=seed)
+        want = circuit_matrix(n, c.ops)
+        k, dmax = knobs
+        fused, stats = fuse_ops(list(c.ops), max_k=k, diag_max=dmax)
+        got = circuit_matrix(n, fused)
+        np.testing.assert_allclose(got, want, atol=1e-10)
+        assert stats.gates_in == len(c.ops)
+        assert stats.kernels_out == len(fused) <= len(c.ops)
+
+    def test_diag_ladders_fold_and_commute(self):
+        # the QFT shape: dense H runs interleaved with phase ladders —
+        # the ladders must fold into shared factors and carry across the
+        # dense runs, never fencing them
+        c = alg.qft(8, swap_order=False)
+        fused, stats = fuse_ops(list(c.ops), max_k=3)
+        np.testing.assert_allclose(circuit_matrix(8, fused),
+                                   circuit_matrix(8, c.ops), atol=1e-10)
+        assert stats.fused_groups >= 2          # H runs welded
+        assert stats.diag_folds >= 15           # ladders folded
+        assert stats.commuted_diagonals >= 1    # carried across a run
+        assert stats.kernels_out <= len(c.ops) // 3
+
+    def test_param_and_kraus_flush(self):
+        c = Circuit(4)
+        t = c.parameter("t")
+        c.h(0).h(1).ry(2, t).h(2).h(3)
+        fused, stats = fuse_ops(list(c.ops), max_k=3)
+        # the parameterized op survives in place; statics fuse around it
+        kinds = [op.mat_fn is not None for op in fused]
+        assert kinds.count(True) == 1
+        assert len(fused) == 3
+
+    def test_resolve_knob(self):
+        assert resolve_fusion_k(None, 15) == 3
+        assert resolve_fusion_k(True, 15) == 3
+        assert resolve_fusion_k(False, 15) == 0
+        assert resolve_fusion_k(0, 15) == 0
+        assert resolve_fusion_k(5, 15) == 5
+        assert resolve_fusion_k(5, 2) == 2      # local-fit clamp
+
+
+class TestCompiledParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_single_device(self, env, seed):
+        c = random_mixed_circuit(8, depth=20, seed=seed)
+        outs = []
+        for fz in (0, 3):
+            q = qt.createQureg(8, env)
+            qt.initDebugState(q)
+            c.compile(env, fusion=fz).run(q)
+            outs.append(q.to_numpy())
+        np.testing.assert_allclose(outs[1], outs[0], atol=1e-10)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_sharded_mesh(self, env, mesh_env, seed):
+        c = random_mixed_circuit(7, depth=16, seed=seed)
+        outs = []
+        for e, fz in ((env, 0), (mesh_env, None), (mesh_env, 0)):
+            q = qt.createQureg(7, e)
+            qt.initDebugState(q)
+            c.compile(e, fusion=fz).run(q)
+            outs.append(q.to_numpy())
+        np.testing.assert_allclose(outs[1], outs[0], atol=1e-10)
+        np.testing.assert_allclose(outs[2], outs[0], atol=1e-10)
+
+    def test_matches_dense_oracle(self, env):
+        n = 6
+        c = random_mixed_circuit(n, depth=18, seed=7)
+        q = qt.createQureg(n, env)
+        qt.initDebugState(q)
+        start = q.to_numpy()
+        c.compile(env).run(q)
+        want = circuit_matrix(n, c.ops) @ start
+        np.testing.assert_allclose(q.to_numpy(), want, atol=1e-10)
+
+    def test_parameterized(self, env):
+        c = Circuit(6)
+        t = c.parameter("t")
+        c.h(0).t(1).cnot(0, 1).ry(2, t).cz(1, 2).h(3).s(3).rz(4, t).h(5)
+        outs = []
+        for fz in (0, 3):
+            q = qt.createQureg(6, env)
+            c.compile(env, fusion=fz).run(q, params={"t": 0.43})
+            outs.append(q.to_numpy())
+        np.testing.assert_allclose(outs[1], outs[0], atol=1e-10)
+
+    def test_density_with_channels(self, env):
+        c = Circuit(4)
+        c.h(0).cnot(0, 1).dephase(1, 0.2).t(1).damp(2, 0.1).cz(2, 3).h(3)
+        outs = []
+        for fz in (0, 3):
+            q = qt.createDensityQureg(4, env)
+            qt.initPlusState(q)
+            c.compile(env, density=True, fusion=fz).run(q)
+            outs.append(q.to_numpy())
+        np.testing.assert_allclose(outs[1], outs[0], atol=1e-10)
+
+    def test_qft_grover_sharded(self, env, mesh_env):
+        for circ in (alg.qft(7), alg.grover(6, 0b101, num_iterations=2)):
+            outs = []
+            for e in (env, mesh_env):
+                q = qt.createQureg(circ.num_qubits, e)
+                qt.initDebugState(q)
+                circ.compile(e).run(q)
+                outs.append(q.to_numpy())
+            np.testing.assert_allclose(outs[1], outs[0], atol=1e-10)
+
+
+class TestImperativeBuffer:
+    def program(self, q):
+        n = q.num_qubits_represented
+        for i in range(n):
+            qt.hadamard(q, i)
+        qt.controlledNot(q, 0, 1)
+        qt.tGate(q, 2)
+        qt.sGate(q, 0)
+        qt.rotateX(q, 1, 0.3)
+        qt.controlledPhaseShift(q, 0, 3, 0.5)
+        qt.swapGate(q, 0, 2)
+        qt.multiRotateZ(q, [0, 2, 3], 0.9)
+        qt.pauliY(q, 2)
+        qt.rotateAroundAxis(q, 0, 0.6, (1.0, 2.0, -1.0))
+
+    @pytest.mark.parametrize("mesh", [False, True])
+    def test_matches_eager(self, env, mesh_env, mesh):
+        e = mesh_env if mesh else env
+        q1 = qt.createQureg(7, e)
+        q2 = qt.createQureg(7, e)
+        qt.initDebugState(q1)
+        qt.initDebugState(q2)
+        self.program(q1)
+        with qt.fusedGates(q2, 3):
+            self.program(q2)
+        np.testing.assert_allclose(q2.to_numpy(), q1.to_numpy(), atol=1e-12)
+
+    def test_mid_fusion_read_flushes(self, env):
+        q = qt.createQureg(5, env)
+        qt.initZeroState(q)
+        qt.startGateFusion(q)
+        qt.hadamard(q, 0)
+        # any reader must see the buffered gate applied
+        assert abs(qt.calcProbOfOutcome(q, 0, 1) - 0.5) < 1e-12
+        qt.hadamard(q, 0)
+        qt.stopGateFusion(q)
+        assert abs(qt.getAmp(q, 0) - 1.0) < 1e-12
+
+    def test_overwrite_discards(self, env):
+        q = qt.createQureg(4, env)
+        qt.initZeroState(q)
+        qt.startGateFusion(q)
+        qt.pauliX(q, 0)
+        qt.initZeroState(q)          # full overwrite supersedes the X
+        qt.stopGateFusion(q)
+        assert abs(qt.getAmp(q, 0) - 1.0) < 1e-12
+
+    def test_device_put_overwrite_discards(self, env):
+        # initStateFromAmps routes through Qureg.device_put, which writes
+        # _state directly — it must discard buffered gates like the state
+        # setter does, or the stale gates flush on top of the new state
+        q = qt.createQureg(2, env)
+        qt.initZeroState(q)
+        qt.startGateFusion(q)
+        qt.hadamard(q, 0)
+        qt.initStateFromAmps(q, [1.0, 0, 0, 0], [0, 0, 0, 0])
+        qt.stopGateFusion(q)
+        np.testing.assert_allclose(q.to_numpy(), [1.0, 0, 0, 0],
+                                   atol=1e-12)
+
+    def test_density_with_channel_flush(self, env):
+        d1 = qt.createDensityQureg(3, env)
+        d2 = qt.createDensityQureg(3, env)
+        qt.initPlusState(d1)
+        qt.initPlusState(d2)
+
+        def prog(d):
+            qt.hadamard(d, 0)
+            qt.tGate(d, 1)
+            qt.controlledNot(d, 0, 2)
+            qt.mixDephasing(d, 1, 0.1)     # channel: flushes mid-stream
+            qt.pauliZ(d, 2)
+            qt.hadamard(d, 1)
+
+        prog(d1)
+        with qt.fusedGates(d2):
+            prog(d2)
+        np.testing.assert_allclose(d2.to_numpy(), d1.to_numpy(), atol=1e-12)
+
+    def test_nested_contexts_resume_outer(self, env):
+        q = qt.createQureg(3, env)
+        qt.initZeroState(q)
+        with qt.fusedGates(q):
+            qt.hadamard(q, 0)
+            with qt.fusedGates(q, max_qubits=2):
+                qt.hadamard(q, 1)
+            # outer context must still be buffering, not eager
+            assert q._fusion_buffer is not None
+            qt.hadamard(q, 2)
+        assert q._fusion_buffer is None
+        for i in range(3):
+            assert abs(qt.calcProbOfOutcome(q, i, 1) - 0.5) < 1e-12
+
+    def test_quad_register_rejected(self):
+        from quest_tpu.config import QUAD64
+        env4 = qt.createQuESTEnv(num_devices=1, seed=[3], precision=QUAD64)
+        q = qt.createQureg(3, env4)
+        with pytest.raises(qt.QuESTError):
+            qt.startGateFusion(q)
+
+
+def imperative_qft(q, n):
+    """The qft() gate sequence through the per-gate API (same ordering
+    as algorithms._append_qft, no bit-reversal swaps)."""
+    for i in range(n - 1, -1, -1):
+        qt.hadamard(q, i)
+        for k, j in enumerate(range(i - 1, -1, -1), start=2):
+            qt.controlledPhaseShift(q, j, i, 2.0 * np.pi / (1 << k))
+
+
+class TestDispatchGuardrails:
+    """Fixed budgets: a regression that re-inflates kernel dispatch or
+    relayout counts for QFT must fail loudly (ISSUE r6 acceptance)."""
+
+    def test_qft18_compiled_budgets(self, mesh_env):
+        qc = alg.qft(18)
+        on = qc.compile(mesh_env, pallas="off")           # fusion default
+        off = qc.compile(mesh_env, pallas="off", fusion=0)
+        ds_on, ds_off = on.dispatch_stats(), off.dispatch_stats()
+        # measured r6: fusion-on 22 kernels + 4 relayouts vs 60 + 4 off
+        assert ds_on.kernels_out <= 30, ds_on.as_dict()
+        assert ds_on.relayouts <= 6, ds_on.as_dict()
+        assert ds_on.dispatches <= 36, ds_on.as_dict()
+        assert ds_on.dispatches < ds_off.dispatches
+        assert ds_on.gates_in == ds_off.gates_in == len(qc.ops)
+
+    def test_qft_single_device_budgets(self, env):
+        cc = alg.qft(16).compile(env)
+        ds = cc.dispatch_stats()
+        assert ds.kernels_out <= 24, ds.as_dict()   # measured 17 at r6
+        assert ds.relayouts == 0
+
+    def test_imperative_qft_relayout_budget(self, mesh_env):
+        from quest_tpu.parallel import pergate as pg
+        n = 10
+        q = qt.createQureg(n, mesh_env)
+        qt.initPlusState(q)
+        start = pg.RELAYOUT_COUNT
+        with qt.fusedGates(q, 3):
+            imperative_qft(q, n)
+        fused_relayouts = pg.RELAYOUT_COUNT - start
+        # 3 sharded qubits, fused groups of support <= 3: single-digit
+        # relayouts where per-gate routing would pay one per H on a
+        # sharded position (plus canonicalisation)
+        assert fused_relayouts <= 6, fused_relayouts
+        # parity against the compiled program on a fresh register
+        q2 = qt.createQureg(n, mesh_env)
+        qt.initPlusState(q2)
+        alg.qft(n, swap_order=False).compile(mesh_env).run(q2)
+        np.testing.assert_allclose(q.to_numpy(), q2.to_numpy(), atol=1e-10)
+
+    def test_stats_surface(self, mesh_env):
+        cc = alg.qft(18).compile(mesh_env, pallas="off")
+        d = cc.dispatch_stats().as_dict()
+        for key in ("gates_in", "kernels_out", "relayouts", "dispatches",
+                    "fused_groups", "diag_folds", "commuted_diagonals"):
+            assert key in d
+        assert isinstance(cc.fusion_stats, FusionStats)
+        assert cc.fusion_stats.diag_folds > 0

@@ -18,7 +18,7 @@ pytestmark = pytest.mark.skipif(not nat.available(),
 
 def native_and_python_plans(circ, n, shard_bits, lookahead=32, fuse=True):
     ops_n, plan_n = _schedule(list(circ.ops), n, shard_bits, lookahead,
-                              fuse, circ)
+                              fuse)
     ops_p = circ._fused_ops() if fuse else list(circ.ops)
     plan_p = plan_layout(ops_p, n, shard_bits, lookahead=lookahead)
     return (ops_n, plan_n), (ops_p, plan_p)
@@ -93,7 +93,7 @@ class TestScheduleEquality:
                             + 1j * rng.normal(size=(8, 8)))
         c.gate(u, (0, 1, 2))
         with pytest.raises(ValueError, match="cannot be localised"):
-            _schedule(list(c.ops), 6, 4, 32, True, c)
+            _schedule(list(c.ops), 6, 4, 32, True)
 
 
 class TestExecutionViaNative:
