@@ -404,14 +404,10 @@ def bench_pallas_compare(qt, env, platform: str, num_qubits: int,
     }
 
 
-def bench_dd(qt, env, platform: str) -> dict:
-    """Double-double (two-f32) high-precision compiled program: the
-    reference quad-build analogue on f32-only hardware (docs/accuracy.md).
-    The roofline baseline is scaled to the dd state's byte width (16 B/amp
-    = same bytes as the complex128 the TPU cannot natively compute on)."""
-    num_qubits = int(os.environ.get(
-        "QUEST_BENCH_DD_QUBITS", "20" if _is_accel(platform) else "16"))
-    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 3)
+def _time_dd(env, num_qubits: int, trials: int) -> float:
+    """Shared dd timing protocol (compile_dd + warm-up + trial loop) for
+    the single-chip and sharded QUAD rows — one place to fix, so the two
+    rows always measure the same thing. Returns gates/sec."""
     circ, n_gates = build_bench_circuit(num_qubits, 1)
     prog = circ.compile_dd(env)
     planes = prog.run(prog.init_zero())          # compile + warm-up
@@ -420,8 +416,18 @@ def bench_dd(qt, env, platform: str) -> dict:
     for _ in range(trials):
         planes = prog.run(planes)
     planes.block_until_ready()
-    dt = time.perf_counter() - t0
-    ops_per_sec = n_gates * trials / dt
+    return n_gates * trials / (time.perf_counter() - t0)
+
+
+def bench_dd(qt, env, platform: str) -> dict:
+    """Double-double (two-f32) high-precision compiled program: the
+    reference quad-build analogue on f32-only hardware (docs/accuracy.md).
+    The roofline baseline is scaled to the dd state's byte width (16 B/amp
+    = same bytes as the complex128 the TPU cannot natively compute on)."""
+    num_qubits = int(os.environ.get(
+        "QUEST_BENCH_DD_QUBITS", "20" if _is_accel(platform) else "16"))
+    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 3)
+    ops_per_sec = _time_dd(env, num_qubits, trials)
     # dd state is 16 B/amp (4 f32 planes) — same roofline bytes as f64
     baseline = _roofline_baseline(num_qubits, 8)
     return {
@@ -597,15 +603,22 @@ def bench_trajectories(qt, env, platform: str) -> dict:
 def _dispatch_fields(cc) -> dict:
     """Machine-parseable dispatch accounting for a compiled circuit: how
     many kernels the program dispatches per run vs gates recorded (the
-    gate-fusion engine's observable, quest_tpu/core/fusion.py). Thin
-    rename shim over DispatchStats.as_dict — the row keys are the
+    gate-fusion engine's observable, quest_tpu/core/fusion.py) plus the
+    communication planner's accounting (quest_tpu/parallel/layout.py).
+    Thin rename shim over DispatchStats.as_dict — the row keys are the
     documented bench column names (docs/tpu.md)."""
     d = cc.dispatch_stats().as_dict()
     return {"gates_in": d["gates_in"],
             "fused_kernels": d["kernels_out"],
             "dispatch_count": d["dispatches"],
             "fused_groups": d["fused_groups"],
-            "diag_folds": d["diag_folds"]}
+            "diag_folds": d["diag_folds"],
+            "collective_launches": d["collective_launches"],
+            "comm_bytes_planned": d["comm_bytes_planned"],
+            "comm_bytes_saved": d["comm_bytes_saved"],
+            "collectives_fused": d["collectives_fused"],
+            "swaps_absorbed": d["swaps_absorbed"],
+            "cross_shard_exchanges": d["cross_shard_exchanges"]}
 
 
 def bench_sharded_mesh(qt, platform: str) -> dict:
@@ -635,18 +648,25 @@ def bench_sharded_mesh(qt, platform: str) -> dict:
         n_gates, trials, dt, num_qubits, env),
         "planned_relayouts": cc.plan.num_relayouts,
         **_dispatch_fields(cc)})
-    # structured-circuit rows: QFT with the gate-fusion pass OFF then ON
-    # — the SAME recorded workload (gates/sec computed from recorded
-    # gates both times), so the two rows are directly comparable and the
-    # dispatch shrink is machine-parsed from the fused-kernel/dispatch
-    # counts. QFT's controlled phases are position-free diagonals, so
-    # the planner only relayouts for the H ladder; fusion additionally
-    # folds the phase ladders and welds the H runs into 3q kernels.
-    from quest_tpu.algorithms import qft
+    # structured-circuit rows: QFT with the gate-fusion pass OFF then ON,
+    # and the communication planner OFF then ON — the SAME recorded
+    # workload every time (gates/sec computed from recorded gates), so
+    # the rows are directly comparable and the dispatch/collective shrink
+    # is machine-parsed from the fused-kernel/collective-launch counts.
+    # QFT's controlled phases are position-free diagonals, so the planner
+    # only relayouts for the H ladder; fusion folds the phase ladders and
+    # welds the H runs into 3q kernels; the comm planner absorbs the
+    # bit-reversal swap network into the layout permutation (one
+    # composed exchange instead of dense swap kernels + extra relayouts).
+    # "fusion-on" and "planner-on" are the SAME default-compile config,
+    # measured once and emitted against both baselines.
+    from quest_tpu.algorithms import qft, grover
     qc = qft(num_qubits)
     compiled = {}
-    for label, fz in (("fusion-off", 0), ("fusion-on", None)):
-        qcc = qc.compile(env, pallas="off", fusion=fz)
+    for label, kw in (("fusion-off", {"fusion": 0}),
+                      ("planner-off", {"comm_planner": False}),
+                      ("planner-on", {})):
+        qcc = qc.compile(env, pallas="off", **kw)
         q2 = _qt.createQureg(num_qubits, env)
         _qt.initPlusState(q2)
         compiled[label] = (qcc, q2, [_time_compiled(qcc, q2, trials)])
@@ -665,10 +685,92 @@ def bench_sharded_mesh(qt, platform: str) -> dict:
             "planned_relayouts": qcc.plan.num_relayouts,
             **_dispatch_fields(qcc)}
     emit(rows["fusion-off"])
-    ret = rows["fusion-on"]
-    ret["speedup_vs_fusion_off"] = round(
-        ret["value"] / max(rows["fusion-off"]["value"], 1e-9), 3)
+    emit({**rows["planner-on"],
+          "metric": rows["planner-on"]["metric"].replace(
+              "planner-on", "fusion-on"),
+          "speedup_vs_fusion_off": round(
+              rows["planner-on"]["value"]
+              / max(rows["fusion-off"]["value"], 1e-9), 3)})
+    emit(rows["planner-off"])
+    ret = dict(rows["planner-on"])
+    ret["speedup_vs_planner_off"] = round(
+        ret["value"] / max(rows["planner-off"]["value"], 1e-9), 3)
+
+    # Grover planner-off/on rows: the diffusion H-layers are the
+    # collective-bound workload with NO swap network, so these rows pin
+    # the planner's no-regression side
+    g_qubits = int(os.environ.get("QUEST_BENCH_GROVER_MESH_QUBITS", "16"))
+    gc = grover(g_qubits, marked=(1 << g_qubits) - 3, num_iterations=4)
+    gcompiled = {}
+    for label, kw in (("planner-off", {"comm_planner": False}),
+                      ("planner-on", {})):
+        gcc = gc.compile(env, pallas="off", **kw)
+        q3 = _qt.createQureg(g_qubits, env)
+        _qt.initZeroState(q3)
+        gcompiled[label] = (gcc, q3, [_time_compiled(gcc, q3, trials)])
+    for _ in range(2):
+        for gcc, q3, dts in gcompiled.values():
+            dts.append(_time_compiled(gcc, q3, trials))
+    growz = {}
+    for label, (gcc, q3, dts) in gcompiled.items():
+        growz[label] = {**_result(
+            f"Grover-{g_qubits} (4 iter) gate throughput sharded over 8 "
+            f"{platform} devices ({label})", len(gc.ops), trials,
+            min(dts), g_qubits, env),
+            "planned_relayouts": gcc.plan.num_relayouts,
+            **_dispatch_fields(gcc)}
+    emit(growz["planner-off"])
+    emit({**growz["planner-on"],
+          "speedup_vs_planner_off": round(
+              growz["planner-on"]["value"]
+              / max(growz["planner-off"]["value"], 1e-9), 3)})
+
+    # sharded QUAD (double-double) row: the high-precision tier over the
+    # same 8-device mesh, with dd roofline accounting — 2x the bytes per
+    # pass (4 planes vs 2) and ~6x the flops of a plain gate
+    try:
+        emit(bench_sharded_dd(platform))
+    except Exception as e:
+        emit({"metric": "sharded QUAD dd (bench error)", "value": 0.0,
+              "unit": "gates/sec", "vs_baseline": 0.0,
+              "errors": [f"{type(e).__name__}: {e}"]})
     return ret
+
+
+def bench_sharded_dd(platform: str) -> dict:
+    """Double-double (QUAD tier, 2xf32 planes) gate throughput sharded
+    over the 8-device mesh — the high-precision tier's first distributed
+    number. Roofline accounting per the dd cost model: each gate streams
+    4 real planes instead of 2 (2x bytes; 16 B/amp at f32) and performs
+    ~6x the flops of a plain complex gate (two-product TwoProd + TwoSum
+    cascades per multiply-add), so the bytes-based roofline is the
+    binding bound exactly as for the plain tiers."""
+    import quest_tpu as _qt
+    env = _qt.createQuESTEnv(num_devices=8, seed=[2026],
+                             precision=_qt.QUAD)
+    num_qubits = int(os.environ.get(
+        "QUEST_BENCH_MESH_DD_QUBITS", "20" if _is_accel(platform) else "16"))
+    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 3)
+    ops_per_sec = _time_dd(env, num_qubits, trials)
+    # dd state: 4 f32 planes = 16 B/amp, same roofline bytes as complex128
+    baseline = _roofline_baseline(num_qubits, 8)
+    itemsize = np.dtype(env.precision.real_dtype).itemsize
+    bytes_per_gate = 8.0 * itemsize * (1 << num_qubits)   # 2x plain tier
+    bw_name, peak_bw = _platform_peak_bw()
+    achieved = ops_per_sec * bytes_per_gate
+    return {
+        "metric": f"QUAD double-double (2xf32) gate throughput, "
+                  f"{num_qubits}-qubit statevector sharded over 8 "
+                  f"{platform} devices",
+        "value": round(ops_per_sec, 2),
+        "unit": "gates/sec",
+        "vs_baseline": round(ops_per_sec / baseline, 4),
+        "bytes_per_gate": bytes_per_gate,
+        "dd_flops_factor": 6.0,
+        "achieved_gbps": round(achieved / 1e9, 2),
+        "roofline_frac": round(achieved / peak_bw, 4),
+        "roofline_model": bw_name,
+    }
 
 
 def bench_pauli_sum(qt, env, platform: str) -> dict:
@@ -817,9 +919,12 @@ def supervise() -> None:
     if relayed and os.environ.get("QUEST_BENCH_HEADLINE_ONLY", "0") != "1":
         # the sharded-mesh config needs 8 virtual devices, which tax
         # single-device configs ~30% (the CPU backend splits per-device)
-        # — so it gets its own short child with the flag set, bounded to
-        # 30s past the CPU window
-        mesh_end = time.perf_counter() + min(30.0, cpu_reserve)
+        # — so it gets its own child with the flag set. The window grew
+        # with the planner-off/on + Grover + QUAD rows; rows stream out
+        # as they complete, so a timeout truncates rather than erases.
+        mesh_window = float(os.environ.get("QUEST_BENCH_MESH_WINDOW_S",
+                                           str(min(90.0, 1.2 * cpu_reserve))))
+        mesh_end = time.perf_counter() + mesh_window
         mesh_rows = _run_child(
             {"QUEST_BENCH_FORCE_CPU": "1",
              "QUEST_BENCH_MESH_CHILD": "1",
@@ -829,7 +934,7 @@ def supervise() -> None:
             first_line_deadline=mesh_end, total_deadline=mesh_end)
         if mesh_rows == 0:
             emit({"metric": "sharded (mesh child produced no result "
-                            "within 30s)", "value": 0.0,
+                            f"within {mesh_window:.0f}s)", "value": 0.0,
                   "unit": "gates/sec", "vs_baseline": 0.0})
     if relayed == 0:
         # even the CPU child died: leave a parseable record of that

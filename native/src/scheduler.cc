@@ -14,10 +14,19 @@
 //      paired gate whose target sits on a sharded position triggers ONE
 //      batched relayout (Belady eviction over a lookahead window) instead of
 //      per-gate exchanges.
+//   4. (cost-aware mode, qsched_set_cost_model) communication-aware
+//      planning under a linear alpha+beta*bytes collective model: SWAP
+//      gates are absorbed into the permutation (zero bytes), a lone
+//      sharded 1q gate rides a whole-chunk pair exchange ("xshard" item)
+//      when modeled cheaper than localise+restore, and adjacent relayouts
+//      compose into one exchange when the intervening ops stay executable
+//      under the composed permutation and the composed collective is
+//      modeled no slower than the pair.
 //
-// Output is a schedule of items — ops at physical positions, plus relayout
-// permutations — that the Python/JAX side lowers into a single XLA program.
-// Semantics must match quest_tpu/parallel/layout.py (tested for equality).
+// Output is a schedule of items — ops at physical positions, relayout
+// permutations, cross-shard exchanges — that the Python/JAX side lowers
+// into a single XLA program. Semantics must match
+// quest_tpu/parallel/layout.py (tested for equality, in both modes).
 //
 // Build: native/Makefile -> quest_tpu/native/libquest_sched.so
 
@@ -49,9 +58,13 @@ struct Op {
   int source_index;           // index of the (first) source op, for param fns
 };
 
+constexpr int ITEM_OP = 0;
+constexpr int ITEM_RELAYOUT = 1;
+constexpr int ITEM_XSHARD = 2;      // cross-shard 1q pair exchange
+
 struct Item {
-  bool is_relayout;
-  // op item
+  int kind = ITEM_OP;
+  // op / xshard item
   int op_index = -1;                  // into fused op table
   std::vector<int> phys_targets;
   int64_t phys_ctrl_mask = 0;
@@ -68,6 +81,14 @@ struct Sched {
   int num_qubits = 0;
   int shard_bits = 0;
   int num_relayouts = 0;
+  // communication-aware mode (mirrors quest_tpu/parallel/layout.py)
+  bool cost_aware = false;
+  double alpha = 0.0;          // per-collective latency, seconds
+  double beta = 0.0;           // seconds per byte
+  double chunk_bytes = 0.0;    // per-device chunk payload
+  int num_xshard = 0;
+  int swaps_absorbed = 0;
+  int fused_collectives = 0;
   std::string error;
 };
 
@@ -164,9 +185,154 @@ bool is_paired(const Op& op) {
   return op.kind == KIND_U || op.kind == KIND_U_PARAM;
 }
 
+// static uncontrolled 2q SWAP (the ops the cost-aware planner absorbs
+// into the permutation); tolerance mirrors layout.py::is_swap_op
+bool is_swap(const Op& op) {
+  if (op.kind != KIND_U || op.ctrl_mask != 0 || op.targets.size() != 2 ||
+      op.data.size() != 16)
+    return false;
+  static const double SWAP_RE[16] = {1, 0, 0, 0, 0, 0, 1, 0,
+                                     0, 1, 0, 0, 0, 0, 0, 1};
+  for (int i = 0; i < 16; ++i) {
+    if (std::abs(op.data[i].real() - SWAP_RE[i]) > 1e-12) return false;
+    if (std::abs(op.data[i].imag()) > 1e-12) return false;
+  }
+  return true;
+}
+
+// physical permutation a relayout realizes: perm_before[l] -> perm_after[l]
+std::vector<int> relayout_sigma(const std::vector<int>& before,
+                                const std::vector<int>& after, int n) {
+  std::vector<int> sigma(n);
+  for (int l = 0; l < n; ++l) sigma[before[l]] = after[l];
+  return sigma;
+}
+
+double a2a_seconds(const Sched& s, int k) {
+  if (k <= 0) return 0.0;
+  return s.alpha + s.beta * (s.chunk_bytes *
+                             ((double)((1 << k) - 1) / (double)(1 << k)));
+}
+
+double ppermute_seconds(const Sched& s) {
+  return s.alpha + s.beta * s.chunk_bytes;
+}
+
+// modeled seconds for one relayout, mirroring layout.py::relayout_comm:
+// one all_to_all over the k exchanged bits plus a whole-chunk ppermute
+// iff a residual device-bit permutation remains
+double relayout_seconds(const Sched& s, const std::vector<int>& sigma,
+                        int lt) {
+  int n = (int)sigma.size();
+  int k = 0;
+  bool residual = false;
+  for (int p = 0; p < lt; ++p)
+    if (sigma[p] >= lt) {
+      ++k;
+      if (sigma[sigma[p]] >= lt) residual = true;
+    }
+  for (int d = lt; d < n; ++d)
+    if (sigma[d] >= lt && sigma[d] != d) residual = true;
+  double sec = 0.0;
+  if (k) sec += a2a_seconds(s, k);
+  if (residual) sec += ppermute_seconds(s);
+  return sec;
+}
+
+int64_t remap_mask(int64_t mask, const std::vector<int>& delta) {
+  int64_t out = 0;
+  for (int p = 0; mask != 0; ++p, mask >>= 1)
+    if (mask & 1) out |= int64_t{1} << delta[p];
+  return out;
+}
+
+// rewrite an op/xshard item's physical coordinates through delta
+void remap_item(Item& it, const std::vector<int>& delta) {
+  if (it.kind == ITEM_XSHARD || it.axis_order.empty()) {
+    for (int& p : it.phys_targets) p = delta[p];
+    it.phys_ctrl_mask = remap_mask(it.phys_ctrl_mask, delta);
+    it.phys_flip_mask = remap_mask(it.phys_flip_mask, delta);
+    return;
+  }
+  // diagonal: remap positions, re-sort descending, compose axis order
+  size_t k = it.phys_targets.size();
+  std::vector<std::pair<int, int>> pairs(k);
+  for (size_t i = 0; i < k; ++i)
+    pairs[i] = {delta[it.phys_targets[i]], it.axis_order[i]};
+  std::sort(pairs.begin(), pairs.end(),
+            std::greater<std::pair<int, int>>());
+  for (size_t i = 0; i < k; ++i) {
+    it.phys_targets[i] = pairs[i].first;
+    it.axis_order[i] = pairs[i].second;
+  }
+}
+
+// merge adjacent relayouts (layout.py::_compose_relayouts): R2's
+// permutation applies early (composed into R1) when every item between
+// stays executable under it and the composed collective is modeled no
+// slower than the pair. Returns relayouts removed; counts merges.
+int compose_relayouts(Sched& s, int lt) {
+  int n = s.num_qubits;
+  int removed = 0;
+  bool changed = true;
+  while (changed) {
+    changed = false;
+    std::vector<int> idxs;
+    for (int j = 0; j < (int)s.items.size(); ++j)
+      if (s.items[j].kind == ITEM_RELAYOUT) idxs.push_back(j);
+    for (size_t t = 0; t + 1 < idxs.size(); ++t) {
+      int a = idxs[t], b = idxs[t + 1];
+      std::vector<int> delta = relayout_sigma(s.items[b].perm_before,
+                                              s.items[b].perm_after, n);
+      bool ok = true;
+      for (int j = a + 1; j < b; ++j) {
+        const Item& it = s.items[j];
+        if (it.kind == ITEM_OP) {
+          if (it.axis_order.empty()) {
+            for (int p : it.phys_targets)
+              if (delta[p] >= lt) { ok = false; break; }
+            if (!ok) break;
+          }
+        } else if (it.kind == ITEM_XSHARD) {
+          if (delta[it.phys_targets[0]] < lt) { ok = false; break; }
+        } else {
+          ok = false;
+          break;
+        }
+      }
+      if (!ok) continue;
+      const std::vector<int>& before = s.items[a].perm_before;
+      const std::vector<int>& after = s.items[a].perm_after;
+      std::vector<int> new_after(n);
+      for (int l = 0; l < n; ++l) new_after[l] = delta[after[l]];
+      double c1 = relayout_seconds(s, relayout_sigma(before, after, n), lt);
+      double c2 = relayout_seconds(s, delta, lt);
+      double cc = relayout_seconds(s, relayout_sigma(before, new_after, n),
+                                   lt);
+      if (cc > c1 + c2) continue;
+      for (int j = a + 1; j < b; ++j) remap_item(s.items[j], delta);
+      bool identity = true;
+      for (int l = 0; l < n; ++l)
+        if (before[l] != new_after[l]) { identity = false; break; }
+      s.items.erase(s.items.begin() + b);
+      if (identity) {
+        s.items.erase(s.items.begin() + a);
+        removed += 2;
+      } else {
+        s.items[a].perm_after = new_after;
+        removed += 1;
+      }
+      ++s.fused_collectives;
+      changed = true;
+      break;
+    }
+  }
+  return removed;
+}
+
 Item op_item(int idx, const Op& op, const std::vector<int>& perm) {
   Item it;
-  it.is_relayout = false;
+  it.kind = ITEM_OP;
   it.op_index = idx;
   if (is_paired(op)) {
     for (int t : op.targets) it.phys_targets.push_back(perm[t]);
@@ -200,6 +366,10 @@ void plan(Sched& s, int lookahead) {
   auto& ops = s.fused;
   s.items.clear();
   s.num_relayouts = 0;
+  s.num_xshard = 0;
+  s.swaps_absorbed = 0;
+  s.fused_collectives = 0;
+  const bool comm_aware = s.cost_aware && S > 0;
 
   std::vector<int> perm(n);
   for (int i = 0; i < n; ++i) perm[i] = i;
@@ -210,9 +380,15 @@ void plan(Sched& s, int lookahead) {
     return;
   }
 
+  std::vector<char> absorbable(ops.size(), 0);
+  if (comm_aware)
+    for (size_t i = 0; i < ops.size(); ++i)
+      absorbable[i] = is_swap(ops[i]) ? 1 : 0;
+
   int max_k = 0;
-  for (const Op& op : ops)
-    if (is_paired(op)) max_k = std::max(max_k, (int)op.targets.size());
+  for (size_t i = 0; i < ops.size(); ++i)
+    if (is_paired(ops[i]) && !absorbable[i])
+      max_k = std::max(max_k, (int)ops[i].targets.size());
   if (max_k > local_top) {
     s.error = "a " + std::to_string(max_k) +
               "-qubit unitary cannot be localised with " +
@@ -231,12 +407,14 @@ void plan(Sched& s, int lookahead) {
   };
 
   const int64_t INF = static_cast<int64_t>(ops.size()) + 1;
-  // next use (as a target of a paired op), next_use[i][q]
+  // next use (as a target of a paired op; absorbed SWAPs never demand
+  // locality so they are not uses), next_use[i][q]
   std::vector<std::vector<int64_t>> next_use(ops.size() + 1,
                                              std::vector<int64_t>(n, INF));
   for (int64_t i = static_cast<int64_t>(ops.size()) - 1; i >= 0; --i) {
     next_use[i] = next_use[i + 1];
-    for (int q : used_qubits(ops[i])) next_use[i][q] = i;
+    if (!absorbable[i])
+      for (int q : used_qubits(ops[i])) next_use[i][q] = i;
   }
 
   auto contains = [](const std::vector<int>& v, int q) {
@@ -245,6 +423,62 @@ void plan(Sched& s, int lookahead) {
 
   for (size_t i = 0; i < ops.size(); ++i) {
     const Op& op = ops[i];
+    if (absorbable[i]) {
+      // SWAP = pure relabeling: exchange the two physical positions in
+      // the bookkeeping, move zero amplitudes (layout.py mirror).
+      std::swap(perm[op.targets[0]], perm[op.targets[1]]);
+      ++s.swaps_absorbed;
+      continue;
+    }
+    // lone sharded 1q gate: whole-chunk ppermute vs localise+restore
+    // relayout pair — only when it is the SOLE sharded demand in the
+    // lookahead window (any other sharded use means a relayout is
+    // coming anyway and amortizes; layout.py mirror)
+    auto try_xshard = [&]() -> bool {
+      // any paired 1q op qualifies — including KIND_U_PARAM: the
+      // executor resolves mat_fn at trace time (layout.py parity: the
+      // Python condition is kind == "u" with no staticness check)
+      if (!comm_aware || !is_paired(op) || op.targets.size() != 1 ||
+          perm[op.targets[0]] < local_top)
+        return false;
+      int t = op.targets[0];
+      size_t wend = std::min(i + static_cast<size_t>(lookahead),
+                             ops.size());
+      bool sole = true;
+      // scratch perm applies the window's absorbed SWAPs as they pass
+      // (layout.py mirror): later gates' locality is judged where their
+      // labels will sit THEN
+      std::vector<int> wp = perm;
+      for (size_t j = i; j < wend && sole; ++j) {
+        if (absorbable[j]) {
+          std::swap(wp[ops[j].targets[0]], wp[ops[j].targets[1]]);
+          continue;
+        }
+        for (int q : used_qubits(ops[j]))
+          if (wp[q] >= local_top && (j != i || q != t)) {
+            sole = false;
+            break;
+          }
+      }
+      if (!sole || ppermute_seconds(s) > 2.0 * a2a_seconds(s, 1))
+        return false;
+      Item it;
+      it.kind = ITEM_XSHARD;
+      it.op_index = static_cast<int>(i);
+      it.phys_targets.push_back(perm[t]);
+      int64_t m = op.ctrl_mask;
+      for (int q = 0; m != 0; ++q, m >>= 1) {
+        if (m & 1) {
+          it.phys_ctrl_mask |= int64_t{1} << perm[q];
+          if ((op.flip_mask >> q) & 1)
+            it.phys_flip_mask |= int64_t{1} << perm[q];
+        }
+      }
+      s.items.push_back(std::move(it));
+      ++s.num_xshard;
+      return true;
+    };
+    if (try_xshard()) continue;
     std::vector<int> used = used_qubits(op);
     bool offending = false;
     for (int q : used)
@@ -254,14 +488,32 @@ void plan(Sched& s, int lookahead) {
       std::vector<int> need_now;
       for (int t : op.targets)
         if (perm[t] >= local_top) need_now.push_back(t);
-      // sharded qubits used in the lookahead window (prefetch)
-      std::vector<int> window_hot;
+      // sharded DATA used in the lookahead window (prefetch), scanned
+      // under a scratch perm that applies absorbed SWAPs as they pass —
+      // the data serving a future gate is whatever CURRENT label
+      // occupies that future position (layout.py mirror; reduces to the
+      // legacy label scan when nothing is absorbable)
+      std::vector<std::pair<int, int64_t>> window_hot;  // (label, use idx)
+      std::vector<int> wp = perm;
+      std::vector<int> inv(n);
+      for (int l = 0; l < n; ++l) inv[perm[l]] = l;
+      std::vector<char> seen(n, 0);
+      for (int q : need_now) seen[q] = 1;
       size_t wend = std::min(i + static_cast<size_t>(lookahead), ops.size());
-      for (size_t j = i; j < wend; ++j)
+      for (size_t j = i; j < wend; ++j) {
+        if (absorbable[j]) {
+          std::swap(wp[ops[j].targets[0]], wp[ops[j].targets[1]]);
+          continue;
+        }
         for (int q : used_qubits(ops[j]))
-          if (perm[q] >= local_top && !contains(window_hot, q) &&
-              !contains(need_now, q))
-            window_hot.push_back(q);
+          if (wp[q] >= local_top) {
+            int hot = inv[wp[q]];
+            if (!seen[hot]) {
+              window_hot.emplace_back(hot, static_cast<int64_t>(j));
+              seen[hot] = 1;
+            }
+          }
+      }
       // victims: local positions not used by this op, farthest next use
       // first (Belady)
       std::vector<std::pair<int64_t, int>> locals_;
@@ -272,15 +524,16 @@ void plan(Sched& s, int lookahead) {
       }
       std::sort(locals_.begin(), locals_.end(),
                 std::greater<std::pair<int64_t, int>>());
-      std::vector<int> bring = need_now;
-      for (int q : window_hot) bring.push_back(q);
+      std::vector<std::pair<int, int64_t>> bring;
+      for (int q : need_now) bring.emplace_back(q, int64_t{-1});
+      for (auto& h : window_hot) bring.push_back(h);
 
       std::vector<int> new_perm = perm;
       size_t vi = 0;
-      for (int q : bring) {
+      for (auto [q, nu_q] : bring) {
         if (vi >= locals_.size()) break;
         auto [nu_victim, victim] = locals_[vi];
-        if (!contains(need_now, q) && next_use[i][q] >= nu_victim) continue;
+        if (!contains(need_now, q) && nu_q >= nu_victim) continue;
         // three-way rotation landing the incoming qubit at a TOP local
         // position (the all_to_all staging slot): q -> stage, the qubit at
         // stage -> the victim's slot, victim -> q's device position — so
@@ -296,7 +549,7 @@ void plan(Sched& s, int lookahead) {
         ++vi;
       }
       Item r;
-      r.is_relayout = true;
+      r.kind = ITEM_RELAYOUT;
       r.perm_before = perm;
       r.perm_after = new_perm;
       s.items.push_back(std::move(r));
@@ -311,13 +564,16 @@ void plan(Sched& s, int lookahead) {
     if (perm[l] != l) { identity = false; break; }
   if (!identity) {
     Item r;
-    r.is_relayout = true;
+    r.kind = ITEM_RELAYOUT;
     r.perm_before = perm;
     r.perm_after.resize(n);
     for (int l = 0; l < n; ++l) r.perm_after[l] = l;
     s.items.push_back(std::move(r));
     ++s.num_relayouts;
   }
+
+  if (comm_aware)
+    s.num_relayouts -= compose_relayouts(s, local_top);
 }
 
 }  // namespace
@@ -357,6 +613,17 @@ int qsched_add_op(void* h, int kind, int num_targets, const int* targets,
   }
   s.ops.push_back(std::move(op));
   return static_cast<int>(s.ops.size()) - 1;
+}
+
+// enable the communication-aware planner with a linear collective cost
+// model (seconds = alpha + beta * bytes; chunk_bytes = per-device chunk)
+void qsched_set_cost_model(void* h, double alpha, double beta,
+                           double chunk_bytes) {
+  Sched& s = *static_cast<Sched*>(h);
+  s.cost_aware = true;
+  s.alpha = alpha;
+  s.beta = beta;
+  s.chunk_bytes = chunk_bytes;
 }
 
 // run fusion + planning; returns 0 on success, nonzero on error
@@ -416,17 +683,30 @@ int qsched_num_relayouts(void* h) {
   return static_cast<Sched*>(h)->num_relayouts;
 }
 
-// returns 1 if item is a relayout else 0; for ops fills op_index, num
-// phys targets, masks; for relayouts fills nothing here
+int qsched_num_xshard(void* h) {
+  return static_cast<Sched*>(h)->num_xshard;
+}
+
+int qsched_num_swaps_absorbed(void* h) {
+  return static_cast<Sched*>(h)->swaps_absorbed;
+}
+
+int qsched_num_fused_collectives(void* h) {
+  return static_cast<Sched*>(h)->fused_collectives;
+}
+
+// returns the item kind (0 op, 1 relayout, 2 cross-shard exchange); for
+// op/xshard items fills op_index, num phys targets, masks; for relayouts
+// fills nothing here
 int qsched_item_info(void* h, int i, int* op_index, int* num_targets,
                      int64_t* ctrl_mask, int64_t* flip_mask) {
   const Item& it = static_cast<Sched*>(h)->items[i];
-  if (it.is_relayout) return 1;
+  if (it.kind == ITEM_RELAYOUT) return ITEM_RELAYOUT;
   *op_index = it.op_index;
   *num_targets = static_cast<int>(it.phys_targets.size());
   *ctrl_mask = it.phys_ctrl_mask;
   *flip_mask = it.phys_flip_mask;
-  return 0;
+  return it.kind;
 }
 
 void qsched_item_targets(void* h, int i, int* targets, int* axis_order) {

@@ -30,7 +30,7 @@ The public API mirrors the reference's function names and argument orders
 (``QuEST.h``); C count-parameters are inferred from Python sequence lengths.
 """
 
-from .config import Precision, SINGLE, DOUBLE, default_precision
+from .config import Precision, SINGLE, DOUBLE, QUAD, QUAD64, default_precision
 from .types import (
     PauliOpType, PAULI_I, PAULI_X, PAULI_Y, PAULI_Z,
     QuESTError, invalid_quest_input_error, invalidQuESTInputError,
@@ -48,7 +48,7 @@ __version__ = "0.1.0"
 
 __all__ = (
     [
-        "Precision", "SINGLE", "DOUBLE", "default_precision",
+        "Precision", "SINGLE", "DOUBLE", "QUAD", "QUAD64", "default_precision",
         "PauliOpType", "PAULI_I", "PAULI_X", "PAULI_Y", "PAULI_Z",
         "QuESTError", "invalid_quest_input_error",
         "invalidQuESTInputError", "set_input_error_handler",

@@ -751,7 +751,8 @@ class Circuit:
     def compile(self, env: QuESTEnv, donate: bool = True, fuse: bool = True,
                 lookahead: int = 32, pallas: Optional[object] = None,
                 supergate_k: int = 4, fusion: Optional[object] = None,
-                density: bool = False) -> "CompiledCircuit":
+                density: bool = False, comm_planner: Optional[bool] = None,
+                overlap: bool = False) -> "CompiledCircuit":
         """Compile to one XLA program; ``lookahead`` is the layout planner's
         relayout-batching window (quest_tpu.parallel.layout); ``pallas``
         controls the fused-layer kernel pass (None=auto on TPU,
@@ -762,7 +763,18 @@ class Circuit:
         dense kernels BEFORE layout planning, so relayouts are planned
         per fused group; ``density=True`` compiles the program for
         density registers (gates lift to superoperator form; Kraus
-        channels allowed)."""
+        channels allowed).
+
+        ``comm_planner`` (default on; only meaningful on a mesh env)
+        switches the layout planner to the communication-aware cost model
+        (:mod:`quest_tpu.parallel.layout` module docs: SWAP absorption,
+        cross-shard 1q pair exchanges, collective composition — priced by
+        :func:`quest_tpu.profiling.comm_model`); ``False`` restores the
+        count-based planner. ``overlap=True`` additionally double-buffers
+        each relayout with the dense kernel it serves (slab-pipelined
+        ``all_to_all``, :func:`quest_tpu.parallel.exchange.
+        run_exchange_overlapped`) so collective and gate math can overlap
+        on backends with async collectives."""
         if density:
             from . import validation as val
             for op in self.ops:
@@ -779,7 +791,8 @@ class Circuit:
             circ = self
         cc = CompiledCircuit(circ, env, donate=donate, fuse=fuse,
                              lookahead=lookahead, pallas=pallas,
-                             supergate_k=supergate_k, fusion=fusion)
+                             supergate_k=supergate_k, fusion=fusion,
+                             comm_planner=comm_planner, overlap=overlap)
         cc.is_density = density
         return cc
 
@@ -1178,13 +1191,16 @@ def _collect_layers(ops: list, num_qubits: int,
 
 def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
               lookahead: int, fuse_flag: bool,
-              diag_row_cap: int = -1):
+              diag_row_cap: int = -1, cost_model=None,
+              chunk_bytes: float = 0.0):
     """Peephole-fuse + layout-plan the op stream (which the gate-fusion
     pass of :mod:`quest_tpu.core.fusion` has usually already contracted).
 
     Prefers the native C++ scheduler (quest_tpu.native / native/src/
     scheduler.cc); falls back to the pure-Python passes (_peephole_fused +
     quest_tpu.parallel.plan_layout). Both produce identical schedules.
+    ``cost_model``/``chunk_bytes`` switch both planners to the
+    communication-aware mode (quest_tpu/parallel/layout.py module docs).
 
     Returns (ops_table, LayoutPlan).
     """
@@ -1192,7 +1208,8 @@ def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
 
     try:
         from . import native as nat
-        use_native = nat.available()
+        use_native = nat.available() and (
+            cost_model is None or nat.supports_cost_model())
     except Exception:
         use_native = False
 
@@ -1208,6 +1225,9 @@ def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
                 data = op.diag
             sch.add_op(kind, op.targets, op.ctrl_mask, op.flip_mask,
                        data, i)
+        if cost_model is not None:
+            sch.set_cost_model(cost_model.alpha_s,
+                               cost_model.beta_s_per_byte, chunk_bytes)
         sch.compile(num_qubits, shard_bits, lookahead, fuse_flag,
                     diag_row_cap)
         ops_table: list[_Op] = []
@@ -1219,14 +1239,19 @@ def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
             else:
                 ops_table.append(recorded[si])   # param ops pass through
         plan = LayoutPlan(sch.items(num_qubits), num_qubits, shard_bits,
-                          sch.num_relayouts())
+                          sch.num_relayouts(),
+                          num_xshard=sch.num_xshard(),
+                          swaps_absorbed=sch.num_swaps_absorbed(),
+                          collectives_fused=sch.num_fused_collectives())
         return ops_table, plan
 
     from .parallel import plan_layout
     ops_table = _peephole_fused(recorded, diag_row_cap) if fuse_flag \
         else list(recorded)
     return ops_table, plan_layout(ops_table, num_qubits, shard_bits,
-                                  lookahead=lookahead)
+                                  lookahead=lookahead,
+                                  cost_model=cost_model,
+                                  chunk_bytes=chunk_bytes)
 
 
 class CompiledCircuit:
@@ -1240,7 +1265,9 @@ class CompiledCircuit:
     def __init__(self, circuit: Circuit, env: QuESTEnv,
                  donate: bool = True, fuse: bool = True,
                  lookahead: int = 32, pallas: Optional[object] = None,
-                 supergate_k: int = 4, fusion: Optional[object] = None):
+                 supergate_k: int = 4, fusion: Optional[object] = None,
+                 comm_planner: Optional[bool] = None,
+                 overlap: bool = False):
         self.circuit = circuit
         self.env = env
         self.num_qubits = circuit.num_qubits
@@ -1248,7 +1275,9 @@ class CompiledCircuit:
         # recorded for the layer-free twin (_xla_only): it must differ
         # from this program ONLY in the Pallas pass
         self._compile_opts = {"fuse": fuse, "lookahead": lookahead,
-                              "supergate_k": supergate_k, "fusion": fusion}
+                              "supergate_k": supergate_k, "fusion": fusion,
+                              "comm_planner": comm_planner,
+                              "overlap": overlap}
         n = circuit.num_qubits
         if (1 << n) < env.num_devices:   # register smaller than the mesh
             sharding = None
@@ -1274,6 +1303,18 @@ class CompiledCircuit:
         self._pallas_interpret = interpret
         use_layers = enabled and (n - shard_bits) >= 7
 
+        # communication-aware planner: on by default wherever there is a
+        # mesh to communicate over; ``comm_planner=False`` pins the
+        # count-based legacy planner (the bench's planner-off rows).
+        comm_on = (comm_planner if comm_planner is not None else True) \
+            and shard_bits > 0
+        from .profiling import comm_model as _get_comm_model
+        cost_model = _get_comm_model(env) if comm_on else None
+        chunk_bytes = 2.0 * np.dtype(env.precision.real_dtype).itemsize \
+            * (1 << (n - shard_bits))
+        self._chunk_bytes = chunk_bytes
+        self._cost_model = cost_model
+
         # gate-fusion pass (core/fusion.py): record -> FUSE -> plan ->
         # lower. Runs of adjacent gates contract into single dense
         # kernels / folded diagonal factors BEFORE layout planning, so
@@ -1281,70 +1322,109 @@ class CompiledCircuit:
         # XLA dispatches one kernel where it used to dispatch a ladder.
         # Clamped local-fit-aware (a fused gate must stay gatherable on
         # one chunk); layer-eligible runs are fenced when the Pallas
-        # pass will claim them more cheaply.
+        # pass will claim them more cheaply, and SWAP gates are fenced
+        # when the communication planner will absorb them for free.
         from .core.fusion import fuse_ops, resolve_fusion_k
-        recorded = list(circuit.ops)
-        self.fusion_stats = None
-        k_fuse = resolve_fusion_k(fusion, n - shard_bits)
-        if k_fuse >= 2:
-            recorded, self.fusion_stats = fuse_ops(
-                recorded, max_k=k_fuse,
-                diag_row_cap=3 if use_layers else -1,
-                barrier=_layer_barrier(recorded, n, shard_bits)
-                if use_layers else None)
+        from .parallel.layout import is_swap_op
 
-        # schedule gate positions over the mesh: lazy logical->physical
-        # permutation with batched relayouts (native scheduler when
-        # built, else quest_tpu.parallel.layout)
+        def _fence(base, comm):
+            """Compose the layer barrier with the comm planner's SWAP
+            fence (an absorbed SWAP costs zero; welded into a group it
+            costs a full kernel pass and may force relayouts)."""
+            if not comm:
+                return base
+            if base is None:
+                return is_swap_op
+            return lambda op: is_swap_op(op) or base(op)
+
+        def build_pipeline(comm: bool):
+            """fuse -> schedule -> supergate -> replan, under one planner
+            mode. Returns (ops_table, plan, fusion_stats)."""
+            cm = cost_model if comm else None
+            recorded = list(circuit.ops)
+            fstats = None
+            k_fuse = resolve_fusion_k(fusion, n - shard_bits)
+            if k_fuse >= 2:
+                barrier = _fence(_layer_barrier(recorded, n, shard_bits)
+                                 if use_layers else None, comm)
+                recorded, fstats = fuse_ops(
+                    recorded, max_k=k_fuse,
+                    diag_row_cap=3 if use_layers else -1,
+                    barrier=barrier)
+            ops, plan = _schedule(recorded, n, shard_bits,
+                                  lookahead, fuse,
+                                  diag_row_cap=3 if use_layers else -1,
+                                  cost_model=cm, chunk_bytes=chunk_bytes)
+
+            # super-gate grouping: consecutive static gates collapse into
+            # one k-qubit pass. Layer-eligible gates are fenced off
+            # (barrier) when the Pallas pass is on — the layer kernel
+            # fuses them into a single state pass, strictly cheaper than
+            # any super-gate. On a mesh, diagonal ops stay separate —
+            # they are communication-free at any position, and folding
+            # one into a dense super-gate would force relocalisation it
+            # never needed.
+            replan = False
+            if supergate_k >= 2:
+                k_eff = min(supergate_k, n - shard_bits) if shard_bits \
+                    else supergate_k
+                if k_eff >= 2:
+                    before = len(ops)
+                    ops = _group_supergates(
+                        ops, k_eff, fold_diags=(shard_bits == 0),
+                        barrier=_fence(_layer_barrier(ops, n, shard_bits)
+                                       if use_layers else None, comm))
+                    replan = len(ops) != before
+            if replan:
+                from .parallel import plan_layout
+                plan = plan_layout(ops, n, shard_bits, lookahead=lookahead,
+                                   cost_model=cm, chunk_bytes=chunk_bytes)
+            return ops, plan, fstats
+
         from .parallel import apply_relayout
-        ops, self.plan = _schedule(recorded, n, shard_bits,
-                                   lookahead, fuse,
-                                   diag_row_cap=3 if use_layers else -1)
+        ops, self.plan, self.fusion_stats = build_pipeline(comm_on)
 
-        # super-gate grouping: consecutive static gates collapse into one
-        # k-qubit pass. Layer-eligible gates are fenced off (barrier) when
-        # the Pallas pass is on — the layer kernel fuses them into a
-        # single state pass, strictly cheaper than any super-gate. On a
-        # mesh, diagonal ops stay separate — they are communication-free
-        # at any position, and folding one into a dense super-gate would
-        # force relocalisation it never needed.
-        replan = False
-        if supergate_k >= 2:
-            k_eff = min(supergate_k, n - shard_bits) if shard_bits else \
-                supergate_k
-            if k_eff >= 2:
-                before = len(ops)
-                ops = _group_supergates(
-                    ops, k_eff, fold_diags=(shard_bits == 0),
-                    barrier=_layer_barrier(ops, n, shard_bits)
-                    if use_layers else None)
-                replan = len(ops) != before
-        if replan:
-            from .parallel import plan_layout
-            self.plan = plan_layout(ops, n, shard_bits, lookahead=lookahead)
+        # comm accounting is LAZY (first dispatch_stats() call): the
+        # baseline count-based replan that comm_bytes_saved compares
+        # against would otherwise double every mesh compile's host-side
+        # planning work even when nobody reads the stats. The pipeline
+        # closure is retained for that deferred replan.
+        self._comm_bytes_planned = None
+        self._comm_bytes_saved = 0.0
+        self._baseline_pipeline = build_pipeline if comm_on else None
+
         if use_layers:
             from .parallel.layout import LayoutPlan
             items, ops = _collect_layers_plan(self.plan.items, ops,
                                               n - shard_bits)
             # prune the table to executed ops (fused members are
             # superseded by their LayerOp) so _ops remains the program
-            ref = sorted({it[1] for it in items if it[0] == "op"})
+            ref = sorted({it[1] for it in items
+                          if it[0] in ("op", "xshard")})
             remap = {old: new for new, old in enumerate(ref)}
             ops = [ops[i] for i in ref]
-            items = [("op", remap[it[1]], *it[2:]) if it[0] == "op" else it
+            items = [(it[0], remap[it[1]], *it[2:])
+                     if it[0] in ("op", "xshard") else it
                      for it in items]
             self.plan = LayoutPlan(items, n, shard_bits,
-                                   self.plan.num_relayouts)
+                                   self.plan.num_relayouts,
+                                   num_xshard=self.plan.num_xshard,
+                                   swaps_absorbed=self.plan.swaps_absorbed,
+                                   collectives_fused=self.plan
+                                   .collectives_fused)
 
         self._ops = ops
+        self._overlapped_pairs = 0
         plan_items = self.plan.items
         flat_sharding = env.sharding_flat() if shard_bits else None
 
         def run_plan_seq(state, params):
             """Sequential (single-trace) form: relayouts as plain
-            transposes, no collectives. The compiled path on a mesh uses
-            the shard_map program instead; this form serves vmapped uses
-            (sweep), where the BATCH axis is the parallel axis and
+            transposes, no collectives (a cross-shard pair-exchange item
+            is just the unitary at its physical position here — the
+            full-state form reaches any bit). The compiled path on a mesh
+            uses the shard_map program instead; this form serves vmapped
+            uses (sweep), where the BATCH axis is the parallel axis and
             collectives inside the per-element program cannot be
             vmapped."""
             for item in plan_items:
@@ -1375,10 +1455,14 @@ class CompiledCircuit:
         if shard_bits:
             # the distributed fast path: ONE shard_map program — local
             # kernels on per-device chunks, relayouts as explicit
-            # all_to_all/ppermute pair exchanges (parallel/exchange.py).
+            # all_to_all/ppermute pair exchanges (parallel/exchange.py),
+            # cross-shard 1q items as role-split ppermute combines.
             # GSPMD never sees a transpose it could rematerialize.
             from .parallel.exchange import (plan_exchange, run_exchange,
-                                            apply_op_local)
+                                            apply_op_local,
+                                            apply_1q_cross_shard,
+                                            overlap_eligible,
+                                            run_exchange_overlapped)
             from .env import AMP_AXIS
             from jax.sharding import PartitionSpec as P
             lt = n - shard_bits
@@ -1386,14 +1470,53 @@ class CompiledCircuit:
                         if item[0] == "relayout" else None
                         for item in plan_items]
 
+            # comm/compute overlap (opt-in): a relayout immediately
+            # followed by the dense kernel it localises runs as the slab
+            # double-buffered pipeline — the collective for slab i+1 is
+            # independent of the gate math on slab i, so async-collective
+            # backends overlap them. Pairs are chosen at trace-setup time
+            # (static plan), with strict eligibility (no post-transpose,
+            # gate must not touch the slab bit).
+            overlapped = set()
+            if overlap:
+                for j, item in enumerate(plan_items):
+                    if item[0] != "relayout" or j + 1 >= len(plan_items):
+                        continue
+                    nxt = plan_items[j + 1]
+                    if nxt[0] != "op" or \
+                            getattr(ops[nxt[1]], "kind", None) != "u":
+                        continue
+                    if overlap_eligible(ex_plans[j], nxt[2], nxt[3]):
+                        overlapped.add(j)
+            self._overlapped_pairs = len(overlapped)
+
             def local_body(local, params):
-                for item, expl in zip(plan_items, ex_plans):
+                consumed = False
+                for j, (item, expl) in enumerate(zip(plan_items, ex_plans)):
+                    if consumed:
+                        consumed = False
+                        continue
                     if item[0] == "relayout":
+                        if j in overlapped:
+                            _, i, pt, cmask, fmask, _ = plan_items[j + 1]
+                            op = ops[i]
+                            u = op.mat_fn(params) if op.mat_fn is not None \
+                                else op.mat
+                            local = run_exchange_overlapped(
+                                local, expl, AMP_AXIS, u, pt, cmask, fmask)
+                            consumed = True
+                            continue
                         local = run_exchange(local, expl, AMP_AXIS)
                         continue
                     _, i, phys_targets, cmask, fmask, axis_order = item
                     op = ops[i]
-                    if op.kind == "layer":
+                    if item[0] == "xshard":
+                        u = op.mat_fn(params) if op.mat_fn is not None \
+                            else op.mat
+                        local = apply_1q_cross_shard(
+                            local, u, phys_targets[0], lt, shard_bits,
+                            AMP_AXIS, cmask, fmask)
+                    elif op.kind == "layer":
                         from .ops import pallas_kernels as pk
                         local = pk.apply_layer(
                             local, lt, op,
@@ -1561,11 +1684,35 @@ class CompiledCircuit:
     def dispatch_stats(self):
         """Compile-time dispatch accounting (:class:`quest_tpu.profiling.
         DispatchStats`): recorded gates in, kernels out, planned
-        relayouts, and the gate-fusion pass's per-group counters. The
-        observable the fusion engine optimises — ``bench.py`` emits these
-        fields next to gates/sec."""
+        relayouts, the gate-fusion pass's per-group counters, and the
+        communication planner's accounting (cross-shard pair exchanges,
+        absorbed SWAPs, fused collectives, modeled collective bytes
+        planned/saved). The observables the fusion engine and the comm
+        planner optimise — ``bench.py`` emits these fields next to
+        gates/sec."""
         from .profiling import DispatchStats
         fs = self.fusion_stats
+        if self._comm_bytes_planned is None:
+            # deferred comm accounting: modeled bytes of the active plan,
+            # and — when the comm planner chose it — a count-based replan
+            # of the same circuit as the comm_bytes_saved baseline
+            # (host-side only; cached after the first call)
+            planned = 0.0
+            saved = 0.0
+            if self.plan.shard_bits:
+                from .parallel.layout import plan_comm_stats
+                from .profiling import DEFAULT_COMM_MODEL
+                model = self._cost_model or DEFAULT_COMM_MODEL
+                planned = plan_comm_stats(
+                    self.plan, self._chunk_bytes, model,
+                    self.env.num_devices)["bytes"]
+                if self._baseline_pipeline is not None:
+                    _, base_plan, _ = self._baseline_pipeline(False)
+                    base = plan_comm_stats(base_plan, self._chunk_bytes,
+                                           model, self.env.num_devices)
+                    saved = max(0.0, base["bytes"] - planned)
+            self._comm_bytes_planned = planned
+            self._comm_bytes_saved = saved
         return DispatchStats(
             gates_in=self.circuit.depth,
             kernels_out=self.plan.num_kernels,
@@ -1573,7 +1720,12 @@ class CompiledCircuit:
             fused_groups=fs.fused_groups if fs else 0,
             diag_folds=fs.diag_folds if fs else 0,
             commuted_diagonals=fs.commuted_diagonals if fs else 0,
-            max_group_gates=fs.max_group_gates if fs else 0)
+            max_group_gates=fs.max_group_gates if fs else 0,
+            cross_shard_exchanges=self.plan.num_xshard,
+            swaps_absorbed=self.plan.swaps_absorbed,
+            collectives_fused=self.plan.collectives_fused,
+            comm_bytes_planned=self._comm_bytes_planned,
+            comm_bytes_saved=self._comm_bytes_saved)
 
     def _xla_only(self) -> "CompiledCircuit":
         """This program with the Pallas layer pass off (cached twin).

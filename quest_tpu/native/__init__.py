@@ -108,6 +108,19 @@ def load() -> Optional[ctypes.CDLL]:
     lib.qsched_num_items.argtypes = [ctypes.c_void_p]
     lib.qsched_num_relayouts.restype = ctypes.c_int
     lib.qsched_num_relayouts.argtypes = [ctypes.c_void_p]
+    # communication-aware planner ABI (absent from pre-cost-model builds;
+    # the mtime check rebuilds a stale .so, so absence only means the
+    # source itself predates the feature)
+    if hasattr(lib, "qsched_set_cost_model"):
+        lib.qsched_set_cost_model.restype = None
+        lib.qsched_set_cost_model.argtypes = [
+            ctypes.c_void_p, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double]
+        for name in ("qsched_num_xshard", "qsched_num_swaps_absorbed",
+                     "qsched_num_fused_collectives"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p]
     lib.qsched_item_info.restype = ctypes.c_int
     lib.qsched_item_info.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
@@ -125,6 +138,13 @@ def load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return load() is not None
+
+
+def supports_cost_model() -> bool:
+    """True when the loaded scheduler library exposes the
+    communication-aware planner ABI (``qsched_set_cost_model``)."""
+    lib = load()
+    return lib is not None and hasattr(lib, "qsched_set_cost_model")
 
 
 class NativeScheduler:
@@ -159,6 +179,18 @@ class NativeScheduler:
         return self._lib.qsched_add_op(
             self._h, kind, len(targets), t, ctrl_mask, flip_mask, d,
             source_index)
+
+    def set_cost_model(self, alpha_s: float, beta_s_per_byte: float,
+                       chunk_bytes: float) -> None:
+        """Enable the communication-aware planner (call before
+        :meth:`compile`); parameters mirror
+        :class:`quest_tpu.profiling.CommCostModel`."""
+        if not hasattr(self._lib, "qsched_set_cost_model"):
+            raise RuntimeError("scheduler library predates the cost-model "
+                               "ABI; rebuild native/src/scheduler.cc")
+        self._lib.qsched_set_cost_model(self._h, float(alpha_s),
+                                        float(beta_s_per_byte),
+                                        float(chunk_bytes))
 
     def compile(self, num_qubits: int, shard_bits: int, lookahead: int,
                 fusion: bool, diag_row_cap: int = -1) -> None:
@@ -207,15 +239,21 @@ class NativeScheduler:
             nt = ctypes.c_int()
             cm = ctypes.c_int64()
             fm = ctypes.c_int64()
-            is_re = self._lib.qsched_item_info(
+            kind = self._lib.qsched_item_info(
                 self._h, i, ctypes.byref(oi), ctypes.byref(nt),
                 ctypes.byref(cm), ctypes.byref(fm))
-            if is_re:
+            if kind == 1:
                 before = (ctypes.c_int * num_qubits)()
                 after = (ctypes.c_int * num_qubits)()
                 self._lib.qsched_item_perms(self._h, i, before, after)
                 out.append(("relayout", np.array(before, dtype=np.int64),
                             np.array(after, dtype=np.int64)))
+            elif kind == 2:
+                targets = (ctypes.c_int * nt.value)()
+                axis_order = (ctypes.c_int * nt.value)()
+                self._lib.qsched_item_targets(self._h, i, targets, axis_order)
+                out.append(("xshard", oi.value, tuple(targets), cm.value,
+                            fm.value, None))
             else:
                 targets = (ctypes.c_int * nt.value)()
                 axis_order = (ctypes.c_int * nt.value)()
@@ -226,3 +264,18 @@ class NativeScheduler:
 
     def num_relayouts(self) -> int:
         return self._lib.qsched_num_relayouts(self._h)
+
+    def num_xshard(self) -> int:
+        if not hasattr(self._lib, "qsched_num_xshard"):
+            return 0
+        return self._lib.qsched_num_xshard(self._h)
+
+    def num_swaps_absorbed(self) -> int:
+        if not hasattr(self._lib, "qsched_num_swaps_absorbed"):
+            return 0
+        return self._lib.qsched_num_swaps_absorbed(self._h)
+
+    def num_fused_collectives(self) -> int:
+        if not hasattr(self._lib, "qsched_num_fused_collectives"):
+            return 0
+        return self._lib.qsched_num_fused_collectives(self._h)

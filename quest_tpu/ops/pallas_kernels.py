@@ -347,13 +347,15 @@ def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, tre_ref, tim_ref,
     oim_ref[:] = im
 
 
-def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
-                block_rows: int = DEFAULT_BLOCK_ROWS,
-                interpret: bool = False) -> jnp.ndarray:
-    """Apply a fused layer to a flat complex state (traceable; call under
-    jit — the pallas_call compiles into the surrounding program)."""
-    from jax.experimental import pallas as pl
+def layer_kernel_plan(layer: LayerOp, num_qubits: int,
+                      block_rows: int = DEFAULT_BLOCK_ROWS):
+    """The static kernel plan for one fused layer: validated stage
+    descriptors plus the stacked matrix/table operands. Shared by
+    :func:`apply_layer` and the VMEM-budget tests (which need the EXACT
+    per-chip stage chains the collector emits, without tracing).
 
+    Returns ``(kstages, mats, tables, block_rows, total_rows)``.
+    """
     total_rows = (1 << num_qubits) // 128
     if total_rows < 1:
         raise ValueError("fused layers need at least 7 qubits")
@@ -403,6 +405,41 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
             kstages.append(("rowdiag", len(tables), tuple(int(b)
                                                           for b in bits)))
             tables.extend(np.asarray(table))
+    return kstages, mats, tables, block_rows, total_rows
+
+
+def choose_block_rows(kstages, mstack, tstack, block_rows: int,
+                      itemsize: int, vmem_limit: int) -> tuple[int, int]:
+    """Shrink ``block_rows`` until the Mosaic working-set estimate fits
+    ``vmem_limit`` (halving trades grid steps for VMEM), respecting the
+    pairing floor: a row stage pairing rows at ``stride`` needs its whole
+    ``2*stride`` pair group inside one block — never shrink below that
+    (the collector validated targets against the PRE-shrink hi).
+
+    Returns ``(block_rows, estimated_bytes)`` — the estimate may still
+    exceed the limit when the floor binds.
+    """
+    min_block = max([2 * st[1] for st in kstages if st[0] == "row"]
+                    + [2 << st[1][-1] for st in kstages
+                       if st[0] == "rowk" and st[1]],
+                    default=8)
+    est = _vmem_estimate(block_rows, kstages, mstack, tstack, itemsize)
+    while block_rows > max(8, min_block) and est > vmem_limit:
+        block_rows //= 2
+        est = _vmem_estimate(block_rows, kstages, mstack, tstack,
+                             itemsize)
+    return block_rows, est
+
+
+def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
+                block_rows: int = DEFAULT_BLOCK_ROWS,
+                interpret: bool = False) -> jnp.ndarray:
+    """Apply a fused layer to a flat complex state (traceable; call under
+    jit — the pallas_call compiles into the surrounding program)."""
+    from jax.experimental import pallas as pl
+
+    kstages, mats, tables, block_rows, total_rows = layer_kernel_plan(
+        layer, num_qubits, block_rows)
 
     rdtype = jnp.float32 if state.dtype == jnp.complex64 else jnp.float64
     re = jnp.real(state).astype(rdtype).reshape(total_rows, 128)
@@ -422,22 +459,12 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
     # against the 16 MB default limit on real v5e silicon (r5 tunnel,
     # HTTP-500 from the compile helper). Raise the limit toward the
     # chip's real VMEM and, if the estimate still exceeds it, halve the
-    # block until it fits — smaller blocks trade grid steps for VMEM.
+    # block until it fits (choose_block_rows).
     itemsize = np.dtype(rdtype).itemsize
     vmem_limit = int(os.environ.get("QUEST_PALLAS_VMEM_LIMIT",
                                     100 * 1024 * 1024))
-    # floor: a row stage pairing rows at `stride` needs its whole
-    # 2*stride pair group inside one block — never shrink below that
-    # (the collector validated targets against the PRE-shrink hi)
-    min_block = max([2 * st[1] for st in kstages if st[0] == "row"]
-                    + [2 << st[1][-1] for st in kstages
-                       if st[0] == "rowk" and st[1]],
-                    default=8)
-    est = _vmem_estimate(block_rows, kstages, mstack, tstack, itemsize)
-    while block_rows > max(8, min_block) and est > vmem_limit:
-        block_rows //= 2
-        est = _vmem_estimate(block_rows, kstages, mstack, tstack,
-                             itemsize)
+    block_rows, _ = choose_block_rows(kstages, mstack, tstack, block_rows,
+                                      itemsize, vmem_limit)
     kernel = functools.partial(_layer_kernel, stages=tuple(kstages),
                                block_rows=block_rows)
     state_spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))

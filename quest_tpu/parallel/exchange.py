@@ -47,7 +47,8 @@ from jax import lax
 from ..core.apply import apply_unitary, apply_diagonal
 
 __all__ = ["ExchangePlan", "plan_exchange", "run_exchange",
-           "apply_op_local", "apply_1q_cross_shard"]
+           "apply_op_local", "apply_1q_cross_shard",
+           "overlap_eligible", "run_exchange_overlapped", "slab_remap"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,6 +239,92 @@ def apply_op_local(local: jnp.ndarray, kind: str, operand: jnp.ndarray,
         if not loc_pos:
             return local * d.astype(local.dtype)
     return apply_diagonal(local, lt, loc_pos, d)
+
+
+def overlap_eligible(plan: ExchangePlan, phys_targets: tuple,
+                     ctrl_mask: int, slab_bits: int = 1) -> bool:
+    """True when a relayout + following dense gate can run as the slab
+    double-buffered pipeline of :func:`run_exchange_overlapped`.
+
+    The slab axis is carved out of the TOP ``slab_bits`` column bits of
+    the ``(2^k, columns)`` exchange view — physical positions
+    ``[lt-k-slab_bits, lt-k)`` — so the gate must not target or condition
+    on those positions (staging slots and low positions are fine, device
+    bits are fine), the exchange must actually move data (``k >= 1``) and
+    leave no post-transpose (the planner's three-way staging guarantees
+    this on its own relayouts), and at least one column bit must remain
+    below the slab."""
+    lt = plan.local_top
+    k = plan.k
+    if k < 1 or plan.post_axes is not None:
+        return False
+    if lt - k - slab_bits <= 0:
+        return False      # >= 1 column bit must remain below the slab
+    slab_lo, slab_hi = lt - k - slab_bits, lt - k
+    if any(slab_lo <= p < slab_hi for p in phys_targets):
+        return False
+    if any((ctrl_mask >> p) & 1 for p in range(slab_lo, slab_hi)):
+        return False
+    return True
+
+
+def slab_remap(pos: int, lt: int, k: int, slab_bits: int = 1) -> int:
+    """Physical position inside one slab's reduced ``lt - slab_bits``-qubit
+    coordinate system: low column bits keep their position, staging and
+    device bits shift down by the carved-out slab bits."""
+    return pos - slab_bits if pos >= lt - k else pos
+
+
+def _slab_mask(mask: int, lt: int, k: int, slab_bits: int) -> int:
+    out = 0
+    p = 0
+    m = mask
+    while m:
+        if m & 1:
+            out |= 1 << slab_remap(p, lt, k, slab_bits)
+        m >>= 1
+        p += 1
+    return out
+
+
+def run_exchange_overlapped(local: jnp.ndarray, plan: ExchangePlan,
+                            axis_name: str, u: jnp.ndarray,
+                            phys_targets: tuple, ctrl_mask: int,
+                            flip_mask: int, slab_bits: int = 1
+                            ) -> jnp.ndarray:
+    """One relayout fused with the dense gate it serves, double-buffered
+    over ``2^slab_bits`` slabs of the chunk.
+
+    The reference's distributed path serializes exchange and compute
+    (``exchangeStateVectors`` then the local kernel,
+    ``QuEST_cpu_distributed.c:843-878``); here the chunk is split into
+    slabs along a column bit untouched by both the exchange and the gate,
+    and each slab's ``all_to_all`` is issued independently of every other
+    slab's gate kernel — so XLA's async collectives can put slab ``i+1``'s
+    exchange on the wire while slab ``i``'s gate math runs. Caller must
+    have checked :func:`overlap_eligible`."""
+    lt = plan.local_top
+    k = plan.k
+    if plan.pre_axes is not None:
+        local = local.reshape((2,) * lt).transpose(plan.pre_axes).reshape(-1)
+    y = local.reshape(1 << k, -1)
+    nslabs = 1 << slab_bits
+    m = y.shape[1] // nslabs
+    lt_slab = lt - slab_bits
+    tgt = tuple(slab_remap(p, lt, k, slab_bits) for p in phys_targets)
+    cm = _slab_mask(ctrl_mask, lt, k, slab_bits)
+    fm = _slab_mask(flip_mask, lt, k, slab_bits)
+    outs = []
+    for j in range(nslabs):
+        slab = y[:, j * m:(j + 1) * m]
+        slab = lax.all_to_all(slab, axis_name, 0, 0,
+                              axis_index_groups=plan.groups, tiled=True)
+        if plan.device_perm is not None:
+            slab = lax.ppermute(slab, axis_name, plan.device_perm)
+        z = apply_op_local(slab.reshape(-1), "u", u, tgt, cm, fm,
+                           lt_slab, axis_name)
+        outs.append(z.reshape(1 << k, m))
+    return jnp.concatenate(outs, axis=1).reshape(-1)
 
 
 def apply_1q_cross_shard(local: jnp.ndarray, u: jnp.ndarray, position: int,

@@ -30,6 +30,34 @@ Here the whole circuit is known at compile time, so layout becomes a
 A circuit touching high qubits every layer thus costs one all-to-all per
 *batch* of high-qubit gates rather than two exchanges per gate — the same
 economics as ring-attention's rotate-once-per-block schedule.
+
+**Communication-aware mode** (``cost_model`` given): the planner prices
+every candidate data movement in modeled collective seconds
+(:class:`quest_tpu.profiling.CommCostModel`) and minimizes comm time
+rather than relayout count:
+
+- an uncontrolled static SWAP gate is *absorbed* into the permutation —
+  pure bookkeeping, zero bytes — so the pair exchange the reference pays
+  per ``statevec_swapQubitAmps`` (``QuEST_cpu_distributed.c:1355-1371``)
+  vanishes entirely and the program-end relayout realizes the whole
+  accumulated permutation in one collective;
+- a 1q dense gate on a sharded position with no further paired use inside
+  the lookahead window rides the role-split pair exchange
+  (``("xshard", ...)`` item → ``apply_1q_cross_shard``) whenever one
+  whole-chunk ``ppermute`` is modeled cheaper than the localise+restore
+  relayout pair it replaces — layout unchanged, one collective instead of
+  two;
+- adjacent relayouts whose intervening ops stay executable under the
+  composed permutation merge into ONE exchange
+  (:func:`_compose_relayouts`) when the composed collective is modeled no
+  slower than the pair — the "back-to-back relayouts compose" rule.
+
+Window-prefetch decisions need no per-case pricing: growing a k-bit
+exchange to k+1 bits costs ``chunk/2^(k+2)`` extra bytes while a deferred
+standalone relayout costs at least ``alpha + chunk/2`` — marginal prefetch
+is monotonically cheaper for every k, so the Belady window rule is already
+the cost model's optimum and is kept bit-for-bit identical to the
+count-based mode.
 """
 
 from __future__ import annotations
@@ -41,7 +69,22 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["LayoutPlan", "plan_layout", "apply_relayout"]
+__all__ = ["LayoutPlan", "plan_layout", "apply_relayout", "is_swap_op",
+           "plan_comm_stats", "relayout_comm"]
+
+_SWAP_MAT = np.array([[1, 0, 0, 0], [0, 0, 1, 0],
+                      [0, 1, 0, 0], [0, 0, 0, 1]], dtype=np.complex128)
+
+
+def is_swap_op(op) -> bool:
+    """True for a static, uncontrolled 2-qubit SWAP gate — the ops the
+    communication-aware planner absorbs into the layout permutation."""
+    return (getattr(op, "kind", None) == "u"
+            and getattr(op, "mat", None) is not None
+            and getattr(op, "mat_fn", None) is None
+            and op.ctrl_mask == 0 and len(op.targets) == 2
+            and op.mat.shape == (4, 4)
+            and bool(np.abs(op.mat - _SWAP_MAT).max() <= 1e-12))
 
 
 @dataclasses.dataclass
@@ -52,12 +95,19 @@ class LayoutPlan:
        diag_axis_order)`` — run op ``op_index`` at physical positions;
     - ``("relayout", perm_before, perm_after)`` — transpose the state so the
       qubit at physical position ``perm_before[l]`` moves to
-      ``perm_after[l]`` for each logical qubit ``l``.
+      ``perm_after[l]`` for each logical qubit ``l``;
+    - ``("xshard", op_index, (phys_position,), phys_ctrl_mask,
+       phys_flip_mask, None)`` — run 1q op ``op_index`` on a device-index
+      bit via the role-split pair exchange (communication-aware mode
+      only; ``parallel/exchange.py:apply_1q_cross_shard``).
     """
     items: list
     num_qubits: int
     shard_bits: int
     num_relayouts: int
+    num_xshard: int = 0          # cross-shard 1q pair-exchange items
+    swaps_absorbed: int = 0      # SWAP gates folded into the permutation
+    collectives_fused: int = 0   # relayout pairs merged into one exchange
 
     @property
     def num_kernels(self) -> int:
@@ -70,8 +120,8 @@ class LayoutPlan:
 
     @property
     def num_dispatches(self) -> int:
-        """Kernels plus relayout exchanges — total device dispatches."""
-        return self.num_kernels + self.num_relayouts
+        """Kernels plus relayout/pair exchanges — total device dispatches."""
+        return self.num_kernels + self.num_relayouts + self.num_xshard
 
 
 def _phys_diag_order(op_targets_desc_logical: tuple[int, ...],
@@ -90,7 +140,8 @@ def _phys_diag_order(op_targets_desc_logical: tuple[int, ...],
 
 
 def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
-                lookahead: int = 32) -> LayoutPlan:
+                lookahead: int = 32, cost_model=None,
+                chunk_bytes: float = 0.0) -> LayoutPlan:
     """Schedule ``ops`` (quest_tpu.circuits._Op sequence) over a mesh that
     shards the top ``shard_bits`` physical positions.
 
@@ -103,9 +154,20 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
     relayout decisions (and the ``lookahead`` window) are group-granular:
     one all-to-all serves every source gate inside the groups it
     localises.
+
+    ``cost_model`` (a :class:`quest_tpu.profiling.CommCostModel`) switches
+    on the communication-aware mode (see module docstring): SWAP
+    absorption, cross-shard 1q pair-exchange items, and collective
+    composition, each priced in modeled seconds against ``chunk_bytes``
+    (the per-device chunk payload; defaults to 16 B/amplitude when not
+    given). ``cost_model=None`` reproduces the count-based planner
+    bit-for-bit.
     """
     n = num_qubits
     local_top = n - shard_bits  # phys positions >= local_top are sharded
+    comm_aware = cost_model is not None and shard_bits > 0
+    if comm_aware and chunk_bytes <= 0.0:
+        chunk_bytes = 16.0 * (1 << local_top)
     if shard_bits == 0:
         items = []
         ident = np.arange(n)
@@ -113,7 +175,10 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
             items.append(_op_item(i, op, ident))
         return LayoutPlan(items, n, 0, 0)
 
-    max_k = max((len(op.targets) for op in ops if op.kind == "u"), default=0)
+    absorbable = [comm_aware and is_swap_op(op) for op in ops]
+
+    max_k = max((len(op.targets) for i, op in enumerate(ops)
+                 if op.kind == "u" and not absorbable[i]), default=0)
     if max_k > local_top:
         raise ValueError(
             f"a {max_k}-qubit unitary cannot be localised with "
@@ -130,44 +195,107 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
             return ()
         return op.targets
 
-    # next use index (as a target of a paired op) per logical qubit
+    # next use index (as a target of a paired op) per logical qubit;
+    # absorbed SWAPs never demand locality, so they are not uses
     INF = len(ops) + 1
     next_use = np.full((len(ops) + 1, n), INF, dtype=np.int64)
     for i in range(len(ops) - 1, -1, -1):
         next_use[i] = next_use[i + 1]
-        for q in used_qubits(ops[i]):
-            next_use[i, q] = i
+        if not absorbable[i]:
+            for q in used_qubits(ops[i]):
+                next_use[i, q] = i
 
     perm = np.arange(n)  # perm[logical] = physical
     items: list = []
     n_relayouts = 0
+    n_xshard = 0
+    n_absorbed = 0
 
     for i, op in enumerate(ops):
+        if absorbable[i]:
+            # SWAP = pure relabeling: exchange the two physical positions
+            # in the bookkeeping, move zero amplitudes. The data movement
+            # (if any is ever needed) rides the next planned relayout.
+            a, b = op.targets
+            perm[a], perm[b] = perm[b], perm[a]
+            n_absorbed += 1
+            continue
         used = used_qubits(op)
+        if (comm_aware and op.kind == "u" and len(op.targets) == 1
+                and perm[op.targets[0]] >= local_top):
+            # lone sharded 1q gate: one whole-chunk ppermute (role-split
+            # combine) vs the localise+restore relayout pair it would
+            # otherwise cost. Worth it only when this gate is the SOLE
+            # sharded demand inside the lookahead window — any other
+            # sharded use there means a relayout is coming anyway and
+            # amortizes over everything the window prefetches, making the
+            # marginal cost of localising this qubit ~chunk/2^(k+1)
+            # instead of a whole-chunk ppermute.
+            t = op.targets[0]
+            wend = min(i + lookahead, len(ops))
+            sole = True
+            # scan under a SCRATCH perm that applies the window's
+            # absorbed SWAPs as they pass: a later gate's locality is
+            # decided by where its label will sit THEN, not now
+            wp = perm.copy()
+            for j in range(i, wend):
+                if absorbable[j]:
+                    a2, b2 = ops[j].targets
+                    wp[a2], wp[b2] = wp[b2], wp[a2]
+                    continue
+                for q in used_qubits(ops[j]):
+                    if wp[q] >= local_top and (j != i or q != t):
+                        sole = False
+                        break
+                if not sole:
+                    break
+            if (sole and cost_model.ppermute_seconds(chunk_bytes)
+                    <= 2.0 * cost_model.all_to_all_seconds(chunk_bytes, 1)):
+                cm, fm = _phys_masks_of(op, perm)
+                items.append(("xshard", i, (int(perm[t]),), cm, fm, None))
+                n_xshard += 1
+                continue
         if used and any(perm[q] >= local_top for q in used):
             # everything this op needs now (its sharded targets)
             need_now = [t for t in op.targets if perm[t] >= local_top]
-            # plus sharded qubits used in the lookahead window (prefetch)
-            window_hot = []
+            # plus sharded DATA used in the lookahead window (prefetch).
+            # The scan runs under a scratch perm that applies absorbed
+            # SWAPs as they pass: a gate at j needs its label local THEN,
+            # and the data serving it is whatever CURRENT label occupies
+            # that future position (inv[wp[q]]) — with no absorbable ops
+            # this reduces exactly to the label itself with serving index
+            # next_use[i, q], i.e. the legacy scan bit-for-bit.
+            window_hot = []               # (current label, serving index)
+            wp = perm.copy()
+            inv = np.empty(n, dtype=np.int64)
+            inv[perm] = np.arange(n)
+            seen = set(need_now)
             for j in range(i, min(i + lookahead, len(ops))):
+                if absorbable[j]:
+                    a2, b2 = ops[j].targets
+                    wp[a2], wp[b2] = wp[b2], wp[a2]
+                    continue
                 for q in used_qubits(ops[j]):
-                    if (perm[q] >= local_top and q not in window_hot
-                            and q not in need_now):
-                        window_hot.append(q)
+                    if wp[q] >= local_top:
+                        hot = int(inv[wp[q]])
+                        if hot not in seen:
+                            window_hot.append((hot, j))
+                            seen.add(hot)
             # victims: local positions not used by this op, farthest next
             # use first (Belady)
             locals_ = [(int(next_use[i, l]), l)
                        for l in range(n)
                        if perm[l] < local_top and l not in used]
             locals_.sort(reverse=True)
+            need_set = set(need_now)
             new_perm = perm.copy()
             vi = 0
-            for q in need_now + window_hot:
+            for q, nu_q in [(q, -1) for q in need_now] + window_hot:
                 if vi >= len(locals_):
                     break
                 nu_victim, victim = locals_[vi]
                 # window prefetches must not evict a sooner-used qubit
-                if q not in need_now and next_use[i, q] >= nu_victim:
+                if q not in need_set and nu_q >= nu_victim:
                     continue
                 # three-way rotation landing the incoming qubit at a TOP
                 # local position (the all_to_all staging slot,
@@ -192,23 +320,37 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
         items.append(("relayout", perm.copy(), np.arange(n)))
         n_relayouts += 1
 
-    return LayoutPlan(items, n, shard_bits, n_relayouts)
+    n_fused = 0
+    if comm_aware:
+        items, n_merged, n_dropped = _compose_relayouts(
+            items, n, local_top, cost_model, chunk_bytes)
+        n_relayouts -= n_dropped
+        n_fused = n_merged
+
+    return LayoutPlan(items, n, shard_bits, n_relayouts,
+                      num_xshard=n_xshard, swaps_absorbed=n_absorbed,
+                      collectives_fused=n_fused)
+
+
+def _phys_masks_of(op, perm: np.ndarray) -> tuple[int, int]:
+    ctrl_mask = 0
+    flip_mask = 0
+    m = op.ctrl_mask
+    q = 0
+    while m:
+        if m & 1:
+            ctrl_mask |= 1 << int(perm[q])
+            if (op.flip_mask >> q) & 1:
+                flip_mask |= 1 << int(perm[q])
+        m >>= 1
+        q += 1
+    return ctrl_mask, flip_mask
 
 
 def _op_item(i: int, op, perm: np.ndarray):
     if op.kind == "u":
         phys_targets = tuple(int(perm[t]) for t in op.targets)
-        ctrl_mask = 0
-        flip_mask = 0
-        m = op.ctrl_mask
-        q = 0
-        while m:
-            if m & 1:
-                ctrl_mask |= 1 << int(perm[q])
-                if (op.flip_mask >> q) & 1:
-                    flip_mask |= 1 << int(perm[q])
-            m >>= 1
-            q += 1
+        ctrl_mask, flip_mask = _phys_masks_of(op, perm)
         return ("op", i, phys_targets, ctrl_mask, flip_mask, None)
     phys_desc, axis_order = _phys_diag_order(op.targets, perm)
     return ("op", i, phys_desc, 0, 0, axis_order)
@@ -232,3 +374,158 @@ def apply_relayout(state: jnp.ndarray, num_qubits: int,
     if sharding is not None:
         out = jax.lax.with_sharding_constraint(out, sharding)
     return out
+
+
+# ---------------------------------------------------------------------------
+# communication accounting + collective composition (cost-aware mode)
+# ---------------------------------------------------------------------------
+
+def _relayout_sigma(perm_before, perm_after, n: int) -> np.ndarray:
+    """The physical permutation a relayout realizes: position
+    ``perm_before[l]`` moves to ``perm_after[l]``."""
+    sigma = np.empty(n, dtype=np.int64)
+    for b, a in zip(perm_before, perm_after):
+        sigma[int(b)] = int(a)
+    return sigma
+
+
+def relayout_comm(sigma: np.ndarray, local_top: int,
+                  chunk_bytes: float, cost_model) -> tuple[float, float, int]:
+    """(seconds, per-device bytes, collective launches) for one relayout
+    realizing physical permutation ``sigma``, under the closed-form
+    choreography of :func:`quest_tpu.parallel.exchange.plan_exchange`:
+    one ``all_to_all`` over the ``k`` exchanged bits plus a whole-chunk
+    ``ppermute`` iff a residual device-bit permutation remains (a staying
+    device bit moves, or an exchanged bit cannot land in its destined
+    slot — ``sigma(sigma(p))`` still a device bit)."""
+    n = len(sigma)
+    lt = local_top
+    A = [p for p in range(lt) if sigma[p] >= lt]
+    k = len(A)
+    residual = any(sigma[d] != d and sigma[d] >= lt
+                   for d in range(lt, n) if sigma[d] >= lt) \
+        or any(sigma[sigma[p]] >= lt for p in A)
+    seconds = 0.0
+    nbytes = 0.0
+    launches = 0
+    if k:
+        seconds += cost_model.all_to_all_seconds(chunk_bytes, k)
+        nbytes += cost_model.all_to_all_bytes(chunk_bytes, k)
+        launches += 1
+    if residual:
+        seconds += cost_model.ppermute_seconds(chunk_bytes)
+        nbytes += cost_model.ppermute_bytes(chunk_bytes)
+        launches += 1
+    return seconds, nbytes, launches
+
+
+def _remap_mask(mask: int, delta: np.ndarray) -> int:
+    out = 0
+    p = 0
+    while mask:
+        if mask & 1:
+            out |= 1 << int(delta[p])
+        mask >>= 1
+        p += 1
+    return out
+
+
+def _remap_item(item, delta: np.ndarray):
+    """Rewrite an op/xshard item's physical coordinates through the
+    physical permutation ``delta`` (applied early by a composed
+    relayout)."""
+    kind, i, phys, cm, fm, axis_order = item
+    if kind == "xshard" or axis_order is None:
+        new_phys = tuple(int(delta[p]) for p in phys)
+        return (kind, i, new_phys, _remap_mask(cm, delta),
+                _remap_mask(fm, delta), axis_order)
+    # diagonal: remap positions, re-sort descending, compose axis order
+    pairs = sorted(((int(delta[p]), ao) for p, ao in zip(phys, axis_order)),
+                   reverse=True)
+    return (kind, i, tuple(p for p, _ in pairs), 0, 0,
+            tuple(ao for _, ao in pairs))
+
+
+def _compose_relayouts(items: list, n: int, local_top: int,
+                       cost_model, chunk_bytes: float):
+    """Merge adjacent relayouts: for each consecutive pair (R1, R2), R2's
+    permutation ``delta`` is applied early (composed into R1) when every
+    item between stays executable under ``delta`` — dense targets stay
+    chunk-local, pair-exchange positions stay device bits, diagonals run
+    anywhere — and the composed collective is modeled no slower than the
+    pair. A composition that cancels to the identity drops the relayout
+    entirely. Returns ``(items, merges, relayouts_removed)``."""
+    merges = 0
+    removed = 0
+    changed = True
+    while changed:
+        changed = False
+        idxs = [j for j, it in enumerate(items) if it[0] == "relayout"]
+        for a, b in zip(idxs, idxs[1:]):
+            delta = _relayout_sigma(items[b][1], items[b][2], n)
+            ok = True
+            for j in range(a + 1, b):
+                it = items[j]
+                if it[0] == "op":
+                    if it[5] is None and any(int(delta[p]) >= local_top
+                                             for p in it[2]):
+                        ok = False
+                        break
+                elif it[0] == "xshard":
+                    if int(delta[it[2][0]]) < local_top:
+                        ok = False
+                        break
+                else:               # unexpected item kind: leave untouched
+                    ok = False
+                    break
+            if not ok:
+                continue
+            before = np.asarray(items[a][1], dtype=np.int64)
+            after = np.asarray(items[a][2], dtype=np.int64)
+            new_after = np.array([int(delta[p]) for p in after],
+                                 dtype=np.int64)
+            s1 = _relayout_sigma(before, after, n)
+            sc = _relayout_sigma(before, new_after, n)
+            c1 = relayout_comm(s1, local_top, chunk_bytes, cost_model)[0]
+            c2 = relayout_comm(delta, local_top, chunk_bytes, cost_model)[0]
+            cc = relayout_comm(sc, local_top, chunk_bytes, cost_model)[0]
+            if cc > c1 + c2:
+                continue
+            mid = [_remap_item(items[j], delta) for j in range(a + 1, b)]
+            if np.array_equal(before, new_after):
+                head = []           # composition cancelled: pure identity
+                removed += 2
+            else:
+                head = [("relayout", before, new_after)]
+                removed += 1
+            items = items[:a] + head + mid + items[b + 1:]
+            merges += 1
+            changed = True
+            break
+    return items, merges, removed
+
+
+def plan_comm_stats(plan: LayoutPlan, chunk_bytes: float, cost_model,
+                    num_devices: Optional[int] = None) -> dict:
+    """Modeled communication totals for a plan: per-execution collective
+    bytes (mesh-total when ``num_devices`` given, else per-device),
+    modeled seconds, and collective launch count."""
+    if plan.shard_bits == 0:
+        return {"bytes": 0.0, "seconds": 0.0, "launches": 0}
+    lt = plan.num_qubits - plan.shard_bits
+    total_b = total_s = 0.0
+    launches = 0
+    for it in plan.items:
+        if it[0] == "relayout":
+            sigma = _relayout_sigma(it[1], it[2], plan.num_qubits)
+            s, b, l = relayout_comm(sigma, lt, chunk_bytes, cost_model)
+            total_s += s
+            total_b += b
+            launches += l
+        elif it[0] == "xshard":
+            total_s += cost_model.ppermute_seconds(chunk_bytes)
+            total_b += cost_model.ppermute_bytes(chunk_bytes)
+            launches += 1
+    scale = num_devices if num_devices else 1
+    return {"bytes": total_b * scale, "seconds": total_s,
+            "launches": launches}

@@ -27,6 +27,7 @@ cached per static signature like the ``api`` module's jit kernels.
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 import jax
@@ -36,16 +37,27 @@ from ..compat import shard_map
 from ..core.packing import pack, unpack
 from ..env import AMP_AXIS
 from .exchange import (plan_exchange, run_exchange, apply_op_local,
-                       apply_1q_cross_shard)
+                       apply_1q_cross_shard, overlap_eligible,
+                       run_exchange_overlapped)
 
 __all__ = ["use_lazy", "phys_targets", "localise_targets", "canonicalise",
            "sharded_unitary", "sharded_diag", "metadata_swap", "phys_index",
-           "GateFusionBuffer"]
+           "GateFusionBuffer", "overlap_enabled"]
 
 # number of relayout exchanges actually executed (observability/testing:
 # the lazy layout exists to keep this far below the count of gates that
 # touch sharded qubits)
 RELAYOUT_COUNT = 0
+
+
+def overlap_enabled() -> bool:
+    """Opt-in comm/compute overlap for the per-gate path
+    (``QUEST_TPU_OVERLAP=1``): a swap-to-local relayout and the gate
+    kernel it serves fuse into ONE dispatch whose collective is slab
+    double-buffered (``exchange.run_exchange_overlapped``) — the
+    imperative analogue of ``compile(overlap=True)``. Read per call so
+    tests (and users) can flip it at run time."""
+    return os.environ.get("QUEST_TPU_OVERLAP", "0") not in ("0", "", "off")
 
 
 def use_lazy(qureg) -> bool:
@@ -150,6 +162,21 @@ def _relayout_fn(mesh, n, s, before, after):
     return _shard_jit(mesh, body, 0)
 
 
+@functools.lru_cache(maxsize=1024)
+def _relayout_gate_fn(mesh, n, s, before, after, targets, cmask, fmask):
+    """Fused swap-to-local + gate dispatch with the slab double-buffered
+    collective (one shard_map program instead of two; opt-in via
+    ``QUEST_TPU_OVERLAP``)."""
+    plan = plan_exchange(n, s, before, after)
+
+    def body(local_f, u_f):
+        z = run_exchange_overlapped(unpack(local_f), plan, AMP_AXIS,
+                                    unpack(u_f), targets, cmask, fmask)
+        return pack(z)
+
+    return _shard_jit(mesh, body, 1)
+
+
 # ---------------------------------------------------------------------------
 # layout management
 # ---------------------------------------------------------------------------
@@ -172,18 +199,18 @@ def canonicalise(qureg) -> None:
     qureg.layout = None
 
 
-def localise_targets(qureg, targets) -> np.ndarray:
-    """Ensure every logical target sits on a local physical position,
-    emitting at most ONE relayout (targets land on the all_to_all staging
-    slots — the swap-to-local of ``QuEST_cpu_distributed.c:1426-1448``,
-    batched, with the swap-back deferred). Returns the active perm."""
+def _localise_perm(qureg, targets):
+    """The permutation a swap-to-local relayout would realize: every
+    sharded logical target lands on an all_to_all staging slot. Returns
+    ``(perm, new_perm)`` where ``new_perm is None`` when nothing is
+    sharded (no relayout needed)."""
     n = qureg.num_qubits_in_state_vec
     s = _shard_bits(qureg)
     lt = n - s
     perm = _perm(qureg)
     sharded = [t for t in targets if perm[t] >= lt]
     if not sharded:
-        return perm
+        return perm, None
     inv = np.empty(n, dtype=np.int64)
     inv[perm] = np.arange(n)
     # victims: the qubits occupying the staging slots themselves (direct
@@ -206,6 +233,19 @@ def localise_targets(qureg, targets) -> np.ndarray:
         new_perm[q] = stage
         inv[stage] = q
         inv[new_perm[victim]] = victim
+    return perm, new_perm
+
+
+def localise_targets(qureg, targets) -> np.ndarray:
+    """Ensure every logical target sits on a local physical position,
+    emitting at most ONE relayout (targets land on the all_to_all staging
+    slots — the swap-to-local of ``QuEST_cpu_distributed.c:1426-1448``,
+    batched, with the swap-back deferred). Returns the active perm."""
+    perm, new_perm = _localise_perm(qureg, targets)
+    if new_perm is None:
+        return perm
+    n = qureg.num_qubits_in_state_vec
+    s = _shard_bits(qureg)
     fn = _relayout_fn(qureg.env.mesh, n, s,
                       tuple(int(p) for p in perm),
                       tuple(int(p) for p in new_perm))
@@ -242,6 +282,25 @@ def sharded_unitary(qureg, u_packed, targets, ctrl_mask, flip_mask) -> None:
         qureg.state = fn(qureg.state, u_packed)
         return
     if any(p >= lt for p in phys_t):
+        if overlap_enabled():
+            # fused relayout+gate with the slab double-buffered
+            # collective: one dispatch, and the exchange for slab i+1 is
+            # independent of the gate math on slab i
+            old_perm, new_perm = _localise_perm(qureg, tuple(targets))
+            phys_new = tuple(int(new_perm[t]) for t in targets)
+            cmask, fmask = _phys_masks(new_perm, ctrl_mask, flip_mask)
+            expl = plan_exchange(n, s, tuple(int(p) for p in old_perm),
+                                 tuple(int(p) for p in new_perm))
+            if overlap_eligible(expl, phys_new, cmask):
+                fn = _relayout_gate_fn(
+                    mesh, n, s, tuple(int(p) for p in old_perm),
+                    tuple(int(p) for p in new_perm), phys_new, cmask,
+                    fmask)
+                global RELAYOUT_COUNT
+                RELAYOUT_COUNT += 1
+                qureg.state = fn(qureg.state, u_packed)
+                qureg.layout = new_perm
+                return
         perm = localise_targets(qureg, tuple(targets))
         phys_t = tuple(int(perm[t]) for t in targets)
     cmask, fmask = _phys_masks(perm, ctrl_mask, flip_mask)

@@ -24,7 +24,160 @@ from typing import Callable, Optional
 
 import jax
 
-__all__ = ["trace", "GateStats", "DispatchStats", "probe_gate"]
+__all__ = ["trace", "GateStats", "DispatchStats", "probe_gate",
+           "CommCostModel", "DEFAULT_COMM_MODEL", "comm_model",
+           "measure_comm_model"]
+
+
+# ---------------------------------------------------------------------------
+# collective cost model (the layout planner's objective function)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CommCostModel:
+    """Linear latency/bandwidth model for one mesh collective:
+    ``seconds = alpha + beta * bytes_on_the_wire`` per device.
+
+    The layout planner (:mod:`quest_tpu.parallel.layout`) prices every
+    candidate data movement with this model and minimizes modeled comm
+    TIME rather than relayout count:
+
+    - a relayout trading ``k`` device-index bits against ``k`` chunk-local
+      bits is one ``all_to_all`` over groups of ``2^k`` devices — each
+      device keeps ``1/2^k`` of its chunk and ships the rest, so
+      ``bytes = chunk_bytes * (2^k - 1) / 2^k`` (plus a full-chunk
+      ``ppermute`` when a residual device-bit permutation remains);
+    - a cross-shard 1q pair exchange (``apply_1q_cross_shard``) ships the
+      whole chunk once: ``bytes = chunk_bytes``.
+
+    ``alpha``/``beta`` default to a conservative interconnect model
+    (:data:`DEFAULT_COMM_MODEL`); :func:`measure_comm_model` calibrates
+    them per mesh with a tiny collective microbenchmark and caches the
+    fit. Decisions only depend on cost *ratios*, so plans stay
+    deterministic for any non-degenerate (alpha >= 0, beta > 0) fit.
+    """
+
+    alpha_s: float              # per-collective launch latency (seconds)
+    beta_s_per_byte: float      # per-byte transfer time (seconds/byte)
+    source: str = "default"     # "default" | "measured"
+
+    @staticmethod
+    def all_to_all_bytes(chunk_bytes: float, k: int) -> float:
+        """Per-device bytes shipped by a k-bit relayout exchange."""
+        if k <= 0:
+            return 0.0
+        return chunk_bytes * ((1 << k) - 1) / float(1 << k)
+
+    @staticmethod
+    def ppermute_bytes(chunk_bytes: float) -> float:
+        """Per-device bytes shipped by a whole-chunk pair exchange."""
+        return float(chunk_bytes)
+
+    def all_to_all_seconds(self, chunk_bytes: float, k: int) -> float:
+        if k <= 0:
+            return 0.0
+        return self.alpha_s + self.beta_s_per_byte * \
+            self.all_to_all_bytes(chunk_bytes, k)
+
+    def ppermute_seconds(self, chunk_bytes: float) -> float:
+        return self.alpha_s + self.beta_s_per_byte * \
+            self.ppermute_bytes(chunk_bytes)
+
+
+# ~50 GB/s per-link bandwidth with a few-microsecond launch cost: the
+# shape of both ICI links and a shared-memory host "mesh". The planner's
+# decisions are ratio-based, so the default is safe wherever no
+# measurement has run.
+DEFAULT_COMM_MODEL = CommCostModel(alpha_s=5e-6, beta_s_per_byte=2e-11)
+
+_COMM_MODEL_CACHE: dict = {}
+
+
+def _mesh_cache_key(mesh) -> tuple:
+    devs = mesh.devices.reshape(-1)
+    return (len(devs), devs[0].platform,
+            getattr(devs[0], "device_kind", ""))
+
+
+def measure_comm_model(mesh, probe_bytes=(1 << 14, 1 << 19),
+                       trials: int = 5) -> CommCostModel:
+    """Fit (alpha, beta) from a tiny ``ppermute`` ring microbenchmark at
+    two payload sizes on ``mesh``; the result is cached per mesh
+    fingerprint so the calibration runs once per process. Falls back to
+    :data:`DEFAULT_COMM_MODEL` (uncached) if the measurement fails or
+    produces a degenerate fit."""
+    import numpy as np
+    key = _mesh_cache_key(mesh)
+    if key in _COMM_MODEL_CACHE:
+        return _COMM_MODEL_CACHE[key]
+    try:
+        from jax.sharding import PartitionSpec as P
+        from .compat import shard_map
+        from .env import AMP_AXIS
+        n_dev = int(np.prod(mesh.devices.shape))
+        pairs = tuple((i, (i + 1) % n_dev) for i in range(n_dev))
+
+        times = []
+        for nbytes in probe_bytes:
+            n_f32 = max(n_dev, (nbytes // 4) * n_dev)
+            x = jax.device_put(
+                np.zeros(n_f32, dtype=np.float32),
+                jax.sharding.NamedSharding(mesh, P(AMP_AXIS)))
+
+            def body(local):
+                return jax.lax.ppermute(local, AMP_AXIS, pairs)
+
+            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(AMP_AXIS),),
+                                   out_specs=P(AMP_AXIS), check_vma=False))
+            fn(x).block_until_ready()          # compile + warm-up
+            t0 = time.perf_counter()
+            for _ in range(trials):
+                x = fn(x)
+            x.block_until_ready()
+            times.append((time.perf_counter() - t0) / trials)
+        b0, b1 = (float(b) for b in probe_bytes)
+        t0_, t1_ = times
+        beta = (t1_ - t0_) / (b1 - b0)
+        alpha = t0_ - beta * b0
+        if beta <= 0.0 or not np.isfinite(alpha) or not np.isfinite(beta):
+            return DEFAULT_COMM_MODEL
+        model = CommCostModel(alpha_s=max(alpha, 0.0),
+                              beta_s_per_byte=beta, source="measured")
+        _COMM_MODEL_CACHE[key] = model
+        return model
+    except Exception:
+        return DEFAULT_COMM_MODEL
+
+
+def comm_model(env=None, measure: Optional[bool] = None) -> CommCostModel:
+    """The cost model for ``env``'s mesh: the cached per-mesh calibration
+    when one exists, measuring one when asked, else
+    :data:`DEFAULT_COMM_MODEL`.
+
+    ``measure=None`` (the compile path's default) auto-calibrates on
+    TPU-class meshes — real interconnects whose alpha/beta the default
+    model cannot know — and keeps the default on host (CPU) meshes,
+    where the virtual devices timeshare one memory system and a timing
+    fit adds cross-process nondeterminism for no information.
+    ``QUEST_TPU_COMM_CALIBRATE=1``/``0`` overrides either way; the fit
+    runs once per process per mesh fingerprint (cached)."""
+    import os
+    mesh = getattr(env, "mesh", None) if env is not None else None
+    if mesh is None:
+        return DEFAULT_COMM_MODEL
+    key = _mesh_cache_key(mesh)
+    if key in _COMM_MODEL_CACHE:
+        return _COMM_MODEL_CACHE[key]
+    if measure is None:
+        flag = os.environ.get("QUEST_TPU_COMM_CALIBRATE")
+        if flag is not None:
+            measure = flag not in ("0", "", "off")
+        else:
+            measure = mesh.devices.reshape(-1)[0].platform in (
+                "tpu", "axon")
+    if measure:
+        return measure_comm_model(mesh)
+    return DEFAULT_COMM_MODEL
 
 
 @dataclasses.dataclass
@@ -42,13 +195,26 @@ class DispatchStats:
     diag_folds: int = 0      # diagonal gates folded into shared factors
     commuted_diagonals: int = 0  # diagonals deferred past a dense run
     max_group_gates: int = 0     # largest gates-per-group count
+    # communication-planner accounting (quest_tpu/parallel/layout.py):
+    cross_shard_exchanges: int = 0  # 1q pair-exchange items in the plan
+    swaps_absorbed: int = 0      # SWAP gates composed into the layout perm
+    collectives_fused: int = 0   # relayout pairs merged into one exchange
+    comm_bytes_planned: float = 0.0  # mesh-total collective bytes per run
+    comm_bytes_saved: float = 0.0    # vs the count-based planner's plan
 
     @property
     def dispatches(self) -> int:
         """Kernels the device runs per program execution (op passes plus
-        relayout exchanges) — the number the fusion pass exists to
-        shrink."""
-        return self.kernels_out + self.relayouts
+        relayout and pair exchanges) — the number the fusion pass and the
+        communication planner exist to shrink."""
+        return self.kernels_out + self.relayouts + self.cross_shard_exchanges
+
+    @property
+    def collective_launches(self) -> int:
+        """Collectives issued per program execution (relayout exchanges
+        plus cross-shard pair exchanges) — the communication planner's
+        primary observable."""
+        return self.relayouts + self.cross_shard_exchanges
 
     def as_dict(self) -> dict:
         return {"gates_in": self.gates_in,
@@ -58,7 +224,13 @@ class DispatchStats:
                 "fused_groups": self.fused_groups,
                 "diag_folds": self.diag_folds,
                 "commuted_diagonals": self.commuted_diagonals,
-                "max_group_gates": self.max_group_gates}
+                "max_group_gates": self.max_group_gates,
+                "cross_shard_exchanges": self.cross_shard_exchanges,
+                "swaps_absorbed": self.swaps_absorbed,
+                "collectives_fused": self.collectives_fused,
+                "collective_launches": self.collective_launches,
+                "comm_bytes_planned": self.comm_bytes_planned,
+                "comm_bytes_saved": self.comm_bytes_saved}
 
 
 @contextlib.contextmanager

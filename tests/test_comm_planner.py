@@ -1,0 +1,464 @@
+"""Communication-aware planner tests (ISSUE 2).
+
+Covers the three planner mechanisms — SWAP absorption, cross-shard 1q
+pair-exchange items, collective composition — plus the cost model they
+share: closed-form collective accounting checked against a brute-force
+enumeration over every physical permutation (the real
+``plan_exchange`` choreography as oracle), Python-vs-native plan
+equality under the cost model, and execution parity (planner-on vs
+planner-off vs single device) at the 1e-12 acceptance bar.
+"""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import algorithms as alg
+from quest_tpu.circuits import Circuit, _schedule
+from quest_tpu.parallel import plan_layout
+from quest_tpu.parallel.exchange import plan_exchange
+from quest_tpu.parallel.layout import (is_swap_op, plan_comm_stats,
+                                       relayout_comm, _relayout_sigma)
+from quest_tpu.profiling import (CommCostModel, DEFAULT_COMM_MODEL,
+                                 comm_model)
+
+MODEL = DEFAULT_COMM_MODEL
+
+
+def rand_unitary(rng, k):
+    m = rng.normal(size=(1 << k, 1 << k)) + 1j * rng.normal(
+        size=(1 << k, 1 << k))
+    u, _ = np.linalg.qr(m)
+    return u
+
+
+class TestCostModelOracle:
+    def test_relayout_comm_matches_exchange_plan_enumeration(self):
+        """Brute force: for EVERY physical permutation of a 5-position /
+        2-shard-bit layout, the closed-form accounting
+        (``relayout_comm``) must agree with the actual choreography
+        ``plan_exchange`` produces — all_to_all bytes from the exchanged
+        bit count, ppermute bytes iff a residual device permutation
+        remains."""
+        n, s = 5, 2
+        lt = n - s
+        B = 16.0 * (1 << lt)
+        before = tuple(range(n))
+        for sig in itertools.permutations(range(n)):
+            after = tuple(sig[l] for l in before)
+            plan = plan_exchange(n, s, before, after)
+            oracle_bytes = 0.0
+            oracle_launches = 0
+            if plan.k:
+                oracle_bytes += B * ((1 << plan.k) - 1) / (1 << plan.k)
+                oracle_launches += 1
+            if plan.device_perm is not None:
+                oracle_bytes += B
+                oracle_launches += 1
+            sigma = _relayout_sigma(before, after, n)
+            sec, got_bytes, got_launches = relayout_comm(sigma, lt, B,
+                                                         MODEL)
+            assert got_bytes == pytest.approx(oracle_bytes), (sig, plan)
+            assert got_launches == oracle_launches, (sig, plan)
+            # modeled seconds consistent with the same decomposition
+            want_sec = 0.0
+            if plan.k:
+                want_sec += MODEL.all_to_all_seconds(B, plan.k)
+            if plan.device_perm is not None:
+                want_sec += MODEL.ppermute_seconds(B)
+            assert sec == pytest.approx(want_sec)
+
+    def test_marginal_prefetch_always_cheaper_than_standalone(self):
+        """The Belady-window prefetch rule needs no per-case pricing:
+        growing a k-bit exchange by one bit costs B/2^(k+2) extra bytes,
+        strictly below the B/2 + alpha a deferred standalone relayout
+        costs — for every k (the argument in layout.py's module docs)."""
+        B = 1e6
+        for k in range(1, 10):
+            marginal = MODEL.all_to_all_seconds(B, k + 1) \
+                - MODEL.all_to_all_seconds(B, k)
+            standalone = MODEL.all_to_all_seconds(B, 1)
+            assert marginal < standalone
+
+    def test_xshard_rule_prices_pair_exchange(self):
+        B = 1e6
+        # one whole-chunk ppermute vs the localise+restore pair it avoids
+        assert MODEL.ppermute_seconds(B) <= \
+            2.0 * MODEL.all_to_all_seconds(B, 1)
+        # a zero-latency, bandwidth-only model makes them exactly equal
+        flat = CommCostModel(alpha_s=0.0, beta_s_per_byte=1e-9)
+        assert flat.ppermute_seconds(B) == \
+            pytest.approx(2.0 * flat.all_to_all_seconds(B, 1))
+
+    def test_planner_never_regresses_modeled_comm(self):
+        """On a corpus of small circuits the cost-aware plan never
+        launches more collectives or dispatches more kernels than the
+        count-based plan, and its modeled comm seconds stay within one
+        marginal-bit slack of it. (Exact comm-seconds dominance cannot be
+        asserted: SWAP absorption is priced against the KERNEL passes it
+        deletes, which the comm-only total deliberately excludes — a
+        greedily absorbed swap may re-shape the final restore by a bit.)"""
+        for seed in range(5):
+            c = alg.random_circuit(8, depth=14, seed=seed)
+            c.swap(7, 0).swap(6, 3)
+            ops = c._fused_ops()
+            for s in (1, 2, 3):
+                B = 16.0 * (1 << (8 - s))
+                p_on = plan_layout(ops, 8, s, cost_model=MODEL,
+                                   chunk_bytes=B)
+                p_off = plan_layout(ops, 8, s)
+                on = plan_comm_stats(p_on, B, MODEL)
+                off = plan_comm_stats(p_off, B, MODEL)
+                assert on["launches"] <= off["launches"], (seed, s)
+                assert p_on.num_dispatches <= p_off.num_dispatches, \
+                    (seed, s)
+                slack = MODEL.beta_s_per_byte * B      # one marginal bit
+                assert on["seconds"] <= off["seconds"] + slack, (seed, s)
+
+    def test_comm_model_defaults_and_cache(self, env):
+        m = comm_model(env)            # single device -> default model
+        assert m is DEFAULT_COMM_MODEL
+        assert m.all_to_all_bytes(1024.0, 0) == 0.0
+        assert m.all_to_all_bytes(1024.0, 1) == pytest.approx(512.0)
+        assert m.all_to_all_bytes(1024.0, 3) == pytest.approx(896.0)
+        assert m.ppermute_bytes(1024.0) == 1024.0
+
+    def test_calibration_wiring(self, mesh_env, monkeypatch):
+        # host-CPU meshes keep the default model unless the env flag
+        # forces a measurement; a forced fit is cached per mesh
+        from quest_tpu import profiling as prof
+        prof._COMM_MODEL_CACHE.clear()
+        assert comm_model(mesh_env) is DEFAULT_COMM_MODEL
+        monkeypatch.setenv("QUEST_TPU_COMM_CALIBRATE", "1")
+        m = comm_model(mesh_env)
+        if m.source == "measured":       # fit can fail on a loaded box
+            assert m.beta_s_per_byte > 0.0
+            assert comm_model(mesh_env) is m     # cached
+        prof._COMM_MODEL_CACHE.clear()
+
+
+class TestSwapAbsorption:
+    def test_swaps_become_metadata(self):
+        c = alg.qft(10)                     # ends in 5 bit-reversal swaps
+        ops = c._fused_ops()
+        assert sum(1 for op in ops if is_swap_op(op)) == 5
+        p_on = plan_layout(ops, 10, 3, cost_model=MODEL)
+        p_off = plan_layout(ops, 10, 3)
+        assert p_on.swaps_absorbed == 5
+        assert p_on.num_kernels == p_off.num_kernels - 5
+        assert p_on.num_relayouts <= p_off.num_relayouts
+
+    def test_is_swap_op_rejects_lookalikes(self):
+        rng = np.random.default_rng(0)
+        c = Circuit(4)
+        c.swap(0, 1)                                   # the real thing
+        c.gate(rand_unitary(rng, 2), (0, 1))           # dense 2q
+        c.gate(np.eye(4), (0, 1))                      # identity
+        c.gate(qt_swap_mat(), (2, 3), controls=(0,))   # controlled swap
+        flags = [is_swap_op(op) for op in c.ops]
+        assert flags == [True, False, False, False]
+
+
+def qt_swap_mat():
+    return np.array([[1, 0, 0, 0], [0, 0, 1, 0],
+                     [0, 1, 0, 0], [0, 0, 0, 1]], dtype=complex)
+
+
+class TestCrossShardItems:
+    def test_lone_sharded_1q_rides_pair_exchange(self):
+        c = Circuit(8)
+        c.h(0).h(7).cnot(0, 1)
+        plan = plan_layout(c._fused_ops(), 8, 2, cost_model=MODEL)
+        assert plan.num_xshard == 1
+        assert plan.num_relayouts == 0
+        (x,) = [it for it in plan.items if it[0] == "xshard"]
+        assert x[2][0] >= 6                 # runs at the device position
+
+    def test_amortized_demand_prefers_relayout(self):
+        # three sharded 1q gates inside one window: a single prefetching
+        # relayout serves all three; per-gate pair exchanges would ship
+        # 3 whole chunks
+        c = Circuit(8)
+        c.h(7).h(6).h(5)
+        plan = plan_layout(c._fused_ops(), 8, 3, cost_model=MODEL)
+        assert plan.num_xshard == 0
+        assert plan.num_relayouts >= 1
+
+    def test_window_scan_sees_through_absorbed_swaps(self):
+        # h(7); swap(7,0); U2(0,1): the absorbed swap moves label 0 to
+        # the sharded position, so the upcoming U2 IS a sharded demand —
+        # a stale-perm scan would call h(7) sole-demand and waste a
+        # whole-chunk pair exchange on top of the relayout the U2 forces
+        # anyway (found by review; the scan runs under a scratch perm)
+        rng = np.random.default_rng(5)
+        c = Circuit(8)
+        c.h(7).swap(7, 0).gate(rand_unitary(rng, 2), (0, 1))
+        ops = c._fused_ops()
+        B = 16.0 * (1 << 7)
+        p_on = plan_layout(ops, 8, 1, cost_model=MODEL, chunk_bytes=B)
+        p_off = plan_layout(ops, 8, 1)
+        assert p_on.num_xshard == 0
+        on = plan_comm_stats(p_on, B, MODEL)
+        off = plan_comm_stats(p_off, B, MODEL)
+        assert on["bytes"] <= off["bytes"]
+        assert on["launches"] <= off["launches"]
+
+
+class TestCollectiveComposition:
+    def test_dense_then_absorbed_swap_composes(self):
+        rng = np.random.default_rng(0)
+        c = Circuit(8)
+        c.gate(rand_unitary(rng, 2), (7, 0)).swap(7, 3)
+        ops = c._fused_ops()
+        p_on = plan_layout(ops, 8, 2, cost_model=MODEL)
+        p_off = plan_layout(ops, 8, 2)
+        assert p_on.collectives_fused == 1
+        assert p_on.num_relayouts == 1
+        assert p_off.num_relayouts == 2
+
+    def test_composition_preserves_modeled_cost(self):
+        rng = np.random.default_rng(1)
+        c = Circuit(8)
+        c.gate(rand_unitary(rng, 2), (7, 0)).swap(7, 3).t(7).h(2)
+        ops = c._fused_ops()
+        B = 16.0 * (1 << 6)
+        p_on = plan_layout(ops, 8, 2, cost_model=MODEL, chunk_bytes=B)
+        p_off = plan_layout(ops, 8, 2)
+        on = plan_comm_stats(p_on, B, MODEL)
+        off = plan_comm_stats(p_off, B, MODEL)
+        assert on["seconds"] <= off["seconds"] + 1e-15
+        assert on["launches"] <= off["launches"]
+
+
+@pytest.mark.skipif(
+    not __import__("quest_tpu.native", fromlist=["available"]).available(),
+    reason="native scheduler did not build")
+class TestNativeParityUnderCostModel:
+    """scheduler.cc must mirror the cost-aware planner bit-for-bit."""
+
+    def both_plans(self, circ, n, s, lookahead=32):
+        B = 16.0 * (1 << (n - s))
+        ops_n, plan_n = _schedule(list(circ.ops), n, s, lookahead, True,
+                                  cost_model=MODEL, chunk_bytes=B)
+        os.environ["QUEST_TPU_NO_NATIVE"] = "1"
+        try:
+            ops_p, plan_p = _schedule(list(circ.ops), n, s, lookahead,
+                                      True, cost_model=MODEL,
+                                      chunk_bytes=B)
+        finally:
+            del os.environ["QUEST_TPU_NO_NATIVE"]
+        return (ops_n, plan_n), (ops_p, plan_p)
+
+    def assert_equal(self, native, python):
+        (ops_n, plan_n), (ops_p, plan_p) = native, python
+        assert len(plan_n.items) == len(plan_p.items)
+        for ia, ib in zip(plan_n.items, plan_p.items):
+            assert ia[0] == ib[0], (ia, ib)
+            if ia[0] == "relayout":
+                np.testing.assert_array_equal(ia[1], ib[1])
+                np.testing.assert_array_equal(ia[2], ib[2])
+            else:
+                assert ia[1] == ib[1]
+                assert tuple(ia[2]) == tuple(ib[2])
+                assert ia[3] == ib[3] and ia[4] == ib[4]
+                if ops_n[ia[1]].kind == "diag":
+                    assert tuple(ia[5]) == tuple(ib[5])
+        for field in ("num_relayouts", "num_xshard", "swaps_absorbed",
+                      "collectives_fused"):
+            assert getattr(plan_n, field) == getattr(plan_p, field), field
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    @pytest.mark.parametrize("shard_bits", [1, 2, 3])
+    def test_random_with_swaps(self, seed, shard_bits):
+        c = alg.random_circuit(8, depth=12, seed=seed)
+        c.swap(7, 0).h(7).swap(6, 2)
+        self.assert_equal(*self.both_plans(c, 8, shard_bits))
+
+    @pytest.mark.parametrize("lookahead", [1, 4, 32])
+    def test_lookahead_sweep(self, lookahead):
+        c = alg.qft(9)
+        self.assert_equal(*self.both_plans(c, 9, 2, lookahead))
+
+    def test_structured(self):
+        self.assert_equal(*self.both_plans(alg.qft(12), 12, 3))
+        self.assert_equal(*self.both_plans(
+            alg.grover(10, 13, 3), 10, 3))
+
+    def test_xshard_and_compose_cases(self):
+        c = Circuit(8)
+        c.h(0).h(7).cnot(0, 1)
+        self.assert_equal(*self.both_plans(c, 8, 2))
+        rng = np.random.default_rng(0)
+        c2 = Circuit(8)
+        c2.gate(rand_unitary(rng, 2), (7, 0)).swap(7, 3)
+        self.assert_equal(*self.both_plans(c2, 8, 2))
+
+    def test_parameterized_lone_sharded_1q(self):
+        # a lone sharded PARAMETERIZED 1q gate must plan identically on
+        # both sides (the executor resolves mat_fn at trace time, so the
+        # xshard rule applies to KIND_U_PARAM exactly like KIND_U)
+        c = Circuit(8)
+        t = c.parameter("t")
+        c.h(0).ry(7, t).cnot(0, 1)
+        (ops_n, plan_n), python = self.both_plans(c, 8, 2)
+        self.assert_equal((ops_n, plan_n), python)
+        assert plan_n.num_xshard == 1
+
+
+class TestExecutionParity:
+    """Planner-on vs planner-off amplitude parity <= 1e-12 (acceptance
+    criterion), single device and the 8-device mesh, including the
+    overlap path."""
+
+    def run_all(self, circ, env, mesh_env, init="debug"):
+        outs = {}
+        for label, e, kw in (("single", env, {}),
+                             ("mesh_on", mesh_env, {}),
+                             ("mesh_off", mesh_env,
+                              {"comm_planner": False}),
+                             ("mesh_overlap", mesh_env,
+                              {"overlap": True})):
+            q = qt.createQureg(circ.num_qubits, e)
+            if init == "debug":
+                qt.initDebugState(q)
+            else:
+                qt.initPlusState(q)
+            circ.compile(e, pallas="off", **kw).run(q)
+            outs[label] = q.to_numpy()
+        return outs
+
+    def assert_parity(self, outs):
+        ref = outs["single"]
+        for label in ("mesh_on", "mesh_off", "mesh_overlap"):
+            np.testing.assert_allclose(outs[label], ref, atol=1e-12,
+                                       err_msg=label)
+
+    def test_qft_with_swap_network(self, env, mesh_env):
+        self.assert_parity(self.run_all(alg.qft(8), env, mesh_env))
+
+    def test_grover(self, env, mesh_env):
+        self.assert_parity(self.run_all(
+            alg.grover(8, 0b110101, num_iterations=3), env, mesh_env))
+
+    @pytest.mark.parametrize("seed", [4, 11])
+    def test_random_with_swaps(self, env, mesh_env, seed):
+        c = alg.random_circuit(9, depth=18, seed=seed)
+        c.swap(8, 0).swap(7, 2).h(8)
+        self.assert_parity(self.run_all(c, env, mesh_env))
+
+    def test_xshard_execution(self, env, mesh_env):
+        # fusion/supergates off so the lone sharded H survives as a 1q op
+        # (the default pipeline welds it into a 3q group — equally valid,
+        # but then nothing exercises the pair-exchange item)
+        c = Circuit(8)
+        c.h(0).h(7).cnot(0, 1).t(7)
+        cc = c.compile(mesh_env, pallas="off", fusion=0, supergate_k=0)
+        assert cc.plan.num_xshard >= 1       # the mechanism actually runs
+        outs = {}
+        for label, e, kw in (("single", env, {}),
+                             ("mesh_on", mesh_env, {})):
+            q = qt.createQureg(8, e)
+            qt.initDebugState(q)
+            c.compile(e, pallas="off", fusion=0, supergate_k=0,
+                      **kw).run(q)
+            outs[label] = q.to_numpy()
+        np.testing.assert_allclose(outs["mesh_on"], outs["single"],
+                                   atol=1e-12)
+
+    def test_compose_execution(self, env, mesh_env):
+        rng = np.random.default_rng(2)
+        c = Circuit(8)
+        c.gate(rand_unitary(rng, 2), (7, 0)).swap(7, 3).t(7).h(2)
+        cc = c.compile(mesh_env, pallas="off")
+        assert cc.plan.collectives_fused >= 1
+        self.assert_parity(self.run_all(c, env, mesh_env))
+
+    def test_parameterized_with_swaps(self, env, mesh_env):
+        n = 7
+        c = Circuit(n)
+        t = c.parameter("t")
+        for q_ in range(n):
+            c.ry(q_, t)
+        c.cnot(n - 1, 0).swap(n - 1, 1)
+        outs = []
+        for e, kw in ((env, {}), (mesh_env, {}),
+                      (mesh_env, {"comm_planner": False})):
+            q = qt.createQureg(n, e)
+            c.compile(e, pallas="off", **kw).run(q, params={"t": 0.37})
+            outs.append(q.to_numpy())
+        np.testing.assert_allclose(outs[1], outs[0], atol=1e-12)
+        np.testing.assert_allclose(outs[2], outs[0], atol=1e-12)
+
+    def test_sweep_and_expectation_with_planner(self, env, mesh_env):
+        # the sequential twin must execute xshard/absorbed-swap plans too
+        n = 7
+        c = Circuit(n)
+        t = c.parameter("t")
+        c.h(n - 1).ry(0, t).swap(n - 1, 0).cnot(0, 1)
+        vals = []
+        for e in (env, mesh_env):
+            f = c.compile(e, pallas="off").expectation_fn(
+                [[(0, int(qt.PAULI_Z))], [(n - 1, int(qt.PAULI_X))]],
+                [0.7, -0.3])
+            vals.append(float(f(np.array([0.41]))))
+        assert vals[0] == pytest.approx(vals[1], abs=1e-12)
+        cc = c.compile(mesh_env, pallas="off")
+        batch = cc.sweep(np.array([[0.1], [0.2]]))
+        assert batch.shape == (2, 2, 1 << n)
+
+    def test_imperative_overlap_parity(self, mesh_env, monkeypatch):
+        rng = np.random.default_rng(3)
+        u = rand_unitary(rng, 2)
+
+        def run():
+            q = qt.createQureg(9, mesh_env)
+            qt.initDebugState(q)
+            qt.twoQubitUnitary(q, 8, 0, u)
+            qt.twoQubitUnitary(q, 7, 2, u)
+            qt.hadamard(q, 8)
+            q.ensure_canonical()
+            return q.to_numpy()
+
+        monkeypatch.setenv("QUEST_TPU_OVERLAP", "0")
+        a = run()
+        monkeypatch.setenv("QUEST_TPU_OVERLAP", "1")
+        b = run()
+        np.testing.assert_allclose(b, a, atol=1e-12)
+
+
+class TestPlannerGuardrails:
+    """Fixed budgets for the headline workload: a regression that
+    re-inflates QFT-18's collective launches must fail loudly."""
+
+    def test_qft18_fewer_collectives_than_planner_off(self, mesh_env):
+        qc = alg.qft(18)
+        on = qc.compile(mesh_env, pallas="off")
+        off = qc.compile(mesh_env, pallas="off", comm_planner=False)
+        d_on, d_off = on.dispatch_stats(), off.dispatch_stats()
+        assert d_on.collective_launches < d_off.collective_launches
+        assert d_on.dispatches < d_off.dispatches
+        assert d_on.swaps_absorbed == 9
+        assert d_on.comm_bytes_planned < d_off.comm_bytes_planned
+        assert d_on.comm_bytes_saved > 0
+
+    def test_stats_surface(self, mesh_env):
+        d = alg.qft(10).compile(mesh_env, pallas="off") \
+            .dispatch_stats().as_dict()
+        for key in ("collective_launches", "comm_bytes_planned",
+                    "comm_bytes_saved", "collectives_fused",
+                    "swaps_absorbed", "cross_shard_exchanges"):
+            assert key in d, key
+
+    def test_count_planner_unchanged(self):
+        # cost_model=None must stay bit-identical to the legacy planner:
+        # same item stream, no comm-planner artifacts
+        c = alg.random_circuit(8, depth=12, seed=7)
+        c.swap(7, 0)
+        plan = plan_layout(c._fused_ops(), 8, 3)
+        assert plan.num_xshard == 0
+        assert plan.swaps_absorbed == 0
+        assert plan.collectives_fused == 0
+        assert all(it[0] in ("op", "relayout") for it in plan.items)

@@ -1,0 +1,61 @@
+"""tools/comm_trace.py smoke (fast tier): the planned-collective dump
+must agree with the plan's own accounting and survive a JSON round trip,
+and the CLI must produce parseable output end-to-end."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import comm_trace  # noqa: E402
+from quest_tpu import algorithms as alg  # noqa: E402
+
+
+def test_trace_matches_dispatch_stats(mesh_env):
+    cc = alg.qft(10).compile(mesh_env, pallas="off")
+    doc = json.loads(json.dumps(comm_trace.trace_schedule(cc)))
+    ds = cc.dispatch_stats().as_dict()
+    assert doc["shard_bits"] == 3
+    assert doc["num_devices"] == 8
+    assert doc["totals"]["bytes"] == pytest.approx(
+        ds["comm_bytes_planned"])
+    assert sum(e["collectives"] for e in doc["events"]) \
+        == doc["totals"]["launches"]
+    kinds = {e["kind"] for e in doc["events"]}
+    assert kinds <= {"relayout", "pair_exchange"}
+    for e in doc["events"]:
+        assert e["mesh_bytes"] == pytest.approx(
+            e["bytes_per_device"] * 8)
+        assert e["fused_group"] is None or isinstance(e["fused_group"],
+                                                      int)
+
+
+def test_trace_planner_off_baseline(mesh_env):
+    on = comm_trace.trace_schedule(
+        alg.qft(12).compile(mesh_env, pallas="off"))
+    off = comm_trace.trace_schedule(
+        alg.qft(12).compile(mesh_env, pallas="off", comm_planner=False))
+    assert on["totals"]["launches"] < off["totals"]["launches"]
+    assert on["totals"]["bytes"] <= off["totals"]["bytes"]
+
+
+def test_cli_end_to_end():
+    tool = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "comm_trace.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}
+    proc = subprocess.run(
+        [sys.executable, tool, "--qubits", "10", "--devices", "8",
+         "--circuit", "qft"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    doc = json.loads(proc.stdout)
+    assert doc["num_qubits"] == 10
+    assert doc["events"], "no collectives traced"
+    assert "dispatch_stats" in doc

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Dump a compiled circuit's planned collective schedule as JSON.
+
+Offline inspection for the communication-aware planner
+(quest_tpu/parallel/layout.py): every collective the compiled program
+will launch — relayout ``all_to_all``/``ppermute`` exchanges and
+cross-shard 1q pair exchanges — with modeled bytes, exchanged-bit count,
+and the fused-group (op item) index it serves, plus the plan's dispatch
+stats and comm totals. No device work: planning is host-side, so the
+tool runs anywhere (the virtual-mesh flag is set before JAX loads).
+
+Usage::
+
+    python tools/comm_trace.py --qubits 18 --devices 8 --circuit qft
+    python tools/comm_trace.py --circuit grover --planner off
+
+``--planner off`` traces the count-based legacy plan for comparison.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def trace_schedule(cc) -> dict:
+    """The planned collective schedule of a CompiledCircuit as a plain
+    dict (JSON-ready): one event per plan item that moves data."""
+    from quest_tpu.parallel.layout import (_relayout_sigma, relayout_comm,
+                                           plan_comm_stats)
+    from quest_tpu.profiling import DEFAULT_COMM_MODEL
+
+    plan = cc.plan
+    n = plan.num_qubits
+    lt = n - plan.shard_bits
+    model = getattr(cc, "_cost_model", None) or DEFAULT_COMM_MODEL
+    chunk_bytes = getattr(cc, "_chunk_bytes", 16.0 * (1 << lt))
+    num_devices = cc.env.num_devices
+
+    def serves(idx: int):
+        """Index (into plan.items) of the first op the collective
+        localises — the fused group it serves."""
+        for j in range(idx + 1, len(plan.items)):
+            if plan.items[j][0] in ("op", "xshard"):
+                return j
+        return None
+
+    events = []
+    for idx, it in enumerate(plan.items):
+        if it[0] == "relayout":
+            sigma = _relayout_sigma(it[1], it[2], n)
+            sec, per_dev, launches = relayout_comm(sigma, lt, chunk_bytes,
+                                                   model)
+            k = sum(1 for p in range(lt) if sigma[p] >= lt)
+            events.append({
+                "item": idx, "kind": "relayout",
+                "exchanged_bits": int(k),
+                "collectives": int(launches),
+                "bytes_per_device": per_dev,
+                "mesh_bytes": per_dev * num_devices,
+                "modeled_seconds": sec,
+                "fused_group": serves(idx),
+            })
+        elif it[0] == "xshard":
+            events.append({
+                "item": idx, "kind": "pair_exchange",
+                "exchanged_bits": 1,
+                "collectives": 1,
+                "bytes_per_device": model.ppermute_bytes(chunk_bytes),
+                "mesh_bytes": model.ppermute_bytes(chunk_bytes)
+                * num_devices,
+                "modeled_seconds": model.ppermute_seconds(chunk_bytes),
+                "fused_group": idx,
+                "op_index": it[1],
+                "position": int(it[2][0]),
+            })
+    totals = plan_comm_stats(plan, chunk_bytes, model, num_devices)
+    return {
+        "num_qubits": n,
+        "shard_bits": plan.shard_bits,
+        "num_devices": num_devices,
+        "chunk_bytes": chunk_bytes,
+        "cost_model": {"alpha_s": model.alpha_s,
+                       "beta_s_per_byte": model.beta_s_per_byte,
+                       "source": model.source},
+        "events": events,
+        "totals": totals,
+        "dispatch_stats": cc.dispatch_stats().as_dict(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--qubits", type=int, default=18)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--circuit", choices=("qft", "grover", "bench"),
+                    default="qft")
+    ap.add_argument("--planner", choices=("on", "off"), default="on")
+    ap.add_argument("--lookahead", type=int, default=32)
+    ap.add_argument("--fusion", type=int, default=None,
+                    help="gate-fusion cap k (default: compile default)")
+    args = ap.parse_args(argv)
+
+    # virtual mesh before the first JAX import, so the tool runs on any
+    # host (planning is host-side; no kernels execute)
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{args.devices}").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    repo_root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             os.pardir)
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+
+    import quest_tpu as qt
+    from quest_tpu import algorithms as alg
+
+    env = qt.createQuESTEnv(num_devices=args.devices, seed=[0])
+    if args.circuit == "qft":
+        circ = alg.qft(args.qubits)
+    elif args.circuit == "grover":
+        circ = alg.grover(args.qubits, marked=(1 << args.qubits) - 3,
+                          num_iterations=4)
+    else:
+        from bench import build_bench_circuit
+        circ, _ = build_bench_circuit(args.qubits, 1)
+    kw = {}
+    if args.fusion is not None:
+        kw["fusion"] = args.fusion
+    cc = circ.compile(env, pallas="off",
+                      comm_planner=(args.planner == "on"),
+                      lookahead=args.lookahead, **kw)
+    json.dump(trace_schedule(cc), sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
