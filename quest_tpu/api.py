@@ -1238,37 +1238,27 @@ def _jit_apply_pauli_sum(state_f, num_qubits_vec, num_qubits, codes_flat,
     return pack(acc)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
-def _jit_expec_pauli_sum_sv(state_f, num_qubits, n, codes_flat, coeffs_f):
-    """sum_t c_t <psi|P_t|psi> in ONE executable — the reference (and the
-    round-3 code) pays one dispatch + host sync per term
+@jax.jit
+def _jit_expec_pauli_sum_sv(state_f, xmask, ymask, zmask, coeffs_f):
+    """sum_t c_t <psi|P_t|psi> in ONE executable with ONE scalar transfer
+    — the reference pays one dispatch + host sync per term
     (``QuEST_common.c:464-491``); a 50-term molecular Hamiltonian cost 50
-    round-trips. Term count is static, so one compile serves every
-    coefficient vector of that Hamiltonian shape."""
-    z = unpack(state_f)
-    targets = tuple(range(n))
-    num_terms = len(codes_flat) // n
-    total = jnp.zeros((), dtype=coeffs_f.dtype)
-    for t in range(num_terms):
-        codes = codes_flat[t * n:(t + 1) * n]
-        phi = _pauli_prod_state(z, num_qubits, targets, codes)
-        total = total + coeffs_f[t] * jnp.real(jnp.vdot(z, phi)).astype(
-            coeffs_f.dtype)
-    return total
+    round-trips. Terms are bit masks (DATA, ``ops/reductions.py``), so
+    one compile serves every Hamiltonian of a bucketed term count — the
+    round-7 code unrolled a Python loop over static codes, which forced
+    48-term compile chunks and one host sync per chunk."""
+    return red.pauli_sum_total_sv(unpack(state_f), xmask, ymask, zmask,
+                                  coeffs_f)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
-def _jit_expec_pauli_sum_dm(state_f, num_qubits_vec, n, codes_flat, coeffs_f):
-    z = unpack(state_f)
-    targets = tuple(range(n))
-    num_terms = len(codes_flat) // n
-    total = jnp.zeros((), dtype=coeffs_f.dtype)
-    for t in range(num_terms):
-        codes = codes_flat[t * n:(t + 1) * n]
-        phi = _pauli_prod_state(z, num_qubits_vec, targets, codes)
-        total = total + coeffs_f[t] * dm.calc_total_prob(phi, n).astype(
-            coeffs_f.dtype)
-    return total
+@functools.partial(jax.jit, static_argnums=(1,))
+def _jit_expec_pauli_sum_dm(state_f, n, xmask, ymask, zmask, coeffs_f):
+    """sum_t c_t Tr(P_t rho), device-accumulated, one scalar transfer.
+    Each term reads only the 2^n paired-diagonal entries (an xor-gather,
+    ``ops/reductions.py``) instead of streaming the 2^(2n) flat vector
+    through per-qubit Pauli kernels."""
+    return red.pauli_sum_total_dm(unpack(state_f), n, xmask, ymask, zmask,
+                                  coeffs_f)
 
 
 def calcExpecPauliProd(qureg: Qureg, targets: Sequence[int],
@@ -1314,12 +1304,6 @@ def calcExpecPauliProd(qureg: Qureg, targets: Sequence[int],
     return float(value)
 
 
-# unroll/remap guards for the fused Pauli-sum executables (advisor r4):
-# above _PAULI_SUM_CHUNK terms the program is compiled in chunks; above
-# _PAULI_REMAP_TERMS_MAX terms a lazy layout is canonicalised rather than
-# remapped into the (static, hence recompiling) codes argument
-_PAULI_SUM_CHUNK = 48
-_PAULI_REMAP_TERMS_MAX = 8
 
 
 def calcExpecPauliSum(qureg: Qureg, all_codes: Sequence[int],
@@ -1350,49 +1334,38 @@ def calcExpecPauliSum(qureg: Qureg, all_codes: Sequence[int],
                 value += float(coeffs[t]) * ddm.dd_vdot(qureg.state,
                                                         phi).real
         return value
-    coeffs_f = jnp.asarray(np.asarray(coeffs[:num_terms], np.float64),
-                           qureg.real_dtype)
     if qureg.layout is not None:
-        if qureg.is_density_matrix or num_terms > _PAULI_REMAP_TERMS_MAX:
-            # large sums: one relayout beats recompiling the whole
-            # Hamiltonian program per layout permutation (codes are a
-            # static arg — every distinct remap is a fresh executable)
-            _canon(qureg)
+        if qureg.is_density_matrix:
+            _canon(qureg)    # row/col pairing is positional
         else:
             # permute each term's codes to the physical positions — the
-            # expectation probes targets in place, no exchange
+            # expectation probes targets in place, no exchange. Codes are
+            # DATA (bit masks) now, so the remap never recompiles and is
+            # worth it at ANY term count (the old static-codes path
+            # canonicalised above 8 terms to avoid per-permutation
+            # executables)
             lay = qureg.layout
             remapped = list(codes_flat)
             for t in range(num_terms):
                 for q_l in range(n):
                     remapped[t * n + int(lay[q_l])] = codes_flat[t * n + q_l]
             codes_flat = tuple(remapped)
-    if num_terms > _PAULI_SUM_CHUNK:
-        # cap the unrolled program length: XLA compile time grows
-        # superlinearly with trace size, so a many-hundred-term
-        # Hamiltonian compiles as ceil(T/chunk) mid-size executables
-        # (each cached) instead of one enormous one
-        total = 0.0
-        for start in range(0, num_terms, _PAULI_SUM_CHUNK):
-            stop = min(start + _PAULI_SUM_CHUNK, num_terms)
-            chunk_codes = codes_flat[start * n:stop * n]
-            chunk_coeffs = coeffs_f[start:stop]
-            if qureg.is_density_matrix:
-                total += float(_jit_expec_pauli_sum_dm(
-                    qureg.state, qureg.num_qubits_in_state_vec, n,
-                    chunk_codes, chunk_coeffs))
-            else:
-                total += float(_jit_expec_pauli_sum_sv(
-                    qureg.state, qureg.num_qubits_in_state_vec, n,
-                    chunk_codes, chunk_coeffs))
-        return total
+    # term-batched device-resident reduction (ops/reductions.py): the
+    # terms become xor/sign mask ARRAYS, padded to a power-of-two bucket
+    # (zero-coefficient identity terms) so one executable serves every
+    # Hamiltonian in the band — no per-chunk compiles, no per-chunk (or
+    # per-term) host syncs on either the statevector or density path;
+    # the single float() below is the only device->host transfer.
+    xm, ym, zm, coeffs_np = red.pauli_sum_operands(
+        codes_flat, n, np.asarray(coeffs[:num_terms], np.float64))
+    coeffs_f = jnp.asarray(coeffs_np, qureg.real_dtype)
     if qureg.is_density_matrix:
         value = _jit_expec_pauli_sum_dm(
-            qureg.state, qureg.num_qubits_in_state_vec, n, codes_flat,
-            coeffs_f)
+            qureg.state, n, jnp.asarray(xm), jnp.asarray(ym),
+            jnp.asarray(zm), coeffs_f)
     else:
         value = _jit_expec_pauli_sum_sv(
-            qureg.state, qureg.num_qubits_in_state_vec, n, codes_flat,
+            qureg.state, jnp.asarray(xm), jnp.asarray(ym), jnp.asarray(zm),
             coeffs_f)
     return float(value)
 

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import warnings
 from typing import Callable, Optional, Sequence, Union
 
 import numpy as np
@@ -1561,6 +1562,14 @@ class CompiledCircuit:
         self._donate = donate
         self._in_sharding = sharding   # the run()/precompile() input layout
 
+        # batched ensemble engine (sweep / expectation_sweep /
+        # sample_sweep): executables keyed on (form, dtype,
+        # batch-sharding mode, donation) — a precision or mesh-policy
+        # change compiles its own program instead of reusing a stale one
+        self._batched_cache: dict = {}
+        self._batch_stats: Optional[dict] = None
+        self._warned_nondivisible = False
+
     def _param_vec(self, params: Optional[dict]) -> jnp.ndarray:
         if params is None:
             params = {}
@@ -1713,6 +1722,7 @@ class CompiledCircuit:
                     saved = max(0.0, base["bytes"] - planned)
             self._comm_bytes_planned = planned
             self._comm_bytes_saved = saved
+        bs = self._batch_stats or {}
         return DispatchStats(
             gates_in=self.circuit.depth,
             kernels_out=self.plan.num_kernels,
@@ -1725,7 +1735,10 @@ class CompiledCircuit:
             swaps_absorbed=self.plan.swaps_absorbed,
             collectives_fused=self.plan.collectives_fused,
             comm_bytes_planned=self._comm_bytes_planned,
-            comm_bytes_saved=self._comm_bytes_saved)
+            comm_bytes_saved=self._comm_bytes_saved,
+            batch_size=bs.get("batch_size", 0),
+            host_syncs_avoided=bs.get("host_syncs_avoided", 0),
+            batch_sharding_mode=bs.get("batch_sharding_mode", "none"))
 
     def _xla_only(self) -> "CompiledCircuit":
         """This program with the Pallas layer pass off (cached twin).
@@ -1742,6 +1755,27 @@ class CompiledCircuit:
                 self.circuit, self.env, donate=False, pallas=False,
                 **self._compile_opts)
         return self._xla_twin
+
+    def _validated_pauli_terms(self, pauli_terms, coeffs):
+        """Shared Hamiltonian validation for :meth:`expectation_fn` and
+        :meth:`expectation_sweep`: returns ``(nq, terms, coeffs)`` with
+        identity factors dropped AFTER validation (a malformed
+        ``(qubit, 0)`` pair still errors instead of vanishing)."""
+        nq = self.num_qubits // 2 if self.is_density else self.num_qubits
+        for t in pauli_terms:
+            for q, code in t:
+                if not 0 <= int(q) < nq:
+                    raise ValueError(
+                        f"pauli qubit {q} out of range [0, {nq})")
+                if int(code) not in (0, 1, 2, 3):
+                    raise ValueError(f"invalid pauli code {code}")
+        terms = [tuple((int(q), int(c)) for q, c in t if int(c) != 0)
+                 for t in pauli_terms]
+        coeffs = np.asarray(coeffs, dtype=np.float64)
+        if len(coeffs) != len(terms):
+            raise ValueError(f"{len(terms)} pauli terms but "
+                             f"{len(coeffs)} coefficients")
+        return nq, terms, coeffs
 
     def expectation_fn(self, pauli_terms: Sequence[Sequence[tuple[int, int]]],
                        coeffs: Sequence[float]) -> Callable:
@@ -1761,22 +1795,7 @@ class CompiledCircuit:
         """
         n = self.num_qubits
         cdtype = self.env.precision.complex_dtype
-        nq = n // 2 if self.is_density else n
-        for t in pauli_terms:
-            for q, code in t:
-                if not 0 <= int(q) < nq:
-                    raise ValueError(
-                        f"pauli qubit {q} out of range [0, {nq})")
-                if int(code) not in (0, 1, 2, 3):
-                    raise ValueError(f"invalid pauli code {code}")
-        # identity factors are free: drop them AFTER validation so a
-        # malformed (qubit, 0) pair still errors instead of vanishing
-        terms = [tuple((int(q), int(c)) for q, c in t if int(c) != 0)
-                 for t in pauli_terms]
-        coeffs = np.asarray(coeffs, dtype=np.float64)
-        if len(coeffs) != len(terms):
-            raise ValueError(f"{len(terms)} pauli terms but "
-                             f"{len(coeffs)} coefficients")
+        nq, terms, coeffs = self._validated_pauli_terms(pauli_terms, coeffs)
 
         if self.is_density:
             # Tr(P rho): P applied on the KET half (low positions — the
@@ -1809,48 +1828,405 @@ class CompiledCircuit:
 
         return jax.jit(energy)
 
-    def sweep(self, param_matrix, state_f=None):
-        """Run a whole batch of parameter vectors through ONE executable.
+    # -- batched ensemble engine ------------------------------------------
+    #
+    # The serving workload is not one circuit — it is thousands of
+    # parameter bindings of the SAME circuit (VQE energy surfaces,
+    # phase-diagram sweeps, shot batches; arXiv:2203.16044,
+    # arXiv:2111.10466 optimise exactly this ensemble shape). The engine
+    # maps (batch, 2, 2^n) planes through ONE executable: sequential plan
+    # segments are vmapped, Pallas layer runs ride a batch-grown kernel
+    # grid (ops/pallas_kernels.apply_layer_batched) instead of falling
+    # back to the layer-free XLA twin, and on a mesh the batch axis
+    # shards per the CommCostModel-priced policy
+    # (parallel/layout.choose_batch_sharding) with non-divisible batches
+    # padded-and-masked rather than silently replicated.
 
-        ``param_matrix``: ``(B, len(param_names))``. ``state_f``: packed
-        planes shared by every run (default |0..0>). Returns ``(B, 2,
-        2^n)`` packed planes — ``jax.vmap`` over the sequential program
-        form, so the batch dimension rides the MXU instead of a Python
-        loop (the VQE / phase-diagram sweep workload; no reference
-        counterpart). On a mesh env the BATCH axis shards over the
-        devices when divisible (sweeps are embarrassingly parallel — the
-        amplitude-sharded shard_map program cannot be vmapped and would
-        be the wrong layout anyway)."""
+    def _batched_segments(self):
+        """The plan's item stream split into vmappable sequential
+        segments and batched Pallas layer steps: a list of
+        ``("seq", items)`` / ``("layer", op_index)`` entries."""
+        segs: list = []
+        cur: list = []
+        for item in self.plan.items:
+            if (item[0] == "op"
+                    and getattr(self._ops[item[1]], "kind", None)
+                    == "layer"):
+                if cur:
+                    segs.append(("seq", tuple(cur)))
+                    cur = []
+                segs.append(("layer", item[1]))
+            else:
+                cur.append(item)
+        if cur:
+            segs.append(("seq", tuple(cur)))
+        return segs
+
+    def _run_plan_batched(self, states, pm):
+        """(batch, 2^n) complex states + (batch, P) params -> same shape.
+        Mirrors ``run_plan_seq`` (relayouts as plain transposes; a
+        cross-shard pair-exchange item is just the unitary at its
+        physical position — the full-state form reaches any bit), with
+        the batch axis vmapped per segment and fused layers applied by
+        the batch-gridded Pallas kernel."""
+        from .parallel import apply_relayout
+        n = self.num_qubits
+        ops = self._ops
+        names = self.param_names
+        for kind, payload in self._batched_segments():
+            if kind == "layer":
+                from .ops import pallas_kernels as pk
+                states = pk.apply_layer_batched(
+                    states, n, ops[payload],
+                    interpret=self._pallas_interpret)
+                continue
+
+            def seg_fn(state, vec, _items=payload):
+                params = {nm: vec[i] for i, nm in enumerate(names)}
+                for item in _items:
+                    if item[0] == "relayout":
+                        _, before, after = item
+                        state = apply_relayout(state, n, before, after,
+                                               None)
+                        continue
+                    _, i, phys_targets, cmask, fmask, axis_order = item
+                    op = ops[i]
+                    if op.kind == "u":
+                        u = op.mat_fn(params) if op.mat_fn is not None \
+                            else op.mat
+                        state = apply_unitary(state, n, u, phys_targets,
+                                              cmask, fmask)
+                    else:
+                        d = op.diag_fn(params) if op.diag_fn is not None \
+                            else op.diag
+                        d = jnp.transpose(jnp.asarray(d), axis_order)
+                        state = apply_diagonal(state, n, phys_targets, d)
+                return state
+
+            states = jax.vmap(seg_fn, in_axes=(0, 0))(states, pm)
+        return states
+
+    def _batch_policy(self, batch: int) -> dict:
+        """The mesh batch-sharding decision for a ``batch``-point
+        ensemble (:func:`quest_tpu.parallel.layout.choose_batch_sharding`,
+        priced by the compile-time comm model)."""
+        from .parallel.layout import choose_batch_sharding
+        return choose_batch_sharding(
+            self.num_qubits, batch, self.env.num_devices,
+            np.dtype(self.env.precision.real_dtype).itemsize,
+            self.plan.num_relayouts, cost_model=self._cost_model)
+
+    def _batch_constraint(self, mode: str):
+        """Amplitude-axis sharding constraint for the in-engine
+        (batch, 2^n) complex ensemble (``amp`` mode only — batch mode
+        runs under shard_map and needs no constraints)."""
+        if mode != "amp" or self.env.mesh is None:
+            return lambda z: z
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .env import AMP_AXIS
+        sh = NamedSharding(self.env.mesh, P(None, AMP_AXIS))
+        return lambda z: jax.lax.with_sharding_constraint(z, sh)
+
+    def _batched_runner(self, mode: str):
+        """The plan executor for a policy mode. In ``amp`` mode the
+        ensemble is amplitude-sharded under GSPMD, which has no
+        partitioning rule for a ``pallas_call`` (it would replicate the
+        whole batch on every device — an OOM exactly where amp mode was
+        chosen for memory), so the layer-free XLA twin's plan runs
+        there; every other mode keeps the fused layers (batch mode wraps
+        the call in shard_map, where the kernel sees only the per-device
+        sub-batch)."""
+        src = self._xla_only() if (mode == "amp"
+                                   and self.env.mesh is not None) else self
+        return src._run_plan_batched
+
+    def _validated_param_matrix(self, param_matrix):
+        """Shared (B, P) coercion/validation for the engine entries."""
         pm = jnp.asarray(param_matrix, dtype=self.env.precision.real_dtype)
         if pm.ndim != 2 or pm.shape[1] != len(self.param_names):
             raise ValueError(
                 f"param_matrix must be (batch, {len(self.param_names)}); "
                 f"got {pm.shape}")
+        return pm
+
+    def _wrap_batch_spmd(self, fn, mode: str, in_specs, out_specs):
+        """Batch-parallel SPMD wrapper, shared by every batched
+        executable: in ``batch`` mode on a mesh the whole body runs as a
+        shard_map over the batch axis — each device computes WHOLE
+        states on its local sub-batch with zero collectives, and the
+        Pallas layer call stays inside the per-device body (the same
+        pattern as the amplitude-sharded executor's local_body) so it
+        never meets the GSPMD partitioner, which has no rule for a
+        ``pallas_call`` and would replicate the ensemble. Identity in
+        every other mode."""
+        if mode != "batch" or self.env.mesh is None:
+            return fn
+        from .compat import shard_map
+        return shard_map(fn, mesh=self.env.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+    def _batched_fn(self, broadcast: bool, donate: bool, mode: str):
+        """The batched executable for one (form, mode) combination.
+        Keyed cache — dtype and batch-sharding mode are part of the key,
+        so a precision or mesh-policy change compiles fresh instead of
+        reusing a stale program (the round-7 code cached one executable
+        under a bare ``hasattr``)."""
+        key = (broadcast, donate, mode,
+               str(np.dtype(self.env.precision.real_dtype)))
+        fn = self._batched_cache.get(key)
+        if fn is not None:
+            return fn
+        constrain = self._batch_constraint(mode)
+        run_batched = self._batched_runner(mode)
+
+        def body(states, pm):
+            states = constrain(states)
+            states = run_batched(states, pm)
+            out = constrain(states)
+            return jnp.stack([jnp.real(out), jnp.imag(out)], axis=1)
+
+        if broadcast:
+            def apply_fn(state_f, pm):
+                z = unpack(state_f)
+                states = jnp.broadcast_to(z, (pm.shape[0],) + z.shape)
+                return body(states, pm)
+        else:
+            def apply_fn(planes, pm):
+                return body(jax.lax.complex(planes[:, 0], planes[:, 1]),
+                            pm)
+
+        from jax.sharding import PartitionSpec as P
+        from .env import AMP_AXIS
+        apply_fn = self._wrap_batch_spmd(
+            apply_fn, mode,
+            in_specs=(P() if broadcast else P(AMP_AXIS, None, None),
+                      P(AMP_AXIS, None)),
+            out_specs=P(AMP_AXIS, None, None))
+        # a shared (broadcast) input cannot be donated
+        fn = jax.jit(apply_fn,
+                     donate_argnums=(0,) if donate and not broadcast
+                     else ())
+        self._batched_cache[key] = fn
+        return fn
+
+    def _padded_params(self, pm, mode: str):
+        """Pad-and-mask for non-divisible batches: the parameter matrix
+        is zero-padded to the next device multiple (the padded rows
+        compute throwaway states that the caller-facing slice masks off)
+        instead of silently running the whole sweep replicated. Warns
+        once per compiled circuit."""
+        B = pm.shape[0]
+        D = self.env.num_devices
+        # only the batch-parallel mode splits the batch axis; amp mode
+        # shards amplitudes, so any batch size runs unpadded there
+        if mode != "batch" or B % D == 0:
+            return pm, B
+        pad = (-B) % D
+        if not self._warned_nondivisible:
+            warnings.warn(
+                f"sweep batch of {B} is not divisible by the {D}-device "
+                f"mesh; padding to {B + pad} and masking the {pad} extra "
+                "rows (earlier releases silently ran the batch "
+                "replicated on every device)", UserWarning, stacklevel=3)
+            self._warned_nondivisible = True
+        pm = jnp.concatenate(
+            [pm, jnp.zeros((pad,) + pm.shape[1:], pm.dtype)])
+        return pm, B
+
+    def _record_batch_stats(self, batch: int, mode: str,
+                            host_syncs_avoided: int) -> None:
+        self._batch_stats = {"batch_size": batch,
+                             "batch_sharding_mode": mode,
+                             "host_syncs_avoided": host_syncs_avoided}
+
+    def _place_batch(self, arr, mode: str, amp_shardable: bool = False):
+        """Commit a batch-leading array to the policy's input layout so
+        the executable starts from the right placement instead of
+        resharding on entry. In ``amp`` mode only state-plane arrays
+        (``amp_shardable``) split — small operands (the parameter
+        matrix) stay replicated."""
+        if mode == "none" or self.env.mesh is None:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .env import AMP_AXIS
+        if mode == "batch":
+            spec = P(AMP_AXIS, *([None] * (arr.ndim - 1)))
+        elif amp_shardable:
+            spec = P(*([None] * (arr.ndim - 1)), AMP_AXIS)
+        else:
+            return arr
+        return jax.device_put(arr, NamedSharding(self.env.mesh, spec))
+
+    def sweep(self, param_matrix, state_f=None):
+        """Run a whole batch of parameter vectors through ONE executable.
+
+        ``param_matrix``: ``(B, len(param_names))``. ``state_f``: either
+        packed ``(2, 2^n)`` planes shared by every run (default |0..0>),
+        or an OWNED ``(B, 2, 2^n)`` batch of planes — the batch form is
+        DONATED to the executable (XLA reuses the buffer in place), so
+        chained sweeps stream through one allocation. Returns ``(B, 2,
+        2^n)`` packed planes.
+
+        Fused Pallas layer runs stay active under the batch axis (the
+        kernel grid grows a batch dimension); on a mesh env the batch
+        axis shards per :func:`quest_tpu.parallel.layout.
+        choose_batch_sharding` — batch-parallel while the per-device
+        working set fits, amplitude-sharded past the memory wall — and
+        non-divisible batches are padded and masked."""
+        pm = self._validated_param_matrix(param_matrix)
+        n = self.num_qubits
+        B = pm.shape[0]
+        mode = self._batch_policy(B)["mode"]
+        pm_run, B = self._padded_params(pm, mode)
+        pm_run = self._place_batch(pm_run, mode)
+        # coerce BEFORE shape-dispatching: a nested list has no .ndim,
+        # and a wrong-width or wrong-dtype shared state must fail here
+        # with a shaped error, not deep inside the trace
+        if state_f is not None:
+            state_f = jnp.asarray(state_f,
+                                  dtype=self.env.precision.real_dtype)
+            if state_f.ndim not in (2, 3):
+                raise ValueError(
+                    f"state_f must be shared (2, {1 << n}) planes or an "
+                    f"owned (batch, 2, {1 << n}) batch; got shape "
+                    f"{state_f.shape}")
+            if state_f.ndim == 2 and state_f.shape != (2, 1 << n):
+                raise ValueError(
+                    f"shared state_f must be (2, {1 << n}); got "
+                    f"{state_f.shape}")
+        if state_f is None or state_f.ndim == 2:
+            if state_f is None:
+                state_f = jnp.zeros((2, 1 << n),
+                                    dtype=self.env.precision.real_dtype
+                                    ).at[0, 0].set(1.0)
+            out = self._batched_fn(True, False, mode)(state_f, pm_run)
+        else:
+            planes = state_f
+            if planes.shape != (B, 2, 1 << n):
+                raise ValueError(
+                    f"batched state_f must be ({B}, 2, {1 << n}); got "
+                    f"{planes.shape}")
+            if pm_run.shape[0] != B:
+                planes = jnp.concatenate(
+                    [planes, jnp.zeros((pm_run.shape[0] - B,) +
+                                       planes.shape[1:], planes.dtype)])
+            planes = self._place_batch(planes, mode, amp_shardable=True)
+            out = self._batched_fn(False, True, mode)(planes, pm_run)
+        self._record_batch_stats(B, mode, B - 1)
+        return out[:B] if out.shape[0] != B else out
+
+    def expectation_sweep(self, param_matrix, hamiltonian, state_f=None):
+        """``(B,)`` energies ``<H>(params_b)`` from ONE executable and
+        ONE device->host transfer.
+
+        ``hamiltonian``: ``(pauli_terms, coeffs)`` exactly as
+        :meth:`expectation_fn` takes them. Each point runs the compiled
+        program from |0..0> (or ``state_f`` planes) and reduces the
+        whole Pauli sum device-side (term-batched xor-gather kernels,
+        :mod:`quest_tpu.ops.reductions`) — where a loop of ``run`` +
+        ``calcExpecPauliSum`` pays at least one transfer per point (the
+        reference pays one per TERM per point,
+        ``QuEST_common.c:464-491``). Works on density-compiled circuits
+        too: the value is ``Tr(H rho(params))`` through the program's
+        channels."""
+        pauli_terms, coeffs = hamiltonian
+        nq, terms, coeffs = self._validated_pauli_terms(pauli_terms,
+                                                        coeffs)
+        from .ops import reductions as red
+        n = self.num_qubits
+        T = len(terms)
+        # flatten to the calcExpecPauliSum codes layout and run the ONE
+        # shared encoder (masks + term-bucket padding) — two mask
+        # builders would desynchronise silently
+        codes = np.zeros((T, nq), np.int64)
+        for t, term in enumerate(terms):
+            for q, code in term:
+                if codes[t, q]:
+                    raise ValueError(
+                        f"pauli term {t} repeats qubit {q} (a product of "
+                        "Paulis on one qubit is not a Pauli string)")
+                codes[t, q] = code
+        xm, ym, zm, coeffs = red.pauli_sum_operands(
+            codes.reshape(-1), nq, coeffs)
+
+        pm = self._validated_param_matrix(param_matrix)
+        B = pm.shape[0]
+        mode = self._batch_policy(B)["mode"]
+        pm_run, B = self._padded_params(pm, mode)
+        pm_run = self._place_batch(pm_run, mode)
+
+        key = ("energy", mode,
+               str(np.dtype(self.env.precision.real_dtype)))
+        fn = self._batched_cache.get(key)
+        if fn is None:
+            constrain = self._batch_constraint(mode)
+            run_batched = self._batched_runner(mode)
+            is_density = self.is_density
+
+            def energy(state_f_, pm_, xm_, ym_, zm_, cf_):
+                z = unpack(state_f_)
+                states = jnp.broadcast_to(z, (pm_.shape[0],) + z.shape)
+                states = constrain(states)
+                states = run_batched(states, pm_)
+                states = constrain(states)
+                if is_density:
+                    return jax.vmap(lambda s: red.pauli_sum_total_dm(
+                        s, nq, xm_, ym_, zm_, cf_))(states)
+                return jax.vmap(lambda s: red.pauli_sum_total_sv(
+                    s, xm_, ym_, zm_, cf_))(states)
+
+            from jax.sharding import PartitionSpec as P
+            from .env import AMP_AXIS
+            energy = self._wrap_batch_spmd(
+                energy, mode,
+                in_specs=(P(), P(AMP_AXIS, None), P(), P(), P(), P()),
+                out_specs=P(AMP_AXIS))
+            fn = jax.jit(energy)
+            self._batched_cache[key] = fn
         if state_f is None:
-            n = self.num_qubits
             state_f = jnp.zeros((2, 1 << n),
                                 dtype=self.env.precision.real_dtype
                                 ).at[0, 0].set(1.0)
-        # the pure (non-donating) form: the shared input state cannot be
-        # donated across a vmapped batch. Cached so repeat sweeps (an
-        # optimiser loop) hit the jit cache instead of retracing.
-        if not hasattr(self, "_sweep_jitted"):
-            run_plan_seq = self._xla_only()._run_plan_seq
+        elif getattr(state_f, "shape", None) != (2, 1 << n):
+            # the energy executable broadcasts ONE shared start state; a
+            # (B, 2, 2^n) batch would silently mis-unpack deep in the
+            # trace — reject it at the boundary
+            raise ValueError(
+                f"expectation_sweep state_f must be shared (2, {1 << n}) "
+                f"planes; got {getattr(state_f, 'shape', None)} (run "
+                "batched planes through sweep(), then reduce)")
+        out = fn(state_f, pm_run, jnp.asarray(xm), jnp.asarray(ym),
+                 jnp.asarray(zm),
+                 jnp.asarray(coeffs, dtype=self.env.precision.real_dtype))
+        # the engine-off path is B runs x (>= 1 sync per point; the
+        # reference: one per term per point) — the engine's whole sweep
+        # is one (B,) transfer
+        self._record_batch_stats(B, mode, B * max(T, 1) - 1)
+        return out[:B] if out.shape[0] != B else out
 
-            def seq_apply(sf, vec):
-                params = {nm: vec[i]
-                          for i, nm in enumerate(self.param_names)}
-                return pack(run_plan_seq(unpack(sf), params))
-
-            self._sweep_jitted = jax.jit(
-                jax.vmap(seq_apply, in_axes=(None, 0)))
-        if (self.env.mesh is not None
-                and pm.shape[0] % self.env.num_devices == 0):
-            from jax.sharding import NamedSharding, PartitionSpec
-            from .env import AMP_AXIS
-            pm = jax.device_put(pm, NamedSharding(
-                self.env.mesh, PartitionSpec(AMP_AXIS, None)))
-        return self._sweep_jitted(state_f, pm)
+    def sample_sweep(self, param_matrix, num_shots: int, key=None):
+        """Shot batches over a parameter sweep: run the batched program
+        and draw ``num_shots`` basis outcomes per point (one vmapped
+        sampling pass, :func:`quest_tpu.parallel.sampling.
+        sample_batched`). Returns ``(indices, totals)``: an int64
+        ``(B, num_shots)`` outcome array and the ``(B,)`` pre-sampling
+        norms. Statevector-compiled circuits only."""
+        if self.is_density:
+            raise ValueError(
+                "sample_sweep draws from |amp|^2 of statevector "
+                "programs; sample density registers via sampleOutcomes")
+        from .parallel.sampling import sample_batched
+        planes = self.sweep(param_matrix)
+        if key is None:
+            key = self.env.next_key()
+        idx, totals = sample_batched(planes, key, int(num_shots))
+        stats = dict(self._batch_stats or {})
+        # the engine pays exactly two transfers (the (B, shots) index
+        # block and the (B,) totals) where the per-point loop pays 2B
+        # (one run + one sampling sync per point)
+        stats["host_syncs_avoided"] = 2 * planes.shape[0] - 2
+        self._batch_stats = stats
+        return idx, totals
 
     def __repr__(self) -> str:
         return (f"CompiledCircuit(qubits={self.num_qubits}, "

@@ -47,7 +47,7 @@ DEFAULT_BLOCK_ROWS = 1024
 
 __all__ = ["LANE_QUBITS", "DEFAULT_BLOCK_ROWS", "LayerOp",
            "embed_lane_matrix", "lane_diag_matrix", "lane_diag_vector",
-           "max_mid_qubit", "apply_layer"]
+           "max_mid_qubit", "apply_layer", "apply_layer_batched"]
 
 
 def embed_lane_matrix(u: np.ndarray, targets: Sequence[int],
@@ -176,13 +176,25 @@ def _global_row(base, shape, axis):
 
 
 def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, tre_ref, tim_ref,
-                  ore_ref, oim_ref, *, stages, block_rows):
+                  ore_ref, oim_ref, *, stages, block_rows,
+                  batched: bool = False):
     from jax.experimental import pallas as pl
 
-    re = re_ref[:]
-    im = im_ref[:]
-    rows = block_rows
-    base = pl.program_id(0) * rows
+    # batched form: the grid grows a LEADING batch dimension and state
+    # blocks carry a unit batch axis — grid (B, row_blocks), block
+    # (1, block_rows, 128). The row base comes from grid axis 1, so every
+    # row-indexed stage (controls, rowdiag tables, rowk regroups) sees the
+    # same per-STATE row coordinates as the unbatched kernel.
+    if batched:
+        re = re_ref[0]
+        im = im_ref[0]
+        rows = block_rows
+        base = pl.program_id(1) * rows
+    else:
+        re = re_ref[:]
+        im = im_ref[:]
+        rows = block_rows
+        base = pl.program_id(0) * rows
     acc = re.dtype  # f32 accumulate on TPU; f64 under x64 interpret
     for st in stages:
         tag = st[0]
@@ -343,8 +355,12 @@ def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, tre_ref, tim_ref,
             new_re = re * fre - im * fim
             new_im = re * fim + im * fre
             re, im = new_re, new_im
-    ore_ref[:] = re
-    oim_ref[:] = im
+    if batched:
+        ore_ref[0] = re
+        oim_ref[0] = im
+    else:
+        ore_ref[:] = re
+        oim_ref[:] = im
 
 
 def layer_kernel_plan(layer: LayerOp, num_qubits: int,
@@ -431,19 +447,22 @@ def choose_block_rows(kstages, mstack, tstack, block_rows: int,
     return block_rows, est
 
 
-def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
-                block_rows: int = DEFAULT_BLOCK_ROWS,
-                interpret: bool = False) -> jnp.ndarray:
-    """Apply a fused layer to a flat complex state (traceable; call under
-    jit — the pallas_call compiles into the surrounding program)."""
-    from jax.experimental import pallas as pl
+def _layer_operands(layer: LayerOp, num_qubits: int, block_rows: int,
+                    rdtype):
+    """Shared operand prep for the (batched and unbatched) layer calls:
+    validated stage plan, stacked matrix/table operands as split-plane
+    jnp arrays, and the VMEM-fitted block size.
 
+    Mosaic scoped-VMEM budget: the stage chain keeps ~2 live (rows,128)
+    plane pairs per stage (Mosaic does not fully reuse buffers across
+    stage boundaries); a 15-stage 22q brickwork layer measured 21.8 MB
+    against the 16 MB default limit on real v5e silicon (r5 tunnel,
+    HTTP-500 from the compile helper). Raise the limit toward the
+    chip's real VMEM and, if the estimate still exceeds it, halve the
+    block until it fits (choose_block_rows).
+    """
     kstages, mats, tables, block_rows, total_rows = layer_kernel_plan(
         layer, num_qubits, block_rows)
-
-    rdtype = jnp.float32 if state.dtype == jnp.complex64 else jnp.float64
-    re = jnp.real(state).astype(rdtype).reshape(total_rows, 128)
-    im = jnp.imag(state).astype(rdtype).reshape(total_rows, 128)
     mstack = (np.stack(mats) if mats
               else np.zeros((1, 128, 128), np.complex128))
     tstack = (np.stack(tables) if tables
@@ -452,29 +471,41 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
     mim = jnp.asarray(mstack.imag, rdtype)
     tre = jnp.asarray(tstack.real, rdtype)
     tim = jnp.asarray(tstack.imag, rdtype)
-
-    # Mosaic scoped-VMEM budget: the stage chain keeps ~2 live (rows,128)
-    # plane pairs per stage (Mosaic does not fully reuse buffers across
-    # stage boundaries); a 15-stage 22q brickwork layer measured 21.8 MB
-    # against the 16 MB default limit on real v5e silicon (r5 tunnel,
-    # HTTP-500 from the compile helper). Raise the limit toward the
-    # chip's real VMEM and, if the estimate still exceeds it, halve the
-    # block until it fits (choose_block_rows).
     itemsize = np.dtype(rdtype).itemsize
     vmem_limit = int(os.environ.get("QUEST_PALLAS_VMEM_LIMIT",
                                     100 * 1024 * 1024))
     block_rows, _ = choose_block_rows(kstages, mstack, tstack, block_rows,
                                       itemsize, vmem_limit)
+    return (kstages, mstack, tstack, mre, mim, tre, tim, block_rows,
+            total_rows, vmem_limit)
+
+
+def _compiler_kwargs(interpret: bool, vmem_limit: int) -> dict:
+    if interpret:
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+    return {"compiler_params": pltpu.CompilerParams(
+        vmem_limit_bytes=vmem_limit)}
+
+
+def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
+                block_rows: int = DEFAULT_BLOCK_ROWS,
+                interpret: bool = False) -> jnp.ndarray:
+    """Apply a fused layer to a flat complex state (traceable; call under
+    jit — the pallas_call compiles into the surrounding program)."""
+    from jax.experimental import pallas as pl
+
+    rdtype = jnp.float32 if state.dtype == jnp.complex64 else jnp.float64
+    (kstages, mstack, tstack, mre, mim, tre, tim, block_rows,
+     total_rows, vmem_limit) = _layer_operands(layer, num_qubits,
+                                               block_rows, rdtype)
+    re = jnp.real(state).astype(rdtype).reshape(total_rows, 128)
+    im = jnp.imag(state).astype(rdtype).reshape(total_rows, 128)
     kernel = functools.partial(_layer_kernel, stages=tuple(kstages),
                                block_rows=block_rows)
     state_spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
     mat_spec = pl.BlockSpec(mstack.shape, lambda i: (0, 0, 0))
     tab_spec = pl.BlockSpec(tstack.shape, lambda i: (0, 0))
-    kwargs = {}
-    if not interpret:
-        from jax.experimental.pallas import tpu as pltpu
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            vmem_limit_bytes=vmem_limit)
     with jax.named_scope(f"pallas_layer_{layer.members}gates"):
         out_re, out_im = pl.pallas_call(
             kernel,
@@ -484,9 +515,53 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
             out_specs=[state_spec, state_spec],
             out_shape=[jax.ShapeDtypeStruct((total_rows, 128), rdtype)] * 2,
             interpret=interpret,
-            **kwargs,
+            **_compiler_kwargs(interpret, vmem_limit),
         )(re, im, mre, mim, tre, tim)
     return jax.lax.complex(out_re, out_im).reshape(-1).astype(state.dtype)
+
+
+def apply_layer_batched(states: jnp.ndarray, num_qubits: int, layer: LayerOp,
+                        block_rows: int = DEFAULT_BLOCK_ROWS,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Apply a fused layer to a BATCH of flat complex states
+    ``(batch, 2^n)`` in one ``pallas_call``.
+
+    The kernel grid grows a leading batch dimension — ``(batch,
+    row_blocks)`` with state blocks of ``(1, block_rows, 128)`` — so the
+    batched ensemble engine keeps the fused-layer pass instead of
+    falling back to the per-gate XLA twin (``jax.vmap`` has no batching
+    rule for a compiled ``pallas_call``; growing the grid is the
+    TPU-native answer). Per-grid-step VMEM working set is identical to
+    the unbatched kernel: the batch axis only adds grid steps."""
+    from jax.experimental import pallas as pl
+
+    batch = states.shape[0]
+    rdtype = jnp.float32 if states.dtype == jnp.complex64 else jnp.float64
+    (kstages, mstack, tstack, mre, mim, tre, tim, block_rows,
+     total_rows, vmem_limit) = _layer_operands(layer, num_qubits,
+                                               block_rows, rdtype)
+    re = jnp.real(states).astype(rdtype).reshape(batch, total_rows, 128)
+    im = jnp.imag(states).astype(rdtype).reshape(batch, total_rows, 128)
+    kernel = functools.partial(_layer_kernel, stages=tuple(kstages),
+                               block_rows=block_rows, batched=True)
+    state_spec = pl.BlockSpec((1, block_rows, 128), lambda b, i: (b, i, 0))
+    mat_spec = pl.BlockSpec(mstack.shape, lambda b, i: (0, 0, 0))
+    tab_spec = pl.BlockSpec(tstack.shape, lambda b, i: (0, 0))
+    with jax.named_scope(
+            f"pallas_layer_b{batch}_{layer.members}gates"):
+        out_re, out_im = pl.pallas_call(
+            kernel,
+            grid=(batch, total_rows // block_rows),
+            in_specs=[state_spec, state_spec, mat_spec, mat_spec,
+                      tab_spec, tab_spec],
+            out_specs=[state_spec, state_spec],
+            out_shape=[jax.ShapeDtypeStruct((batch, total_rows, 128),
+                                            rdtype)] * 2,
+            interpret=interpret,
+            **_compiler_kwargs(interpret, vmem_limit),
+        )(re, im, mre, mim, tre, tim)
+    return jax.lax.complex(out_re, out_im).reshape(batch, -1).astype(
+        states.dtype)
 
 
 def _vmem_estimate(block_rows: int, kstages, mstack, tstack,

@@ -70,7 +70,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["LayoutPlan", "plan_layout", "apply_relayout", "is_swap_op",
-           "plan_comm_stats", "relayout_comm"]
+           "plan_comm_stats", "relayout_comm", "choose_batch_sharding"]
 
 _SWAP_MAT = np.array([[1, 0, 0, 0], [0, 0, 1, 0],
                       [0, 1, 0, 0], [0, 0, 0, 1]], dtype=np.complex128)
@@ -529,3 +529,63 @@ def plan_comm_stats(plan: LayoutPlan, chunk_bytes: float, cost_model,
     scale = num_devices if num_devices else 1
     return {"bytes": total_b * scale, "seconds": total_s,
             "launches": launches}
+
+
+# Per-device working-set budget for the batch-parallel mode's feasibility
+# check (overridable via QUEST_TPU_BATCH_MEM_BYTES). 2 GiB is a deliberate
+# floor — half a v5e chip's HBM after program + double-buffering headroom,
+# and comfortably inside any host that can run the mesh at all.
+DEFAULT_BATCH_MEM_BYTES = 2 << 30
+
+
+def choose_batch_sharding(num_qubits: int, batch: int, num_devices: int,
+                          itemsize: int, num_relayouts: int,
+                          cost_model=None,
+                          mem_limit_bytes: Optional[int] = None) -> dict:
+    """Pick the batched ensemble engine's sharding axis on a mesh.
+
+    An ensemble of ``batch`` independent states can shard the BATCH axis
+    (each device runs whole states, zero collectives) or the AMPLITUDE
+    axis (each state spans the mesh, every planned relayout becomes a
+    real collective — per batch element). The two modes do identical
+    arithmetic, so the decision is priced entirely in memory and modeled
+    collective seconds (:class:`quest_tpu.profiling.CommCostModel`):
+
+    - batch-parallel needs ``ceil(batch/D) * 2 * state_bytes`` resident
+      per device (input + output planes; donation reuses one of them,
+      the factor 2 is headroom for XLA temporaries) and spends 0 s on
+      the wire;
+    - amplitude-sharded needs only ``2 * state_bytes / D`` per device but
+      pays ``batch * num_relayouts`` all-to-all exchanges of the
+      ``state_bytes / D`` chunk.
+
+    Modeled comm time of the amp mode is >= 0 always, so batch-parallel
+    wins WHENEVER IT FITS — the crossover is the per-device memory wall,
+    and the cost model quantifies what crossing it costs (the returned
+    ``amp_comm_seconds``; docs/tpu.md "Batched execution & observables").
+
+    Returns ``{"mode": "none"|"batch"|"amp", "amp_comm_seconds": float,
+    "per_device_bytes": float}``.
+    """
+    import os
+    if num_devices <= 1 or batch < 1:
+        return {"mode": "none", "amp_comm_seconds": 0.0,
+                "per_device_bytes": 2.0 * itemsize * (1 << num_qubits)}
+    if mem_limit_bytes is None:
+        mem_limit_bytes = int(os.environ.get("QUEST_TPU_BATCH_MEM_BYTES",
+                                             DEFAULT_BATCH_MEM_BYTES))
+    if cost_model is None:
+        from ..profiling import DEFAULT_COMM_MODEL
+        cost_model = DEFAULT_COMM_MODEL
+    state_bytes = 2.0 * itemsize * (1 << num_qubits)    # split re/im planes
+    shard_bits = max(num_devices.bit_length() - 1, 1)
+    per_dev_batch = -(-batch // num_devices)
+    batch_mode_bytes = per_dev_batch * 2.0 * state_bytes
+    amp_comm = (batch * num_relayouts
+                * cost_model.all_to_all_seconds(state_bytes / num_devices,
+                                                shard_bits))
+    if batch_mode_bytes <= mem_limit_bytes:
+        return {"mode": "batch", "amp_comm_seconds": amp_comm,
+                "per_device_bytes": batch_mode_bytes}
+    return {"mode": "amp", "amp_comm_seconds": amp_comm,
+            "per_device_bytes": 2.0 * state_bytes / num_devices}

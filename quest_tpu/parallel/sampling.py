@@ -35,7 +35,7 @@ from jax.sharding import PartitionSpec as P
 from ..compat import shard_map
 from ..env import AMP_AXIS
 
-__all__ = ["sample_sharded"]
+__all__ = ["sample_sharded", "sample_batched"]
 
 
 # Bounded: an unbounded cache keyed on raw shot counts compiles and pins
@@ -110,3 +110,45 @@ def sample_sharded(planes: jax.Array, key, num_samples: int, density: bool,
     idx = (np.asarray(shard, dtype=np.int64)[:num_samples] * per_shard
            + np.asarray(loc, dtype=np.int64)[:num_samples])
     return idx, float(total)
+
+
+# Batch-keyed shot sampler for the ensemble engine: one vmapped
+# inverse-CDF executable draws num_samples outcomes from EVERY state of a
+# (B, 2, N) batch, each batch element under its own fold of the key.
+# Bounded + bucketed exactly like the mesh `_sampler` above (ADVICE r5):
+# shot counts share `_shot_bucket`'s power-of-two bands, so a shot-count
+# sweep reuses one executable per band instead of pinning a fresh
+# compilation per distinct count — and the two caches are independent
+# (batched draws never populate mesh `_sampler` entries, or vice versa).
+@functools.lru_cache(maxsize=32)
+def _batch_sampler(num_samples: int):
+    def body(planes, key):
+        probs = planes[0] * planes[0] + planes[1] * planes[1]
+        cum = jnp.cumsum(probs)
+        draws = jax.random.uniform(key, (num_samples,),
+                                   dtype=cum.dtype) * cum[-1]
+        idx = jnp.searchsorted(cum, draws, side="right")
+        return (jnp.minimum(idx, probs.shape[0] - 1).astype(jnp.int32),
+                cum[-1])
+
+    return jax.jit(jax.vmap(body, in_axes=(0, 0)))
+
+
+def sample_batched(planes: jax.Array, key, num_samples: int):
+    """Draw ``num_samples`` basis outcomes from EACH state of a batch.
+
+    ``planes``: ``(B, 2, N)`` packed re/im planes (the batched engine's
+    output shape). ``key`` is split per batch element so the B shot
+    streams are independent. Returns ``(indices, totals)``: int64
+    ``(B, num_samples)`` basis indices and the ``(B,)`` state norms
+    (pre-normalisation totals, for zero-norm guards) — one device pass
+    and two transfers (index block + totals) for the whole shot batch,
+    where per-point ``sampleOutcomes`` loops pay one round-trip per
+    point."""
+    if int(num_samples) < 1:
+        raise ValueError("num_samples must be >= 1")
+    bucket = _shot_bucket(int(num_samples))
+    keys = jax.random.split(key, planes.shape[0])
+    idx, totals = _batch_sampler(bucket)(planes, keys)
+    return (np.asarray(idx, dtype=np.int64)[:, :num_samples],
+            np.asarray(totals))

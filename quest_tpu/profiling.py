@@ -201,6 +201,11 @@ class DispatchStats:
     collectives_fused: int = 0   # relayout pairs merged into one exchange
     comm_bytes_planned: float = 0.0  # mesh-total collective bytes per run
     comm_bytes_saved: float = 0.0    # vs the count-based planner's plan
+    # batched ensemble engine accounting (set by the last sweep /
+    # expectation_sweep / sample_sweep on the compiled circuit):
+    batch_size: int = 0              # points in the last batched run
+    host_syncs_avoided: int = 0      # device->host transfers vs per-point
+    batch_sharding_mode: str = "none"  # "none" | "batch" | "amp"
 
     @property
     def dispatches(self) -> int:
@@ -230,7 +235,10 @@ class DispatchStats:
                 "collectives_fused": self.collectives_fused,
                 "collective_launches": self.collective_launches,
                 "comm_bytes_planned": self.comm_bytes_planned,
-                "comm_bytes_saved": self.comm_bytes_saved}
+                "comm_bytes_saved": self.comm_bytes_saved,
+                "batch_size": self.batch_size,
+                "host_syncs_avoided": self.host_syncs_avoided,
+                "batch_sharding_mode": self.batch_sharding_mode}
 
 
 @contextlib.contextmanager

@@ -76,6 +76,67 @@ def test_fast_exit_returns_promptly(capsys):
     assert elapsed < 30.0         # EOF ends the wait, no deadline sleep
 
 
+def test_ensemble_sweep_rows_required():
+    """The bench must deliver the ISSUE-3 sweep rows: engine-off and
+    engine-on points/sec for the same ensemble workload, with the
+    engine's accounting fields. Run tiny (6 qubits, batch 8) so the
+    delivery contract is tested, not the measurement."""
+    env_overrides = {
+        "QUEST_BENCH_SWEEP_QUBITS": "6",
+        "QUEST_BENCH_SWEEP_BATCH": "8",
+        "QUEST_BENCH_SWEEP_TERMS": "4",
+        "QUEST_BENCH_SWEEP_LAYERS": "1",
+        "QUEST_BENCH_TRIALS": "3",
+    }
+    old = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        import quest_tpu as qt
+        env = qt.createQuESTEnv(num_devices=1, seed=[2026])
+        rows = bench.bench_ensemble_sweep(qt, env, "cpu")
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    assert len(rows) == 2
+    off, on = rows
+    assert "engine-off" in off["metric"] and "engine-on" in on["metric"]
+    for row in rows:
+        assert row["unit"] == "points/sec"
+        assert row["value"] > 0.0
+        assert "hardware-efficient-ansatz-6" in row["metric"]
+        assert "batch=8" in row["metric"]
+        assert "Pauli sum" in row["metric"]
+    assert on["speedup_vs_engine_off"] > 0.0
+    assert on["batch_size"] == 8
+    assert on["host_syncs_avoided"] == 8 * 4 - 1   # O(1) transfers
+    assert on["batch_sharding_mode"] in ("none", "batch", "amp")
+    assert on["max_energy_deviation"] < 1e-10      # f64 suite precision
+    # bench_sharded_mesh must carry the rows too (the acceptance mesh)
+    import inspect
+    src = inspect.getsource(bench.bench_sharded_mesh)
+    assert "bench_ensemble_sweep" in src
+
+
+def test_warning_dedup_filter():
+    """Repeated xla_bridge 'Platform ... is experimental' records are
+    collapsed to one; distinct messages still pass."""
+    import logging
+    f = bench._DedupLogFilter()
+    mk = lambda msg: logging.LogRecord("jax._src.xla_bridge",
+                                       logging.WARNING, __file__, 1,
+                                       msg, (), None)
+    r = mk("Platform 'axon' is experimental and may not be stable.")
+    assert f.filter(r) is True
+    assert f.filter(r) is False                      # repeat dropped
+    assert f.filter(mk("different message")) is True
+    # installation is idempotent and targets the xla_bridge logger
+    bench._install_warning_dedup()
+    bench._install_warning_dedup()
+    log = logging.getLogger("jax._src.xla_bridge")
+    assert log.filters.count(bench._DEDUP_FILTER) == 1
+
+
 def test_sink_captures_first_real_row_and_reemit(capsys):
     code = ("import json\n"
             "print(json.dumps({'metric': 'err (bench error)', 'value': 0.0}))\n"
