@@ -18,7 +18,10 @@ cross-backend testing) — designed TPU-first rather than ported:
 Beyond the reference's surface: parameterized + differentiable compiled
 circuits — including exact gradients of NOISY circuits and of channel
 strengths themselves (noise-model fitting on the density path),
-batched/vmapped sweeps, quantum-trajectory noise unraveling
+batched/vmapped sweeps, an asynchronous request-coalescing serving
+runtime (``quest_tpu.serve``: admission control, deadline-aware
+scheduling, padded batch buckets over the ensemble engine),
+quantum-trajectory noise unraveling
 (statevector-cost noise, mesh-shardable), uniform noise models and
 mid-circuit measurement, one-pass multi-shot sampling (shard-local on a
 mesh), ahead-of-time compilation (``CompiledCircuit.precompile``), an
@@ -41,6 +44,8 @@ from .env import (QuESTEnv, create_quest_env, destroy_quest_env,
 from .qureg import Qureg
 from .circuits import Circuit, CompiledCircuit, Param
 from .qasm_import import ParsedQASM, parse_qasm, load_qasm_file
+from .serve import (SimulationService, CoalescePolicy, ServeError,
+                    QueueFull, DeadlineExceeded, ServiceClosed)
 from .api import *  # noqa: F401,F403  (the QuEST-compatible surface)
 from .api import __all__ as _api_all
 
@@ -55,6 +60,8 @@ __all__ = (
         "QuESTEnv", "create_quest_env", "destroy_quest_env", "Qureg",
         "Circuit", "CompiledCircuit", "Param",
         "ParsedQASM", "parse_qasm", "load_qasm_file",
+        "SimulationService", "CoalescePolicy", "ServeError",
+        "QueueFull", "DeadlineExceeded", "ServiceClosed",
     ]
     + list(_api_all)
 )

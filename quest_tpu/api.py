@@ -44,6 +44,7 @@ __all__ = [
     # env
     "createQuESTEnv", "destroyQuESTEnv", "syncQuESTEnv", "syncQuESTSuccess",
     "reportQuESTEnv", "getEnvironmentString", "seedQuEST", "seedQuESTDefault",
+    "createSimulationService",       # serving runtime (TPU-native addition)
     # registers
     "createQureg", "createDensityQureg", "createCloneQureg", "destroyQureg",
     "createComplexMatrixN", "destroyComplexMatrixN", "initComplexMatrixN",
@@ -590,6 +591,18 @@ def seedQuEST(env: QuESTEnv, seeds: Sequence[int]) -> None:
 
 def seedQuESTDefault(env: QuESTEnv) -> None:
     env.seed_default()
+
+
+def createSimulationService(env: QuESTEnv, **kwargs):
+    """Create an asynchronous serving runtime over ``env`` — the
+    request-coalescing front end for many-caller workloads
+    (:class:`quest_tpu.serve.SimulationService`; TPU-native addition,
+    no reference counterpart). Keyword arguments are the service knobs:
+    ``max_queue``, ``max_batch``, ``max_wait_s``, ``request_timeout_s``,
+    ``max_retries``. Destroy with ``service.close()`` (or use it as a
+    context manager)."""
+    from .serve import SimulationService
+    return SimulationService(env, **kwargs)
 
 
 # ---------------------------------------------------------------------------

@@ -35,8 +35,10 @@ Usage::
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import os
+import threading
 import warnings
 from typing import Callable, Optional, Sequence, Union
 
@@ -1255,6 +1257,49 @@ def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
                                   chunk_bytes=chunk_bytes)
 
 
+class _BoundedExecutableCache:
+    """LRU bound for the batched-engine executable cache.
+
+    Keys are (form, donation, mode, dtype) tuples — a serving workload
+    that cycles precisions, batch buckets, or mesh policies would
+    otherwise pin one jitted executable per distinct key FOREVER (the
+    same leak class as the unbounded sampler cache, ADVICE r5).
+    Evictions are counted for ``dispatch_stats()``; dropping the jit
+    wrapper releases the executable (XLA's own compilation cache may
+    still serve a re-compile warm). Iteration/containment mirror a
+    plain dict so existing introspection keeps working."""
+
+    def __init__(self, maxsize: int):
+        if maxsize < 1:
+            raise ValueError("cache bound must be >= 1")
+        self.maxsize = maxsize
+        self.evictions = 0
+        self._d: "collections.OrderedDict" = collections.OrderedDict()
+
+    def get(self, key, default=None):
+        fn = self._d.get(key, default)
+        if key in self._d:
+            self._d.move_to_end(key)
+        return fn
+
+    def __setitem__(self, key, value) -> None:
+        if key in self._d:
+            self._d.move_to_end(key)
+        self._d[key] = value
+        while len(self._d) > self.maxsize:
+            self._d.popitem(last=False)
+            self.evictions += 1
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
 class CompiledCircuit:
     """One jitted XLA program for a whole :class:`Circuit`.
 
@@ -1565,10 +1610,18 @@ class CompiledCircuit:
         # batched ensemble engine (sweep / expectation_sweep /
         # sample_sweep): executables keyed on (form, dtype,
         # batch-sharding mode, donation) — a precision or mesh-policy
-        # change compiles its own program instead of reusing a stale one
-        self._batched_cache: dict = {}
+        # change compiles its own program instead of reusing a stale
+        # one. LRU-bounded (QUEST_TPU_BATCH_CACHE, default 16 entries)
+        # with evictions surfaced in dispatch_stats().
+        self._batched_cache = _BoundedExecutableCache(
+            int(os.environ.get("QUEST_TPU_BATCH_CACHE", "16")))
         self._batch_stats: Optional[dict] = None
         self._warned_nondivisible = False
+        # the serving runtime mutates batch stats / the executable
+        # cache from its background dispatcher thread while callers may
+        # read dispatch_stats() (or run their own sweeps) concurrently;
+        # RLock so the lazy comm accounting can nest
+        self._stats_lock = threading.RLock()
 
     def _param_vec(self, params: Optional[dict]) -> jnp.ndarray:
         if params is None:
@@ -1701,28 +1754,33 @@ class CompiledCircuit:
         gates/sec."""
         from .profiling import DispatchStats
         fs = self.fusion_stats
-        if self._comm_bytes_planned is None:
-            # deferred comm accounting: modeled bytes of the active plan,
-            # and — when the comm planner chose it — a count-based replan
-            # of the same circuit as the comm_bytes_saved baseline
-            # (host-side only; cached after the first call)
-            planned = 0.0
-            saved = 0.0
-            if self.plan.shard_bits:
-                from .parallel.layout import plan_comm_stats
-                from .profiling import DEFAULT_COMM_MODEL
-                model = self._cost_model or DEFAULT_COMM_MODEL
-                planned = plan_comm_stats(
-                    self.plan, self._chunk_bytes, model,
-                    self.env.num_devices)["bytes"]
-                if self._baseline_pipeline is not None:
-                    _, base_plan, _ = self._baseline_pipeline(False)
-                    base = plan_comm_stats(base_plan, self._chunk_bytes,
-                                           model, self.env.num_devices)
-                    saved = max(0.0, base["bytes"] - planned)
-            self._comm_bytes_planned = planned
-            self._comm_bytes_saved = saved
-        bs = self._batch_stats or {}
+        with self._stats_lock:
+            if self._comm_bytes_planned is None:
+                # deferred comm accounting: modeled bytes of the active
+                # plan, and — when the comm planner chose it — a
+                # count-based replan of the same circuit as the
+                # comm_bytes_saved baseline (host-side only; cached
+                # after the first call)
+                planned = 0.0
+                saved = 0.0
+                if self.plan.shard_bits:
+                    from .parallel.layout import plan_comm_stats
+                    from .profiling import DEFAULT_COMM_MODEL
+                    model = self._cost_model or DEFAULT_COMM_MODEL
+                    planned = plan_comm_stats(
+                        self.plan, self._chunk_bytes, model,
+                        self.env.num_devices)["bytes"]
+                    if self._baseline_pipeline is not None:
+                        _, base_plan, _ = self._baseline_pipeline(False)
+                        base = plan_comm_stats(base_plan,
+                                               self._chunk_bytes, model,
+                                               self.env.num_devices)
+                        saved = max(0.0, base["bytes"] - planned)
+                self._comm_bytes_planned = planned
+                self._comm_bytes_saved = saved
+            bs = dict(self._batch_stats or {})
+            cache_evictions = self._batched_cache.evictions
+            cache_size = len(self._batched_cache)
         return DispatchStats(
             gates_in=self.circuit.depth,
             kernels_out=self.plan.num_kernels,
@@ -1738,7 +1796,9 @@ class CompiledCircuit:
             comm_bytes_saved=self._comm_bytes_saved,
             batch_size=bs.get("batch_size", 0),
             host_syncs_avoided=bs.get("host_syncs_avoided", 0),
-            batch_sharding_mode=bs.get("batch_sharding_mode", "none"))
+            batch_sharding_mode=bs.get("batch_sharding_mode", "none"),
+            batched_cache_size=cache_size,
+            batched_cache_evictions=cache_evictions)
 
     def _xla_only(self) -> "CompiledCircuit":
         """This program with the Pallas layer pass off (cached twin).
@@ -1973,7 +2033,8 @@ class CompiledCircuit:
         under a bare ``hasattr``)."""
         key = (broadcast, donate, mode,
                str(np.dtype(self.env.precision.real_dtype)))
-        fn = self._batched_cache.get(key)
+        with self._stats_lock:
+            fn = self._batched_cache.get(key)
         if fn is not None:
             return fn
         constrain = self._batch_constraint(mode)
@@ -2006,7 +2067,8 @@ class CompiledCircuit:
         fn = jax.jit(apply_fn,
                      donate_argnums=(0,) if donate and not broadcast
                      else ())
-        self._batched_cache[key] = fn
+        with self._stats_lock:
+            self._batched_cache[key] = fn
         return fn
 
     def _padded_params(self, pm, mode: str):
@@ -2022,22 +2084,28 @@ class CompiledCircuit:
         if mode != "batch" or B % D == 0:
             return pm, B
         pad = (-B) % D
-        if not self._warned_nondivisible:
+        with self._stats_lock:
+            warn_now = not self._warned_nondivisible
+            self._warned_nondivisible = True
+        if warn_now:
             warnings.warn(
                 f"sweep batch of {B} is not divisible by the {D}-device "
                 f"mesh; padding to {B + pad} and masking the {pad} extra "
                 "rows (earlier releases silently ran the batch "
                 "replicated on every device)", UserWarning, stacklevel=3)
-            self._warned_nondivisible = True
         pm = jnp.concatenate(
             [pm, jnp.zeros((pad,) + pm.shape[1:], pm.dtype)])
         return pm, B
 
     def _record_batch_stats(self, batch: int, mode: str,
                             host_syncs_avoided: int) -> None:
-        self._batch_stats = {"batch_size": batch,
-                             "batch_sharding_mode": mode,
-                             "host_syncs_avoided": host_syncs_avoided}
+        # one atomic dict swap under the stats lock: the serving
+        # dispatcher records from its background thread while callers
+        # read dispatch_stats() (satellite: no torn batch accounting)
+        with self._stats_lock:
+            self._batch_stats = {"batch_size": batch,
+                                 "batch_sharding_mode": mode,
+                                 "host_syncs_avoided": host_syncs_avoided}
 
     def _place_batch(self, arr, mode: str, amp_shardable: bool = False):
         """Commit a batch-leading array to the policy's input layout so
@@ -2157,7 +2225,8 @@ class CompiledCircuit:
 
         key = ("energy", mode,
                str(np.dtype(self.env.precision.real_dtype)))
-        fn = self._batched_cache.get(key)
+        with self._stats_lock:
+            fn = self._batched_cache.get(key)
         if fn is None:
             constrain = self._batch_constraint(mode)
             run_batched = self._batched_runner(mode)
@@ -2182,7 +2251,8 @@ class CompiledCircuit:
                 in_specs=(P(), P(AMP_AXIS, None), P(), P(), P(), P()),
                 out_specs=P(AMP_AXIS))
             fn = jax.jit(energy)
-            self._batched_cache[key] = fn
+            with self._stats_lock:
+                self._batched_cache[key] = fn
         if state_f is None:
             state_f = jnp.zeros((2, 1 << n),
                                 dtype=self.env.precision.real_dtype
@@ -2220,12 +2290,13 @@ class CompiledCircuit:
         if key is None:
             key = self.env.next_key()
         idx, totals = sample_batched(planes, key, int(num_shots))
-        stats = dict(self._batch_stats or {})
-        # the engine pays exactly two transfers (the (B, shots) index
-        # block and the (B,) totals) where the per-point loop pays 2B
-        # (one run + one sampling sync per point)
-        stats["host_syncs_avoided"] = 2 * planes.shape[0] - 2
-        self._batch_stats = stats
+        with self._stats_lock:
+            stats = dict(self._batch_stats or {})
+            # the engine pays exactly two transfers (the (B, shots)
+            # index block and the (B,) totals) where the per-point loop
+            # pays 2B (one run + one sampling sync per point)
+            stats["host_syncs_avoided"] = 2 * planes.shape[0] - 2
+            self._batch_stats = stats
         return idx, totals
 
     def __repr__(self) -> str:

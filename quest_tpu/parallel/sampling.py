@@ -35,7 +35,7 @@ from jax.sharding import PartitionSpec as P
 from ..compat import shard_map
 from ..env import AMP_AXIS
 
-__all__ = ["sample_sharded", "sample_batched"]
+__all__ = ["sample_sharded", "sample_batched", "shot_bucket"]
 
 
 # Bounded: an unbounded cache keyed on raw shot counts compiles and pins
@@ -80,16 +80,22 @@ def _sampler(mesh, num_samples: int, density: bool, num_qubits: int):
         out_specs=(P(), P(), P()), check_vma=False))
 
 
-def _shot_bucket(num_samples: int) -> int:
+def shot_bucket(num_samples: int) -> int:
     """Static shot-count bucket: the next power of two at or above
     ``num_samples`` (floor 16). One compiled program then serves every
     shot count in (bucket/2, bucket]; surplus draws are discarded
     host-side — they are iid, so the kept prefix is an exact
-    ``num_samples``-shot draw."""
+    ``num_samples``-shot draw. Public because the serving runtime's
+    coalescer (:mod:`quest_tpu.serve.coalesce`) groups shot requests by
+    this same band — two requests share a sampling executable exactly
+    when they share a bucket."""
     b = 16
     while b < num_samples:
         b <<= 1
     return b
+
+
+_shot_bucket = shot_bucket   # pre-serve internal name (kept for callers)
 
 
 def sample_sharded(planes: jax.Array, key, num_samples: int, density: bool,
@@ -100,9 +106,9 @@ def sample_sharded(planes: jax.Array, key, num_samples: int, density: bool,
     shard-locally). Returns ``(indices int64 ndarray, total)`` with the
     shard/local split recombined in host int64, so the device program
     never needs 64-bit indices even at pod widths. Shot counts are
-    bucketed (``_shot_bucket``) so a sweep over counts reuses one
+    bucketed (``shot_bucket``) so a sweep over counts reuses one
     compiled program per power-of-two band."""
-    bucket = _shot_bucket(int(num_samples))
+    bucket = shot_bucket(int(num_samples))
     shard, loc, total = _sampler(mesh, bucket, bool(density),
                                  int(num_qubits))(planes, key)
     n_dev = int(np.prod(mesh.devices.shape))
@@ -116,7 +122,7 @@ def sample_sharded(planes: jax.Array, key, num_samples: int, density: bool,
 # inverse-CDF executable draws num_samples outcomes from EVERY state of a
 # (B, 2, N) batch, each batch element under its own fold of the key.
 # Bounded + bucketed exactly like the mesh `_sampler` above (ADVICE r5):
-# shot counts share `_shot_bucket`'s power-of-two bands, so a shot-count
+# shot counts share `shot_bucket`'s power-of-two bands, so a shot-count
 # sweep reuses one executable per band instead of pinning a fresh
 # compilation per distinct count — and the two caches are independent
 # (batched draws never populate mesh `_sampler` entries, or vice versa).
@@ -147,7 +153,7 @@ def sample_batched(planes: jax.Array, key, num_samples: int):
     point."""
     if int(num_samples) < 1:
         raise ValueError("num_samples must be >= 1")
-    bucket = _shot_bucket(int(num_samples))
+    bucket = shot_bucket(int(num_samples))
     keys = jax.random.split(key, planes.shape[0])
     idx, totals = _batch_sampler(bucket)(planes, keys)
     return (np.asarray(idx, dtype=np.int64)[:, :num_samples],

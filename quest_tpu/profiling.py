@@ -206,6 +206,12 @@ class DispatchStats:
     batch_size: int = 0              # points in the last batched run
     host_syncs_avoided: int = 0      # device->host transfers vs per-point
     batch_sharding_mode: str = "none"  # "none" | "batch" | "amp"
+    # keyed executable cache accounting (serving workloads cycle
+    # (form, donation, mode, dtype) keys; the cache is LRU-bounded —
+    # QUEST_TPU_BATCH_CACHE — so long-lived services can't pin one
+    # executable per key forever):
+    batched_cache_size: int = 0        # live entries in the bounded cache
+    batched_cache_evictions: int = 0   # executables dropped by the bound
 
     @property
     def dispatches(self) -> int:
@@ -238,7 +244,9 @@ class DispatchStats:
                 "comm_bytes_saved": self.comm_bytes_saved,
                 "batch_size": self.batch_size,
                 "host_syncs_avoided": self.host_syncs_avoided,
-                "batch_sharding_mode": self.batch_sharding_mode}
+                "batch_sharding_mode": self.batch_sharding_mode,
+                "batched_cache_size": self.batched_cache_size,
+                "batched_cache_evictions": self.batched_cache_evictions}
 
 
 @contextlib.contextmanager

@@ -1,0 +1,21 @@
+"""quest_tpu.serve — the asynchronous serving runtime.
+
+Turns many independent callers into the large, well-shaped batches the
+batched ensemble engine (:meth:`quest_tpu.circuits.CompiledCircuit.
+sweep` family) is fast at: request coalescing with padded batch
+buckets, bounded-queue admission control with typed backpressure, and
+deadline-aware dispatch with one retry on transient executor failure.
+See ``docs/tpu.md`` ("Serving runtime") for the operational model.
+"""
+
+from .coalesce import (CoalescePolicy, batch_bucket, coalesce_key,
+                       plan_schedule, split_ready)
+from .engine import (DeadlineExceeded, QueueFull, ServeError,
+                     ServiceClosed, SimulationService)
+from .metrics import ServiceMetrics
+
+__all__ = [
+    "SimulationService", "ServeError", "QueueFull", "DeadlineExceeded",
+    "ServiceClosed", "CoalescePolicy", "ServiceMetrics",
+    "batch_bucket", "coalesce_key", "plan_schedule", "split_ready",
+]

@@ -1,0 +1,492 @@
+"""The asynchronous simulation service: many callers, one batched engine.
+
+Everything below the serving layer is a synchronous single-caller
+library; the utilization story at scale (QuEST whitepaper
+arXiv:1802.08032, mpiQulacs arXiv:2203.16044, and every inference-serving
+stack) is won ABOVE the kernels, by the dispatch layer that turns many
+independent requests into the large same-shaped batches the engine is
+fast at. :class:`SimulationService` is that layer:
+
+- :meth:`SimulationService.submit` accepts a request (circuit +
+  parameter binding, optionally an observable or a shot count) and
+  returns a :class:`concurrent.futures.Future` immediately;
+- a background **dispatcher thread** drains a bounded admission queue,
+  groups compatible requests per :mod:`quest_tpu.serve.coalesce`, and
+  executes each group as ONE ``sweep`` / ``expectation_sweep`` /
+  ``sample_sweep`` dispatch, fanning results back to the futures;
+- **backpressure** is typed: a full queue raises :class:`QueueFull` at
+  submit time (the caller sheds load, nothing is silently dropped), an
+  unmeetable deadline raises / resolves :class:`DeadlineExceeded`;
+- each request carries a **deadline** (caller-supplied, capped by the
+  service's ``request_timeout_s``); requests that expire while queued
+  get :class:`DeadlineExceeded` instead of occupying a batch slot;
+- a batch whose executor raises is **retried once** per surviving
+  request (transient failure absorption — the retried requests rejoin
+  the queue and may coalesce differently), then fails the futures;
+- :meth:`SimulationService.warm` pre-compiles the padded batch-bucket
+  executables so first requests don't eat the compile.
+
+Request execution happens on the dispatcher thread; ``submit`` only
+touches numpy and the future, so the serving path's JAX dispatch is
+single-threaded — the safe and fast configuration for the tunneled
+backends this repo targets (docs/tpu.md). :meth:`SimulationService.
+warm` and the one-time compile of a raw ``Circuit`` submission are the
+deliberate exceptions (caller-thread setup work, meant to happen before
+traffic opens).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..circuits import Circuit, CompiledCircuit, _BoundedExecutableCache
+from .coalesce import (KIND_EXPECTATION, KIND_SAMPLE, KIND_STATE,
+                       CoalescePolicy, coalesce_key, split_ready)
+from .metrics import ServiceMetrics
+
+__all__ = ["ServeError", "QueueFull", "DeadlineExceeded", "ServiceClosed",
+           "SimulationService"]
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-runtime errors."""
+
+
+class QueueFull(ServeError):
+    """The admission queue is at capacity — backpressure: shed load or
+    retry later. Raised by :meth:`SimulationService.submit`."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline (or the service's per-request timeout)
+    passed before it could be dispatched."""
+
+
+class ServiceClosed(ServeError):
+    """The service no longer accepts submissions."""
+
+
+class _Request:
+    """One queued submission (internal)."""
+
+    __slots__ = ("compiled", "param_vec", "kind", "observables", "shots",
+                 "submit_t", "deadline", "future", "retries_left", "key")
+
+    def __init__(self, compiled, param_vec, kind, observables, shots,
+                 submit_t, deadline, future, retries_left, key):
+        self.compiled = compiled
+        self.param_vec = param_vec
+        self.kind = kind
+        self.observables = observables
+        self.shots = shots
+        self.submit_t = submit_t
+        self.deadline = deadline
+        self.future = future
+        self.retries_left = retries_left
+        self.key = key
+
+
+def _canonical_observables(compiled, observables) -> tuple:
+    """Validate a ``(pauli_terms, coeffs)`` Hamiltonian at SUBMIT time
+    (errors belong to the caller, not the dispatcher thread) and return
+    ``(normalized_ham, hashable_key)`` — the key is what makes two
+    requests' observables coalescible."""
+    terms_in, coeffs_in = observables
+    _, terms, coeffs = compiled._validated_pauli_terms(terms_in, coeffs_in)
+    key = (tuple(terms), tuple(float(c) for c in coeffs))
+    return (terms, coeffs), key
+
+
+class SimulationService:
+    """Asynchronous request-coalescing front end over the batched engine.
+
+    Parameters
+    ----------
+    env : QuESTEnv
+        Environment every served circuit must be compiled against.
+    max_queue : int
+        Admission bound — requests admitted but not yet dispatched.
+        Submissions past it raise :class:`QueueFull`.
+    max_batch, max_wait_s :
+        The coalescing knobs (:class:`quest_tpu.serve.coalesce.
+        CoalescePolicy`): requests per dispatch cap, and the longest a
+        lone request waits for batch companions.
+    request_timeout_s : float
+        Default per-request deadline; ``submit(deadline=...)`` can only
+        tighten it.
+    max_retries : int
+        Dispatch retries per request after a transient executor failure.
+    max_circuits : int
+        LRU bound on recorded-Circuit submissions compiled and cached
+        by the service (CompiledCircuit submissions are never cached —
+        the caller owns those).
+    """
+
+    def __init__(self, env, *, max_queue: int = 1024, max_batch: int = 64,
+                 max_wait_s: float = 2e-3, request_timeout_s: float = 60.0,
+                 max_retries: int = 1, latency_window: int = 4096,
+                 max_circuits: int = 32):
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        if request_timeout_s <= 0.0:
+            raise ValueError("request_timeout_s must be > 0")
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        self.env = env
+        self.policy = CoalescePolicy(max_batch=max_batch,
+                                     max_wait_s=max_wait_s)
+        self.max_queue = int(max_queue)
+        self.request_timeout_s = float(request_timeout_s)
+        self.max_retries = int(max_retries)
+        self.metrics = ServiceMetrics(latency_window=latency_window)
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._backlog = 0          # admitted, not yet dispatched/expired
+        self._closed = False
+        self._drain_on_close = True
+        self._paused = False
+        # id(Circuit) -> (Circuit, CompiledCircuit); LRU-bounded
+        # (``max_circuits``) — a service whose callers keep recording
+        # fresh circuits must not pin one compiled program (and its own
+        # executable cache) per circuit forever, the same leak class the
+        # engine-level cache bound closes one layer down
+        self._compiled = _BoundedExecutableCache(int(max_circuits))
+        self._last_cc: Optional[CompiledCircuit] = None
+        self.metrics.queue_depth_fn = lambda: self._backlog
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"quest-tpu-serve-{id(self):x}")
+        self._thread.start()
+
+    # -- circuit resolution ------------------------------------------------
+
+    def _resolve(self, circuit) -> CompiledCircuit:
+        """Accept a CompiledCircuit as-is; compile (and cache) a recorded
+        Circuit. The cache is keyed on object identity — the strong ref
+        to the source circuit keeps the id stable for the service's
+        lifetime."""
+        if isinstance(circuit, CompiledCircuit):
+            if circuit.env is not self.env:
+                raise ValueError(
+                    "circuit was compiled against a different QuESTEnv "
+                    "than this service's")
+            return circuit
+        if isinstance(circuit, Circuit):
+            entry = self._compiled.get(id(circuit))
+            if entry is None or entry[0] is not circuit:
+                entry = (circuit, circuit.compile(self.env))
+                self._compiled[id(circuit)] = entry
+            return entry[1]
+        raise TypeError(f"expected Circuit or CompiledCircuit, got "
+                        f"{type(circuit).__name__}")
+
+    def _param_vec(self, compiled: CompiledCircuit, params) -> np.ndarray:
+        names = compiled.param_names
+        params = params or {}
+        if not isinstance(params, dict):
+            vec = np.asarray(params, dtype=np.float64)
+            if vec.shape != (len(names),):
+                raise ValueError(
+                    f"parameter vector has shape {vec.shape}; expected "
+                    f"({len(names)},) ordered like {list(names)}")
+            return vec
+        missing = [nm for nm in names if nm not in params]
+        if missing:
+            raise ValueError(f"missing circuit parameters: {missing}")
+        return np.asarray([float(params[nm]) for nm in names],
+                          dtype=np.float64)
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, circuit, params: Optional[dict] = None, *,
+               observables=None, shots: Optional[int] = None,
+               deadline: Optional[float] = None) -> Future:
+        """Enqueue one simulation request; returns its Future.
+
+        ``circuit``: a :class:`CompiledCircuit` (preferred — submissions
+        sharing the object coalesce) or a recorded :class:`Circuit`
+        (compiled once and cached per object). ``params``: name->angle
+        dict (or an ordered vector). Exactly one result shape per
+        request:
+
+        - default — the final packed ``(2, 2^n)`` planes (numpy);
+        - ``observables=(pauli_terms, coeffs)`` — the scalar
+          ``<H>`` / ``Tr(H rho)`` energy;
+        - ``shots=m`` — ``(outcomes int64[m], total_norm)`` basis
+          samples.
+
+        ``deadline`` is a per-request latency budget in SECONDS from
+        now (capped by the service's ``request_timeout_s``); a request
+        that cannot dispatch in time resolves its future with
+        :class:`DeadlineExceeded` instead of running stale. A
+        non-positive deadline raises immediately; a full admission
+        queue raises :class:`QueueFull`.
+        """
+        if self._closed:
+            raise ServiceClosed("service is closed")
+        if observables is not None and shots is not None:
+            raise ValueError(
+                "a request returns ONE result: pass observables= for an "
+                "energy or shots= for samples, not both (submit twice "
+                "to get both)")
+        compiled = self._resolve(circuit)
+        vec = self._param_vec(compiled, params)
+        now = time.monotonic()
+        abs_deadline = now + self.request_timeout_s
+        if deadline is not None:
+            if deadline <= 0.0:
+                self.metrics.incr("rejected_deadline")
+                raise DeadlineExceeded(
+                    f"deadline {deadline!r} s is already unmeetable")
+            abs_deadline = min(abs_deadline, now + float(deadline))
+        if shots is not None:
+            if int(shots) < 1:
+                raise ValueError("shots must be >= 1")
+            if compiled.is_density:
+                raise ValueError(
+                    "shot requests draw from |amp|^2 of statevector "
+                    "programs; use observables= on density circuits")
+            kind, ham, obs_key = KIND_SAMPLE, None, ()
+        elif observables is not None:
+            kind = KIND_EXPECTATION
+            ham, obs_key = _canonical_observables(compiled, observables)
+        else:
+            kind, ham, obs_key = KIND_STATE, None, ()
+        key = coalesce_key(compiled, kind, obs_key, int(shots or 0))
+        fut: Future = Future()
+        req = _Request(compiled, vec, kind, ham, int(shots or 0), now,
+                       abs_deadline, fut, self.max_retries, key)
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed("service is closed")
+            if self._backlog >= self.max_queue:
+                self.metrics.incr("rejected_queue_full")
+                raise QueueFull(
+                    f"admission queue is at capacity ({self.max_queue}); "
+                    "retry later or raise max_queue")
+            self._backlog += 1
+            self._queue.append(req)
+            self._cond.notify_all()
+        self.metrics.incr("submitted")
+        return fut
+
+    def warm(self, circuit, batch_sizes: Optional[Sequence[int]] = None,
+             observables=None, shots: Optional[int] = None
+             ) -> CompiledCircuit:
+        """Pre-compile the executables the given traffic will hit, so
+        first requests pay dispatch latency, not compiles.
+
+        Runs one throwaway dispatch per batch size in ``batch_sizes``
+        (default: the policy's ``max_batch`` bucket) through the same
+        entry point live requests will use — ``sweep`` by default,
+        ``expectation_sweep`` when ``observables`` is given,
+        ``sample_sweep`` when ``shots`` is. Returns the compiled
+        circuit (submit it back for guaranteed coalescing)."""
+        compiled = self._resolve(circuit)
+        sizes = tuple(batch_sizes) if batch_sizes is not None \
+            else (self.policy.max_batch,)
+        mult = self._device_multiple(compiled)
+        for bs in sizes:
+            padded = self.policy.bucket_size(int(bs), mult)
+            pm = np.zeros((padded, len(compiled.param_names)),
+                          dtype=np.float64)
+            if observables is not None:
+                ham, _ = _canonical_observables(compiled, observables)
+                np.asarray(compiled.expectation_sweep(pm, ham))
+            elif shots is not None:
+                compiled.sample_sweep(pm, int(shots))
+            else:
+                np.asarray(compiled.sweep(pm))
+        self._last_cc = compiled
+        return compiled
+
+    def pause(self) -> None:
+        """Hold dispatching (requests keep queueing, deadlines keep
+        counting). For drain-control and deterministic tests."""
+        with self._cond:
+            self._paused = True
+            self._cond.notify_all()
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def dispatch_stats(self) -> dict:
+        """Engine-level :class:`~quest_tpu.profiling.DispatchStats`
+        fields of the most recently served compiled circuit (empty dict
+        before the first dispatch), with the serving metrics snapshot
+        folded in under ``"service"``."""
+        base = self._last_cc.dispatch_stats().as_dict() \
+            if self._last_cc is not None else {}
+        return {**base, "service": self.metrics.snapshot()}
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 30.0
+              ) -> None:
+        """Stop accepting submissions and shut the dispatcher down.
+
+        ``drain=True`` (default) dispatches everything already queued
+        (max-wait no longer applies — partial batches flush
+        immediately); ``drain=False`` fails queued futures with
+        :class:`ServiceClosed`. Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._drain_on_close = self._drain_on_close and drain
+            self._paused = False
+            self._cond.notify_all()
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "SimulationService":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close(drain=exc == (None, None, None))
+        return False
+
+    # -- dispatcher --------------------------------------------------------
+
+    @staticmethod
+    def _device_multiple(compiled: CompiledCircuit) -> int:
+        """Batch-bucket floor: pad to a device multiple wherever the
+        engine would batch-shard, so serving dispatches never trip the
+        engine's non-divisible warning path."""
+        return compiled.env.num_devices if compiled.env.mesh is not None \
+            else 1
+
+    def _dispatch_loop(self) -> None:
+        pending: dict = {}   # coalesce key -> FIFO list of _Request
+        while True:
+            with self._cond:
+                if self._paused and not self._closed:
+                    # held: requests stay in the admission queue
+                    # (deadlines keep counting; they expire on resume)
+                    self._cond.wait(timeout=0.005)
+                    continue
+                if self._closed and not self._drain_on_close:
+                    for req in list(self._queue) + \
+                            [r for v in pending.values() for r in v]:
+                        self._backlog -= 1
+                        if req.future.set_running_or_notify_cancel():
+                            req.future.set_exception(ServiceClosed(
+                                "service closed before dispatch"))
+                    self._queue.clear()
+                    return
+                while self._queue:
+                    req = self._queue.popleft()
+                    pending.setdefault(req.key, []).append(req)
+                if not pending:
+                    if self._closed:
+                        return
+                    self._cond.wait(timeout=0.1)
+                    continue
+            now = time.monotonic()
+            self._expire(pending, now)
+            ready: list = []
+            next_deadline = None
+            for key in list(pending):
+                batches, rest, nd = split_ready(pending[key], now,
+                                                self.policy,
+                                                drain=self._closed)
+                if rest:
+                    pending[key] = rest
+                else:
+                    del pending[key]
+                ready.extend(batches)
+                if nd is not None:
+                    next_deadline = nd if next_deadline is None \
+                        else min(next_deadline, nd)
+            if not ready:
+                with self._cond:
+                    if not self._queue and not self._closed:
+                        wait = 0.05 if next_deadline is None else \
+                            max(1e-4, next_deadline - time.monotonic())
+                        self._cond.wait(timeout=min(wait, 0.05))
+                continue
+            for batch in ready:
+                self._execute(batch)
+
+    def _expire(self, pending: dict, now: float) -> None:
+        for key in list(pending):
+            alive = []
+            for req in pending[key]:
+                if now > req.deadline:
+                    with self._cond:
+                        self._backlog -= 1
+                    self.metrics.incr("timeouts")
+                    if req.future.set_running_or_notify_cancel():
+                        req.future.set_exception(DeadlineExceeded(
+                            f"request expired after "
+                            f"{now - req.submit_t:.3f}s in queue"))
+                else:
+                    alive.append(req)
+            if alive:
+                pending[key] = alive
+            else:
+                del pending[key]
+
+    def _execute(self, batch: list) -> None:
+        """Run one coalesced group as a single engine dispatch and fan
+        the results back to the futures. On executor failure, requests
+        with retries left rejoin the queue (they may coalesce into a
+        different batch); the rest fail."""
+        with self._cond:
+            self._backlog -= len(batch)
+        cc = batch[0].compiled
+        B = len(batch)
+        padded = self.policy.bucket_size(B, self._device_multiple(cc))
+        pm = np.zeros((padded, len(cc.param_names)), dtype=np.float64)
+        for i, req in enumerate(batch):
+            pm[i] = req.param_vec
+        t_dispatch = time.monotonic()
+        kind = batch[0].kind
+        try:
+            if kind == KIND_EXPECTATION:
+                out = np.asarray(cc.expectation_sweep(
+                    pm, batch[0].observables))[:B]
+                results = [float(v) for v in out]
+            elif kind == KIND_SAMPLE:
+                shots = max(req.shots for req in batch)
+                idx, totals = cc.sample_sweep(pm, shots)
+                results = [(np.asarray(idx[i, :req.shots]),
+                            float(totals[i]))
+                           for i, req in enumerate(batch)]
+            else:
+                planes = np.asarray(cc.sweep(pm))[:B]
+                results = [np.array(planes[i]) for i in range(B)]
+        except Exception as e:  # noqa: BLE001 — executor fault barrier
+            retriable = [r for r in batch if r.retries_left > 0]
+            for req in batch:
+                if req.retries_left > 0:
+                    continue
+                self.metrics.incr("failed")
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(e)
+            if retriable:
+                self.metrics.incr("retries", len(retriable))
+                with self._cond:
+                    for req in retriable:
+                        req.retries_left -= 1
+                        self._backlog += 1
+                        self._queue.append(req)
+                    self._cond.notify_all()
+            return
+        self._last_cc = cc
+        done_t = time.monotonic()
+        # metrics BEFORE resolving any future: a caller blocked on the
+        # last result may read dispatch_stats() the instant it unblocks,
+        # and must see this batch's accounting
+        self.metrics.record_batch(B, padded)
+        for req in batch:
+            self.metrics.incr("completed")
+            self.metrics.record_latency(done_t - req.submit_t,
+                                        t_dispatch - req.submit_t)
+        for req, res in zip(batch, results):
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(res)

@@ -1,0 +1,128 @@
+"""Per-service metrics registry for the serving runtime.
+
+Every :class:`quest_tpu.serve.SimulationService` owns one
+:class:`ServiceMetrics`: thread-safe counters for the request lifecycle
+(submitted / completed / rejected / timed out / retried), per-batch
+coalescing accounting (occupancy, padded rows), and a bounded latency
+reservoir from which the snapshot derives p50/p99. The registry is
+deliberately dependency-free — plain counters under one lock — because
+it is updated from BOTH the caller threads (submit-side rejections) and
+the service's background dispatcher thread.
+
+:meth:`ServiceMetrics.snapshot` returns a plain dict;
+``SimulationService.dispatch_stats()`` folds that snapshot in next to
+the engine-level :class:`quest_tpu.profiling.DispatchStats` fields, so
+one call answers both "what did the compiler do" and "what did the
+serving layer do".
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+__all__ = ["ServiceMetrics"]
+
+
+_COUNTERS = (
+    "submitted",             # requests accepted into the queue
+    "completed",             # futures resolved with a result
+    "failed",                # futures resolved with an executor exception
+    "timeouts",              # expired in queue (deadline / request timeout)
+    "retries",               # re-queued after a transient executor failure
+    "rejected_queue_full",   # submit() raised QueueFull
+    "rejected_deadline",     # submit() raised DeadlineExceeded up front
+    "batches",               # coalesced dispatches issued to the engine
+    "coalesced_requests",    # requests carried by those dispatches
+    "shared_batch_requests",  # of those, requests that shared their batch
+    "padded_rows",           # throwaway rows added by batch bucketing
+)
+
+
+class ServiceMetrics:
+    """Thread-safe counters + bounded latency reservoir for one service.
+
+    ``latency_window`` bounds the reservoir (ring buffer of the most
+    recent completions): percentiles stay O(window) to compute and the
+    registry's memory is constant regardless of how long the service
+    lives. ``queue_depth_fn`` is an optional gauge callback installed by
+    the owning service (the queue lives there, not here).
+    """
+
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._latencies = collections.deque(maxlen=latency_window)
+        self._queue_waits = collections.deque(maxlen=latency_window)
+        self._c = {name: 0 for name in _COUNTERS}
+        self._max_occupancy = 0
+        self.queue_depth_fn = None
+
+    # -- recording ---------------------------------------------------------
+
+    def incr(self, name: str, k: int = 1) -> None:
+        if name not in self._c:
+            raise KeyError(f"unknown service counter {name!r}")
+        with self._lock:
+            self._c[name] += k
+
+    def record_batch(self, size: int, padded_size: int) -> None:
+        """One coalesced dispatch of ``size`` live requests, executed at
+        ``padded_size`` rows (the batch bucket the executable ran at)."""
+        with self._lock:
+            self._c["batches"] += 1
+            self._c["coalesced_requests"] += size
+            if size > 1:
+                self._c["shared_batch_requests"] += size
+            self._c["padded_rows"] += max(0, padded_size - size)
+            self._max_occupancy = max(self._max_occupancy, size)
+
+    def record_latency(self, total_s: float, queue_wait_s: float) -> None:
+        with self._lock:
+            self._latencies.append(float(total_s))
+            self._queue_waits.append(float(queue_wait_s))
+
+    # -- reading -----------------------------------------------------------
+
+    @staticmethod
+    def _pct(sorted_vals, p: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        i = min(len(sorted_vals) - 1,
+                max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+        return float(sorted_vals[i])
+
+    def snapshot(self) -> dict:
+        """Point-in-time view as a plain dict (JSON-ready).
+
+        ``batch_occupancy`` is mean live requests per dispatch — the
+        number the coalescer exists to raise above 1. ``coalesce_ratio``
+        is the fraction of dispatched requests that shared their batch
+        with at least one other request.
+        """
+        with self._lock:
+            c = dict(self._c)
+            lat = sorted(self._latencies)
+            waits = sorted(self._queue_waits)
+            max_occ = self._max_occupancy
+        batches = c["batches"]
+        dispatched = c["coalesced_requests"]
+        depth = 0
+        if self.queue_depth_fn is not None:
+            try:
+                depth = int(self.queue_depth_fn())
+            except Exception:
+                depth = 0
+        return {
+            **c,
+            "queue_depth": depth,
+            "batch_occupancy": (dispatched / batches) if batches else 0.0,
+            "max_batch_occupancy": max_occ,
+            "coalesce_ratio": (c["shared_batch_requests"] / dispatched)
+            if dispatched else 0.0,
+            "padded_fraction": c["padded_rows"]
+            / max(1, c["padded_rows"] + dispatched),
+            "p50_latency_s": self._pct(lat, 50.0),
+            "p99_latency_s": self._pct(lat, 99.0),
+            "p50_queue_wait_s": self._pct(waits, 50.0),
+            "p99_queue_wait_s": self._pct(waits, 99.0),
+        }

@@ -29,7 +29,7 @@ import pytest  # noqa: E402
 # < 2 min on this box; README "Testing").
 FAST_MODULES = {
     "test_essential", "test_golden", "test_golden_ref", "test_exchange",
-    "test_validation_taxonomy", "test_comm_trace",
+    "test_validation_taxonomy", "test_comm_trace", "test_serve_trace",
 }
 
 

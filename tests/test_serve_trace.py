@@ -1,0 +1,90 @@
+"""Fast-tier smoke for tools/serve_trace.py and the pure coalescing
+schedule simulation it wraps (quest_tpu/serve/coalesce.plan_schedule).
+No device work anywhere in this module — it must stay cheap enough for
+the bounded fast tier."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+import serve_trace  # noqa: E402
+
+from quest_tpu.serve.coalesce import CoalescePolicy, plan_schedule  # noqa: E402
+
+
+def test_plan_schedule_burst_and_tail():
+    """A zero-gap burst splits into full batches plus one max-wait
+    tail; every request is dispatched exactly once."""
+    pol = CoalescePolicy(max_batch=4, max_wait_s=0.010)
+    arrivals = [(0.0, "a")] * 10
+    events = plan_schedule(arrivals, pol)
+    assert [e["size"] for e in events] == [4, 4, 2]
+    assert [e["reason"] for e in events] == ["full", "full", "max_wait"]
+    assert events[0]["t"] == 0.0
+    assert events[2]["t"] == pytest.approx(0.010)
+    assert events[2]["bucket"] == 2 and events[2]["padded_rows"] == 0
+    assert sorted(i for e in events for i in e["requests"]) \
+        == list(range(10))
+
+
+def test_plan_schedule_respects_compatibility_classes():
+    """Different coalesce keys never share a batch, and a stale group
+    flushes at its own maturity even while other classes keep arriving."""
+    pol = CoalescePolicy(max_batch=8, max_wait_s=0.005)
+    arrivals = [(0.000, "a"), (0.001, "b"), (0.002, "a"),
+                (0.020, "b")]
+    events = plan_schedule(arrivals, pol)
+    by_key = {(e["key"], e["t"]): e for e in events}
+    assert ("a", pytest.approx(0.005)) and len(events) == 3
+    a_ev = [e for e in events if e["key"] == "a"]
+    b_ev = [e for e in events if e["key"] == "b"]
+    assert len(a_ev) == 1 and a_ev[0]["size"] == 2
+    assert [e["size"] for e in b_ev] == [1, 1]   # too far apart to share
+    assert a_ev[0]["t"] == pytest.approx(0.005)  # oldest + max_wait
+    assert by_key[("b", b_ev[0]["t"])]["reason"] == "max_wait"
+
+
+def test_plan_schedule_device_floor():
+    pol = CoalescePolicy(max_batch=8, max_wait_s=0.001)
+    events = plan_schedule([(0.0, "k")] * 3, pol, device_multiple=8)
+    assert events[0]["size"] == 3
+    assert events[0]["bucket"] == 8          # floored at the mesh width
+    assert events[0]["padded_rows"] == 5
+
+
+def test_trace_report_totals_consistent():
+    arrivals = serve_trace.simulate_trace(200, 50000.0, 3, seed=7,
+                                          burst=0.3)
+    doc = serve_trace.trace_report(arrivals,
+                                   CoalescePolicy(max_batch=16,
+                                                  max_wait_s=2e-3))
+    t = doc["totals"]
+    assert t["requests"] == 200
+    assert t["batches"] == len(doc["events"])
+    assert t["batch_occupancy"] == pytest.approx(
+        200.0 / max(1, t["batches"]))
+    assert 0.0 <= t["coalesce_ratio"] <= 1.0
+    assert t["max_batch_occupancy"] <= 16
+    # arrival order is preserved within every batch
+    for e in doc["events"]:
+        assert e["requests"] == sorted(e["requests"])
+
+
+def test_cli_end_to_end():
+    tool = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "serve_trace.py")
+    proc = subprocess.run(
+        [sys.executable, tool, "--requests", "64", "--rate", "40000",
+         "--classes", "2", "--max-batch", "8", "--seed", "3"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    doc = json.loads(proc.stdout)
+    assert doc["totals"]["requests"] == 64
+    assert doc["events"], "no dispatches planned"
+    assert doc["policy"]["max_batch"] == 8
+    assert {e["reason"] for e in doc["events"]} <= {"full", "max_wait"}

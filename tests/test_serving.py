@@ -1,0 +1,444 @@
+"""The async serving runtime (ISSUE 4): request coalescing, admission
+control, and deadline-aware scheduling over the batched engine.
+
+The service promises: concurrent callers get EXACTLY the answers the
+synchronous per-request loop would give them (oracle parity <= 1e-12,
+single device and the 8-device CPU mesh), backpressure is typed and
+deterministic (QueueFull at the admission bound, DeadlineExceeded for
+expired requests), transient executor failures absorb one retry, and
+the keyed executable cache underneath stays bounded.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+from quest_tpu.serve import (CoalescePolicy, DeadlineExceeded, QueueFull,
+                             ServiceClosed, SimulationService,
+                             batch_bucket, split_ready)
+
+
+def _hea(num_qubits, layers=1, ring=True):
+    c = Circuit(num_qubits)
+    for layer in range(layers):
+        for q in range(num_qubits):
+            c.ry(q, c.parameter(f"y{layer}_{q}"))
+            c.rz(q, c.parameter(f"z{layer}_{q}"))
+        for q in range(num_qubits if ring else num_qubits - 1):
+            c.cnot(q, (q + 1) % num_qubits)
+    return c
+
+
+def _random_ham(rng, num_qubits, num_terms):
+    codes = rng.integers(0, 4, size=(num_terms, num_qubits))
+    coeffs = rng.normal(size=num_terms)
+    terms = [[(q, int(codes[t, q])) for q in range(num_qubits)]
+             for t in range(num_terms)]
+    return terms, coeffs, [int(x) for x in codes.reshape(-1)]
+
+
+def _oracle_energies(cc, env, pm, codes_flat, coeffs):
+    names = cc.param_names
+    out = []
+    for row in np.asarray(pm):
+        q = qt.createQureg(cc.circuit.num_qubits, env)
+        qt.initZeroState(q)
+        cc.run(q, dict(zip(names, row)))
+        out.append(qt.calcExpecPauliSum(q, codes_flat, coeffs))
+    return np.asarray(out)
+
+
+class TestServiceOracle:
+    """Concurrent submission vs the per-point oracle (acceptance:
+    <= 1e-12, single device AND the 8-device mesh)."""
+
+    N_THREADS = 4
+    PER_THREAD = 6
+
+    def _run_threads(self, svc, cc, pm, ham):
+        names = cc.param_names
+        results = [None] * len(pm)
+        errors = []
+
+        def worker(tid):
+            try:
+                futs = []
+                for j in range(self.PER_THREAD):
+                    i = tid * self.PER_THREAD + j
+                    futs.append((i, svc.submit(
+                        cc, dict(zip(names, pm[i])), observables=ham)))
+                for i, f in futs:
+                    results[i] = f.result(timeout=120)
+            except Exception as e:  # surface in the main thread
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(self.N_THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        return np.asarray(results, dtype=np.float64)
+
+    def test_concurrent_single_device(self, env, rng):
+        n = 5
+        c = _hea(n)
+        terms, coeffs, codes_flat = _random_ham(rng, n, 9)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi,
+                         size=(self.N_THREADS * self.PER_THREAD,
+                               len(c.param_names)))
+        with SimulationService(env, max_batch=8, max_wait_s=5e-3) as svc:
+            got = self._run_threads(svc, cc, pm, (terms, coeffs))
+            snap = svc.dispatch_stats()["service"]
+        want = _oracle_energies(cc, env, pm, codes_flat, coeffs)
+        np.testing.assert_allclose(got, want, atol=1e-12)
+        assert snap["completed"] == len(pm)
+        assert snap["batches"] < len(pm)          # it actually coalesced
+        assert snap["batch_occupancy"] > 1.0
+        assert snap["failed"] == snap["timeouts"] == 0
+
+    def test_concurrent_mesh(self, env, mesh_env, rng):
+        n = 5
+        c = _hea(n)
+        terms, coeffs, codes_flat = _random_ham(rng, n, 7)
+        cc = c.compile(mesh_env)
+        ccs = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi,
+                         size=(self.N_THREADS * self.PER_THREAD,
+                               len(c.param_names)))
+        with SimulationService(mesh_env, max_batch=8,
+                               max_wait_s=5e-3) as svc:
+            got = self._run_threads(svc, cc, pm, (terms, coeffs))
+        want = _oracle_energies(ccs, env, pm, codes_flat, coeffs)
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_mixed_kinds_roundtrip(self, env, rng):
+        """One service, three request shapes: planes match run(), shot
+        requests on basis states are deterministic, energies match the
+        oracle — and the shapes coalesce independently."""
+        n = 4
+        c = Circuit(n)
+        a = c.parameter("a")
+        c.rx(0, a)
+        cc = c.compile(env)
+        terms = [[(0, 3)]]
+        coeffs = [1.0]
+        with SimulationService(env, max_batch=4, max_wait_s=5e-3) as svc:
+            f_state = svc.submit(cc, {"a": 0.0})
+            f_e0 = svc.submit(cc, {"a": 0.0}, observables=(terms, coeffs))
+            f_epi = svc.submit(cc, {"a": np.pi},
+                               observables=(terms, coeffs))
+            f_shot0 = svc.submit(cc, {"a": 0.0}, shots=13)
+            f_shotpi = svc.submit(cc, {"a": np.pi}, shots=5)
+            planes = f_state.result(timeout=60)
+            q = qt.createQureg(n, env)
+            qt.initZeroState(q)
+            cc.run(q, {"a": 0.0})
+            np.testing.assert_allclose(planes, np.asarray(q.state),
+                                       atol=1e-12)
+            assert abs(f_e0.result(timeout=60) - 1.0) < 1e-12
+            assert abs(f_epi.result(timeout=60) + 1.0) < 1e-12
+            idx0, tot0 = f_shot0.result(timeout=60)
+            idxpi, totpi = f_shotpi.result(timeout=60)
+        assert idx0.shape == (13,) and np.all(idx0 == 0)
+        # angle pi: X on qubit 0 -> |0..01>
+        assert idxpi.shape == (5,) and np.all(idxpi == 1)
+        np.testing.assert_allclose([tot0, totpi], 1.0, atol=1e-12)
+
+    def test_submit_accepts_recorded_circuit(self, env):
+        """A raw Circuit compiles once per object and is cached; two
+        submissions of the same object coalesce."""
+        c = _hea(3, ring=False)
+        pm = np.zeros((2, len(c.param_names)))
+        with SimulationService(env, max_batch=4, max_wait_s=5e-3) as svc:
+            svc.pause()
+            f1 = svc.submit(c, dict(zip(c.param_names, pm[0])))
+            f2 = svc.submit(c, dict(zip(c.param_names, pm[1])))
+            assert len(svc._compiled) == 1
+            svc.resume()
+            f1.result(timeout=60)
+            f2.result(timeout=60)
+            snap = svc.dispatch_stats()["service"]
+        assert snap["batches"] == 1
+        assert snap["batch_occupancy"] == 2.0
+
+    def test_submit_validates(self, env):
+        c = _hea(3, ring=False)
+        cc = c.compile(env)
+        with SimulationService(env) as svc:
+            with pytest.raises(ValueError, match="not both"):
+                svc.submit(cc, {nm: 0.0 for nm in cc.param_names},
+                           observables=([[(0, 3)]], [1.0]), shots=4)
+            with pytest.raises(ValueError, match="missing circuit"):
+                svc.submit(cc, {})
+            with pytest.raises(ValueError, match="out of range"):
+                svc.submit(cc, {nm: 0.0 for nm in cc.param_names},
+                           observables=([[(9, 3)]], [1.0]))
+            with pytest.raises(ValueError, match="shots"):
+                svc.submit(cc, {nm: 0.0 for nm in cc.param_names},
+                           shots=0)
+            with pytest.raises(TypeError, match="Circuit"):
+                svc.submit("nope")
+            other = qt.createQuESTEnv(num_devices=1, seed=[7])
+            with pytest.raises(ValueError, match="different QuESTEnv"):
+                svc.submit(_hea(3, ring=False).compile(other))
+
+
+class TestBackpressureAndDeadlines:
+    def test_queue_full_backpressure(self, env):
+        c = _hea(3, ring=False)
+        cc = c.compile(env)
+        params = {nm: 0.0 for nm in cc.param_names}
+        with SimulationService(env, max_queue=3, max_batch=8,
+                               max_wait_s=5e-3) as svc:
+            svc.pause()
+            futs = [svc.submit(cc, params) for _ in range(3)]
+            with pytest.raises(QueueFull, match="capacity"):
+                svc.submit(cc, params)
+            snap = svc.dispatch_stats()["service"]
+            assert snap["rejected_queue_full"] == 1
+            assert snap["queue_depth"] == 3
+            svc.resume()
+            for f in futs:        # held requests still complete
+                assert f.result(timeout=60).shape == (2, 8)
+
+    def test_unmeetable_deadline_rejected_at_submit(self, env):
+        cc = _hea(3, ring=False).compile(env)
+        params = {nm: 0.0 for nm in cc.param_names}
+        with SimulationService(env) as svc:
+            for bad in (0.0, -1.0):
+                with pytest.raises(DeadlineExceeded):
+                    svc.submit(cc, params, deadline=bad)
+            assert svc.dispatch_stats()["service"][
+                "rejected_deadline"] == 2
+
+    def test_deadline_expires_in_queue(self, env):
+        cc = _hea(3, ring=False).compile(env)
+        params = {nm: 0.0 for nm in cc.param_names}
+        with SimulationService(env, max_wait_s=1e-3) as svc:
+            svc.pause()
+            doomed = svc.submit(cc, params, deadline=0.05)
+            alive = svc.submit(cc, params)
+            time.sleep(0.15)
+            svc.resume()
+            with pytest.raises(DeadlineExceeded, match="expired"):
+                doomed.result(timeout=60)
+            assert alive.result(timeout=60).shape == (2, 8)
+            snap = svc.dispatch_stats()["service"]
+        assert snap["timeouts"] == 1
+        assert snap["completed"] == 1
+
+    def test_request_timeout_default(self, env):
+        """The service-level request_timeout_s caps every request that
+        doesn't bring its own tighter deadline."""
+        cc = _hea(3, ring=False).compile(env)
+        params = {nm: 0.0 for nm in cc.param_names}
+        with SimulationService(env, request_timeout_s=0.05) as svc:
+            svc.pause()
+            fut = svc.submit(cc, params)
+            time.sleep(0.15)
+            svc.resume()
+            with pytest.raises(DeadlineExceeded):
+                fut.result(timeout=60)
+
+    def test_transient_failure_retries_once(self, env, rng):
+        """First dispatch raises, the retry lands: the future resolves
+        with the right energy and the retry is counted."""
+        c = _hea(4, ring=False)
+        terms, coeffs, codes_flat = _random_ham(rng, 4, 5)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(1, len(c.param_names)))
+        want = _oracle_energies(cc, env, pm, codes_flat, coeffs)[0]
+        real = cc.expectation_sweep
+        calls = {"n": 0}
+
+        def flaky(pm_, ham_, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient executor fault")
+            return real(pm_, ham_, **kw)
+
+        cc.expectation_sweep = flaky
+        try:
+            with SimulationService(env, max_wait_s=1e-3,
+                                   max_retries=1) as svc:
+                fut = svc.submit(cc, dict(zip(c.param_names, pm[0])),
+                                 observables=(terms, coeffs))
+                got = fut.result(timeout=60)
+                snap = svc.dispatch_stats()["service"]
+        finally:
+            del cc.expectation_sweep
+        assert abs(got - want) < 1e-12
+        assert calls["n"] == 2
+        assert snap["retries"] == 1
+        assert snap["failed"] == 0
+
+    def test_persistent_failure_fails_future(self, env):
+        cc = _hea(3, ring=False).compile(env)
+
+        def always_fail(pm_, **kw):
+            raise RuntimeError("executor is down")
+
+        cc.sweep = always_fail
+        try:
+            with SimulationService(env, max_wait_s=1e-3,
+                                   max_retries=1) as svc:
+                fut = svc.submit(cc, {nm: 0.0 for nm in cc.param_names})
+                with pytest.raises(RuntimeError, match="down"):
+                    fut.result(timeout=60)
+                snap = svc.dispatch_stats()["service"]
+        finally:
+            del cc.sweep
+        assert snap["retries"] == 1       # one retry was attempted
+        assert snap["failed"] == 1
+
+    def test_closed_service_rejects(self, env):
+        cc = _hea(3, ring=False).compile(env)
+        svc = SimulationService(env)
+        svc.close()
+        with pytest.raises(ServiceClosed):
+            svc.submit(cc, {nm: 0.0 for nm in cc.param_names})
+        svc.close()                        # idempotent
+
+    def test_close_drains_queued_work(self, env):
+        cc = _hea(3, ring=False).compile(env)
+        params = {nm: 0.0 for nm in cc.param_names}
+        svc = SimulationService(env, max_batch=64, max_wait_s=60.0)
+        futs = [svc.submit(cc, params) for _ in range(3)]
+        # max_wait is a minute: only the drain can flush this batch
+        svc.close(drain=True)
+        for f in futs:
+            assert f.result(timeout=1).shape == (2, 8)
+
+    def test_close_without_drain_fails_futures(self, env):
+        cc = _hea(3, ring=False).compile(env)
+        svc = SimulationService(env, max_wait_s=60.0)
+        svc.pause()
+        fut = svc.submit(cc, {nm: 0.0 for nm in cc.param_names})
+        svc.close(drain=False)
+        with pytest.raises(ServiceClosed):
+            fut.result(timeout=1)
+
+
+class TestWarmAndCache:
+    def test_warm_precompiles_bucket_executables(self, env, rng):
+        c = _hea(4, ring=False)
+        terms, coeffs, _ = _random_ham(rng, 4, 5)
+        with SimulationService(env, max_batch=8) as svc:
+            cc = svc.warm(c, batch_sizes=(8,),
+                          observables=(terms, coeffs))
+            dt = str(np.dtype(env.precision.real_dtype))
+            assert ("energy", "none", dt) in cc._batched_cache
+            svc.warm(cc, batch_sizes=(4,))
+            assert (True, False, "none", dt) in cc._batched_cache
+            svc.warm(cc, batch_sizes=(2,), shots=8)
+
+    def test_cache_is_lru_bounded_with_eviction_counter(self, env,
+                                                        monkeypatch,
+                                                        rng):
+        """Satellite: the keyed executable cache evicts past the bound
+        and dispatch_stats() reports it."""
+        monkeypatch.setenv("QUEST_TPU_BATCH_CACHE", "2")
+        c = _hea(4, ring=False)
+        terms, coeffs, _ = _random_ham(rng, 4, 5)
+        cc = c.compile(env)
+        assert cc._batched_cache.maxsize == 2
+        pm = rng.uniform(0, 2 * np.pi, size=(3, len(c.param_names)))
+        cc.sweep(pm)                                   # key 1: broadcast
+        planes = np.zeros((3, 2, 16))
+        planes[:, 0, 0] = 1.0
+        cc.sweep(pm, state_f=planes)                   # key 2: owned
+        st = cc.dispatch_stats()
+        assert st.batched_cache_size == 2
+        assert st.batched_cache_evictions == 0
+        cc.expectation_sweep(pm, (terms, coeffs))      # key 3: evicts
+        st = cc.dispatch_stats()
+        assert st.batched_cache_size == 2
+        assert st.batched_cache_evictions == 1
+        assert len(cc._batched_cache) == 2
+        # LRU order: the oldest (broadcast) key is the one that left
+        dt = str(np.dtype(env.precision.real_dtype))
+        assert (True, False, "none", dt) not in cc._batched_cache
+        assert ("energy", "none", dt) in cc._batched_cache
+        # as_dict carries the counters for the bench rows
+        d = st.as_dict()
+        assert d["batched_cache_evictions"] == 1
+        assert d["batched_cache_size"] == 2
+
+    def test_batch_stats_are_coherent_under_threads(self, env, rng):
+        """Satellite: DispatchStats accumulation under the dispatcher
+        thread — concurrent sweeps + stats reads never tear the batch
+        accounting dict (each read sees one sweep's complete triple)."""
+        c = _hea(4, ring=False)
+        cc = c.compile(env)
+        pm3 = rng.uniform(0, 2 * np.pi, size=(3, len(c.param_names)))
+        pm5 = rng.uniform(0, 2 * np.pi, size=(5, len(c.param_names)))
+        cc.sweep(pm3)
+        stop = threading.Event()
+        bad = []
+
+        def reader():
+            while not stop.is_set():
+                st = cc.dispatch_stats()
+                if st.batch_size == 3:
+                    expect = 2
+                elif st.batch_size == 5:
+                    expect = 4
+                else:
+                    bad.append(("size", st.batch_size))
+                    continue
+                if st.host_syncs_avoided != expect:
+                    bad.append(("torn", st.batch_size,
+                                st.host_syncs_avoided))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            for _ in range(25):
+                cc.sweep(pm3)
+                cc.sweep(pm5)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert not bad, bad[:5]
+
+
+class TestCoalescePolicyUnits:
+    def test_batch_bucket(self):
+        assert [batch_bucket(n) for n in (1, 2, 3, 5, 8, 9)] \
+            == [1, 2, 4, 8, 8, 16]
+        assert batch_bucket(3, floor=8) == 8
+        with pytest.raises(ValueError):
+            batch_bucket(0)
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            CoalescePolicy(max_batch=0)
+        with pytest.raises(ValueError):
+            CoalescePolicy(max_wait_s=-1.0)
+        assert CoalescePolicy(bucket_batches=False).bucket_size(5) == 5
+
+    def test_split_ready(self):
+        class R:
+            def __init__(self, t):
+                self.submit_t = t
+
+        pol = CoalescePolicy(max_batch=3, max_wait_s=0.010)
+        reqs = [R(0.0), R(0.001), R(0.002), R(0.003)]
+        # full batch dispatches immediately; young tail waits
+        batches, rest, nd = split_ready(list(reqs), 0.004, pol)
+        assert [len(b) for b in batches] == [3]
+        assert len(rest) == 1 and nd == pytest.approx(0.013)
+        # the tail matures at oldest + max_wait
+        batches, rest, nd = split_ready(rest, 0.014, pol)
+        assert [len(b) for b in batches] == [1]
+        assert rest == [] and nd is None
+        # drain flushes regardless of age
+        batches, rest, _ = split_ready([R(5.0)], 5.0, pol, drain=True)
+        assert [len(b) for b in batches] == [1] and rest == []
