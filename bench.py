@@ -35,6 +35,7 @@ published numbers exist (BASELINE.md), so the roofline is the baseline.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -787,6 +788,17 @@ def bench_sharded_mesh(qt, platform: str) -> dict:
               "unit": "requests/sec", "vs_baseline": 0.0,
               "errors": [f"{type(e).__name__}: {e}"]})
 
+    # chaos row (ISSUE 5 acceptance mesh): the same serving trace under
+    # seeded transient fault injection — requests/sec degradation plus
+    # the zero-incorrect-result grade
+    if _remaining() > 30:
+        try:
+            emit(bench_serving_chaos(_qt, env, platform))
+        except Exception as e:
+            emit({"metric": "serving chaos (bench error)", "value": 0.0,
+                  "unit": "requests/sec", "vs_baseline": 0.0,
+                  "errors": [f"{type(e).__name__}: {e}"]})
+
     # sharded QUAD (double-double) row: the high-precision tier over the
     # same 8-device mesh, with dd roofline accounting — 2x the bytes per
     # pass (4 planes vs 2) and ~6x the flops of a plain gate
@@ -1161,6 +1173,118 @@ def bench_serving_config(qt, env, platform: str) -> dict:
     return rows[-1]
 
 
+def bench_serving_chaos(qt, env, platform: str) -> dict:
+    """Chaos row (ISSUE 5): the SAME expectation-request trace served
+    fault-free and under seeded transient fault injection (default 2%
+    per dispatch at the serving boundary, plus one guaranteed fault so
+    the recovery path always runs). Reports requests/sec degradation vs
+    the fault-free pass, the recovery counters (retries, quarantine
+    bisections, breaker trips), and the graded invariant: every request
+    that completes returns EXACTLY the fault-free value — zero
+    incorrect results (typed failures are visible, silence is not)."""
+    from quest_tpu.resilience import FaultInjector, FaultSpec, inject
+    from quest_tpu.serve import SimulationService
+
+    num_qubits = int(os.environ.get(
+        "QUEST_BENCH_CHAOS_QUBITS",
+        os.environ.get("QUEST_BENCH_SERVE_QUBITS", "16")))
+    n_req = int(os.environ.get(
+        "QUEST_BENCH_CHAOS_REQUESTS",
+        "1024" if _remaining() > 200 else "256"))
+    num_terms = int(os.environ.get("QUEST_BENCH_CHAOS_TERMS", "24"))
+    layers = int(os.environ.get("QUEST_BENCH_CHAOS_LAYERS", "2"))
+    max_batch = int(os.environ.get("QUEST_BENCH_CHAOS_BATCH", "64"))
+    fault_rate = float(os.environ.get("QUEST_BENCH_CHAOS_RATE", "0.02"))
+    rng = np.random.default_rng(2027)
+    circ, n_gates, names = build_hea_circuit(num_qubits, layers)
+    codes = rng.integers(0, 4, size=(num_terms, num_qubits))
+    coeffs = rng.normal(size=num_terms)
+    terms = [[(q_, int(codes[t, q_])) for q_ in range(num_qubits)]
+             for t in range(num_terms)]
+    ham = (terms, coeffs)
+    pm = rng.uniform(0.0, 2.0 * np.pi, size=(n_req, len(names)))
+    cc = circ.compile(env, pallas="off")
+    dev_desc = (f"single {platform} chip" if env.num_devices == 1
+                else f"{env.num_devices} {platform} devices")
+    label = (f"hardware-efficient-ansatz-{num_qubits}, {n_req} requests, "
+             f"{num_terms}-term Pauli sum, {dev_desc}")
+
+    def run_trace(injector):
+        svc = SimulationService(env, max_batch=max_batch,
+                                max_wait_s=5e-3,
+                                max_queue=n_req + max_batch,
+                                request_timeout_s=600.0, max_retries=4)
+        sizes = {min(max_batch, n_req)} | \
+            ({n_req % max_batch} if n_req % max_batch else set())
+        svc.warm(cc, batch_sizes=sorted(sizes - {0}), observables=ham)
+        ctx = inject(injector) if injector is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            svc.pause()
+            t0 = time.perf_counter()
+            futs = [svc.submit(cc, dict(zip(names, pm[i])),
+                               observables=ham) for i in range(n_req)]
+            svc.resume()
+            outcomes = []
+            for f in futs:
+                try:
+                    outcomes.append(("ok", float(f.result(timeout=600))))
+                except Exception as e:   # typed failure: visible, graded
+                    outcomes.append((type(e).__name__, None))
+            dt = time.perf_counter() - t0
+            snap = svc.dispatch_stats()["service"]
+        svc.close()
+        return outcomes, n_req / dt, snap
+
+    clean, clean_rate, _ = run_trace(None)
+    inj = FaultInjector(
+        [FaultSpec("transient", site="serve.execute",
+                   probability=fault_rate, at_calls=(0,))], seed=2027)
+    chaos, chaos_rate, snap = run_trace(inj)
+
+    # graded: a completed chaos request must return the fault-free value
+    incorrect = 0
+    typed_failures = 0
+    max_dev = 0.0
+    for (k1, v1), (k2, v2) in zip(clean, chaos):
+        if k2 != "ok":
+            typed_failures += 1
+            continue
+        if k1 != "ok":
+            continue                     # nothing to compare against
+        d = abs(v2 - v1)
+        max_dev = max(max_dev, d)
+        if d > 1e-10:
+            incorrect += 1
+
+    itemsize = np.dtype(env.precision.real_dtype).itemsize
+    baseline = _roofline_baseline(num_qubits, itemsize) \
+        / max(n_gates + num_terms, 1)
+    row = {
+        "metric": f"serving chaos ({100.0 * fault_rate:.1f}% injected "
+                  f"transient faults at the dispatch boundary), {label}",
+        "value": round(chaos_rate, 2),
+        "unit": "requests/sec",
+        "vs_baseline": round(chaos_rate / baseline, 4),
+        "fault_free_rate": round(clean_rate, 2),
+        "degradation_pct": round(
+            100.0 * (1.0 - chaos_rate / max(clean_rate, 1e-9)), 2),
+        "injected_faults": inj.total_injected,
+        "retries": snap["retries"],
+        "quarantine_splits": snap["quarantine_splits"],
+        "executor_faults": snap["executor_faults"],
+        "breaker_trips": snap["breaker_trips"],
+        "typed_failures": typed_failures,
+        "incorrect_results": incorrect,          # graded: must be 0
+        "max_energy_deviation": max_dev,
+    }
+    if incorrect:
+        row["errors"] = [f"{incorrect} chaos-run requests completed "
+                         "with values differing from the fault-free "
+                         "pass — silent corruption"]
+    return row
+
+
 def bench_density_noise(qt, env, platform: str) -> dict:
     """Density register with dephasing/damping channels (the BASELINE.json
     config-4 workload, width-reduced to 12 qubits everywhere — see the
@@ -1486,6 +1610,7 @@ def main() -> None:
         ("sweep", 45, lambda: bench_ensemble_sweep_config(qt, env,
                                                           platform)),
         ("serve", 45, lambda: bench_serving_config(qt, env, platform)),
+        ("chaos", 45, lambda: bench_serving_chaos(qt, env, platform)),
     ]
     if accel:
         # heavyweight compiles last on the tunnel (the heartbeat keeps a

@@ -21,6 +21,9 @@ strengths themselves (noise-model fitting on the density path),
 batched/vmapped sweeps, an asynchronous request-coalescing serving
 runtime (``quest_tpu.serve``: admission control, deadline-aware
 scheduling, padded batch buckets over the ensemble engine),
+fault-tolerant execution (``quest_tpu.resilience``: seeded fault
+injection, numerical health guards, typed retry/breaker/quarantine
+recovery, checkpoint-backed segment re-execution),
 quantum-trajectory noise unraveling
 (statevector-cost noise, mesh-shardable), uniform noise models and
 mid-circuit measurement, one-pass multi-shot sampling (shard-local on a
@@ -45,7 +48,10 @@ from .qureg import Qureg
 from .circuits import Circuit, CompiledCircuit, Param
 from .qasm_import import ParsedQASM, parse_qasm, load_qasm_file
 from .serve import (SimulationService, CoalescePolicy, ServeError,
-                    QueueFull, DeadlineExceeded, ServiceClosed)
+                    QueueFull, DeadlineExceeded, ServiceClosed,
+                    CircuitBreakerOpen)
+from .resilience import (FaultInjector, FaultSpec, HealthConfig,
+                         NumericalFault, ResiliencePolicy)
 from .api import *  # noqa: F401,F403  (the QuEST-compatible surface)
 from .api import __all__ as _api_all
 
@@ -62,6 +68,9 @@ __all__ = (
         "ParsedQASM", "parse_qasm", "load_qasm_file",
         "SimulationService", "CoalescePolicy", "ServeError",
         "QueueFull", "DeadlineExceeded", "ServiceClosed",
+        "CircuitBreakerOpen",
+        "FaultInjector", "FaultSpec", "HealthConfig", "NumericalFault",
+        "ResiliencePolicy",
     ]
     + list(_api_all)
 )

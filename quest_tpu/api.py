@@ -599,8 +599,10 @@ def createSimulationService(env: QuESTEnv, **kwargs):
     (:class:`quest_tpu.serve.SimulationService`; TPU-native addition,
     no reference counterpart). Keyword arguments are the service knobs:
     ``max_queue``, ``max_batch``, ``max_wait_s``, ``request_timeout_s``,
-    ``max_retries``. Destroy with ``service.close()`` (or use it as a
-    context manager)."""
+    ``max_retries``, and ``resilience`` (a
+    :class:`quest_tpu.resilience.ResiliencePolicy` — retry backoff,
+    circuit breaker, batch quarantine, watchdog). Destroy with
+    ``service.close()`` (or use it as a context manager)."""
     from .serve import SimulationService
     return SimulationService(env, **kwargs)
 

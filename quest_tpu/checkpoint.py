@@ -21,9 +21,20 @@ import numpy as np
 
 from .qureg import Qureg
 
-__all__ = ["save", "load", "save_npz", "load_npz"]
+__all__ = ["save", "load", "save_npz", "load_npz", "CheckpointMismatch"]
 
 _META_NAME = "quest_meta.json"
+
+
+class CheckpointMismatch(ValueError):
+    """The checkpoint's metadata does not match the target register —
+    qubit count, register kind, precision, plane layout, or dtype. A
+    subclass of ``ValueError`` (existing handlers keep working) carrying
+    ``field``: which metadata check failed."""
+
+    def __init__(self, message: str, field: str = ""):
+        super().__init__(message)
+        self.field = field
 
 
 def _meta(qureg: Qureg) -> dict:
@@ -31,25 +42,47 @@ def _meta(qureg: Qureg) -> dict:
         "num_qubits_represented": qureg.num_qubits_represented,
         "is_density_matrix": qureg.is_density_matrix,
         "precision": qureg.env.precision.name,
+        # plane layout + dtype: a QUAD (4-plane double-double) state and
+        # a float32 state are both silently corruptible by a cast-only
+        # restore; record enough to refuse loudly
+        "num_planes": 4 if qureg.is_quad else 2,
+        "real_dtype": str(np.dtype(qureg.real_dtype)),
     }
 
 
 def _check_meta(meta: dict, qureg: Qureg) -> None:
     if (meta["num_qubits_represented"] != qureg.num_qubits_represented
             or meta["is_density_matrix"] != qureg.is_density_matrix):
-        raise ValueError(
+        raise CheckpointMismatch(
             f"checkpoint holds a "
             f"{meta['num_qubits_represented']}-qubit "
             f"{'density' if meta['is_density_matrix'] else 'statevector'} "
             f"register; target register is "
             f"{qureg.num_qubits_represented}-qubit "
-            f"{'density' if qureg.is_density_matrix else 'statevector'}")
+            f"{'density' if qureg.is_density_matrix else 'statevector'}",
+            field="register")
     saved_prec = meta.get("precision")
     if saved_prec is not None and saved_prec != qureg.env.precision.name:
-        raise ValueError(
+        raise CheckpointMismatch(
             f"checkpoint was saved in {saved_prec} precision; target "
             f"register uses {qureg.env.precision.name} — create the env "
-            f"with precision={saved_prec} (or re-save) to restore")
+            f"with precision={saved_prec} (or re-save) to restore",
+            field="precision")
+    saved_planes = meta.get("num_planes")
+    want_planes = 4 if qureg.is_quad else 2
+    if saved_planes is not None and int(saved_planes) != want_planes:
+        raise CheckpointMismatch(
+            f"checkpoint holds {saved_planes}-plane state but the target "
+            f"register packs {want_planes} planes "
+            f"({'QUAD double-double' if qureg.is_quad else 'real/imag'})",
+            field="num_planes")
+    saved_dtype = meta.get("real_dtype")
+    if saved_dtype is not None and \
+            np.dtype(saved_dtype) != np.dtype(qureg.real_dtype):
+        raise CheckpointMismatch(
+            f"checkpoint planes are {saved_dtype}; target register uses "
+            f"{np.dtype(qureg.real_dtype)} — restoring through a silent "
+            f"cast would corrupt precision", field="real_dtype")
 
 
 def save(qureg: Qureg, path: str) -> None:
@@ -104,13 +137,14 @@ def load_npz(qureg: Qureg, filename: str) -> None:
     with np.load(filename, allow_pickle=False) as data:
         _check_meta(json.loads(str(data["meta"])), qureg)
         host = data["state"].astype(qureg.real_dtype)
+    if host.shape != ((4 if qureg.is_quad else 2), qureg.num_amps_total):
+        raise CheckpointMismatch(
+            f"checkpoint state has shape {host.shape}; target register "
+            f"expects ({4 if qureg.is_quad else 2}, "
+            f"{qureg.num_amps_total})", field="shape")
     if qureg.is_quad:
         # restore the (4, 2^n) dd planes verbatim — recombining through a
         # complex vector would misread re_lo as the imaginary part
-        if host.shape[0] != 4:
-            raise ValueError(
-                "checkpoint holds 2-plane state but the register is a "
-                "quad (4-plane) register")
         qureg.layout = None
         sharding = qureg.sharding()
         arr = jax.numpy.asarray(host)

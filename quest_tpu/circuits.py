@@ -51,6 +51,8 @@ from .core import matrices as mats
 from .core.packing import pack, unpack
 from .env import QuESTEnv
 from .qureg import Qureg
+from .resilience import faults as _faults
+from .resilience import health as _health
 from .types import PauliOpType
 
 __all__ = ["Circuit", "CompiledCircuit", "Param"]
@@ -1622,6 +1624,10 @@ class CompiledCircuit:
         # read dispatch_stats() (or run their own sweeps) concurrently;
         # RLock so the lazy comm accounting can nest
         self._stats_lock = threading.RLock()
+        # numerical health guard cadence counter (resilience/health.py):
+        # ticks once per guarded dispatch; the active config decides
+        # which ticks actually pay a check
+        self._health_counter = 0
 
     def _param_vec(self, params: Optional[dict]) -> jnp.ndarray:
         if params is None:
@@ -1685,7 +1691,12 @@ class CompiledCircuit:
         state = qureg.state
         fn = self._aot if (self._aot is not None
                            and self._aot_accepts(state)) else self._jitted
+        poison = _faults.fire("circuits.run")
         qureg.state = fn(state, self._param_vec(params))
+        qureg.state = _faults.poison_output(poison, qureg.state)
+        qureg.state = self._health_tick(
+            qureg.state, is_density=qureg.is_density_matrix,
+            num_qubits=qureg.num_qubits_represented, where="run")
 
     def apply(self, state_f: jnp.ndarray, params=None):
         """Pure form: packed planes in -> packed planes out.
@@ -1722,6 +1733,28 @@ class CompiledCircuit:
             # must still trace through the jit path.
             return self._aot(state_f, vec)
         return self._jitted(state_f, vec)
+
+    def _health_tick(self, planes, *, is_density: bool, num_qubits: int,
+                     where: str):
+        """Numerical health guard at the dispatch boundary: every
+        ``cadence``-th guarded dispatch (global config,
+        :func:`quest_tpu.resilience.health.configure` /
+        ``QUEST_TPU_HEALTH_EVERY``) checks the output invariants —
+        NaN/Inf, statevector norm, density trace — as one tiny jitted
+        reduction, raising a typed ``NumericalFault`` or renormalizing
+        in the degraded mode. Free when the guard is off (one int
+        compare)."""
+        cfg = _health.get_config()
+        if cfg.cadence <= 0:
+            return planes
+        with self._stats_lock:
+            self._health_counter += 1
+            due = (self._health_counter % cfg.cadence) == 0
+        if not due:
+            return planes
+        return _health.check_planes(
+            planes, is_density=is_density, num_qubits=num_qubits,
+            config=cfg, where=f"{where} ({self.num_qubits}q program)")
 
     def _aot_accepts(self, state_f) -> bool:
         """True when the precompiled executable can take this input as
@@ -2142,6 +2175,7 @@ class CompiledCircuit:
         working set fits, amplitude-sharded past the memory wall — and
         non-divisible batches are padded and masked."""
         pm = self._validated_param_matrix(param_matrix)
+        poison = _faults.fire("circuits.sweep")
         n = self.num_qubits
         B = pm.shape[0]
         mode = self._batch_policy(B)["mode"]
@@ -2181,7 +2215,12 @@ class CompiledCircuit:
             planes = self._place_batch(planes, mode, amp_shardable=True)
             out = self._batched_fn(False, True, mode)(planes, pm_run)
         self._record_batch_stats(B, mode, B - 1)
-        return out[:B] if out.shape[0] != B else out
+        out = out[:B] if out.shape[0] != B else out
+        out = _faults.poison_output(poison, out)
+        return self._health_tick(
+            out, is_density=self.is_density,
+            num_qubits=(self.num_qubits // 2 if self.is_density
+                        else self.num_qubits), where="sweep")
 
     def expectation_sweep(self, param_matrix, hamiltonian, state_f=None):
         """``(B,)`` energies ``<H>(params_b)`` from ONE executable and
@@ -2218,6 +2257,7 @@ class CompiledCircuit:
             codes.reshape(-1), nq, coeffs)
 
         pm = self._validated_param_matrix(param_matrix)
+        poison = _faults.fire("circuits.expectation_sweep")
         B = pm.shape[0]
         mode = self._batch_policy(B)["mode"]
         pm_run, B = self._padded_params(pm, mode)
@@ -2272,7 +2312,8 @@ class CompiledCircuit:
         # reference: one per term per point) — the engine's whole sweep
         # is one (B,) transfer
         self._record_batch_stats(B, mode, B * max(T, 1) - 1)
-        return out[:B] if out.shape[0] != B else out
+        out = out[:B] if out.shape[0] != B else out
+        return _faults.poison_output(poison, out)
 
     def sample_sweep(self, param_matrix, num_shots: int, key=None):
         """Shot batches over a parameter sweep: run the batched program

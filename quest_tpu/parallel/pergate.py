@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 from ..compat import shard_map
 from ..core.packing import pack, unpack
 from ..env import AMP_AXIS
+from ..resilience import faults as _faults
 from .exchange import (plan_exchange, run_exchange, apply_op_local,
                        apply_1q_cross_shard, overlap_eligible,
                        run_exchange_overlapped)
@@ -48,6 +49,18 @@ __all__ = ["use_lazy", "phys_targets", "localise_targets", "canonicalise",
 # the lazy layout exists to keep this far below the count of gates that
 # touch sharded qubits)
 RELAYOUT_COUNT = 0
+
+
+def _maybe_inject(qureg, site: str) -> None:
+    """Fault-injection boundary for the imperative sharded path
+    (:mod:`quest_tpu.resilience.faults`; no-op unless an injector is
+    installed). A drawn ``nan`` fault poisons the INPUT planes — the
+    corruption then propagates through the dispatch exactly like a bad
+    kernel output would."""
+    poison = _faults.fire(site)
+    inj = _faults.active()
+    if poison and inj is not None:
+        qureg.state = inj.poison_array(qureg.state)
 
 
 def overlap_enabled() -> bool:
@@ -193,6 +206,7 @@ def canonicalise(qureg) -> None:
     s = _shard_bits(qureg)
     fn = _relayout_fn(qureg.env.mesh, n, s,
                       tuple(int(p) for p in lay), tuple(range(n)))
+    _maybe_inject(qureg, "pergate.relayout")
     global RELAYOUT_COUNT
     RELAYOUT_COUNT += 1
     qureg.state = fn(qureg.state)
@@ -249,6 +263,7 @@ def localise_targets(qureg, targets) -> np.ndarray:
     fn = _relayout_fn(qureg.env.mesh, n, s,
                       tuple(int(p) for p in perm),
                       tuple(int(p) for p in new_perm))
+    _maybe_inject(qureg, "pergate.relayout")
     global RELAYOUT_COUNT
     RELAYOUT_COUNT += 1
     qureg.state = fn(qureg.state)
@@ -270,6 +285,7 @@ def sharded_unitary(qureg, u_packed, targets, ctrl_mask, flip_mask) -> None:
     gate: local positions -> local kernel; one sharded 1q target ->
     role-split pair exchange; multi-qubit sharded -> batched swap-to-local
     relayout then local kernel. Controls never move."""
+    _maybe_inject(qureg, "pergate.gate")
     n = qureg.num_qubits_in_state_vec
     s = _shard_bits(qureg)
     lt = n - s

@@ -1,0 +1,56 @@
+"""quest_tpu.resilience — fault-tolerant execution.
+
+The failure modes a production simulator meets at scale (ROADMAP north
+star; mpiQulacs arXiv:2203.16044, QuEST arXiv:1802.08032), made
+testable and survivable:
+
+- :mod:`~quest_tpu.resilience.faults` — deterministic, seedable fault
+  injection at the dispatch boundaries (transient errors, simulated
+  OOM, NaN poisoning, slow-device stalls);
+- :mod:`~quest_tpu.resilience.health` — cheap on-device numerical
+  invariant checks (NaN/Inf, norm drift, density trace) raising a typed
+  :class:`NumericalFault` or renormalizing in the opt-in degraded mode;
+- :mod:`~quest_tpu.resilience.recovery` — the typed exception
+  classifier, retry backoff, and per-program circuit breaker the
+  serving runtime's recovery path runs on;
+- :mod:`~quest_tpu.resilience.segments` — checkpoint-backed segment
+  recovery for long runs and sweeps (snapshots via
+  :mod:`quest_tpu.checkpoint`, re-execution from the last good
+  segment, process-restart resumability).
+
+See ``docs/tpu.md`` ("Fault tolerance & health checks").
+"""
+
+from .faults import (FaultInjector, FaultSpec, InjectedFault, SimulatedOOM,
+                     SITES as FAULT_SITES, active as active_injector,
+                     fire, inject, install, uninstall)
+from .health import (HealthConfig, NumericalFault, check_planes, configure,
+                     get_config, guarded, health_stats, reset_stats)
+from .recovery import (FATAL, POISON, TRANSIENT, CircuitBreaker,
+                       ResiliencePolicy, classify)
+
+__all__ = [
+    # faults
+    "FaultInjector", "FaultSpec", "InjectedFault", "SimulatedOOM",
+    "FAULT_SITES", "inject", "install", "uninstall", "active_injector",
+    "fire",
+    # health
+    "HealthConfig", "NumericalFault", "check_planes", "configure",
+    "get_config", "guarded", "health_stats", "reset_stats",
+    # recovery
+    "ResiliencePolicy", "CircuitBreaker", "classify", "TRANSIENT",
+    "POISON", "FATAL",
+    # segments (lazy — they import circuits/checkpoint)
+    "split_circuit", "checkpointed_run", "checkpointed_sweep",
+]
+
+_SEGMENT_NAMES = {"split_circuit", "checkpointed_run", "checkpointed_sweep"}
+
+
+def __getattr__(name):
+    # segments imports quest_tpu.circuits; loading it lazily keeps this
+    # package importable from inside circuits.py (the fault hooks)
+    if name in _SEGMENT_NAMES:
+        from . import segments
+        return getattr(segments, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

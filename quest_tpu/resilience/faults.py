@@ -1,0 +1,262 @@
+"""Deterministic, seedable fault injection at the dispatch boundaries.
+
+Distributed simulators meet real failure modes at scale — transient XLA
+runtime errors, device OOM, NaN-poisoned buffers, and wedged/slow
+collectives (the failure classes mpiQulacs, arXiv:2203.16044, and the
+QuEST whitepaper, arXiv:1802.08032, engineer around) — but none of them
+can be provoked on demand in CI. This module makes them reproducible:
+a :class:`FaultInjector` carries a seeded schedule of faults, and the
+execution layers call :func:`fire` at their dispatch boundaries
+(:data:`SITES`), which is a no-op unless an injector is installed.
+
+Fault kinds:
+
+- ``"transient"`` — raises :class:`InjectedFault` (a ``RuntimeError``,
+  the shape of a transient executor failure; the recovery layer must
+  absorb it with a retry);
+- ``"oom"`` — raises :class:`SimulatedOOM` (message styled like XLA's
+  ``RESOURCE_EXHAUSTED``; recovery may succeed at a smaller batch, which
+  is exactly what the serving layer's quarantine bisection produces);
+- ``"nan"`` — the dispatch RUNS, then its output is NaN-poisoned in one
+  deterministic row (:meth:`FaultInjector.poison_array`) — the silent
+  corruption the numerical health guards exist to catch;
+- ``"stall"`` — the dispatch runs after sleeping ``stall_s`` seconds (a
+  slow device / wedged collective; the serving watchdog's prey).
+
+Determinism: given the same specs, seed, and sequence of ``fire`` calls,
+the injected schedule is identical — ``at_calls`` schedules are exact,
+and probabilistic draws come from one seeded ``numpy`` Generator. All
+counters are thread-safe (the serving dispatcher fires from its own
+thread while callers run warmups).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import fnmatch
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["InjectedFault", "SimulatedOOM", "FaultSpec", "FaultInjector",
+           "install", "uninstall", "active", "inject", "fire",
+           "poison_output", "SITES", "KINDS"]
+
+# the dispatch boundaries that call fire() (site names are stable API —
+# tools/chaos_trace.py and the chaos tests target them by pattern)
+SITES = (
+    "circuits.run",                # CompiledCircuit.run / apply dispatch
+    "circuits.sweep",              # batched ensemble sweep dispatch
+    "circuits.expectation_sweep",  # batched energy dispatch
+    "pergate.gate",                # imperative sharded gate dispatch
+    "pergate.relayout",            # imperative relayout exchange
+    "serve.execute",               # serving dispatcher batch execution
+)
+
+KINDS = ("transient", "oom", "nan", "stall")
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected transient executor fault."""
+
+
+class SimulatedOOM(RuntimeError):
+    """A deliberately injected device out-of-memory failure (styled like
+    XLA's ``RESOURCE_EXHAUSTED`` so classifiers treat it as the real
+    thing)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault class.
+
+    ``kind`` is one of :data:`KINDS`; ``site`` is an ``fnmatch`` pattern
+    over :data:`SITES` (``"*"`` hits every boundary). A spec triggers at
+    the exact per-site call indices in ``at_calls`` (0-based,
+    deterministic) and/or independently with ``probability`` per
+    eligible call (drawn from the injector's seeded generator).
+    """
+
+    kind: str
+    site: str = "*"
+    probability: float = 0.0
+    at_calls: tuple = ()
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"expected one of {KINDS}")
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        object.__setattr__(self, "at_calls",
+                           tuple(int(i) for i in self.at_calls))
+
+
+class FaultInjector:
+    """A seeded fault schedule plus its accounting.
+
+    ``max_faults`` caps total injections (a chaos run that must end);
+    ``stall_s`` is the sleep for ``"stall"`` faults. ``snapshot()``
+    returns the full accounting — the serving runtime folds it into
+    ``dispatch_stats()`` so every injected fault is accounted for next
+    to the recovery counters it caused.
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec], seed: int = 0,
+                 max_faults: Optional[int] = None, stall_s: float = 0.05):
+        self.specs = tuple(specs)
+        for spec in self.specs:
+            if not isinstance(spec, FaultSpec):
+                raise TypeError(f"expected FaultSpec, got {type(spec)}")
+        self.seed = int(seed)
+        self.max_faults = None if max_faults is None else int(max_faults)
+        self.stall_s = float(stall_s)
+        self._rng = np.random.default_rng(self.seed)
+        self._lock = threading.Lock()
+        self._calls: dict = {}       # site -> fire() count
+        self._injected: dict = {}    # (site, kind) -> count
+        self._total = 0
+
+    # -- scheduling --------------------------------------------------------
+
+    def draw(self, site: str) -> Optional[str]:
+        """Advance the site's call counter and return the fault kind to
+        inject at this call (None for a clean dispatch)."""
+        with self._lock:
+            idx = self._calls.get(site, 0)
+            self._calls[site] = idx + 1
+            if self.max_faults is not None and self._total >= self.max_faults:
+                return None
+            for spec in self.specs:
+                if not fnmatch.fnmatchcase(site, spec.site):
+                    continue
+                hit = idx in spec.at_calls
+                if not hit and spec.probability > 0.0:
+                    hit = float(self._rng.random()) < spec.probability
+                if hit:
+                    key = (site, spec.kind)
+                    self._injected[key] = self._injected.get(key, 0) + 1
+                    self._total += 1
+                    return spec.kind
+            return None
+
+    def poison_array(self, arr):
+        """Return ``arr`` with one element of a seeded-random leading row
+        set to NaN — the minimal corruption that makes the whole row's
+        result wrong while leaving its shape intact. Works on numpy and
+        jax arrays (functional update)."""
+        if getattr(arr, "ndim", 0) == 0 or arr.shape[0] == 0:
+            return arr
+        with self._lock:
+            row = int(self._rng.integers(arr.shape[0]))
+        idx = (row,) + (0,) * (arr.ndim - 1)
+        if isinstance(arr, np.ndarray):
+            out = arr.copy()
+            out[idx] = np.nan
+            return out
+        return arr.at[idx].set(np.nan)
+
+    # -- accounting --------------------------------------------------------
+
+    @property
+    def total_injected(self) -> int:
+        with self._lock:
+            return self._total
+
+    def counts(self, kind: Optional[str] = None) -> int:
+        """Total injections, optionally of one kind."""
+        with self._lock:
+            if kind is None:
+                return self._total
+            return sum(n for (_, k), n in self._injected.items()
+                       if k == kind)
+
+    def snapshot(self) -> dict:
+        """JSON-ready accounting: per-site call counts, injections by
+        site/kind, and totals."""
+        with self._lock:
+            by_kind: dict = {}
+            by_site: dict = {}
+            for (site, kind), n in self._injected.items():
+                by_kind[kind] = by_kind.get(kind, 0) + n
+                by_site.setdefault(site, {})[kind] = n
+            return {"seed": self.seed,
+                    "total_calls": sum(self._calls.values()),
+                    "calls_by_site": dict(self._calls),
+                    "total_injected": self._total,
+                    "injected_by_kind": by_kind,
+                    "injected_by_site": by_site}
+
+
+# ---------------------------------------------------------------------------
+# the active-injector hook the dispatch boundaries consult
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def install(injector: FaultInjector) -> None:
+    """Install ``injector`` globally (all dispatch boundaries consult
+    it). Prefer the :func:`inject` context manager."""
+    global _ACTIVE
+    if not isinstance(injector, FaultInjector):
+        raise TypeError("install() takes a FaultInjector")
+    _ACTIVE = injector
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def inject(injector: FaultInjector):
+    """Scope an injector: ``with faults.inject(inj): ...`` — guaranteed
+    uninstall on exit, so a failing chaos test can't poison the suite."""
+    install(injector)
+    try:
+        yield injector
+    finally:
+        uninstall()
+
+
+def fire(site: str) -> bool:
+    """The dispatch-boundary hook. No-op (False) when no injector is
+    installed. Otherwise: raises for ``transient``/``oom`` faults,
+    sleeps for ``stall`` faults, and returns True when the CALLER must
+    NaN-poison this dispatch's output (``nan`` faults poison results,
+    not inputs — the corruption the health guards must catch)."""
+    inj = _ACTIVE
+    if inj is None:
+        return False
+    kind = inj.draw(site)
+    if kind is None:
+        return False
+    if kind == "transient":
+        raise InjectedFault(f"injected transient fault at {site}")
+    if kind == "oom":
+        raise SimulatedOOM(
+            f"RESOURCE_EXHAUSTED: injected simulated OOM at {site}")
+    if kind == "stall":
+        time.sleep(inj.stall_s)
+        return False
+    return True     # "nan": caller poisons its output
+
+
+def poison_output(poison: bool, arr):
+    """Apply a drawn ``nan`` fault to a dispatch output: pass
+    :func:`fire`'s return value and the output array. One helper so
+    every boundary shares the same semantics — including the edge where
+    the injector was uninstalled between ``fire()`` and the dispatch
+    completing (the chaos scope ended: the poison is dropped)."""
+    inj = _ACTIVE
+    if poison and inj is not None:
+        return inj.poison_array(arr)
+    return arr

@@ -1,0 +1,213 @@
+"""Recovery policy: fault classification, backoff, and circuit breaking.
+
+The serving runtime's original fault story was one blind ``except
+Exception`` retry — a ``ValueError`` burned the retry budget exactly
+like a genuine executor hiccup, and a persistently broken program
+re-failed every batch forever. This module is the typed replacement:
+
+- :func:`classify` splits exceptions into **transient** (retry may
+  succeed: runtime/OOM/timeout shapes, injected faults), **poison**
+  (:class:`~quest_tpu.resilience.health.NumericalFault` — the result is
+  numerically wrong; retrying the same binding is pointless, the
+  request gets a typed failure), and **fatal** (caller errors —
+  ``ValueError``/``TypeError``/validation ``QuESTError`` — fail fast
+  with the ORIGINAL exception, never burn a retry);
+- :class:`ResiliencePolicy` is the serving config surface: retry
+  backoff (exponential + seeded jitter), circuit-breaker thresholds,
+  quarantine, output guarding, degraded sequential mode, and the
+  dispatcher watchdog timeout;
+- :class:`CircuitBreaker` trips per compiled program after
+  ``threshold`` failures inside ``window_s``, fast-failing new batches
+  for ``cooldown_s`` (then half-opens: one probe batch decides).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from .faults import InjectedFault, SimulatedOOM
+from .health import NumericalFault
+
+__all__ = ["TRANSIENT", "POISON", "FATAL", "classify", "ResiliencePolicy",
+           "CircuitBreaker"]
+
+TRANSIENT = "transient"
+POISON = "poison"
+FATAL = "fatal"
+
+# caller errors: retrying cannot help and hides the bug from the caller
+_FATAL_TYPES = (ValueError, TypeError, KeyError, IndexError,
+                AttributeError, AssertionError, NotImplementedError,
+                ArithmeticError)
+
+
+def classify(exc: BaseException) -> str:
+    """``"transient"`` | ``"poison"`` | ``"fatal"`` for one executor
+    exception. Unknown ``Exception`` subclasses default to transient —
+    the runtime's failure modes (XLA ``XlaRuntimeError``, RPC resets on
+    tunneled backends) are RuntimeError-shaped, while the fatal set is
+    the closed family of caller errors."""
+    if isinstance(exc, NumericalFault):
+        return POISON
+    if isinstance(exc, (InjectedFault, SimulatedOOM)):
+        return TRANSIENT
+    if isinstance(exc, _FATAL_TYPES):
+        return FATAL
+    return TRANSIENT
+
+
+@dataclasses.dataclass(frozen=True)
+class ResiliencePolicy:
+    """The serving runtime's fault-tolerance knobs (one object so the
+    ``SimulationService`` constructor doesn't sprout ten parameters).
+
+    Backoff for retry attempt k (1-based) is
+    ``min(backoff_cap_s, backoff_base_s * 2^(k-1))`` scaled by a seeded
+    jitter in ``[1, 1 + backoff_jitter]`` — retried requests re-enter
+    the queue after the delay and may coalesce differently.
+    ``degrade_after`` consecutive faulted dispatches of one program put
+    it in sequential per-request mode for ``degrade_cooldown_s`` (a
+    poisoned batch member can't keep failing its companions);
+    ``watchdog_timeout_s`` bounds how long the dispatcher may go
+    without a heartbeat before the watchdog thread counts a stall
+    (0 disables the thread)."""
+
+    backoff_base_s: float = 2e-3
+    backoff_cap_s: float = 0.25
+    backoff_jitter: float = 0.25
+    seed: int = 0
+    breaker_threshold: int = 5
+    breaker_window_s: float = 30.0
+    breaker_cooldown_s: float = 2.0
+    quarantine: bool = True
+    guard_outputs: bool = True
+    degrade_after: int = 3
+    degrade_cooldown_s: float = 5.0
+    watchdog_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if self.backoff_base_s < 0 or self.backoff_cap_s < 0:
+            raise ValueError("backoff delays must be >= 0")
+        if self.backoff_jitter < 0:
+            raise ValueError("backoff_jitter must be >= 0")
+        if self.breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        if self.degrade_after < 0:
+            raise ValueError("degrade_after must be >= 0 (0 disables)")
+
+    def backoff(self, attempt: int, rng) -> float:
+        """Delay before retry ``attempt`` (1-based); ``rng`` supplies
+        the jitter draw (the service owns one seeded generator)."""
+        base = min(self.backoff_cap_s,
+                   self.backoff_base_s * (2.0 ** max(0, attempt - 1)))
+        return base * (1.0 + self.backoff_jitter * float(rng.random()))
+
+
+class CircuitBreaker:
+    """Per-key failure breaker (keys are compiled-program labels).
+
+    Closed: everything flows, failures are recorded in a sliding
+    ``window_s``. ``threshold`` failures in the window trip it OPEN:
+    ``allow`` answers False (the caller fast-fails with a typed error)
+    until ``cooldown_s`` passes, then HALF-OPEN: one batch may probe;
+    success closes the breaker, failure re-opens it for another
+    cooldown. Thread-safe; ``trips`` counts open transitions."""
+
+    def __init__(self, threshold: int = 5, window_s: float = 30.0,
+                 cooldown_s: float = 2.0, clock=time.monotonic):
+        self.threshold = int(threshold)
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures: dict = {}      # key -> deque of failure times
+        self._open_until: dict = {}    # key -> reopen time
+        self._half_open: set = set()   # keys probing after cooldown
+        self.trips = 0
+
+    def _prune(self, key, now: float):
+        dq = self._failures.get(key)
+        while dq and now - dq[0] > self.window_s:
+            dq.popleft()
+
+    def allow(self, key) -> bool:
+        now = self._clock()
+        with self._lock:
+            until = self._open_until.get(key)
+            if until is None:
+                return True
+            if now < until:
+                return False
+            # cooldown over: half-open — one probe through
+            self._half_open.add(key)
+            del self._open_until[key]
+            return True
+
+    def record_failure(self, key) -> bool:
+        """Record one failed dispatch; returns True when this failure
+        TRIPS the breaker open (new trip, not an already-open state)."""
+        now = self._clock()
+        with self._lock:
+            if key in self._half_open:
+                # the probe failed: straight back to open
+                self._half_open.discard(key)
+                self._open_until[key] = now + self.cooldown_s
+                self.trips += 1
+                return True
+            dq = self._failures.setdefault(key, deque())
+            dq.append(now)
+            self._prune(key, now)
+            if len(dq) >= self.threshold and key not in self._open_until:
+                self._open_until[key] = now + self.cooldown_s
+                dq.clear()
+                self.trips += 1
+                return True
+            return False
+
+    def record_success(self, key) -> None:
+        with self._lock:
+            self._half_open.discard(key)
+            self._failures.pop(key, None)
+            self._open_until.pop(key, None)
+
+    def release(self, key) -> None:
+        """An INCONCLUSIVE half-open probe (e.g. it died on a caller
+        error before exercising the executor): return the key to OPEN
+        for another cooldown so a future batch gets the probe slot —
+        without counting a trip or a failure. No-op unless half-open."""
+        now = self._clock()
+        with self._lock:
+            if key in self._half_open:
+                self._half_open.discard(key)
+                self._open_until[key] = now + self.cooldown_s
+
+    def state(self, key) -> str:
+        now = self._clock()
+        with self._lock:
+            if key in self._half_open:
+                return "half-open"
+            until = self._open_until.get(key)
+            if until is not None and now < until:
+                return "open"
+            return "closed"
+
+    def snapshot(self) -> dict:
+        now = self._clock()
+        with self._lock:
+            keys = set(self._failures) | set(self._open_until) \
+                | self._half_open
+            per_key = {}
+            for key in keys:
+                self._prune(key, now)
+                until = self._open_until.get(key)
+                per_key[str(key)] = {
+                    "state": ("half-open" if key in self._half_open else
+                              "open" if until is not None and now < until
+                              else "closed"),
+                    "recent_failures": len(self._failures.get(key, ())),
+                }
+            return {"trips": self.trips, "programs": per_key}

@@ -1,0 +1,224 @@
+"""Checkpoint-backed segment recovery for long executions.
+
+``checkpoint.py`` can already save/restore a register onto any mesh
+shape, but nothing in the execution path ever used it — a transient
+fault (or NaN poisoning) 90% through a long run threw the whole
+computation away. Here:
+
+- :func:`checkpointed_run` splits a recorded :class:`Circuit` into
+  segments, snapshots the register between them (via
+  :mod:`quest_tpu.checkpoint` — orbax when available, ``.npz``
+  otherwise), and on a transient/poison fault restores the LAST GOOD
+  snapshot and re-executes only the failed segment (bounded restart
+  budget; fatal caller errors re-raise immediately);
+- :func:`checkpointed_sweep` does the same for the batched engine along
+  the BATCH axis: row segments execute through ``CompiledCircuit.
+  sweep``, completed segments append to an on-disk ``.npz`` progress
+  file, and a faulted (or NaN-screened) segment re-executes without
+  touching finished rows. The progress file makes the sweep resumable
+  across PROCESS restarts too (``resume=True`` picks up where a killed
+  run stopped, guarded by a parameter-matrix digest).
+
+Both return recovery accounting (segments run, restarts, checkpoint
+count) so chaos tests can assert the machinery actually engaged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from .health import HealthConfig, check_planes, bad_plane_rows, NumericalFault
+from .recovery import classify, FATAL
+
+__all__ = ["split_circuit", "checkpointed_run", "checkpointed_sweep"]
+
+
+def split_circuit(circuit, num_segments: int) -> list:
+    """Slice a recorded circuit into ``num_segments`` contiguous
+    sub-circuits (op granularity, even split; empty tails dropped).
+    Every sub-circuit carries the FULL parameter registry, so one
+    ``params`` dict drives all segments."""
+    from ..circuits import Circuit
+    if num_segments < 1:
+        raise ValueError("num_segments must be >= 1")
+    ops = list(circuit.ops)
+    num_segments = min(num_segments, max(1, len(ops)))
+    per = -(-len(ops) // num_segments)       # ceil
+    out = []
+    for lo in range(0, len(ops), per):
+        seg = Circuit(circuit.num_qubits)
+        seg.ops = ops[lo:lo + per]
+        seg._params = list(circuit._params)
+        out.append(seg)
+    return out or [circuit]
+
+
+def _snap_path(ckpt_dir: str, k: int) -> str:
+    return os.path.join(ckpt_dir, f"seg-{k:04d}")
+
+
+def checkpointed_run(circuit, qureg, params: Optional[dict] = None, *,
+                     num_segments: int = 4, ckpt_dir: Optional[str] = None,
+                     max_restarts: int = 3,
+                     health: Optional[HealthConfig] = None,
+                     keep_checkpoints: bool = False, **compile_kwargs
+                     ) -> dict:
+    """Run ``circuit`` on ``qureg`` in checkpointed segments.
+
+    Each segment compiles against ``qureg.env`` and runs through the
+    normal compiled path; the register is snapshotted before segment 0
+    and after every completed segment. A transient executor fault (see
+    :func:`quest_tpu.resilience.recovery.classify`) or a failed
+    inter-segment health check restores the last good snapshot and
+    re-executes the segment, up to ``max_restarts`` total; fatal errors
+    re-raise with the snapshot intact. ``health`` (a
+    :class:`HealthConfig`) enables an invariant check after EVERY
+    segment regardless of the global cadence.
+
+    Returns ``{"segments", "restarts", "checkpoints", "ckpt_dir"}``
+    (``ckpt_dir`` survives only with ``keep_checkpoints=True``)."""
+    from .. import checkpoint as ckpt
+    own_dir = ckpt_dir is None
+    if own_dir:
+        ckpt_dir = tempfile.mkdtemp(prefix="quest_tpu_segrun_")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    segs = split_circuit(circuit, num_segments)
+    compiled = [s.compile(qureg.env, **compile_kwargs) for s in segs]
+    restarts = 0
+    checkpoints = 0
+    try:
+        ckpt.save(qureg, _snap_path(ckpt_dir, 0))
+        checkpoints += 1
+        k = 0
+        while k < len(compiled):
+            try:
+                compiled[k].run(qureg, params)
+                if health is not None:
+                    nq = qureg.num_qubits_represented
+                    qureg.state = check_planes(
+                        qureg.state, is_density=qureg.is_density_matrix,
+                        num_qubits=nq, config=health,
+                        where=f"segment {k}")
+            except Exception as e:
+                if classify(e) == FATAL or restarts >= max_restarts:
+                    raise
+                restarts += 1
+                ckpt.load(qureg, _snap_path(ckpt_dir, k))
+                continue                      # re-execute this segment
+            k += 1
+            ckpt.save(qureg, _snap_path(ckpt_dir, k))
+            checkpoints += 1
+    finally:
+        if not keep_checkpoints:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return {"segments": len(compiled), "restarts": restarts,
+            "checkpoints": checkpoints,
+            "ckpt_dir": ckpt_dir if keep_checkpoints else None}
+
+
+def _pm_digest(pm: np.ndarray) -> str:
+    return hashlib.sha256(
+        np.ascontiguousarray(pm, dtype=np.float64).tobytes()).hexdigest()
+
+
+def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,
+                       ckpt_path: Optional[str] = None,
+                       max_restarts: int = 3, resume: bool = True,
+                       keep_checkpoint: bool = False):
+    """A :meth:`CompiledCircuit.sweep` that survives faults and process
+    restarts: the ``(B, P)`` parameter matrix executes in row segments
+    of ``segment_rows``, each completed segment's planes are written to
+    their own ``.npy`` sidecar next to the ``.npz`` metadata file at
+    ``ckpt_path`` (per-segment I/O stays O(segment), not O(rows done)),
+    and a faulted or NaN-screened segment re-executes from the last
+    good row (bounded by ``max_restarts``). With ``resume=True`` an
+    existing progress file whose parameter digest matches continues
+    where it stopped.
+
+    Returns ``(planes, stats)``: the full ``(B, 2, 2^n)`` result and
+    ``{"segments", "restarts", "resumed_rows"}``."""
+    pm = np.asarray(param_matrix, dtype=np.float64)
+    if pm.ndim != 2:
+        raise ValueError(f"param_matrix must be 2-D; got shape {pm.shape}")
+    if segment_rows < 1:
+        raise ValueError("segment_rows must be >= 1")
+    B = pm.shape[0]
+    own_path = ckpt_path is None
+    if own_path:
+        fd, ckpt_path = tempfile.mkstemp(suffix=".npz",
+                                         prefix="quest_tpu_segsweep_")
+        os.close(fd)
+        os.unlink(ckpt_path)      # mkstemp created it; savez rewrites
+    elif not ckpt_path.endswith(".npz"):
+        # np.savez appends ".npz" to a bare path; normalize up front or
+        # the resume check and cleanup would look at the wrong file
+        ckpt_path += ".npz"
+
+    def _seg_path(i: int) -> str:
+        return f"{ckpt_path}.seg{i:04d}.npy"
+
+    def _cleanup(n_segs: int) -> None:
+        for p in [ckpt_path] + [_seg_path(i) for i in range(n_segs)]:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    digest = _pm_digest(pm)
+    done = 0
+    chunks: list = []
+    n_saved = 0
+    if resume and os.path.exists(ckpt_path):
+        with np.load(ckpt_path, allow_pickle=False) as f:
+            # a digest mismatch silently restarting would return planes
+            # for the WRONG parameters; start clean instead
+            if str(f["digest"]) == digest and int(f["batch"]) == B:
+                done = int(f["done"])
+                n_saved = int(f["segments"])
+        try:
+            chunks = [np.load(_seg_path(i)) for i in range(n_saved)]
+        except OSError:
+            done, n_saved, chunks = 0, 0, []   # sidecars gone: restart
+        if chunks and sum(c.shape[0] for c in chunks) != done:
+            done, n_saved, chunks = 0, 0, []   # torn progress: restart
+    resumed = done
+    restarts = 0
+    segments = 0
+    try:
+        while done < B:
+            hi = min(B, done + segment_rows)
+            try:
+                planes = np.asarray(cc.sweep(pm[done:hi]))
+                bad = bad_plane_rows(planes)
+                if bad.size:
+                    raise NumericalFault(
+                        f"non-finite planes in sweep rows "
+                        f"{[int(done + r) for r in bad]}", kind="nan",
+                        rows=tuple(int(done + r) for r in bad))
+            except Exception as e:
+                if classify(e) == FATAL or restarts >= max_restarts:
+                    raise
+                restarts += 1
+                continue                      # re-execute this segment
+            segments += 1
+            chunks.append(planes)
+            done = hi
+            np.save(_seg_path(n_saved), planes)
+            n_saved += 1
+            np.savez(ckpt_path, done=done, batch=B, digest=digest,
+                     segments=n_saved)
+        out = np.concatenate(chunks, axis=0) if chunks \
+            else np.zeros((0,), dtype=np.float64)
+    finally:
+        if own_path and not keep_checkpoint:
+            _cleanup(n_saved)
+    if not own_path and not keep_checkpoint:
+        _cleanup(n_saved)
+    return out, {"segments": segments, "restarts": restarts,
+                 "resumed_rows": resumed}

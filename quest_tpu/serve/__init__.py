@@ -10,12 +10,13 @@ See ``docs/tpu.md`` ("Serving runtime") for the operational model.
 
 from .coalesce import (CoalescePolicy, batch_bucket, coalesce_key,
                        plan_schedule, split_ready)
-from .engine import (DeadlineExceeded, QueueFull, ServeError,
-                     ServiceClosed, SimulationService)
+from .engine import (CircuitBreakerOpen, DeadlineExceeded, QueueFull,
+                     ServeError, ServiceClosed, SimulationService)
 from .metrics import ServiceMetrics
 
 __all__ = [
     "SimulationService", "ServeError", "QueueFull", "DeadlineExceeded",
-    "ServiceClosed", "CoalescePolicy", "ServiceMetrics",
-    "batch_bucket", "coalesce_key", "plan_schedule", "split_ready",
+    "ServiceClosed", "CircuitBreakerOpen", "CoalescePolicy",
+    "ServiceMetrics", "batch_bucket", "coalesce_key", "plan_schedule",
+    "split_ready",
 ]

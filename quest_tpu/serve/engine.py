@@ -20,9 +20,23 @@ fast at. :class:`SimulationService` is that layer:
 - each request carries a **deadline** (caller-supplied, capped by the
   service's ``request_timeout_s``); requests that expire while queued
   get :class:`DeadlineExceeded` instead of occupying a batch slot;
-- a batch whose executor raises is **retried once** per surviving
-  request (transient failure absorption — the retried requests rejoin
-  the queue and may coalesce differently), then fails the futures;
+- executor failures go through a **typed recovery path**
+  (:mod:`quest_tpu.resilience`): exceptions are classified (fatal
+  caller errors fail fast with the ORIGINAL exception; transient
+  runtime faults retry within a per-request budget, re-entering the
+  queue after exponential backoff with seeded jitter), a per-program
+  **circuit breaker** fast-fails batches with a typed
+  :class:`CircuitBreakerOpen` after repeated faults, and a faulted
+  multi-request batch is **quarantined by bisection** — halves re-execute
+  independently so one poisoned request gets a typed failure instead of
+  failing its batch companions. Result rows are screened for NaN/Inf
+  (one poisoned row fails typed with
+  :class:`~quest_tpu.resilience.health.NumericalFault`; the rest of the
+  batch completes normally);
+- a program whose batched dispatches keep faulting **degrades to
+  sequential** per-request execution for a cooldown, and a watchdog
+  thread counts dispatcher heartbeat stalls (wedged collective / slow
+  device) into the metrics;
 - :meth:`SimulationService.warm` pre-compiles the padded batch-bucket
   executables so first requests don't eat the compile.
 
@@ -40,18 +54,24 @@ from __future__ import annotations
 import collections
 import threading
 import time
+import weakref
 from concurrent.futures import Future
 from typing import Optional, Sequence
 
 import numpy as np
 
 from ..circuits import Circuit, CompiledCircuit, _BoundedExecutableCache
+from ..resilience import faults as _faults
+from ..resilience import health as _health
+from ..resilience.health import NumericalFault
+from ..resilience.recovery import (FATAL, POISON, TRANSIENT, CircuitBreaker,
+                                   ResiliencePolicy, classify)
 from .coalesce import (KIND_EXPECTATION, KIND_SAMPLE, KIND_STATE,
                        CoalescePolicy, coalesce_key, split_ready)
 from .metrics import ServiceMetrics
 
 __all__ = ["ServeError", "QueueFull", "DeadlineExceeded", "ServiceClosed",
-           "SimulationService"]
+           "CircuitBreakerOpen", "SimulationService"]
 
 
 class ServeError(RuntimeError):
@@ -72,11 +92,18 @@ class ServiceClosed(ServeError):
     """The service no longer accepts submissions."""
 
 
+class CircuitBreakerOpen(ServeError):
+    """The compiled program's circuit breaker is open after repeated
+    executor faults: requests fast-fail (typed) instead of burning the
+    executor/retry budget, until the cooldown half-opens the breaker."""
+
+
 class _Request:
     """One queued submission (internal)."""
 
     __slots__ = ("compiled", "param_vec", "kind", "observables", "shots",
-                 "submit_t", "deadline", "future", "retries_left", "key")
+                 "submit_t", "deadline", "future", "retries_left", "key",
+                 "not_before", "attempts")
 
     def __init__(self, compiled, param_vec, kind, observables, shots,
                  submit_t, deadline, future, retries_left, key):
@@ -90,6 +117,8 @@ class _Request:
         self.future = future
         self.retries_left = retries_left
         self.key = key
+        self.not_before = 0.0    # retry backoff: ineligible before this
+        self.attempts = 0        # executor attempts already failed
 
 
 def _canonical_observables(compiled, observables) -> tuple:
@@ -121,17 +150,30 @@ class SimulationService:
         Default per-request deadline; ``submit(deadline=...)`` can only
         tighten it.
     max_retries : int
-        Dispatch retries per request after a transient executor failure.
+        Dispatch retries per request after a transient executor failure
+        (fatal caller errors never burn one — they fail fast with the
+        original exception).
     max_circuits : int
         LRU bound on recorded-Circuit submissions compiled and cached
         by the service (CompiledCircuit submissions are never cached —
         the caller owns those).
+    resilience : ResiliencePolicy
+        The fault-tolerance knobs (:class:`quest_tpu.resilience.
+        ResiliencePolicy`): retry backoff, circuit-breaker thresholds,
+        batch quarantine, output guarding, degraded mode, and the
+        watchdog timeout. Defaults to the standard policy.
+    record_events : int
+        Ring-buffer bound on the recovery timeline
+        (:attr:`SimulationService.events`; ``tools/chaos_trace.py``
+        dumps it). 0 disables recording.
     """
 
     def __init__(self, env, *, max_queue: int = 1024, max_batch: int = 64,
                  max_wait_s: float = 2e-3, request_timeout_s: float = 60.0,
                  max_retries: int = 1, latency_window: int = 4096,
-                 max_circuits: int = 32):
+                 max_circuits: int = 32,
+                 resilience: Optional[ResiliencePolicy] = None,
+                 record_events: int = 256):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if request_timeout_s <= 0.0:
@@ -159,10 +201,35 @@ class SimulationService:
         self._compiled = _BoundedExecutableCache(int(max_circuits))
         self._last_cc: Optional[CompiledCircuit] = None
         self.metrics.queue_depth_fn = lambda: self._backlog
+        # fault-tolerance state (quest_tpu/resilience): classifier-driven
+        # retries with backoff, per-program circuit breaker, degraded
+        # sequential mode, recovery event timeline, dispatcher heartbeat
+        self.resilience = resilience if resilience is not None \
+            else ResiliencePolicy()
+        rp = self.resilience
+        self._breaker = CircuitBreaker(rp.breaker_threshold,
+                                       rp.breaker_window_s,
+                                       rp.breaker_cooldown_s)
+        self._retry_rng = np.random.default_rng(rp.seed)
+        self._consec_faults: dict = {}     # program key -> fault streak
+        self._degraded_until: dict = {}    # program key -> monotonic time
+        self._program_refs: dict = {}      # program key -> weakref(cc)
+        self._t0 = time.monotonic()
+        self.events: collections.deque = collections.deque(
+            maxlen=max(0, int(record_events)))
+        self._heartbeat = time.monotonic()
+        self._stall_flagged = False
+        self._watchdog_stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
         self._thread = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name=f"quest-tpu-serve-{id(self):x}")
         self._thread.start()
+        if rp.watchdog_timeout_s and rp.watchdog_timeout_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name=f"quest-tpu-serve-watchdog-{id(self):x}")
+            self._watchdog.start()
 
     # -- circuit resolution ------------------------------------------------
 
@@ -322,10 +389,29 @@ class SimulationService:
         """Engine-level :class:`~quest_tpu.profiling.DispatchStats`
         fields of the most recently served compiled circuit (empty dict
         before the first dispatch), with the serving metrics snapshot
-        folded in under ``"service"``."""
+        folded in under ``"service"`` and the fault-tolerance accounting
+        under ``"resilience"`` (breaker states, degraded programs,
+        health-guard counters, and — when a fault injector is installed
+        — its full injection accounting, so every injected fault is
+        accounted for next to the recovery it caused)."""
         base = self._last_cc.dispatch_stats().as_dict() \
             if self._last_cc is not None else {}
-        return {**base, "service": self.metrics.snapshot()}
+        now = time.monotonic()
+        # dict() copies are C-level atomic under the GIL; iterating the
+        # live dict here would race the dispatcher thread's inserts
+        degraded = dict(self._degraded_until)
+        res = {
+            "breaker": self._breaker.snapshot(),
+            "degraded_programs": sorted(
+                k for k, t in degraded.items() if t > now),
+            "health": _health.health_stats(),
+            "events_recorded": len(self.events),
+        }
+        inj = _faults.active()
+        if inj is not None:
+            res["fault_injection"] = inj.snapshot()
+        return {**base, "service": self.metrics.snapshot(),
+                "resilience": res}
 
     def close(self, drain: bool = True, timeout: Optional[float] = 30.0
               ) -> None:
@@ -342,6 +428,7 @@ class SimulationService:
             self._cond.notify_all()
         if threading.current_thread() is not self._thread:
             self._thread.join(timeout)
+        self._watchdog_stop.set()
 
     def __enter__(self) -> "SimulationService":
         return self
@@ -363,6 +450,7 @@ class SimulationService:
     def _dispatch_loop(self) -> None:
         pending: dict = {}   # coalesce key -> FIFO list of _Request
         while True:
+            self._heartbeat = time.monotonic()
             with self._cond:
                 if self._paused and not self._closed:
                     # held: requests stay in the admission queue
@@ -388,12 +476,27 @@ class SimulationService:
                     continue
             now = time.monotonic()
             self._expire(pending, now)
+            drain = self._closed
             ready: list = []
             next_deadline = None
             for key in list(pending):
-                batches, rest, nd = split_ready(pending[key], now,
-                                                self.policy,
-                                                drain=self._closed)
+                group = pending[key]
+                if drain:
+                    # shutdown flushes everything — a retry backoff must
+                    # not outlive the service
+                    eligible, held = group, []
+                else:
+                    # retry backoff: requests sleeping out their delay
+                    # stay pending (invisible to max-wait maturity) and
+                    # wake the loop when the earliest delay lapses
+                    eligible = [r for r in group if r.not_before <= now]
+                    held = [r for r in group if r.not_before > now]
+                batches, rest, nd = split_ready(eligible, now,
+                                                self.policy, drain=drain)
+                rest = rest + held
+                if held:
+                    wake = min(r.not_before for r in held)
+                    nd = wake if nd is None else min(nd, wake)
                 if rest:
                     pending[key] = rest
                 else:
@@ -431,13 +534,170 @@ class SimulationService:
             else:
                 del pending[key]
 
+    # -- recovery path -----------------------------------------------------
+
+    def _program_key(self, cc: CompiledCircuit) -> str:
+        """Stable resilience key for one compiled program. ``id()`` alone
+        is not enough — CPython recycles addresses, so a collected
+        circuit's open-breaker/degraded state could land on an unrelated
+        new program. A weakref per key detects recycling (stale state is
+        dropped) and lets dead keys be pruned, bounding the maps on a
+        long-lived service. Dispatcher-thread only."""
+        key = f"{'dm' if cc.is_density else 'sv'}-" \
+              f"{cc.num_qubits}q-{id(cc):x}"
+        ref = self._program_refs.get(key)
+        if ref is None or ref() is not cc:
+            if ref is not None:
+                # recycled id: the recorded state belongs to a dead
+                # program — reset everything filed under this key
+                self._breaker.record_success(key)
+                self._consec_faults.pop(key, None)
+                self._degraded_until.pop(key, None)
+            self._program_refs[key] = weakref.ref(cc)
+            if len(self._program_refs) > 128:
+                for k, r in list(self._program_refs.items()):
+                    if r() is None:
+                        self._program_refs.pop(k, None)
+                        self._breaker.record_success(k)
+                        self._consec_faults.pop(k, None)
+                        self._degraded_until.pop(k, None)
+        return key
+
+    def _event(self, _name: str, **detail) -> None:
+        """Append one recovery-timeline event (bounded ring;
+        ``tools/chaos_trace.py`` dumps it as JSON)."""
+        if self.events.maxlen:
+            self.events.append({
+                "t": round(time.monotonic() - self._t0, 6),
+                "event": _name, **detail})
+
+    def _watchdog_loop(self) -> None:
+        """Heartbeat watchdog: the dispatcher stamps ``_heartbeat``
+        every loop iteration and around every engine dispatch; a gap
+        past ``watchdog_timeout_s`` (wedged collective, slow device,
+        stuck compile) is counted ONCE per stall episode."""
+        timeout = self.resilience.watchdog_timeout_s
+        poll = max(min(timeout / 4.0, 1.0), 1e-3)
+        while not self._watchdog_stop.wait(poll):
+            if not self._thread.is_alive():
+                return
+            gap = time.monotonic() - self._heartbeat
+            if gap > timeout:
+                if not self._stall_flagged:
+                    self._stall_flagged = True
+                    self.metrics.incr("watchdog_stalls")
+                    self._event("watchdog_stall",
+                                heartbeat_gap_s=round(gap, 3))
+            else:
+                self._stall_flagged = False
+
+    def _note_fault(self, pkey: str) -> None:
+        """Degradation accounting: ``degrade_after`` consecutive faulted
+        dispatches of one program put it in sequential per-request mode
+        for ``degrade_cooldown_s`` (a poisoned batch member can't keep
+        failing its companions while the fault persists)."""
+        rp = self.resilience
+        if not rp.degrade_after:
+            return
+        n = self._consec_faults.get(pkey, 0) + 1
+        self._consec_faults[pkey] = n
+        if n >= rp.degrade_after:
+            until = time.monotonic() + rp.degrade_cooldown_s
+            if self._degraded_until.get(pkey, 0.0) < until:
+                self._degraded_until[pkey] = until
+            self._event("degraded_mode", program=pkey,
+                        consecutive_faults=n)
+
     def _execute(self, batch: list) -> None:
-        """Run one coalesced group as a single engine dispatch and fan
-        the results back to the futures. On executor failure, requests
-        with retries left rejoin the queue (they may coalesce into a
-        different batch); the rest fail."""
+        """Run one coalesced group through the typed recovery path:
+        breaker fast-fail, degraded sequential mode, then the
+        quarantining group executor."""
         with self._cond:
             self._backlog -= len(batch)
+        cc = batch[0].compiled
+        pkey = self._program_key(cc)
+        rp = self.resilience
+        if not self._breaker.allow(pkey):
+            self.metrics.incr("breaker_fastfails", len(batch))
+            self.metrics.incr("failed", len(batch))
+            self._event("breaker_fastfail", program=pkey,
+                        requests=len(batch))
+            err = CircuitBreakerOpen(
+                f"circuit breaker is open for program {pkey} after "
+                f"repeated executor faults; fast-failing "
+                f"(cooldown {rp.breaker_cooldown_s}s)")
+            for req in batch:
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(err)
+            return
+        if rp.degrade_after and len(batch) > 1 and \
+                time.monotonic() < self._degraded_until.get(pkey, 0.0):
+            # graceful degradation: the batched path kept faulting, so
+            # serve each request alone until the cooldown lapses
+            self.metrics.incr("degraded_dispatches", len(batch))
+            self._event("degraded_dispatch", program=pkey,
+                        requests=len(batch))
+            for req in batch:
+                self._run_group([req], pkey)
+            return
+        self._run_group(batch, pkey)
+
+    def _run_group(self, batch: list, pkey: str, depth: int = 0) -> None:
+        """Execute one compatible group as a single engine dispatch; on
+        a classified fault, quarantine by bisection (halves re-execute
+        independently — log2(B) extra dispatches isolate one poisoned
+        request) or retry/fail each request per the policy."""
+        self._heartbeat = time.monotonic()
+        rp = self.resilience
+        try:
+            results, bad_rows, t_dispatch, padded = \
+                self._dispatch_batch(batch)
+        except Exception as e:  # noqa: BLE001 — classified fault barrier
+            self._heartbeat = time.monotonic()
+            kind = classify(e)
+            self._event("fault", program=pkey, kind=kind,
+                        error=type(e).__name__, requests=len(batch),
+                        depth=depth)
+            if kind == FATAL:
+                # caller error (ValueError / TypeError / validation):
+                # fail fast with the ORIGINAL exception — retrying
+                # cannot help and must not burn the retry budget. The
+                # breaker counts only runtime faults, but a half-open
+                # probe must not be left dangling (the probe was
+                # inconclusive, not healthy)
+                self._breaker.release(pkey)
+                self.metrics.incr("failed", len(batch))
+                self.metrics.incr("failed_fatal", len(batch))
+                for req in batch:
+                    if req.future.set_running_or_notify_cancel():
+                        req.future.set_exception(e)
+                return
+            self.metrics.incr("executor_faults")
+            if self._breaker.record_failure(pkey):
+                self.metrics.incr("breaker_trips")
+                self._event("breaker_open", program=pkey)
+            self._note_fault(pkey)
+            if len(batch) > 1 and rp.quarantine:
+                self.metrics.incr("quarantine_splits")
+                self._event("quarantine_split", program=pkey,
+                            requests=len(batch), depth=depth)
+                mid = len(batch) // 2
+                self._run_group(batch[:mid], pkey, depth + 1)
+                self._run_group(batch[mid:], pkey, depth + 1)
+                return
+            for req in batch:
+                self._fail_or_retry(req, e, kind)
+            return
+        self._heartbeat = time.monotonic()
+        self._breaker.record_success(pkey)
+        self._consec_faults.pop(pkey, None)
+        self._fan_out(batch, results, bad_rows, t_dispatch, padded)
+
+    def _dispatch_batch(self, batch: list):
+        """One engine dispatch for one group. Returns ``(results,
+        bad_rows, t_dispatch, padded)`` where ``bad_rows`` indexes
+        result rows screened out as non-finite (NaN poisoning — those
+        requests get a typed failure; their batchmates are unaffected)."""
         cc = batch[0].compiled
         B = len(batch)
         padded = self.policy.bucket_size(B, self._device_multiple(cc))
@@ -446,47 +706,85 @@ class SimulationService:
             pm[i] = req.param_vec
         t_dispatch = time.monotonic()
         kind = batch[0].kind
-        try:
-            if kind == KIND_EXPECTATION:
-                out = np.asarray(cc.expectation_sweep(
-                    pm, batch[0].observables))[:B]
-                results = [float(v) for v in out]
-            elif kind == KIND_SAMPLE:
-                shots = max(req.shots for req in batch)
-                idx, totals = cc.sample_sweep(pm, shots)
-                results = [(np.asarray(idx[i, :req.shots]),
-                            float(totals[i]))
-                           for i, req in enumerate(batch)]
-            else:
-                planes = np.asarray(cc.sweep(pm))[:B]
-                results = [np.array(planes[i]) for i in range(B)]
-        except Exception as e:  # noqa: BLE001 — executor fault barrier
-            retriable = [r for r in batch if r.retries_left > 0]
-            for req in batch:
-                if req.retries_left > 0:
-                    continue
-                self.metrics.incr("failed")
-                if req.future.set_running_or_notify_cancel():
-                    req.future.set_exception(e)
-            if retriable:
-                self.metrics.incr("retries", len(retriable))
-                with self._cond:
-                    for req in retriable:
-                        req.retries_left -= 1
-                        self._backlog += 1
-                        self._queue.append(req)
-                    self._cond.notify_all()
+        poison = _faults.fire("serve.execute")
+        guard = self.resilience.guard_outputs
+        if kind == KIND_EXPECTATION:
+            out = _faults.poison_output(poison, np.asarray(
+                cc.expectation_sweep(pm, batch[0].observables))[:B])
+            results = [float(v) for v in out]
+            bad = _health.bad_value_rows(out) if guard else ()
+        elif kind == KIND_SAMPLE:
+            shots = max(req.shots for req in batch)
+            idx, totals = cc.sample_sweep(pm, shots)
+            totals = _faults.poison_output(poison,
+                                           np.asarray(totals)[:B])
+            results = [(np.asarray(idx[i, :req.shots]), float(totals[i]))
+                       for i, req in enumerate(batch)]
+            bad = _health.bad_value_rows(totals) if guard else ()
+        else:
+            planes = _faults.poison_output(poison,
+                                           np.asarray(cc.sweep(pm))[:B])
+            results = [np.array(planes[i]) for i in range(B)]
+            bad = _health.bad_plane_rows(planes) if guard else ()
+        return results, {int(r) for r in bad}, t_dispatch, padded
+
+    def _fail_or_retry(self, req: _Request, exc: BaseException,
+                       kind: str) -> None:
+        """Transient faults with budget left re-enter the queue after
+        exponential backoff with seeded jitter (the retried request may
+        coalesce into a different batch); everything else fails typed
+        with the classified exception."""
+        rp = self.resilience
+        if kind == TRANSIENT and req.retries_left > 0:
+            req.retries_left -= 1
+            req.attempts += 1
+            delay = rp.backoff(req.attempts, self._retry_rng)
+            req.not_before = time.monotonic() + delay
+            self.metrics.incr("retries")
+            self._event("retry", attempt=req.attempts,
+                        delay_s=round(delay, 6))
+            with self._cond:
+                self._backlog += 1
+                self._queue.append(req)
+                self._cond.notify_all()
             return
+        self.metrics.incr("failed")
+        if kind == POISON:
+            self.metrics.incr("quarantined")
+        self._event("request_failed", error=type(exc).__name__,
+                    kind=kind)
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_exception(exc)
+
+    def _fan_out(self, batch: list, results: list, bad_rows: set,
+                 t_dispatch: float, padded: int) -> None:
+        cc = batch[0].compiled
+        B = len(batch)
         self._last_cc = cc
         done_t = time.monotonic()
         # metrics BEFORE resolving any future: a caller blocked on the
         # last result may read dispatch_stats() the instant it unblocks,
         # and must see this batch's accounting
         self.metrics.record_batch(B, padded)
-        for req in batch:
+        if bad_rows:
+            self.metrics.incr("health_failures", len(bad_rows))
+            self.metrics.incr("quarantined", len(bad_rows))
+            self.metrics.incr("failed", len(bad_rows))
+            self._event("poisoned_rows", rows=sorted(bad_rows),
+                        requests=B)
+        for i, req in enumerate(batch):
+            if i in bad_rows:
+                continue
             self.metrics.incr("completed")
             self.metrics.record_latency(done_t - req.submit_t,
                                         t_dispatch - req.submit_t)
-        for req, res in zip(batch, results):
-            if req.future.set_running_or_notify_cancel():
+        for i, (req, res) in enumerate(zip(batch, results)):
+            if i in bad_rows:
+                err = NumericalFault(
+                    f"request result was non-finite (poisoned row {i} "
+                    f"of a {B}-request batch); batchmates were "
+                    f"unaffected", kind="nan", rows=(i,))
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(err)
+            elif req.future.set_running_or_notify_cancel():
                 req.future.set_result(res)

@@ -36,6 +36,16 @@ _COUNTERS = (
     "coalesced_requests",    # requests carried by those dispatches
     "shared_batch_requests",  # of those, requests that shared their batch
     "padded_rows",           # throwaway rows added by batch bucketing
+    # fault-tolerance accounting (quest_tpu/resilience; ISSUE 5):
+    "executor_faults",       # engine dispatches that raised (non-fatal)
+    "failed_fatal",          # futures failed fast on a caller error
+    "quarantine_splits",     # faulted batches bisected by quarantine
+    "quarantined",           # requests isolated + failed typed by quarantine
+    "health_failures",       # result rows screened out as non-finite
+    "breaker_trips",         # circuit breaker open transitions
+    "breaker_fastfails",     # requests fast-failed by an open breaker
+    "degraded_dispatches",   # requests run in sequential degraded mode
+    "watchdog_stalls",       # dispatcher heartbeat gaps past the timeout
 )
 
 

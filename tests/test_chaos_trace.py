@@ -1,0 +1,74 @@
+"""Fast-tier smoke for tools/chaos_trace.py: the seeded chaos replay
+must run end to end, account for every request and every injected
+fault, and prove zero silent wrong answers. Kept tiny (3 qubits, 24
+requests, CPU) so it fits the bounded fast tier."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+TOOL = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                    "chaos_trace.py")
+
+
+@pytest.mark.chaos
+def test_cli_end_to_end_accounts_for_everything():
+    proc = subprocess.run(
+        [sys.executable, TOOL, "--requests", "24", "--qubits", "3",
+         "--fault-rate", "0.1", "--kinds", "transient,nan",
+         "--at-calls", "0,1", "--sites", "serve.execute", "--seed", "9",
+         "--max-batch", "8", "--oracle"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout)
+
+    # every request is accounted for: completed or typed failure
+    out = doc["outcomes"]
+    assert out["unaccounted"] == 0
+    assert out["completed"] + sum(out["typed_failures"].values()) == 24
+    # typed means TYPED: only known recovery-path exception classes
+    assert set(out["typed_failures"]) <= {
+        "InjectedFault", "SimulatedOOM", "NumericalFault",
+        "CircuitBreakerOpen", "DeadlineExceeded"}
+
+    # the injector accounting is in the dump, and the recovery engaged
+    inj = doc["fault_injection"]
+    assert inj["total_injected"] >= 1
+    svc = doc["service"]
+    raised = inj["injected_by_kind"].get("transient", 0) \
+        + inj["injected_by_kind"].get("oom", 0)
+    assert svc["executor_faults"] == raised
+    # nan injections are screened into typed per-row failures
+    assert svc["health_failures"] >= \
+        out["typed_failures"].get("NumericalFault", 0)
+
+    # no silent wrong answers (the acceptance invariant)
+    assert doc["parity"]["failures"] == 0
+    assert doc["parity"]["checked"] == out["completed"]
+
+    # the recovery timeline names the machinery that ran
+    events = {e["event"] for e in doc["timeline"]}
+    if raised:
+        assert "fault" in events
+
+
+@pytest.mark.chaos
+def test_cli_deterministic_schedule():
+    """Same seed + arguments -> identical injection schedule."""
+    # max-retries 0: retry re-coalescing depends on wall-clock backoff,
+    # so the fully deterministic path is the no-retry one (pre-queued
+    # trace -> deterministic batches -> deterministic draw sequence)
+    argv = [sys.executable, TOOL, "--requests", "16", "--qubits", "3",
+            "--fault-rate", "0.3", "--kinds", "transient", "--seed",
+            "4", "--max-batch", "4", "--max-retries", "0"]
+    docs = []
+    for _ in range(2):
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        docs.append(json.loads(proc.stdout))
+    assert docs[0]["fault_injection"] == docs[1]["fault_injection"]
+    assert docs[0]["outcomes"] == docs[1]["outcomes"]

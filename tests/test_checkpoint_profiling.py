@@ -80,6 +80,71 @@ class TestCheckpoint:
         with pytest.raises(ValueError, match="precision"):
             ckpt.load(other, str(tmp_path / "ck"))
 
+    def test_cross_mesh_density_restore(self, env, mesh_env, tmp_path):
+        """ISSUE-5 satellite: 8-dev save -> 1-dev restore and back for a
+        DENSITY register, amplitude parity <= 1e-12."""
+        d8 = qt.createDensityQureg(3, mesh_env)
+        qt.initPlusState(d8)
+        qt.mixDephasing(d8, 0, 0.2)
+        qt.mixDamping(d8, 1, 0.1)
+        want = d8.to_numpy()
+        ckpt.save(d8, str(tmp_path / "dck8"))
+        d1 = qt.createDensityQureg(3, env)
+        ckpt.load(d1, str(tmp_path / "dck8"))
+        np.testing.assert_allclose(d1.to_numpy(), want, atol=1e-12)
+        ckpt.save(d1, str(tmp_path / "dck1"))
+        d8b = qt.createDensityQureg(3, mesh_env)
+        ckpt.load(d8b, str(tmp_path / "dck1"))
+        np.testing.assert_allclose(d8b.to_numpy(), want, atol=1e-12)
+
+    def test_cross_mesh_npz_fallback(self, env, mesh_env, tmp_path):
+        """The .npz fallback must be mesh-shape-agnostic too: 8-dev
+        save_npz -> 1-dev load_npz and back, statevector AND density,
+        parity <= 1e-12."""
+        q8 = self._prepared(mesh_env)
+        want = q8.to_numpy()
+        ckpt.save_npz(q8, str(tmp_path / "sv8.npz"))
+        q1 = qt.createQureg(5, env)
+        ckpt.load_npz(q1, str(tmp_path / "sv8.npz"))
+        np.testing.assert_allclose(q1.to_numpy(), want, atol=1e-12)
+        ckpt.save_npz(q1, str(tmp_path / "sv1.npz"))
+        q8b = qt.createQureg(5, mesh_env)
+        ckpt.load_npz(q8b, str(tmp_path / "sv1.npz"))
+        np.testing.assert_allclose(q8b.to_numpy(), want, atol=1e-12)
+        d8 = qt.createDensityQureg(2, mesh_env)
+        qt.initPlusState(d8)
+        qt.mixDepolarising(d8, 0, 0.15)
+        dwant = d8.to_numpy()
+        ckpt.save_npz(d8, str(tmp_path / "dm8.npz"))
+        d1 = qt.createDensityQureg(2, env)
+        ckpt.load_npz(d1, str(tmp_path / "dm8.npz"))
+        np.testing.assert_allclose(d1.to_numpy(), dwant, atol=1e-12)
+
+    def test_mismatch_errors_are_typed(self, env, tmp_path):
+        """ISSUE-5 satellite: metadata mismatches raise the typed
+        CheckpointMismatch (a ValueError subclass) naming the field,
+        instead of silently restoring wrong-dtype planes."""
+        q = self._prepared(env, 3)
+        ckpt.save_npz(q, str(tmp_path / "m.npz"))
+        env32 = qt.createQuESTEnv(num_devices=1, seed=[1],
+                                  precision=qt.SINGLE)
+        other = qt.createQureg(3, env32)
+        with pytest.raises(ckpt.CheckpointMismatch) as ei:
+            ckpt.load_npz(other, str(tmp_path / "m.npz"))
+        assert ei.value.field == "precision"
+        assert isinstance(ei.value, ValueError)   # old handlers survive
+        wrong_n = qt.createQureg(4, env)
+        with pytest.raises(ckpt.CheckpointMismatch) as ei:
+            ckpt.load_npz(wrong_n, str(tmp_path / "m.npz"))
+        assert ei.value.field == "register"
+        # a quad register refuses a 2-plane checkpoint (typed, not a
+        # misread of re_lo as the imaginary part)
+        envq = qt.createQuESTEnv(num_devices=1, seed=[1],
+                                 precision=qt.QUAD)
+        quad = qt.createQureg(3, envq)
+        with pytest.raises(ckpt.CheckpointMismatch):
+            ckpt.load_npz(quad, str(tmp_path / "m.npz"))
+
     def test_npz_roundtrip(self, env, tmp_path):
         q = self._prepared(env)
         want = q.to_numpy()

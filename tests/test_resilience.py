@@ -1,0 +1,796 @@
+"""Fault-tolerant execution (ISSUE 5): fault injection, numerical
+health guards, typed recovery in the serving runtime, and
+checkpoint-backed segment recovery.
+
+The acceptance invariant everywhere: under seeded fault injection,
+every request either completes with oracle parity <= 1e-12 or fails
+with a TYPED error — no silent wrong answers, no hung dispatcher, and
+``dispatch_stats()`` accounts for every injected fault.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+from quest_tpu import resilience as rz
+from quest_tpu.resilience import (FaultInjector, FaultSpec, HealthConfig,
+                                  NumericalFault, ResiliencePolicy)
+from quest_tpu.resilience.faults import InjectedFault, SimulatedOOM
+from quest_tpu.resilience.recovery import (FATAL, POISON, TRANSIENT,
+                                           CircuitBreaker, classify)
+from quest_tpu.resilience import health
+from quest_tpu.serve import CircuitBreakerOpen, SimulationService
+
+
+def _hea(num_qubits, layers=1, ring=False):
+    c = Circuit(num_qubits)
+    for layer in range(layers):
+        for q in range(num_qubits):
+            c.ry(q, c.parameter(f"y{layer}_{q}"))
+            c.rz(q, c.parameter(f"z{layer}_{q}"))
+        for q in range(num_qubits if ring else num_qubits - 1):
+            c.cnot(q, (q + 1) % num_qubits)
+    return c
+
+
+def _random_ham(rng, num_qubits, num_terms):
+    codes = rng.integers(0, 4, size=(num_terms, num_qubits))
+    coeffs = rng.normal(size=num_terms)
+    terms = [[(q, int(codes[t, q])) for q in range(num_qubits)]
+             for t in range(num_terms)]
+    return terms, coeffs, [int(x) for x in codes.reshape(-1)]
+
+
+def _oracle_energies(cc, env, pm, codes_flat, coeffs):
+    names = cc.param_names
+    out = []
+    for row in np.asarray(pm):
+        q = qt.createQureg(cc.circuit.num_qubits, env)
+        qt.initZeroState(q)
+        cc.run(q, dict(zip(names, row)))
+        out.append(qt.calcExpecPauliSum(q, codes_flat, coeffs))
+    return np.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# fault injector units
+# ---------------------------------------------------------------------------
+
+class TestFaultInjector:
+    def test_explicit_schedule_is_exact(self):
+        inj = FaultInjector([FaultSpec("transient", site="a.b",
+                                       at_calls=(1, 3))])
+        with rz.inject(inj):
+            assert rz.fire("a.b") is False            # call 0
+            with pytest.raises(InjectedFault):
+                rz.fire("a.b")                        # call 1
+            assert rz.fire("a.b") is False            # call 2
+            with pytest.raises(InjectedFault):
+                rz.fire("a.b")                        # call 3
+            assert rz.fire("other.site") is False     # pattern miss
+        snap = inj.snapshot()
+        assert snap["total_injected"] == 2
+        assert snap["injected_by_site"] == {"a.b": {"transient": 2}}
+        assert snap["calls_by_site"] == {"a.b": 4, "other.site": 1}
+
+    def test_probability_draws_are_seed_deterministic(self):
+        def run(seed):
+            inj = FaultInjector([FaultSpec("transient",
+                                           probability=0.5)], seed=seed)
+            hits = []
+            for i in range(40):
+                try:
+                    inj_hit = False
+                    with rz.inject(inj):
+                        rz.fire("x")
+                except InjectedFault:
+                    inj_hit = True
+                hits.append(inj_hit)
+            return hits
+
+        assert run(3) == run(3)
+        assert run(3) != run(4)          # astronomically unlikely to tie
+
+    def test_kind_behaviours(self):
+        inj = FaultInjector([FaultSpec("oom", at_calls=(0,)),
+                             FaultSpec("stall", at_calls=(1,)),
+                             FaultSpec("nan", at_calls=(2,))],
+                            stall_s=0.01)
+        with rz.inject(inj):
+            with pytest.raises(SimulatedOOM, match="RESOURCE_EXHAUSTED"):
+                rz.fire("s")
+            t0 = time.monotonic()
+            assert rz.fire("s") is False             # stall: sleeps
+            assert time.monotonic() - t0 >= 0.009
+            assert rz.fire("s") is True              # nan: caller poisons
+        assert inj.counts("oom") == 1
+        assert inj.counts() == 3
+
+    def test_poison_array_sets_one_nan_row(self):
+        inj = FaultInjector([], seed=1)
+        a = np.zeros((4, 2, 8))
+        b = inj.poison_array(a)
+        assert np.isfinite(a).all()                  # original untouched
+        bad = np.nonzero(~np.isfinite(b).reshape(4, -1).all(axis=1))[0]
+        assert bad.size == 1
+
+    def test_max_faults_caps_injection(self):
+        inj = FaultInjector([FaultSpec("transient", probability=1.0)],
+                            max_faults=2)
+        with rz.inject(inj):
+            for _ in range(2):
+                with pytest.raises(InjectedFault):
+                    rz.fire("s")
+            assert rz.fire("s") is False             # cap reached
+        assert inj.total_injected == 2
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            FaultSpec("meteor")
+        with pytest.raises(ValueError, match="probability"):
+            FaultSpec("nan", probability=1.5)
+
+    def test_inject_uninstalls_on_error(self):
+        inj = FaultInjector([])
+        with pytest.raises(RuntimeError, match="boom"):
+            with rz.inject(inj):
+                raise RuntimeError("boom")
+        assert rz.active_injector() is None
+
+    def test_pergate_boundaries_fire_on_mesh(self, mesh_env):
+        """The imperative sharded path's dispatch boundaries are hooked:
+        a gate dispatch and a relayout exchange both consult the
+        injector."""
+        q = qt.createQureg(5, mesh_env)
+        qt.initZeroState(q)
+        inj = FaultInjector([FaultSpec("transient", site="pergate.gate",
+                                       at_calls=(0,))])
+        with rz.inject(inj):
+            with pytest.raises(InjectedFault):
+                qt.hadamard(q, 0)
+            qt.hadamard(q, 0)                        # clean retry works
+        assert inj.snapshot()["calls_by_site"]["pergate.gate"] >= 2
+        # a dense 2q gate with a sharded target pays a relayout — that
+        # boundary fires too
+        u4 = np.eye(4, dtype=np.complex128)
+        inj2 = FaultInjector([FaultSpec("transient",
+                                        site="pergate.relayout",
+                                        at_calls=(0,))])
+        with rz.inject(inj2):
+            with pytest.raises(InjectedFault):
+                qt.twoQubitUnitary(q, 0, 4, u4)
+        assert inj2.total_injected == 1
+
+
+# ---------------------------------------------------------------------------
+# numerical health guards
+# ---------------------------------------------------------------------------
+
+class TestHealthGuards:
+    def test_nan_raises_typed_with_rows(self):
+        planes = np.zeros((3, 2, 8))
+        planes[:, 0, 0] = 1.0
+        planes[1, 1, 3] = np.nan
+        with pytest.raises(NumericalFault) as ei:
+            health.check_planes(planes, config=HealthConfig())
+        assert ei.value.kind == "nan"
+        assert ei.value.rows == (1,)
+
+    def test_norm_drift_raises_or_renormalizes(self):
+        planes = np.zeros((2, 8))
+        planes[0, 0] = 1.1                           # norm 1.21
+        with pytest.raises(NumericalFault) as ei:
+            health.check_planes(planes, config=HealthConfig())
+        assert ei.value.kind == "norm"
+        with pytest.warns(UserWarning, match="renormalizing"):
+            fixed = health.check_planes(
+                planes, config=HealthConfig(mode="renormalize"))
+        fixed = np.asarray(fixed)
+        assert abs(np.sum(fixed * fixed) - 1.0) < 1e-12
+
+    def test_density_trace_check(self, env):
+        d = qt.createDensityQureg(2, env)
+        qt.initPlusState(d)
+        qt.mixDephasing(d, 0, 0.2)
+        # a healthy mixed state passes
+        health.check_planes(d.state, is_density=True, num_qubits=2,
+                            config=HealthConfig())
+        bad = np.asarray(d.state) * 1.5              # trace 1.5
+        with pytest.raises(NumericalFault) as ei:
+            health.check_planes(bad, is_density=True, num_qubits=2,
+                                config=HealthConfig())
+        assert ei.value.kind == "trace"
+
+    def test_cadence_hooks_into_compiled_run(self, env):
+        """The guard fires every cadence-th run() dispatch and catches a
+        NaN-poisoned register state."""
+        c = _hea(3)
+        cc = c.compile(env)
+        params = {nm: 0.1 for nm in cc.param_names}
+        q = qt.createQureg(3, env)
+        qt.initZeroState(q)
+        with health.guarded(cadence=1):
+            cc.run(q, params)                        # healthy: passes
+            inj = FaultInjector([FaultSpec("nan", site="circuits.run",
+                                           probability=1.0)])
+            with rz.inject(inj):
+                with pytest.raises(NumericalFault):
+                    cc.run(q, params)
+        assert health.health_stats()["checks"] >= 2
+
+    def test_cadence_zero_is_off(self, env):
+        c = _hea(3)
+        cc = c.compile(env)
+        q = qt.createQureg(3, env)
+        qt.initZeroState(q)
+        inj = FaultInjector([FaultSpec("nan", site="circuits.run",
+                                       probability=1.0)])
+        with health.guarded(cadence=0), rz.inject(inj):
+            cc.run(q, {nm: 0.1 for nm in cc.param_names})  # not guarded
+        assert not np.isfinite(np.asarray(q.state)).all()
+
+
+# ---------------------------------------------------------------------------
+# recovery policy units
+# ---------------------------------------------------------------------------
+
+class TestRecoveryPolicy:
+    def test_classify(self):
+        assert classify(ValueError("x")) == FATAL
+        assert classify(TypeError("x")) == FATAL
+        assert classify(qt.QuESTError("bad input")) == FATAL
+        assert classify(RuntimeError("xla died")) == TRANSIENT
+        assert classify(InjectedFault("x")) == TRANSIENT
+        assert classify(SimulatedOOM("x")) == TRANSIENT
+        assert classify(NumericalFault("x")) == POISON
+        assert classify(OSError("conn reset")) == TRANSIENT
+
+    def test_backoff_growth_and_cap(self):
+        class Zero:
+            @staticmethod
+            def random():
+                return 0.0
+
+        rp = ResiliencePolicy(backoff_base_s=1e-3, backoff_cap_s=5e-3,
+                              backoff_jitter=0.5)
+        delays = [rp.backoff(k, Zero) for k in (1, 2, 3, 4, 10)]
+        assert delays == [1e-3, 2e-3, 4e-3, 5e-3, 5e-3]
+
+        class One:
+            @staticmethod
+            def random():
+                return 1.0
+
+        assert rp.backoff(1, One) == pytest.approx(1.5e-3)
+
+    def test_breaker_trip_cooldown_halfopen(self):
+        clock = {"t": 0.0}
+        br = CircuitBreaker(threshold=2, window_s=10.0, cooldown_s=5.0,
+                            clock=lambda: clock["t"])
+        assert br.allow("p")
+        assert not br.record_failure("p")
+        assert br.record_failure("p")                # trips
+        assert br.trips == 1
+        assert not br.allow("p")                     # open
+        clock["t"] = 6.0
+        assert br.allow("p")                         # half-open probe
+        assert br.state("p") == "half-open"
+        assert br.record_failure("p")                # probe failed: reopen
+        assert not br.allow("p")
+        clock["t"] = 12.0
+        assert br.allow("p")
+        br.record_success("p")                       # probe succeeded
+        assert br.state("p") == "closed"
+        assert br.snapshot()["trips"] == 2
+
+    def test_breaker_release_returns_inconclusive_probe_to_open(self):
+        """A half-open probe that dies on a caller error is
+        inconclusive: release() re-opens without counting a trip, and
+        is a no-op on closed keys."""
+        clock = {"t": 0.0}
+        br = CircuitBreaker(threshold=1, window_s=10.0, cooldown_s=5.0,
+                            clock=lambda: clock["t"])
+        br.record_failure("p")                       # trips (threshold 1)
+        clock["t"] = 6.0
+        assert br.allow("p")                         # half-open probe
+        br.release("p")                              # probe inconclusive
+        assert not br.allow("p")                     # open again
+        assert br.trips == 1                         # no extra trip
+        br.release("q")                              # closed key: no-op
+        assert br.state("q") == "closed"
+
+    def test_breaker_window_forgets_old_failures(self):
+        clock = {"t": 0.0}
+        br = CircuitBreaker(threshold=2, window_s=1.0, cooldown_s=5.0,
+                            clock=lambda: clock["t"])
+        br.record_failure("p")
+        clock["t"] = 2.0                              # outside the window
+        assert not br.record_failure("p")             # streak reset
+        assert br.state("p") == "closed"
+
+
+# ---------------------------------------------------------------------------
+# serving-runtime recovery
+# ---------------------------------------------------------------------------
+
+class TestServingRecovery:
+    @pytest.fixture(autouse=True)
+    def _reset_health_stats(self):
+        health.reset_stats()
+        yield
+
+    def test_fatal_errors_fail_fast_with_original(self, env):
+        """Satellite: ValueError/TypeError never burn the retry budget —
+        the future gets the ORIGINAL exception on the first attempt."""
+        cc = _hea(3).compile(env)
+        calls = {"n": 0}
+
+        def bad(pm_, **kw):
+            calls["n"] += 1
+            raise ValueError("malformed operand reached the executor")
+
+        cc.sweep = bad
+        try:
+            with SimulationService(env, max_wait_s=1e-3,
+                                   max_retries=3) as svc:
+                fut = svc.submit(cc, {nm: 0.0 for nm in cc.param_names})
+                with pytest.raises(ValueError, match="malformed"):
+                    fut.result(timeout=60)
+                snap = svc.dispatch_stats()["service"]
+        finally:
+            del cc.sweep
+        assert calls["n"] == 1                       # exactly one attempt
+        assert snap["retries"] == 0
+        assert snap["failed_fatal"] == 1
+        assert snap["failed"] == 1
+        assert snap["executor_faults"] == 0          # not a runtime fault
+
+    def test_poisoned_row_quarantined_batchmates_complete(self, env, rng):
+        """One NaN-poisoned result row gets a typed NumericalFault; the
+        other requests in the SAME batch complete with oracle parity."""
+        n = 4
+        c = _hea(n)
+        terms, coeffs, codes_flat = _random_ham(rng, n, 5)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(4, len(c.param_names)))
+        want = _oracle_energies(cc, env, pm, codes_flat, coeffs)
+        inj = FaultInjector([FaultSpec("nan", site="serve.execute",
+                                       at_calls=(0,))], seed=11)
+        with SimulationService(env, max_batch=4, max_wait_s=5e-3) as svc:
+            with rz.inject(inj):
+                svc.pause()
+                futs = [svc.submit(cc, dict(zip(c.param_names, row)),
+                                   observables=(terms, coeffs))
+                        for row in pm]
+                svc.resume()
+                got, failed = {}, {}
+                for i, f in enumerate(futs):
+                    try:
+                        got[i] = f.result(timeout=60)
+                    except NumericalFault as e:
+                        failed[i] = e
+                snap = svc.dispatch_stats()["service"]
+        assert len(failed) == 1                      # exactly one isolated
+        assert len(got) == 3
+        for i, v in got.items():
+            assert abs(v - want[i]) < 1e-12
+        assert snap["health_failures"] == 1
+        assert snap["quarantined"] == 1
+        assert snap["completed"] == 3
+        assert snap["batches"] == 1                  # no re-dispatch needed
+
+    def test_batch_fault_bisects_and_isolates(self, env, rng):
+        """A whole-batch executor fault quarantines by bisection: the
+        halves re-execute and every request still completes."""
+        n = 4
+        c = _hea(n)
+        terms, coeffs, codes_flat = _random_ham(rng, n, 5)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(4, len(c.param_names)))
+        want = _oracle_energies(cc, env, pm, codes_flat, coeffs)
+        real = cc.expectation_sweep
+
+        def wedged_above_2(pm_, ham_, **kw):
+            if pm_.shape[0] > 2:
+                raise RuntimeError("collective wedged on the big batch")
+            return real(pm_, ham_, **kw)
+
+        cc.expectation_sweep = wedged_above_2
+        try:
+            with SimulationService(env, max_batch=4,
+                                   max_wait_s=5e-3) as svc:
+                svc.pause()
+                futs = [svc.submit(cc, dict(zip(c.param_names, row)),
+                                   observables=(terms, coeffs))
+                        for row in pm]
+                svc.resume()
+                got = [f.result(timeout=60) for f in futs]
+                snap = svc.dispatch_stats()["service"]
+        finally:
+            del cc.expectation_sweep
+        np.testing.assert_allclose(got, want, atol=1e-12)
+        assert snap["quarantine_splits"] == 1
+        assert snap["executor_faults"] == 1
+        assert snap["completed"] == 4
+        assert snap["failed"] == 0
+        assert snap["retries"] == 0                  # bisection, not retry
+
+    def test_breaker_trips_and_fastfails_typed(self, env):
+        cc = _hea(3).compile(env)
+
+        def down(pm_, **kw):
+            raise RuntimeError("executor is down")
+
+        cc.sweep = down
+        policy = ResiliencePolicy(breaker_threshold=2,
+                                  breaker_cooldown_s=30.0,
+                                  degrade_after=0)
+        try:
+            with SimulationService(env, max_wait_s=1e-3, max_retries=0,
+                                   resilience=policy) as svc:
+                params = {nm: 0.0 for nm in cc.param_names}
+                for _ in range(2):                   # trip the breaker
+                    with pytest.raises(RuntimeError, match="down"):
+                        svc.submit(cc, params).result(timeout=60)
+                with pytest.raises(CircuitBreakerOpen, match="open"):
+                    svc.submit(cc, params).result(timeout=60)
+                snap = svc.dispatch_stats()
+        finally:
+            del cc.sweep
+        s = snap["service"]
+        assert s["breaker_trips"] == 1
+        assert s["breaker_fastfails"] == 1
+        assert s["executor_faults"] == 2             # fastfail ran nothing
+        assert snap["resilience"]["breaker"]["trips"] == 1
+
+    def test_degrades_to_sequential_after_repeated_batch_faults(
+            self, env, rng):
+        """Graceful degradation: when the batched path keeps faulting,
+        the program serves per-request until the cooldown lapses."""
+        n = 4
+        c = _hea(n)
+        terms, coeffs, codes_flat = _random_ham(rng, n, 4)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(4, len(c.param_names)))
+        want = _oracle_energies(cc, env, pm, codes_flat, coeffs)
+        real = cc.expectation_sweep
+
+        def flaky_batched(pm_, ham_, **kw):
+            if pm_.shape[0] > 1:
+                raise RuntimeError("batched path keeps wedging")
+            return real(pm_, ham_, **kw)
+
+        cc.expectation_sweep = flaky_batched
+        policy = ResiliencePolicy(degrade_after=1, degrade_cooldown_s=30.0,
+                                  breaker_threshold=100)
+        try:
+            with SimulationService(env, max_batch=2, max_wait_s=5e-3,
+                                   resilience=policy) as svc:
+                svc.pause()
+                futs = [svc.submit(cc, dict(zip(c.param_names, pm[i])),
+                                   observables=(terms, coeffs))
+                        for i in range(2)]
+                svc.resume()
+                first = [f.result(timeout=60) for f in futs]
+                # second batch: the program is now degraded — it must be
+                # served per-request WITHOUT touching the batched path
+                svc.pause()
+                futs = [svc.submit(cc, dict(zip(c.param_names, pm[i])),
+                                   observables=(terms, coeffs))
+                        for i in (2, 3)]
+                svc.resume()
+                second = [f.result(timeout=60) for f in futs]
+                snap = svc.dispatch_stats()
+        finally:
+            del cc.expectation_sweep
+        np.testing.assert_allclose(first + second, want, atol=1e-12)
+        s = snap["service"]
+        assert s["degraded_dispatches"] == 2         # the second batch
+        assert s["completed"] == 4
+        assert snap["resilience"]["degraded_programs"]
+
+    def test_watchdog_counts_stalled_dispatch(self, env):
+        cc = _hea(3).compile(env)
+        inj = FaultInjector([FaultSpec("stall", site="serve.execute",
+                                       at_calls=(0,))], stall_s=0.4)
+        policy = ResiliencePolicy(watchdog_timeout_s=0.08)
+        with SimulationService(env, max_wait_s=1e-3,
+                               resilience=policy) as svc:
+            with rz.inject(inj):
+                fut = svc.submit(cc, {nm: 0.0 for nm in cc.param_names})
+                assert fut.result(timeout=60).shape == (2, 8)
+                time.sleep(0.05)
+                snap = svc.dispatch_stats()["service"]
+        assert snap["watchdog_stalls"] >= 1
+        assert snap["completed"] == 1                # stalled, not broken
+
+    def test_retry_backoff_delays_requeue(self, env, rng):
+        """A transiently failing request re-enters the queue only after
+        its backoff delay (not_before), then succeeds."""
+        c = _hea(4)
+        terms, coeffs, codes_flat = _random_ham(rng, 4, 3)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(1, len(c.param_names)))
+        want = _oracle_energies(cc, env, pm, codes_flat, coeffs)[0]
+        real = cc.expectation_sweep
+        times = []
+
+        def flaky(pm_, ham_, **kw):
+            times.append(time.monotonic())
+            if len(times) == 1:
+                raise RuntimeError("transient hiccup")
+            return real(pm_, ham_, **kw)
+
+        cc.expectation_sweep = flaky
+        policy = ResiliencePolicy(backoff_base_s=0.05, backoff_jitter=0.0)
+        try:
+            with SimulationService(env, max_wait_s=1e-3, max_retries=1,
+                                   resilience=policy) as svc:
+                fut = svc.submit(cc, dict(zip(c.param_names, pm[0])),
+                                 observables=(terms, coeffs))
+                got = fut.result(timeout=60)
+                snap = svc.dispatch_stats()["service"]
+        finally:
+            del cc.expectation_sweep
+        assert abs(got - want) < 1e-12
+        assert len(times) == 2
+        assert times[1] - times[0] >= 0.045          # backoff honoured
+        assert snap["retries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-backed segment recovery
+# ---------------------------------------------------------------------------
+
+class TestSegmentRecovery:
+    def test_split_circuit_preserves_program(self, env):
+        c = _hea(4, layers=2)
+        segs = rz.split_circuit(c, 3)
+        assert sum(len(s.ops) for s in segs) == len(c.ops)
+        assert all(s.param_names == c.param_names for s in segs)
+
+    def test_checkpointed_run_matches_plain_run(self, env, rng, tmp_path):
+        c = _hea(4, layers=2)
+        params = {nm: float(v) for nm, v in
+                  zip(c.param_names,
+                      rng.uniform(0, 2 * np.pi, len(c.param_names)))}
+        q_ref = qt.createQureg(4, env)
+        qt.initZeroState(q_ref)
+        c.compile(env).run(q_ref, params)
+        q = qt.createQureg(4, env)
+        qt.initZeroState(q)
+        stats = rz.checkpointed_run(c, q, params, num_segments=3,
+                                    ckpt_dir=str(tmp_path / "segs"))
+        np.testing.assert_allclose(q.to_numpy(), q_ref.to_numpy(),
+                                   atol=1e-12)
+        assert stats["segments"] == 3
+        assert stats["restarts"] == 0
+        assert stats["checkpoints"] == 4             # init + 3 segments
+
+    @pytest.mark.chaos
+    def test_checkpointed_run_recovers_from_transient_fault(
+            self, env, rng, tmp_path):
+        """A transient fault mid-run re-executes only the failed segment
+        from its snapshot; the final state still matches the oracle."""
+        c = _hea(4, layers=2)
+        params = {nm: float(v) for nm, v in
+                  zip(c.param_names,
+                      rng.uniform(0, 2 * np.pi, len(c.param_names)))}
+        q_ref = qt.createQureg(4, env)
+        qt.initZeroState(q_ref)
+        c.compile(env).run(q_ref, params)
+        q = qt.createQureg(4, env)
+        qt.initZeroState(q)
+        inj = FaultInjector([FaultSpec("transient", site="circuits.run",
+                                       at_calls=(1, 2))], seed=2)
+        with rz.inject(inj):
+            stats = rz.checkpointed_run(c, q, params, num_segments=4,
+                                        ckpt_dir=str(tmp_path / "segs"),
+                                        max_restarts=4)
+        np.testing.assert_allclose(q.to_numpy(), q_ref.to_numpy(),
+                                   atol=1e-12)
+        assert stats["restarts"] == 2
+        assert inj.total_injected == 2
+
+    @pytest.mark.chaos
+    def test_checkpointed_run_recovers_from_nan_poisoning(
+            self, env, rng, tmp_path):
+        """NaN poisoning caught by the inter-segment health check rolls
+        back to the last good snapshot instead of completing wrong."""
+        c = _hea(4, layers=2)
+        params = {nm: 0.3 for nm in c.param_names}
+        q_ref = qt.createQureg(4, env)
+        qt.initZeroState(q_ref)
+        c.compile(env).run(q_ref, params)
+        q = qt.createQureg(4, env)
+        qt.initZeroState(q)
+        inj = FaultInjector([FaultSpec("nan", site="circuits.run",
+                                       at_calls=(1,))], seed=5)
+        with rz.inject(inj):
+            stats = rz.checkpointed_run(
+                c, q, params, num_segments=3,
+                ckpt_dir=str(tmp_path / "segs"),
+                health=HealthConfig(cadence=1))
+        np.testing.assert_allclose(q.to_numpy(), q_ref.to_numpy(),
+                                   atol=1e-12)
+        assert stats["restarts"] == 1
+
+    def test_checkpointed_run_fatal_raises(self, env, tmp_path):
+        c = _hea(3)
+        q = qt.createQureg(3, env)
+        qt.initZeroState(q)
+        with pytest.raises(ValueError, match="missing circuit"):
+            rz.checkpointed_run(c, q, {}, num_segments=2,
+                                ckpt_dir=str(tmp_path / "segs"))
+
+    def test_checkpointed_sweep_matches_engine(self, env, rng):
+        c = _hea(4)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(10, len(c.param_names)))
+        want = np.asarray(cc.sweep(pm))
+        got, stats = rz.checkpointed_sweep(cc, pm, segment_rows=4)
+        np.testing.assert_allclose(got, want, atol=1e-12)
+        assert stats["segments"] == 3                # 4 + 4 + 2
+        assert stats["restarts"] == 0
+
+    def test_checkpointed_sweep_bare_path_resumes(self, env, rng,
+                                                  tmp_path):
+        """Regression: np.savez appends '.npz' to a bare ckpt_path —
+        resume and cleanup must look at the file actually written."""
+        cc = _hea(3).compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(6, len(cc.param_names)))
+        want = np.asarray(cc.sweep(pm))
+        path = str(tmp_path / "progress")            # no .npz suffix
+        rz.checkpointed_sweep(cc, pm, segment_rows=4, ckpt_path=path,
+                              keep_checkpoint=True)
+        got2, st2 = rz.checkpointed_sweep(cc, pm, segment_rows=4,
+                                          ckpt_path=path)
+        np.testing.assert_allclose(got2, want, atol=1e-12)
+        assert st2["resumed_rows"] == 6              # it actually resumed
+        assert not any(tmp_path.iterdir())           # and cleaned up
+
+    @pytest.mark.chaos
+    def test_checkpointed_sweep_recovers_and_resumes(self, env, rng,
+                                                     tmp_path):
+        c = _hea(4)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(10, len(c.param_names)))
+        want = np.asarray(cc.sweep(pm))
+        path = str(tmp_path / "sweep.npz")
+        inj = FaultInjector([FaultSpec("transient", site="circuits.sweep",
+                                       at_calls=(2,))], seed=3)
+        with rz.inject(inj):
+            got, stats = rz.checkpointed_sweep(
+                cc, pm, segment_rows=4, ckpt_path=path,
+                keep_checkpoint=True)
+        np.testing.assert_allclose(got, want, atol=1e-12)
+        assert stats["restarts"] == 1
+        # process-restart resumability: a second call picks the finished
+        # progress file up instead of recomputing
+        got2, stats2 = rz.checkpointed_sweep(cc, pm, segment_rows=4,
+                                             ckpt_path=path)
+        np.testing.assert_allclose(got2, want, atol=1e-12)
+        assert stats2["resumed_rows"] == 10
+        assert stats2["segments"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the acceptance chaos run: concurrent mesh serving under mixed faults
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestChaosAcceptance:
+    N_THREADS = 4
+    WAVE = 32          # requests per wave (per all threads together)
+    MAX_WAVES = 10
+    TARGET_FAULTS = 50
+
+    def test_mesh_serving_survives_mixed_fault_storm(self, env, mesh_env,
+                                                     rng):
+        """ISSUE 5 acceptance: >= 50 seeded mixed faults across a
+        concurrent 8-device mesh serving trace; every request either
+        completes with oracle parity <= 1e-12 or fails with a typed
+        error — no silent wrong answers, no hung dispatcher — and
+        dispatch_stats() accounts for every injected fault."""
+        n = 5
+        c = _hea(n)
+        terms, coeffs, codes_flat = _random_ham(rng, n, 6)
+        cc = c.compile(mesh_env)
+        cc_oracle = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi,
+                         size=(self.WAVE, len(c.param_names)))
+        want = _oracle_energies(cc_oracle, env, pm, codes_flat, coeffs)
+
+        specs = [
+            FaultSpec("transient", site="serve.execute",
+                      probability=0.25),
+            FaultSpec("oom", site="circuits.expectation_sweep",
+                      probability=0.2),
+            FaultSpec("nan", site="serve.execute", probability=0.15),
+            FaultSpec("stall", site="circuits.expectation_sweep",
+                      probability=0.1),
+        ]
+        inj = FaultInjector(specs, seed=20260803, stall_s=0.01)
+        policy = ResiliencePolicy(
+            seed=1, backoff_base_s=1e-3, backoff_cap_s=0.02,
+            breaker_threshold=25, breaker_cooldown_s=0.05,
+            degrade_after=6, degrade_cooldown_s=0.2,
+            watchdog_timeout_s=10.0)
+        typed = (InjectedFault, SimulatedOOM, NumericalFault,
+                 CircuitBreakerOpen, qt.DeadlineExceeded)
+        completed, typed_failures, wrong = 0, 0, []
+        svc = SimulationService(mesh_env, max_batch=8, max_wait_s=5e-3,
+                                max_retries=3, request_timeout_s=120.0,
+                                resilience=policy,
+                                record_events=4096)
+        try:
+            svc.warm(cc, batch_sizes=(8,), observables=(terms, coeffs))
+            with rz.inject(inj):
+                for wave in range(self.MAX_WAVES):
+                    futs = [None] * self.WAVE
+                    errs = []
+
+                    def worker(tid):
+                        try:
+                            per = self.WAVE // self.N_THREADS
+                            for j in range(per):
+                                i = tid * per + j
+                                futs[i] = svc.submit(
+                                    cc, dict(zip(c.param_names, pm[i])),
+                                    observables=(terms, coeffs))
+                        except Exception as e:
+                            errs.append(e)
+
+                    threads = [threading.Thread(target=worker, args=(t,))
+                               for t in range(self.N_THREADS)]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join(timeout=120)
+                    assert not errs, errs
+                    for i, f in enumerate(futs):
+                        try:
+                            got = f.result(timeout=120)
+                            completed += 1
+                            if abs(got - want[i]) > 1e-12:
+                                wrong.append((wave, i, got, want[i]))
+                        except typed:
+                            typed_failures += 1
+                    if inj.total_injected >= self.TARGET_FAULTS:
+                        break
+                stats = svc.dispatch_stats()
+                dispatcher_alive = svc._thread.is_alive()
+        finally:
+            svc.close()
+
+        # >= 50 mixed faults actually injected, more than one kind
+        snap = stats["resilience"]["fault_injection"]
+        assert snap["total_injected"] >= self.TARGET_FAULTS, snap
+        assert len(snap["injected_by_kind"]) >= 2, snap
+
+        # every request accounted for: completed-with-parity or typed
+        assert not wrong, wrong[:5]
+        total = completed + typed_failures
+        assert total == (wave + 1) * self.WAVE
+
+        # the dispatcher survived (no hang): it was still serving when
+        # the storm ended
+        assert dispatcher_alive
+        s = stats["service"]
+        # every RAISED fault surfaced as a classified executor fault
+        raised = snap["injected_by_kind"].get("transient", 0) \
+            + snap["injected_by_kind"].get("oom", 0)
+        assert s["executor_faults"] == raised
+        # every nan that survived to a result row was screened typed --
+        # never more screens than injections
+        assert s["health_failures"] <= \
+            snap["injected_by_kind"].get("nan", 0)
+        # recovery machinery demonstrably engaged
+        assert s["retries"] + s["quarantine_splits"] > 0
+        assert s["completed"] >= completed
+        # fatal-path counters stayed clean: these were all runtime faults
+        assert s["failed_fatal"] == 0

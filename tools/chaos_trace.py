@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Replay a serving trace under a seeded fault schedule; dump the
+recovery timeline as JSON.
+
+Chaos testing for the fault-tolerant serving runtime
+(quest_tpu/resilience + quest_tpu/serve): builds a hardware-efficient
+ansatz, submits a deterministic request trace to a
+:class:`SimulationService` with a seeded
+:class:`~quest_tpu.resilience.FaultInjector` installed at the dispatch
+boundaries, and prints everything an incident review needs:
+
+- the **recovery timeline** (the service's event ring: faults,
+  retries with backoff, quarantine bisections, breaker transitions,
+  degraded-mode entries, poisoned-row isolations, watchdog stalls);
+- the **injection accounting** (per-site/per-kind counts — every
+  injected fault must be visible next to the recovery it caused);
+- per-request **outcomes** (completed vs typed failure, by exception
+  class) and — with ``--oracle`` — energy parity against the
+  sequential fault-free loop, asserting NO silent wrong answers;
+- the full service metrics snapshot.
+
+Usage::
+
+    python tools/chaos_trace.py --requests 64 --fault-rate 0.05
+    python tools/chaos_trace.py --kinds transient,oom,nan --seed 11
+    python tools/chaos_trace.py --requests 128 --sites 'serve.*' --oracle
+
+Deterministic: same arguments -> same schedule -> same timeline shape.
+Runs on the CPU backend by default (``--backend default`` uses whatever
+JAX picks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def build_trace(args) -> dict:
+    import numpy as np
+    import quest_tpu as qt
+    from quest_tpu.circuits import Circuit
+    from quest_tpu.resilience import FaultInjector, FaultSpec, inject
+    from quest_tpu.serve import SimulationService
+
+    env = qt.createQuESTEnv(num_devices=args.devices, seed=[args.seed])
+    n = args.qubits
+    c = Circuit(n)
+    for q in range(n):
+        c.ry(q, c.parameter(f"y{q}"))
+    for q in range(n - 1):
+        c.cnot(q, q + 1)
+    cc = c.compile(env)
+    rng = np.random.default_rng(args.seed)
+    pm = rng.uniform(0.0, 2.0 * np.pi, size=(args.requests, n))
+    terms = [[(q, 3)] for q in range(n)]          # sum_q Z_q
+    coeffs = [1.0] * n
+    ham = (terms, coeffs)
+
+    kinds = [k for k in args.kinds.split(",") if k]
+    at_calls = tuple(int(i) for i in args.at_calls.split(",") if i)
+    specs = []
+    for j, k in enumerate(kinds):
+        # explicit call indices round-robin over the kinds (only the
+        # first matching spec fires per call, so handing every kind the
+        # same schedule would shadow all but the first)
+        mine = tuple(c for i, c in enumerate(at_calls)
+                     if i % len(kinds) == j)
+        specs.append(FaultSpec(kind=k, site=args.sites,
+                               probability=args.fault_rate,
+                               at_calls=mine))
+    inj = FaultInjector(specs, seed=args.seed, stall_s=args.stall_s)
+
+    policy = qt.ResiliencePolicy(
+        seed=args.seed, backoff_base_s=1e-3, backoff_cap_s=0.05,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=0.05, degrade_after=args.degrade_after,
+        degrade_cooldown_s=0.1, watchdog_timeout_s=args.watchdog_s)
+    svc = SimulationService(
+        env, max_batch=args.max_batch, max_wait_s=2e-3,
+        max_queue=args.requests + args.max_batch,
+        request_timeout_s=args.timeout_s, max_retries=args.max_retries,
+        resilience=policy, record_events=4 * args.requests + 64)
+
+    outcomes = []
+    with inject(inj):
+        svc.pause()
+        futs = [svc.submit(cc, dict(zip(cc.param_names, row)),
+                           observables=ham) for row in pm]
+        svc.resume()
+        for f in futs:
+            try:
+                outcomes.append(("ok", float(f.result(
+                    timeout=args.timeout_s + 30))))
+            except Exception as e:  # typed failure — record its class
+                outcomes.append((type(e).__name__, None))
+        stats = svc.dispatch_stats()
+        timeline = list(svc.events)
+    svc.close()
+
+    by_error: dict = {}
+    for kind, _ in outcomes:
+        if kind != "ok":
+            by_error[kind] = by_error.get(kind, 0) + 1
+    completed = sum(1 for k, _ in outcomes if k == "ok")
+
+    doc = {
+        "config": {
+            "requests": args.requests, "qubits": n,
+            "devices": args.devices, "seed": args.seed,
+            "fault_rate": args.fault_rate, "kinds": args.kinds,
+            "sites": args.sites, "max_batch": args.max_batch,
+            "max_retries": args.max_retries,
+        },
+        "fault_injection": inj.snapshot(),
+        "outcomes": {
+            "completed": completed,
+            "typed_failures": by_error,
+            "unaccounted": args.requests - completed
+            - sum(by_error.values()),
+        },
+        "service": stats.get("service", {}),
+        "resilience": stats.get("resilience", {}),
+        "timeline": timeline,
+    }
+
+    if args.oracle:
+        # sequential fault-free loop: injector is uninstalled here, so
+        # these are the true energies; every COMPLETED request must
+        # match (typed failures are allowed; silent wrong answers not)
+        codes_flat = []
+        for t in range(len(terms)):
+            row = [0] * n
+            for q, code in terms[t]:
+                row[q] = code
+            codes_flat.extend(row)
+        failures = 0
+        max_dev = 0.0
+        for i, (kind, got) in enumerate(outcomes):
+            if kind != "ok":
+                continue
+            q = qt.createQureg(n, env)
+            qt.initZeroState(q)
+            cc.run(q, dict(zip(cc.param_names, pm[i])))
+            want = qt.calcExpecPauliSum(q, codes_flat, coeffs)
+            dev = abs(got - want)
+            max_dev = max(max_dev, dev)
+            if dev > args.parity_tol:
+                failures += 1
+        doc["parity"] = {"checked": completed, "failures": failures,
+                         "max_deviation": max_dev,
+                         "tol": args.parity_tol}
+    return doc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--qubits", type=int, default=4)
+    p.add_argument("--devices", type=int, default=1)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--fault-rate", type=float, default=0.05,
+                   help="per-dispatch injection probability per kind")
+    p.add_argument("--at-calls", default="",
+                   help="comma list of exact call indices to fault "
+                        "(deterministic schedule, round-robin over "
+                        "--kinds; composes with --fault-rate)")
+    p.add_argument("--kinds", default="transient,nan",
+                   help="comma list of transient|oom|nan|stall")
+    p.add_argument("--sites", default="serve.execute",
+                   help="fnmatch pattern over fault sites "
+                        "(e.g. '*', 'circuits.*')")
+    p.add_argument("--stall-s", type=float, default=0.02)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-retries", type=int, default=3)
+    p.add_argument("--breaker-threshold", type=int, default=6)
+    p.add_argument("--degrade-after", type=int, default=4)
+    p.add_argument("--watchdog-s", type=float, default=5.0)
+    p.add_argument("--timeout-s", type=float, default=120.0)
+    p.add_argument("--parity-tol", type=float, default=1e-10)
+    p.add_argument("--oracle", action="store_true",
+                   help="verify completed energies against the "
+                        "sequential fault-free loop")
+    p.add_argument("--backend", default="cpu",
+                   help="'cpu' (default, deterministic) or 'default'")
+    args = p.parse_args(argv)
+
+    repo_root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             os.pardir)
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    if args.backend == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    doc = build_trace(args)
+    json.dump(doc, sys.stdout, indent=2, default=str)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
