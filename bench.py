@@ -38,6 +38,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import shutil
 import sys
 import threading
 import time
@@ -799,6 +800,19 @@ def bench_sharded_mesh(qt, platform: str) -> dict:
                   "unit": "requests/sec", "vs_baseline": 0.0,
                   "errors": [f"{type(e).__name__}: {e}"]})
 
+    # replicated serving row (ISSUE 6 acceptance mesh): 2 replicas over
+    # 4-device subset meshes of the same 8-device pool — mid-trace
+    # replica kill p99 + cold-vs-warm restart-to-ready
+    if _remaining() > 30:
+        try:
+            os.environ.setdefault("QUEST_BENCH_ROUTER_DEVICES", "4")
+            emit(bench_replicated_serving(_qt, platform))
+        except Exception as e:
+            emit({"metric": "replicated serving (bench error)",
+                  "value": 0.0, "unit": "requests/sec",
+                  "vs_baseline": 0.0,
+                  "errors": [f"{type(e).__name__}: {e}"]})
+
     # sharded QUAD (double-double) row: the high-precision tier over the
     # same 8-device mesh, with dd roofline accounting — 2x the bytes per
     # pass (4 planes vs 2) and ~6x the flops of a plain gate
@@ -1285,6 +1299,170 @@ def bench_serving_chaos(qt, env, platform: str) -> dict:
     return row
 
 
+def bench_replicated_serving(qt, platform: str) -> dict:
+    """Replicated serving row (ISSUE 6): the SAME expectation trace
+    served by a 2-replica ServiceRouter twice — fault-free, then with
+    one replica KILLED mid-trace (failover + supervised restart under
+    live traffic) — plus the warm-start restart comparison: service
+    restart-to-ready against an empty cache dir vs the populated one.
+    Graded invariants: zero dropped requests (every future resolves),
+    zero incorrect results vs the engine oracle, and the warm restart
+    reports cache hits where the cold pass reported misses."""
+    import tempfile
+
+    from quest_tpu.resilience import SupervisorPolicy
+    from quest_tpu.serve import ServiceRouter, SimulationService, \
+        WarmCache, replica_envs
+
+    num_qubits = int(os.environ.get(
+        "QUEST_BENCH_ROUTER_QUBITS",
+        os.environ.get("QUEST_BENCH_SERVE_QUBITS", "16")))
+    n_req = int(os.environ.get(
+        "QUEST_BENCH_ROUTER_REQUESTS",
+        "512" if _remaining() > 200 else "128"))
+    num_terms = int(os.environ.get("QUEST_BENCH_ROUTER_TERMS", "24"))
+    layers = int(os.environ.get("QUEST_BENCH_ROUTER_LAYERS", "2"))
+    max_batch = int(os.environ.get("QUEST_BENCH_ROUTER_BATCH", "32"))
+    n_replicas = int(os.environ.get("QUEST_BENCH_ROUTER_REPLICAS", "2"))
+    dev_per = int(os.environ.get("QUEST_BENCH_ROUTER_DEVICES", "1"))
+    rng = np.random.default_rng(2028)
+    circ, n_gates, names = build_hea_circuit(num_qubits, layers)
+    codes = rng.integers(0, 4, size=(num_terms, num_qubits))
+    coeffs = rng.normal(size=num_terms)
+    terms = [[(q_, int(codes[t, q_])) for q_ in range(num_qubits)]
+             for t in range(num_terms)]
+    ham = (terms, coeffs)
+    pm = rng.uniform(0.0, 2.0 * np.pi, size=(n_req, len(names)))
+    label = (f"hardware-efficient-ansatz-{num_qubits}, {n_req} requests, "
+             f"{num_terms}-term Pauli sum, {n_replicas} replicas x "
+             f"{dev_per} {platform} device(s)")
+
+    # the engine oracle for the parity grade (one batched sweep)
+    oracle_env = qt.createQuESTEnv(num_devices=dev_per, seed=[2028])
+    cc_oracle = circ.compile(oracle_env, pallas="off")
+    want = np.asarray(cc_oracle.expectation_sweep(pm, ham))
+
+    cache_dir = tempfile.mkdtemp(prefix="quest_tpu_bench_warm_")
+    # install_xla_cache=False everywhere in this bench: the XLA layer is
+    # a process-GLOBAL jax.config install, so it would (a) leak the
+    # temp dir into every row that runs after the rmtree below and
+    # (b) let the "cold" restart read XLA artifacts the earlier traces
+    # wrote, understating the warm layer's restart_speedup
+    cache = WarmCache(cache_dir, install_xla_cache=False)
+    buckets = []
+    bs = 1
+    while bs <= max_batch:
+        buckets.append(bs)
+        bs *= 2
+    sup = SupervisorPolicy(poll_s=0.01, stall_timeout_s=10.0,
+                           restart_backoff_s=0.02)
+
+    def run_trace(kill_at):
+        envs = replica_envs(n_replicas, devices_per_replica=dev_per,
+                            seed=[2028])
+        router = ServiceRouter(
+            envs, supervisor=sup, warm_cache=cache,
+            max_batch=max_batch, max_wait_s=5e-3,
+            max_queue=n_req + max_batch, request_timeout_s=600.0,
+            max_retries=4)
+        router.warm(circ, batch_sizes=buckets, observables=ham)
+        t0 = time.perf_counter()
+        futs = []
+        for i in range(n_req):
+            if kill_at is not None and i == kill_at:
+                router._replicas[0].service._debug_crash()
+            futs.append(router.submit(
+                circ, dict(zip(names, pm[i])), observables=ham))
+        outcomes = []
+        for f in futs:
+            try:
+                outcomes.append(("ok", float(f.result(timeout=600))))
+            except Exception as e:          # typed failure: visible
+                outcomes.append((type(e).__name__, None))
+        dt = time.perf_counter() - t0
+        stats = router.dispatch_stats()
+        router.close()
+        return outcomes, n_req / dt, stats
+
+    clean, clean_rate, clean_stats = run_trace(None)
+    killed, killed_rate, killed_stats = run_trace(n_req // 2)
+
+    incorrect = 0
+    typed_failures = 0
+    dropped = 0
+    max_dev = 0.0
+    for i, (kind, val) in enumerate(killed):
+        if kind == "TimeoutError":
+            dropped += 1            # future never resolved: a DROP
+            continue
+        if kind != "ok":
+            typed_failures += 1
+            continue
+        d = abs(val - want[i])
+        max_dev = max(max_dev, d)
+        if d > 1e-10:
+            incorrect += 1
+
+    # cold vs warm restart-to-ready: one service + full warm, against
+    # an empty cache dir vs the dir the traces above populated
+    cold_dir = tempfile.mkdtemp(prefix="quest_tpu_bench_cold_")
+    restart = {}
+    for label_r, wc in (
+            ("cold", WarmCache(cold_dir, install_xla_cache=False)),
+            ("warm", WarmCache(cache_dir, install_xla_cache=False))):
+        renv = qt.createQuESTEnv(num_devices=dev_per, seed=[2028])
+        t0 = time.perf_counter()
+        svc = SimulationService(renv, max_batch=max_batch,
+                                max_wait_s=5e-3, warm_cache=wc)
+        svc.warm(circ, batch_sizes=buckets, observables=ham)
+        restart[label_r] = {
+            "ready_s": time.perf_counter() - t0,
+            **{k: v for k, v in svc.metrics.snapshot().items()
+               if k.startswith("warm_cache")}}
+        svc.close()
+    for d in (cache_dir, cold_dir):
+        shutil.rmtree(d, ignore_errors=True)
+
+    itemsize = np.dtype(oracle_env.precision.real_dtype).itemsize
+    baseline = _roofline_baseline(num_qubits, itemsize) \
+        / max(n_gates + num_terms, 1)
+    kr = killed_stats["router"]
+    row = {
+        "metric": f"replicated serving (mid-trace replica kill + "
+                  f"supervised warm restart), {label}",
+        "value": round(killed_rate, 2),
+        "unit": "requests/sec",
+        "vs_baseline": round(killed_rate / baseline, 4),
+        "no_kill_rate": round(clean_rate, 2),
+        "degradation_pct": round(
+            100.0 * (1.0 - killed_rate / max(clean_rate, 1e-9)), 2),
+        "p99_no_kill_s": round(
+            clean_stats["router"]["p99_latency_s"], 6),
+        "p99_with_kill_s": round(kr["p99_latency_s"], 6),
+        "failovers": kr["failovers"],
+        "replica_quarantines": kr["replica_quarantines"],
+        "replica_restarts": kr["replica_restarts"],
+        "readmissions": kr["readmissions"],
+        "dropped_requests": dropped,             # graded: must be 0
+        "typed_failures": typed_failures,
+        "incorrect_results": incorrect,          # graded: must be 0
+        "max_energy_deviation": max_dev,
+        "cold_restart_s": round(restart["cold"]["ready_s"], 3),
+        "warm_restart_s": round(restart["warm"]["ready_s"], 3),
+        "restart_speedup": round(
+            restart["cold"]["ready_s"]
+            / max(restart["warm"]["ready_s"], 1e-9), 2),
+        "warm_cache_hits": restart["warm"]["warm_cache_hits"],
+        "warm_cache_misses": restart["warm"]["warm_cache_misses"],
+        "cold_cache_misses": restart["cold"]["warm_cache_misses"],
+    }
+    if incorrect:
+        row["errors"] = [f"{incorrect} killed-run requests completed "
+                         "with values differing from the oracle — "
+                         "silent corruption"]
+    return row
+
+
 def bench_density_noise(qt, env, platform: str) -> dict:
     """Density register with dephasing/damping channels (the BASELINE.json
     config-4 workload, width-reduced to 12 qubits everywhere — see the
@@ -1611,6 +1789,7 @@ def main() -> None:
                                                           platform)),
         ("serve", 45, lambda: bench_serving_config(qt, env, platform)),
         ("chaos", 45, lambda: bench_serving_chaos(qt, env, platform)),
+        ("router", 45, lambda: bench_replicated_serving(qt, platform)),
     ]
     if accel:
         # heavyweight compiles last on the tunnel (the heartbeat keeps a

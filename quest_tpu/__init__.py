@@ -49,9 +49,11 @@ from .circuits import Circuit, CompiledCircuit, Param
 from .qasm_import import ParsedQASM, parse_qasm, load_qasm_file
 from .serve import (SimulationService, CoalescePolicy, ServeError,
                     QueueFull, DeadlineExceeded, ServiceClosed,
-                    CircuitBreakerOpen)
+                    CircuitBreakerOpen, ServiceRouter,
+                    AllReplicasUnavailable, WarmCache)
 from .resilience import (FaultInjector, FaultSpec, HealthConfig,
-                         NumericalFault, ResiliencePolicy)
+                         NumericalFault, ResiliencePolicy,
+                         SupervisorPolicy)
 from .api import *  # noqa: F401,F403  (the QuEST-compatible surface)
 from .api import __all__ as _api_all
 
@@ -68,9 +70,10 @@ __all__ = (
         "ParsedQASM", "parse_qasm", "load_qasm_file",
         "SimulationService", "CoalescePolicy", "ServeError",
         "QueueFull", "DeadlineExceeded", "ServiceClosed",
-        "CircuitBreakerOpen",
+        "CircuitBreakerOpen", "ServiceRouter", "AllReplicasUnavailable",
+        "WarmCache",
         "FaultInjector", "FaultSpec", "HealthConfig", "NumericalFault",
-        "ResiliencePolicy",
+        "ResiliencePolicy", "SupervisorPolicy",
     ]
     + list(_api_all)
 )

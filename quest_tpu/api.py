@@ -45,6 +45,7 @@ __all__ = [
     "createQuESTEnv", "destroyQuESTEnv", "syncQuESTEnv", "syncQuESTSuccess",
     "reportQuESTEnv", "getEnvironmentString", "seedQuEST", "seedQuESTDefault",
     "createSimulationService",       # serving runtime (TPU-native addition)
+    "createServiceRouter",           # replicated serving (TPU-native)
     # registers
     "createQureg", "createDensityQureg", "createCloneQureg", "destroyQureg",
     "createComplexMatrixN", "destroyComplexMatrixN", "initComplexMatrixN",
@@ -591,6 +592,24 @@ def seedQuEST(env: QuESTEnv, seeds: Sequence[int]) -> None:
 
 def seedQuESTDefault(env: QuESTEnv) -> None:
     env.seed_default()
+
+
+def createServiceRouter(envs=None, **kwargs):
+    """Create a replicated serving front end — N
+    :class:`quest_tpu.serve.SimulationService` replicas behind one
+    ``submit()`` with health-aware routing, replica failover with
+    supervised restart, and the persistent warm-start compile cache
+    (:class:`quest_tpu.serve.router.ServiceRouter`; TPU-native
+    addition, no reference counterpart). Pass ``envs`` (one
+    ``QuESTEnv`` per replica, e.g. from
+    :func:`quest_tpu.serve.replica_envs`) or ``num_replicas=`` /
+    ``devices_per_replica=`` to slice ``jax.devices()``; remaining
+    keyword arguments are the per-replica service knobs plus
+    ``supervisor`` (a :class:`quest_tpu.resilience.SupervisorPolicy`),
+    ``max_failovers``, ``hedge_after_s``, and ``warm_cache``. Destroy
+    with ``router.close()`` (or use it as a context manager)."""
+    from .serve import ServiceRouter
+    return ServiceRouter(envs, **kwargs)
 
 
 def createSimulationService(env: QuESTEnv, **kwargs):

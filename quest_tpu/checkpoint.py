@@ -15,15 +15,42 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 
 import jax
 import numpy as np
 
 from .qureg import Qureg
 
-__all__ = ["save", "load", "save_npz", "load_npz", "CheckpointMismatch"]
+__all__ = ["save", "load", "save_npz", "load_npz", "atomic_savez",
+           "CheckpointMismatch"]
 
 _META_NAME = "quest_meta.json"
+
+
+def atomic_savez(path: str, **arrays) -> None:
+    """``np.savez`` with crash-safe replace semantics: the archive is
+    written to a temp file in the SAME directory, fsynced, then
+    ``os.replace``d over ``path`` — a crash mid-write leaves the last
+    good file intact instead of a torn half-archive that corrupts the
+    next recovery. ``path`` must already carry its ``.npz`` suffix
+    (``np.savez`` would silently append one to the temp name and the
+    replace would miss it)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz",
+                               prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class CheckpointMismatch(ValueError):
@@ -127,10 +154,13 @@ def load(qureg: Qureg, path: str) -> None:
 
 
 def save_npz(qureg: Qureg, filename: str) -> None:
-    """Single-host fallback: gather to host and save as .npz."""
+    """Single-host fallback: gather to host and save as .npz (atomic —
+    a crash mid-write cannot corrupt the previous checkpoint)."""
     qureg.ensure_canonical()
-    np.savez(filename, state=np.asarray(qureg.state),
-             meta=json.dumps(_meta(qureg)))
+    if not filename.endswith(".npz"):
+        filename += ".npz"     # np.savez would append it past the replace
+    atomic_savez(filename, state=np.asarray(qureg.state),
+                 meta=json.dumps(_meta(qureg)))
 
 
 def load_npz(qureg: Qureg, filename: str) -> None:

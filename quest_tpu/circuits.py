@@ -1284,6 +1284,11 @@ class _BoundedExecutableCache:
             self._d.move_to_end(key)
         return fn
 
+    def peek(self, key, default=None):
+        """Read without touching LRU order (safe for cross-thread
+        health probes: no structural mutation)."""
+        return self._d.get(key, default)
+
     def __setitem__(self, key, value) -> None:
         if key in self._d:
             self._d.move_to_end(key)
@@ -1617,6 +1622,12 @@ class CompiledCircuit:
         # with evictions surfaced in dispatch_stats().
         self._batched_cache = _BoundedExecutableCache(
             int(os.environ.get("QUEST_TPU_BATCH_CACHE", "16")))
+        # warm-start AOT side cache (serve/warmcache.py): persisted
+        # executables deserialized at warm() time, keyed (form key,
+        # exact arg shapes). Shape-specialized — the dispatch sites
+        # consult it FIRST and fall back to the retracing jit wrappers
+        # above for any other shape. Installed via install_batched_aot.
+        self._batched_aot: dict = {}
         self._batch_stats: Optional[dict] = None
         self._warned_nondivisible = False
         # the serving runtime mutates batch stats / the executable
@@ -2158,6 +2169,161 @@ class CompiledCircuit:
             return arr
         return jax.device_put(arr, NamedSharding(self.env.mesh, spec))
 
+    def _pauli_operands(self, hamiltonian):
+        """The ONE shared Hamiltonian encoder for the energy executables:
+        validate ``(pauli_terms, coeffs)``, flatten to the
+        calcExpecPauliSum codes layout, and build the device mask
+        operands (two mask builders would desynchronise silently).
+        Returns ``(nq, T, xm, ym, zm, coeffs)``."""
+        from .ops import reductions as red
+        pauli_terms, coeffs = hamiltonian
+        nq, terms, coeffs = self._validated_pauli_terms(pauli_terms,
+                                                        coeffs)
+        T = len(terms)
+        codes = np.zeros((T, nq), np.int64)
+        for t, term in enumerate(terms):
+            for q, code in term:
+                if codes[t, q]:
+                    raise ValueError(
+                        f"pauli term {t} repeats qubit {q} (a product of "
+                        "Paulis on one qubit is not a Pauli string)")
+                codes[t, q] = code
+        xm, ym, zm, coeffs = red.pauli_sum_operands(
+            codes.reshape(-1), nq, coeffs)
+        return nq, T, xm, ym, zm, coeffs
+
+    def _energy_fn(self, mode: str):
+        """The batched-energy jit wrapper for one sharding mode (masks
+        and coefficients are ARGUMENTS, so one executable serves every
+        Hamiltonian of the same bucketed term shape). Cached in the
+        keyed executable cache; also the lowering source for the warm
+        cache's ``energy`` artifacts."""
+        from .ops import reductions as red
+        key = ("energy", mode,
+               str(np.dtype(self.env.precision.real_dtype)))
+        with self._stats_lock:
+            fn = self._batched_cache.get(key)
+        if fn is not None:
+            return fn
+        constrain = self._batch_constraint(mode)
+        run_batched = self._batched_runner(mode)
+        is_density = self.is_density
+        nq = self.num_qubits // 2 if is_density else self.num_qubits
+
+        def energy(state_f_, pm_, xm_, ym_, zm_, cf_):
+            z = unpack(state_f_)
+            states = jnp.broadcast_to(z, (pm_.shape[0],) + z.shape)
+            states = constrain(states)
+            states = run_batched(states, pm_)
+            states = constrain(states)
+            if is_density:
+                return jax.vmap(lambda s: red.pauli_sum_total_dm(
+                    s, nq, xm_, ym_, zm_, cf_))(states)
+            return jax.vmap(lambda s: red.pauli_sum_total_sv(
+                s, xm_, ym_, zm_, cf_))(states)
+
+        from jax.sharding import PartitionSpec as P
+        from .env import AMP_AXIS
+        energy = self._wrap_batch_spmd(
+            energy, mode,
+            in_specs=(P(), P(AMP_AXIS, None), P(), P(), P(), P()),
+            out_specs=P(AMP_AXIS))
+        fn = jax.jit(energy)
+        with self._stats_lock:
+            self._batched_cache[key] = fn
+        return fn
+
+    # -- warm-start AOT hooks (serve/warmcache.py) -------------------------
+
+    def _warm_form_key(self, kind: str, mode: str) -> tuple:
+        """The AOT form key shared by :meth:`lower_batched` (the store/
+        install side) and the ``sweep``/``expectation_sweep`` dispatch
+        lookups — one definition, so a key-shape edit cannot decouple
+        install from lookup and silently turn every warm restart back
+        into a full recompile. The ``sweep`` booleans pin the form the
+        serving dispatcher uses: shared start state, not donated."""
+        dtstr = str(np.dtype(self.env.precision.real_dtype))
+        if kind == "sweep":
+            return ("sweep", True, False, mode, dtstr)
+        if kind == "energy":
+            return ("energy", mode, dtstr)
+        raise ValueError(f"unknown warm form kind {kind!r}")
+
+    @staticmethod
+    def _aot_key(form: tuple, args: tuple) -> tuple:
+        return (form, tuple(getattr(a, "shape", None) for a in args))
+
+    def _aot_lookup(self, form: tuple, args: tuple):
+        """A warm-installed AOT executable for these EXACT concrete arg
+        shapes, or None (any other shape rides the retracing jit
+        wrapper). Tracers never match — transforms must trace the jit
+        path."""
+        if not self._batched_aot:
+            return None
+        if any(isinstance(a, jax.core.Tracer) for a in args):
+            return None
+        return self._batched_aot.get(self._aot_key(form, args))
+
+    def install_batched_aot(self, form: tuple, args_shapes: tuple,
+                            compiled) -> None:
+        """Install one compiled batched executable (typically
+        deserialized from the persistent warm cache) for an exact
+        ``(form, arg shapes)`` slot. Bounded: warm() installs a handful
+        of buckets; past 64 slots the oldest goes."""
+        with self._stats_lock:
+            self._batched_aot[(form, tuple(args_shapes))] = compiled
+            while len(self._batched_aot) > 64:
+                self._batched_aot.pop(next(iter(self._batched_aot)))
+
+    def lower_batched(self, kind: str, batch: int, hamiltonian=None,
+                      lower: bool = True):
+        """Lower (no compile, no execution) the batched executable one
+        warm form would run: ``kind`` is ``"sweep"`` (broadcast start
+        state — the serving dispatcher's state/sample form) or
+        ``"energy"``. Returns ``(form, args_shapes, lowered)`` ready for
+        ``lowered.compile()`` + :meth:`install_batched_aot` — the warm
+        cache serializes the compiled artifact so a restarted replica
+        LOADS it instead of recompiling. ``lower=False`` computes only
+        the ``(form, args_shapes)`` cache coordinates (no tracing) so a
+        cache hit never pays the trace. Only the unsharded (``"none"``)
+        batch mode lowers here: mesh modes carry input shardings that
+        a deserialized executable would have to re-match exactly, and
+        they are covered by the XLA disk-cache layer instead."""
+        if batch < 1:
+            raise ValueError("batch must be >= 1")
+        mode = self._batch_policy(int(batch))["mode"]
+        if mode != "none":
+            raise ValueError(
+                f"warm AOT lowering covers the unsharded batch mode; "
+                f"batch {batch} chose {mode!r} on this mesh env")
+        dt = self.env.precision.real_dtype
+        n = self.num_qubits
+        state = jax.ShapeDtypeStruct((2, 1 << n), dt)
+        pm = jax.ShapeDtypeStruct((int(batch), len(self.param_names)), dt)
+        if kind == "sweep":
+            form = self._warm_form_key("sweep", mode)
+            args = (state, pm)
+            fn_builder = lambda: self._batched_fn(True, False, mode)
+        elif kind == "energy":
+            if hamiltonian is None:
+                raise ValueError("kind='energy' needs hamiltonian=")
+            _, _, xm, ym, zm, coeffs = self._pauli_operands(hamiltonian)
+            xm, ym, zm = jnp.asarray(xm), jnp.asarray(ym), jnp.asarray(zm)
+            cf = jnp.asarray(coeffs, dtype=dt)
+            form = self._warm_form_key("energy", mode)
+            args = (state, pm,
+                    jax.ShapeDtypeStruct(xm.shape, xm.dtype),
+                    jax.ShapeDtypeStruct(ym.shape, ym.dtype),
+                    jax.ShapeDtypeStruct(zm.shape, zm.dtype),
+                    jax.ShapeDtypeStruct(cf.shape, cf.dtype))
+            fn_builder = lambda: self._energy_fn(mode)
+        else:
+            raise ValueError(f"unknown warm form kind {kind!r}")
+        shapes = tuple(a.shape for a in args)
+        if not lower:
+            return form, shapes, None
+        return form, shapes, fn_builder().lower(*args)
+
     def sweep(self, param_matrix, state_f=None):
         """Run a whole batch of parameter vectors through ONE executable.
 
@@ -2201,7 +2367,16 @@ class CompiledCircuit:
                 state_f = jnp.zeros((2, 1 << n),
                                     dtype=self.env.precision.real_dtype
                                     ).at[0, 0].set(1.0)
-            out = self._batched_fn(True, False, mode)(state_f, pm_run)
+            form = self._warm_form_key("sweep", mode)
+            aot = self._aot_lookup(form, (state_f, pm_run))
+            out = None
+            if aot is not None:
+                try:
+                    out = aot(state_f, pm_run)
+                except (TypeError, ValueError):
+                    out = None   # layout/placement drift: retrace via jit
+            if out is None:
+                out = self._batched_fn(True, False, mode)(state_f, pm_run)
         else:
             planes = state_f
             if planes.shape != (B, 2, 1 << n):
@@ -2236,25 +2411,8 @@ class CompiledCircuit:
         ``QuEST_common.c:464-491``). Works on density-compiled circuits
         too: the value is ``Tr(H rho(params))`` through the program's
         channels."""
-        pauli_terms, coeffs = hamiltonian
-        nq, terms, coeffs = self._validated_pauli_terms(pauli_terms,
-                                                        coeffs)
-        from .ops import reductions as red
+        nq, T, xm, ym, zm, coeffs = self._pauli_operands(hamiltonian)
         n = self.num_qubits
-        T = len(terms)
-        # flatten to the calcExpecPauliSum codes layout and run the ONE
-        # shared encoder (masks + term-bucket padding) — two mask
-        # builders would desynchronise silently
-        codes = np.zeros((T, nq), np.int64)
-        for t, term in enumerate(terms):
-            for q, code in term:
-                if codes[t, q]:
-                    raise ValueError(
-                        f"pauli term {t} repeats qubit {q} (a product of "
-                        "Paulis on one qubit is not a Pauli string)")
-                codes[t, q] = code
-        xm, ym, zm, coeffs = red.pauli_sum_operands(
-            codes.reshape(-1), nq, coeffs)
 
         pm = self._validated_param_matrix(param_matrix)
         poison = _faults.fire("circuits.expectation_sweep")
@@ -2263,36 +2421,7 @@ class CompiledCircuit:
         pm_run, B = self._padded_params(pm, mode)
         pm_run = self._place_batch(pm_run, mode)
 
-        key = ("energy", mode,
-               str(np.dtype(self.env.precision.real_dtype)))
-        with self._stats_lock:
-            fn = self._batched_cache.get(key)
-        if fn is None:
-            constrain = self._batch_constraint(mode)
-            run_batched = self._batched_runner(mode)
-            is_density = self.is_density
-
-            def energy(state_f_, pm_, xm_, ym_, zm_, cf_):
-                z = unpack(state_f_)
-                states = jnp.broadcast_to(z, (pm_.shape[0],) + z.shape)
-                states = constrain(states)
-                states = run_batched(states, pm_)
-                states = constrain(states)
-                if is_density:
-                    return jax.vmap(lambda s: red.pauli_sum_total_dm(
-                        s, nq, xm_, ym_, zm_, cf_))(states)
-                return jax.vmap(lambda s: red.pauli_sum_total_sv(
-                    s, xm_, ym_, zm_, cf_))(states)
-
-            from jax.sharding import PartitionSpec as P
-            from .env import AMP_AXIS
-            energy = self._wrap_batch_spmd(
-                energy, mode,
-                in_specs=(P(), P(AMP_AXIS, None), P(), P(), P(), P()),
-                out_specs=P(AMP_AXIS))
-            fn = jax.jit(energy)
-            with self._stats_lock:
-                self._batched_cache[key] = fn
+        fn = self._energy_fn(mode)
         if state_f is None:
             state_f = jnp.zeros((2, 1 << n),
                                 dtype=self.env.precision.real_dtype
@@ -2305,9 +2434,18 @@ class CompiledCircuit:
                 f"expectation_sweep state_f must be shared (2, {1 << n}) "
                 f"planes; got {getattr(state_f, 'shape', None)} (run "
                 "batched planes through sweep(), then reduce)")
-        out = fn(state_f, pm_run, jnp.asarray(xm), jnp.asarray(ym),
-                 jnp.asarray(zm),
-                 jnp.asarray(coeffs, dtype=self.env.precision.real_dtype))
+        args = (state_f, pm_run, jnp.asarray(xm), jnp.asarray(ym),
+                jnp.asarray(zm),
+                jnp.asarray(coeffs, dtype=self.env.precision.real_dtype))
+        aot = self._aot_lookup(self._warm_form_key("energy", mode), args)
+        out = None
+        if aot is not None:
+            try:
+                out = aot(*args)
+            except (TypeError, ValueError):
+                out = None     # layout/placement drift: retrace via jit
+        if out is None:
+            out = fn(*args)
         # the engine-off path is B runs x (>= 1 sync per point; the
         # reference: one per term per point) — the engine's whole sweep
         # is one (B,) transfer

@@ -22,24 +22,25 @@ See ``docs/tpu.md`` ("Fault tolerance & health checks").
 """
 
 from .faults import (FaultInjector, FaultSpec, InjectedFault, SimulatedOOM,
-                     SITES as FAULT_SITES, active as active_injector,
-                     fire, inject, install, uninstall)
+                     SITES as FAULT_SITES, REPLICA_KINDS,
+                     active as active_injector, fire, fire_router, inject,
+                     install, uninstall)
 from .health import (HealthConfig, NumericalFault, check_planes, configure,
                      get_config, guarded, health_stats, reset_stats)
 from .recovery import (FATAL, POISON, TRANSIENT, CircuitBreaker,
-                       ResiliencePolicy, classify)
+                       ResiliencePolicy, SupervisorPolicy, classify)
 
 __all__ = [
     # faults
     "FaultInjector", "FaultSpec", "InjectedFault", "SimulatedOOM",
-    "FAULT_SITES", "inject", "install", "uninstall", "active_injector",
-    "fire",
+    "FAULT_SITES", "REPLICA_KINDS", "inject", "install", "uninstall",
+    "active_injector", "fire", "fire_router",
     # health
     "HealthConfig", "NumericalFault", "check_planes", "configure",
     "get_config", "guarded", "health_stats", "reset_stats",
     # recovery
-    "ResiliencePolicy", "CircuitBreaker", "classify", "TRANSIENT",
-    "POISON", "FATAL",
+    "ResiliencePolicy", "SupervisorPolicy", "CircuitBreaker", "classify",
+    "TRANSIENT", "POISON", "FATAL",
     # segments (lazy — they import circuits/checkpoint)
     "split_circuit", "checkpointed_run", "checkpointed_sweep",
 ]

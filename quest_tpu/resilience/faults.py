@@ -21,7 +21,14 @@ Fault kinds:
   deterministic row (:meth:`FaultInjector.poison_array`) — the silent
   corruption the numerical health guards exist to catch;
 - ``"stall"`` — the dispatch runs after sleeping ``stall_s`` seconds (a
-  slow device / wedged collective; the serving watchdog's prey).
+  slow device / wedged collective; the serving watchdog's prey);
+- ``"replica_crash"`` / ``"replica_stall"`` — replica-level failure
+  domains (a SIGKILLed service process / a wedged dispatcher that stops
+  heartbeating). These fire only at the ROUTER boundary
+  (``"router.route"``, :func:`fire_router`): the router applies them to
+  the replica it was about to pick, then must fail traffic over. At the
+  intra-service boundaries they are no-ops — a single service cannot
+  kill itself meaningfully.
 
 Determinism: given the same specs, seed, and sequence of ``fire`` calls,
 the injected schedule is identical — ``at_calls`` schedules are exact,
@@ -43,7 +50,8 @@ import numpy as np
 
 __all__ = ["InjectedFault", "SimulatedOOM", "FaultSpec", "FaultInjector",
            "install", "uninstall", "active", "inject", "fire",
-           "poison_output", "SITES", "KINDS"]
+           "fire_router", "poison_output", "SITES", "KINDS",
+           "REPLICA_KINDS"]
 
 # the dispatch boundaries that call fire() (site names are stable API —
 # tools/chaos_trace.py and the chaos tests target them by pattern)
@@ -54,9 +62,15 @@ SITES = (
     "pergate.gate",                # imperative sharded gate dispatch
     "pergate.relayout",            # imperative relayout exchange
     "serve.execute",               # serving dispatcher batch execution
+    "router.route",                # ServiceRouter placement decision
 )
 
-KINDS = ("transient", "oom", "nan", "stall")
+KINDS = ("transient", "oom", "nan", "stall",
+         "replica_crash", "replica_stall")
+
+# the replica-scoped subset: returned by fire_router() for the router
+# to apply to its chosen replica, inert at every other boundary
+REPLICA_KINDS = ("replica_crash", "replica_stall")
 
 
 class InjectedFault(RuntimeError):
@@ -247,7 +261,33 @@ def fire(site: str) -> bool:
     if kind == "stall":
         time.sleep(inj.stall_s)
         return False
+    if kind in REPLICA_KINDS:
+        return False    # replica faults only mean something to the router
     return True     # "nan": caller poisons its output
+
+
+def fire_router(site: str) -> Optional[str]:
+    """The ROUTER-boundary hook. Replica-scoped kinds are not raised —
+    only the router knows its replicas, so ``"replica_crash"`` /
+    ``"replica_stall"`` are RETURNED for the caller to apply to the
+    replica it was about to pick. Every other kind behaves exactly as
+    at the engine boundaries (transient/oom raise, stall sleeps); nan
+    has no router meaning and is dropped. None = clean routing."""
+    inj = _ACTIVE
+    if inj is None:
+        return None
+    kind = inj.draw(site)
+    if kind is None or kind == "nan":
+        return None
+    if kind in REPLICA_KINDS:
+        return kind
+    if kind == "transient":
+        raise InjectedFault(f"injected transient fault at {site}")
+    if kind == "oom":
+        raise SimulatedOOM(
+            f"RESOURCE_EXHAUSTED: injected simulated OOM at {site}")
+    time.sleep(inj.stall_s)     # "stall"
+    return None
 
 
 def poison_output(poison: bool, arr):

@@ -33,7 +33,7 @@ from .faults import InjectedFault, SimulatedOOM
 from .health import NumericalFault
 
 __all__ = ["TRANSIENT", "POISON", "FATAL", "classify", "ResiliencePolicy",
-           "CircuitBreaker"]
+           "SupervisorPolicy", "CircuitBreaker"]
 
 TRANSIENT = "transient"
 POISON = "poison"
@@ -105,6 +105,49 @@ class ResiliencePolicy:
         base = min(self.backoff_cap_s,
                    self.backoff_base_s * (2.0 ** max(0, attempt - 1)))
         return base * (1.0 + self.backoff_jitter * float(rng.random()))
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorPolicy:
+    """The replica supervisor's knobs (:class:`quest_tpu.serve.router.
+    ServiceRouter`): when to quarantine a replica, how to restart it,
+    and what a half-open readmission probe must pass.
+
+    A replica is quarantined when its dispatcher thread dies, when its
+    dispatcher heartbeat goes quiet for ``stall_timeout_s`` with work
+    pending (``stall_quarantine``; the heartbeat cannot tick DURING a
+    dispatch, so set this above the worst-case single dispatch —
+    including a cold compile — or warm the buckets traffic will hit),
+    or when its executor-fault count grows by
+    ``fault_quarantine_threshold`` inside one supervisor poll window. Restart attempts are bounded
+    (``max_restart_attempts`` per quarantine episode) and spaced by
+    exponential backoff from ``restart_backoff_s``. A restarted replica
+    is readmitted only after a ``probe_batch``-request half-open probe
+    whose every result matches the reference recorded at warm time to
+    ``probe_tol`` (oracle-grade: NaN, norm drift, or a wrong energy all
+    fail the probe and send the replica back to quarantine)."""
+
+    poll_s: float = 0.02
+    stall_quarantine: bool = True
+    stall_timeout_s: float = 5.0
+    fault_quarantine_threshold: int = 8
+    probe_batch: int = 2
+    probe_timeout_s: float = 60.0
+    probe_tol: float = 1e-9
+    max_restart_attempts: int = 5
+    restart_backoff_s: float = 0.05
+
+    def __post_init__(self):
+        if self.poll_s <= 0:
+            raise ValueError("poll_s must be > 0")
+        if self.probe_batch < 1:
+            raise ValueError("probe_batch must be >= 1")
+        if self.max_restart_attempts < 1:
+            raise ValueError("max_restart_attempts must be >= 1")
+
+    def restart_delay(self, attempt: int) -> float:
+        """Backoff before restart ``attempt`` (1-based)."""
+        return self.restart_backoff_s * (2.0 ** max(0, attempt - 1))
 
 
 class CircuitBreaker:

@@ -143,6 +143,7 @@ def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,
 
     Returns ``(planes, stats)``: the full ``(B, 2, 2^n)`` result and
     ``{"segments", "restarts", "resumed_rows"}``."""
+    from .. import checkpoint as ckpt
     pm = np.asarray(param_matrix, dtype=np.float64)
     if pm.ndim != 2:
         raise ValueError(f"param_matrix must be 2-D; got shape {pm.shape}")
@@ -175,16 +176,22 @@ def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,
     chunks: list = []
     n_saved = 0
     if resume and os.path.exists(ckpt_path):
-        with np.load(ckpt_path, allow_pickle=False) as f:
-            # a digest mismatch silently restarting would return planes
-            # for the WRONG parameters; start clean instead
-            if str(f["digest"]) == digest and int(f["batch"]) == B:
-                done = int(f["done"])
-                n_saved = int(f["segments"])
+        try:
+            with np.load(ckpt_path, allow_pickle=False) as f:
+                # a digest mismatch silently restarting would return
+                # planes for the WRONG parameters; start clean instead
+                if str(f["digest"]) == digest and int(f["batch"]) == B:
+                    done = int(f["done"])
+                    n_saved = int(f["segments"])
+        except Exception:
+            # torn/truncated archive (crash mid-write before the atomic
+            # rename landed, or pre-atomic leftovers): a corrupt
+            # progress file must mean "start clean", never a crash here
+            done, n_saved = 0, 0
         try:
             chunks = [np.load(_seg_path(i)) for i in range(n_saved)]
-        except OSError:
-            done, n_saved, chunks = 0, 0, []   # sidecars gone: restart
+        except (OSError, ValueError):
+            done, n_saved, chunks = 0, 0, []   # sidecars gone/torn: restart
         if chunks and sum(c.shape[0] for c in chunks) != done:
             done, n_saved, chunks = 0, 0, []   # torn progress: restart
     resumed = done
@@ -211,8 +218,11 @@ def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,
             done = hi
             np.save(_seg_path(n_saved), planes)
             n_saved += 1
-            np.savez(ckpt_path, done=done, batch=B, digest=digest,
-                     segments=n_saved)
+            # atomic: the metadata commits AFTER its sidecar exists, and
+            # a crash mid-write leaves the previous progress file whole
+            # (a torn .npz would otherwise poison the next resume)
+            ckpt.atomic_savez(ckpt_path, done=done, batch=B,
+                              digest=digest, segments=n_saved)
         out = np.concatenate(chunks, axis=0) if chunks \
             else np.zeros((0,), dtype=np.float64)
     finally:

@@ -5,18 +5,29 @@ batched ensemble engine (:meth:`quest_tpu.circuits.CompiledCircuit.
 sweep` family) is fast at: request coalescing with padded batch
 buckets, bounded-queue admission control with typed backpressure, and
 deadline-aware dispatch with one retry on transient executor failure.
-See ``docs/tpu.md`` ("Serving runtime") for the operational model.
+For production traffic, :class:`ServiceRouter` fronts N service
+replicas with health-aware routing, replica failover with supervised
+restart, and a persistent warm-start compile cache
+(:class:`~quest_tpu.serve.warmcache.WarmCache`,
+``QUEST_TPU_WARM_CACHE_DIR``) so a restarted replica loads its
+executables instead of recompiling. See ``docs/tpu.md`` ("Serving
+runtime", "Replicated serving & warm restart") for the operational
+model.
 """
 
 from .coalesce import (CoalescePolicy, batch_bucket, coalesce_key,
                        plan_schedule, split_ready)
 from .engine import (CircuitBreakerOpen, DeadlineExceeded, QueueFull,
                      ServeError, ServiceClosed, SimulationService)
-from .metrics import ServiceMetrics
+from .metrics import RouterMetrics, ServiceMetrics
+from .router import AllReplicasUnavailable, ServiceRouter, replica_envs
+from .warmcache import WARM_CACHE_ENV, WarmCache
 
 __all__ = [
     "SimulationService", "ServeError", "QueueFull", "DeadlineExceeded",
     "ServiceClosed", "CircuitBreakerOpen", "CoalescePolicy",
     "ServiceMetrics", "batch_bucket", "coalesce_key", "plan_schedule",
     "split_ready",
+    "ServiceRouter", "AllReplicasUnavailable", "replica_envs",
+    "RouterMetrics", "WarmCache", "WARM_CACHE_ENV",
 ]

@@ -166,6 +166,14 @@ class SimulationService:
         Ring-buffer bound on the recovery timeline
         (:attr:`SimulationService.events`; ``tools/chaos_trace.py``
         dumps it). 0 disables recording.
+    warm_cache : WarmCache | False | None
+        The persistent warm-start compile cache
+        (:class:`quest_tpu.serve.warmcache.WarmCache`). Default None
+        resolves the ambient cache from ``QUEST_TPU_WARM_CACHE_DIR``
+        (disabled when unset); pass an explicit cache to share one, or
+        ``False`` to force it off. With a cache, :meth:`warm` LOADS
+        serialized executables instead of recompiling (hit/miss
+        counters land in the metrics registry).
     """
 
     def __init__(self, env, *, max_queue: int = 1024, max_batch: int = 64,
@@ -173,7 +181,7 @@ class SimulationService:
                  max_retries: int = 1, latency_window: int = 4096,
                  max_circuits: int = 32,
                  resilience: Optional[ResiliencePolicy] = None,
-                 record_events: int = 256):
+                 record_events: int = 256, warm_cache=None):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if request_timeout_s <= 0.0:
@@ -201,6 +209,15 @@ class SimulationService:
         self._compiled = _BoundedExecutableCache(int(max_circuits))
         self._last_cc: Optional[CompiledCircuit] = None
         self.metrics.queue_depth_fn = lambda: self._backlog
+        if warm_cache is None:
+            from .warmcache import WarmCache
+            warm_cache = WarmCache.from_env()
+        self.warm_cache = warm_cache or None
+        self._inflight = 0           # requests inside an engine dispatch
+        # replica-fault simulation hooks (router chaos: a SIGKILLed
+        # process / a wedged dispatcher that stops heartbeating)
+        self._crashed = False
+        self._wedge_until = 0.0
         # fault-tolerance state (quest_tpu/resilience): classifier-driven
         # retries with backoff, per-program circuit breaker, degraded
         # sequential mode, recovery event timeline, dispatcher heartbeat
@@ -353,18 +370,34 @@ class SimulationService:
         (default: the policy's ``max_batch`` bucket) through the same
         entry point live requests will use — ``sweep`` by default,
         ``expectation_sweep`` when ``observables`` is given,
-        ``sample_sweep`` when ``shots`` is. Returns the compiled
-        circuit (submit it back for guaranteed coalescing)."""
+        ``sample_sweep`` when ``shots`` is. With a persistent warm
+        cache configured, each form's executable is LOADED from disk
+        when a previous process stored it (``warm_cache_hits`` in the
+        metrics; the throwaway dispatch then rides the loaded
+        executable) and compiled-and-stored otherwise
+        (``warm_cache_misses``) — restart-to-ready stops paying
+        recompiles. Returns the compiled circuit (submit it back for
+        guaranteed coalescing)."""
         compiled = self._resolve(circuit)
         sizes = tuple(batch_sizes) if batch_sizes is not None \
             else (self.policy.max_batch,)
         mult = self._device_multiple(compiled)
+        ham = None
+        if observables is not None:
+            ham, _ = _canonical_observables(compiled, observables)
         for bs in sizes:
             padded = self.policy.bucket_size(int(bs), mult)
+            if self.warm_cache is not None:
+                kind = "energy" if observables is not None else "sweep"
+                status = self.warm_cache.warm_form(
+                    compiled, kind, padded, hamiltonian=ham)
+                if status == "hit":
+                    self.metrics.incr("warm_cache_hits")
+                elif status == "miss":
+                    self.metrics.incr("warm_cache_misses")
             pm = np.zeros((padded, len(compiled.param_names)),
                           dtype=np.float64)
             if observables is not None:
-                ham, _ = _canonical_observables(compiled, observables)
                 np.asarray(compiled.expectation_sweep(pm, ham))
             elif shots is not None:
                 compiled.sample_sweep(pm, int(shots))
@@ -384,6 +417,69 @@ class SimulationService:
         with self._cond:
             self._paused = False
             self._cond.notify_all()
+
+    # -- replica-lifecycle hooks (serve/router.py) -------------------------
+
+    def quiesce(self, timeout: Optional[float] = 30.0) -> bool:
+        """Block until nothing is queued or mid-dispatch (the rolling-
+        restart drain point: a quiesced replica can be swapped out with
+        zero in-flight work). Returns False on timeout or when the
+        dispatcher died with work still pending."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cond:
+                idle = self._backlog == 0 and self._inflight == 0
+            if idle:
+                return True
+            if not self._thread.is_alive():
+                return self._backlog == 0 and self._inflight == 0
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(1e-3)
+
+    def is_alive(self) -> bool:
+        """True while the dispatcher thread is serving (a crashed
+        replica answers False immediately — the flag, not the thread's
+        exit, is the death; the supervisor's liveness probe)."""
+        return self._thread.is_alive() and not self._closed \
+            and not self._crashed
+
+    def program_state(self, circuit) -> dict:
+        """Read-only per-program health for the router's breaker-aware
+        placement: ``{"breaker": "closed"|"open"|"half-open"|"unknown",
+        "degraded": bool}``. Never mutates breaker/LRU state (safe from
+        any thread)."""
+        cc = None
+        if isinstance(circuit, CompiledCircuit):
+            cc = circuit
+        elif isinstance(circuit, Circuit):
+            entry = self._compiled.peek(id(circuit))
+            if entry is not None and entry[0] is circuit:
+                cc = entry[1]
+        if cc is None:
+            return {"breaker": "unknown", "degraded": False}
+        key = self._program_key_str(cc)
+        return {"breaker": self._breaker.state(key),
+                "degraded":
+                    time.monotonic() < self._degraded_until.get(key, 0.0)}
+
+    def _debug_crash(self) -> None:
+        """TEST/CHAOS HOOK: die the way a SIGKILLed replica process
+        does — the dispatcher thread exits immediately, queued and
+        in-flight futures are STRANDED (never resolved by this
+        service). The router's supervisor must detect the dead
+        dispatcher and fail the work over; nothing in this process
+        cleans up after it, exactly like the real failure."""
+        self._crashed = True
+        with self._cond:
+            self._cond.notify_all()
+
+    def _debug_wedge(self, duration_s: float) -> None:
+        """TEST/CHAOS HOOK: wedge the dispatcher for ``duration_s`` —
+        it stops heartbeating (the watchdog will flag a stall) and
+        serves nothing, the shape of a hung collective. close()
+        unwedges (a convenience a real hang would not offer)."""
+        self._wedge_until = time.monotonic() + float(duration_s)
 
     def dispatch_stats(self) -> dict:
         """Engine-level :class:`~quest_tpu.profiling.DispatchStats`
@@ -410,8 +506,11 @@ class SimulationService:
         inj = _faults.active()
         if inj is not None:
             res["fault_injection"] = inj.snapshot()
-        return {**base, "service": self.metrics.snapshot(),
-                "resilience": res}
+        out = {**base, "service": self.metrics.snapshot(),
+               "resilience": res}
+        if self.warm_cache is not None:
+            out["warm_cache"] = self.warm_cache.stats()
+        return out
 
     def close(self, drain: bool = True, timeout: Optional[float] = 30.0
               ) -> None:
@@ -450,6 +549,15 @@ class SimulationService:
     def _dispatch_loop(self) -> None:
         pending: dict = {}   # coalesce key -> FIFO list of _Request
         while True:
+            if self._crashed:
+                return       # simulated process death: strand everything
+            if self._wedge_until and not self._closed:
+                # simulated hang: no heartbeat, no service, until the
+                # wedge lapses (or close() pulls the plug)
+                if time.monotonic() < self._wedge_until:
+                    time.sleep(2e-3)
+                    continue
+                self._wedge_until = 0.0
             self._heartbeat = time.monotonic()
             with self._cond:
                 if self._paused and not self._closed:
@@ -536,6 +644,15 @@ class SimulationService:
 
     # -- recovery path -----------------------------------------------------
 
+    @staticmethod
+    def _program_key_str(cc: CompiledCircuit) -> str:
+        """The key FORMAT shared by the mutating :meth:`_program_key`
+        and the read-only :meth:`program_state` — one definition, so the
+        router's breaker-aware placement can never drift onto a stale
+        key shape and silently stop seeing open breakers."""
+        return f"{'dm' if cc.is_density else 'sv'}-" \
+               f"{cc.num_qubits}q-{id(cc):x}"
+
     def _program_key(self, cc: CompiledCircuit) -> str:
         """Stable resilience key for one compiled program. ``id()`` alone
         is not enough — CPython recycles addresses, so a collected
@@ -543,8 +660,7 @@ class SimulationService:
         new program. A weakref per key detects recycling (stale state is
         dropped) and lets dead keys be pruned, bounding the maps on a
         long-lived service. Dispatcher-thread only."""
-        key = f"{'dm' if cc.is_density else 'sv'}-" \
-              f"{cc.num_qubits}q-{id(cc):x}"
+        key = self._program_key_str(cc)
         ref = self._program_refs.get(key)
         if ref is None or ref() is not cc:
             if ref is not None:
@@ -614,6 +730,14 @@ class SimulationService:
         quarantining group executor."""
         with self._cond:
             self._backlog -= len(batch)
+            self._inflight += len(batch)
+        try:
+            self._execute_guarded(batch)
+        finally:
+            with self._cond:
+                self._inflight -= len(batch)
+
+    def _execute_guarded(self, batch: list) -> None:
         cc = batch[0].compiled
         pkey = self._program_key(cc)
         rp = self.resilience
@@ -739,7 +863,24 @@ class SimulationService:
             req.retries_left -= 1
             req.attempts += 1
             delay = rp.backoff(req.attempts, self._retry_rng)
-            req.not_before = time.monotonic() + delay
+            now = time.monotonic()
+            if now + delay > req.deadline:
+                # the backoff hold would outlive the request's ORIGINAL
+                # absolute deadline: fail fast with DeadlineExceeded
+                # instead of burning the retry on a dispatch that could
+                # only resolve stale (the deadline is never re-derived
+                # from request_timeout_s on a retry)
+                self.metrics.incr("timeouts")
+                self._event("retry_abandoned",
+                            remaining_s=round(req.deadline - now, 6),
+                            backoff_s=round(delay, 6))
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(DeadlineExceeded(
+                        f"retry backoff of {delay:.3f}s exceeds the "
+                        f"request's remaining deadline budget of "
+                        f"{max(0.0, req.deadline - now):.3f}s"))
+                return
+            req.not_before = now + delay
             self.metrics.incr("retries")
             self._event("retry", attempt=req.attempts,
                         delay_s=round(delay, 6))

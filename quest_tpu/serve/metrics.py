@@ -21,7 +21,7 @@ from __future__ import annotations
 import collections
 import threading
 
-__all__ = ["ServiceMetrics"]
+__all__ = ["ServiceMetrics", "RouterMetrics"]
 
 
 _COUNTERS = (
@@ -46,6 +46,9 @@ _COUNTERS = (
     "breaker_fastfails",     # requests fast-failed by an open breaker
     "degraded_dispatches",   # requests run in sequential degraded mode
     "watchdog_stalls",       # dispatcher heartbeat gaps past the timeout
+    # warm-start compile cache (serve/warmcache.py; ISSUE 6):
+    "warm_cache_hits",       # warm() forms loaded from the persistent cache
+    "warm_cache_misses",     # warm() forms compiled fresh (and stored)
 )
 
 
@@ -74,6 +77,12 @@ class ServiceMetrics:
             raise KeyError(f"unknown service counter {name!r}")
         with self._lock:
             self._c[name] += k
+
+    def get(self, name: str) -> int:
+        """One counter, cheaply (no full snapshot — the router's
+        supervisor polls this per replica per tick)."""
+        with self._lock:
+            return self._c[name]
 
     def record_batch(self, size: int, padded_size: int) -> None:
         """One coalesced dispatch of ``size`` live requests, executed at
@@ -135,4 +144,53 @@ class ServiceMetrics:
             "p99_latency_s": self._pct(lat, 99.0),
             "p50_queue_wait_s": self._pct(waits, 50.0),
             "p99_queue_wait_s": self._pct(waits, 99.0),
+        }
+
+
+_ROUTER_COUNTERS = (
+    "routed",                # requests placed on a replica
+    "rerouted_full",         # re-placed after a replica's QueueFull
+    "failovers",             # re-placed after a replica fault/breaker/crash
+    "hedged_dispatches",     # duplicate dispatches issued by hedging
+    "hedge_wins",            # hedge results that resolved the request
+    "replica_quarantines",   # replicas pulled from routing by the supervisor
+    "replica_restarts",      # replacement services started
+    "readmissions",          # replicas returned to routing after a probe
+    "probe_batches",         # half-open probe batches run
+    "probe_failures",        # probes whose results failed the oracle check
+    "failed_unroutable",     # requests failed: no healthy replica in budget
+    "supervisor_errors",     # supervisor-loop iterations that raised
+)
+
+
+class RouterMetrics:
+    """Thread-safe counters + latency reservoir for one
+    :class:`~quest_tpu.serve.router.ServiceRouter` (the replica-level
+    view; each replica's own :class:`ServiceMetrics` stays the
+    per-service truth). Same shape as :class:`ServiceMetrics` so the
+    bench rows and chaos traces read both uniformly."""
+
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._c = {name: 0 for name in _ROUTER_COUNTERS}
+        self._latencies = collections.deque(maxlen=latency_window)
+
+    def incr(self, name: str, k: int = 1) -> None:
+        if name not in self._c:
+            raise KeyError(f"unknown router counter {name!r}")
+        with self._lock:
+            self._c[name] += k
+
+    def record_latency(self, total_s: float) -> None:
+        with self._lock:
+            self._latencies.append(float(total_s))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            c = dict(self._c)
+            lat = sorted(self._latencies)
+        return {
+            **c,
+            "p50_latency_s": ServiceMetrics._pct(lat, 50.0),
+            "p99_latency_s": ServiceMetrics._pct(lat, 99.0),
         }

@@ -206,6 +206,59 @@ def test_chaos_row_required():
     assert "bench_serving_chaos" in src
 
 
+def test_replicated_serving_row_required():
+    """The bench must deliver the ISSUE-6 replicated-serving row: the
+    expectation trace through a 2-replica router with a mid-trace
+    replica kill, plus the cold-vs-warm-cache restart comparison. Run
+    tiny (6 qubits, 48 requests, batch 8) so the delivery contract is
+    tested, not the measurement."""
+    env_overrides = {
+        "QUEST_BENCH_ROUTER_QUBITS": "6",
+        "QUEST_BENCH_ROUTER_REQUESTS": "48",
+        "QUEST_BENCH_ROUTER_TERMS": "4",
+        "QUEST_BENCH_ROUTER_LAYERS": "1",
+        "QUEST_BENCH_ROUTER_BATCH": "8",
+        "QUEST_BENCH_ROUTER_REPLICAS": "2",
+        "QUEST_BENCH_ROUTER_DEVICES": "1",
+    }
+    old = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        import quest_tpu as qt
+        row = bench.bench_replicated_serving(qt, "cpu")
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    assert row["unit"] == "requests/sec"
+    assert row["value"] > 0.0
+    assert "replica kill" in row["metric"]
+    assert "hardware-efficient-ansatz-6" in row["metric"]
+    assert row["no_kill_rate"] > 0.0
+    assert row["p99_no_kill_s"] > 0.0
+    assert row["p99_with_kill_s"] > 0.0
+    # the replica-level machinery demonstrably ran on the killed pass
+    assert row["replica_quarantines"] >= 1
+    assert row["replica_restarts"] >= 1
+    assert row["failovers"] >= 1
+    # graded invariants: nothing dropped, nothing silently wrong
+    assert row["dropped_requests"] == 0
+    assert row["incorrect_results"] == 0
+    assert "errors" not in row
+    assert row["max_energy_deviation"] < 1e-10
+    # warm-start restart: the cold pass compiled (misses), the warm
+    # pass loaded (hits, zero fresh compiles), and both were timed
+    assert row["cold_cache_misses"] >= 1
+    assert row["warm_cache_hits"] >= 1
+    assert row["warm_cache_misses"] == 0
+    assert row["cold_restart_s"] > 0.0
+    assert row["warm_restart_s"] > 0.0
+    # the acceptance mesh child must carry the row too
+    import inspect
+    src = inspect.getsource(bench.bench_sharded_mesh)
+    assert "bench_replicated_serving" in src
+
+
 def test_warning_dedup_filter():
     """Repeated xla_bridge 'Platform ... is experimental' records are
     collapsed to one; distinct messages still pass."""

@@ -653,6 +653,56 @@ class TestSegmentRecovery:
         assert st2["resumed_rows"] == 6              # it actually resumed
         assert not any(tmp_path.iterdir())           # and cleaned up
 
+    def test_torn_progress_file_restarts_clean(self, env, rng, tmp_path):
+        """ISSUE 6 satellite: a truncated (torn) progress archive — the
+        artifact a crash mid-write used to leave before checkpoint
+        writes went atomic — must make resume START CLEAN, not crash
+        and not resume wrong rows."""
+        cc = _hea(3).compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(6, len(cc.param_names)))
+        want = np.asarray(cc.sweep(pm))
+        path = str(tmp_path / "sweep.npz")
+        rz.checkpointed_sweep(cc, pm, segment_rows=2, ckpt_path=path,
+                              keep_checkpoint=True)
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[:len(blob) // 2])         # torn half-write
+        got, stats = rz.checkpointed_sweep(cc, pm, segment_rows=2,
+                                           ckpt_path=path)
+        np.testing.assert_allclose(got, want, atol=1e-12)
+        assert stats["resumed_rows"] == 0          # clean restart
+        assert stats["segments"] == 3              # recomputed everything
+
+    def test_checkpoint_write_is_atomic(self, env, tmp_path,
+                                        monkeypatch):
+        """A crash mid-write (simulated: np.savez raises after partial
+        output) leaves the PREVIOUS checkpoint intact — the temp-file +
+        os.replace contract — and no temp litter behind."""
+        from quest_tpu import checkpoint as ckpt
+        q = qt.createQureg(3, env)
+        qt.initPlusState(q)
+        path = str(tmp_path / "reg.npz")
+        ckpt.save_npz(q, path)
+        good = open(path, "rb").read()
+
+        real_savez = np.savez
+
+        def exploding_savez(fh, **arrays):
+            fh.write(b"torn")                       # partial bytes
+            raise OSError("disk full mid-write")
+
+        monkeypatch.setattr(np, "savez", exploding_savez)
+        with pytest.raises(OSError, match="disk full"):
+            ckpt.save_npz(q, path)
+        monkeypatch.setattr(np, "savez", real_savez)
+        assert open(path, "rb").read() == good      # last good intact
+        assert [p.name for p in tmp_path.iterdir()] == ["reg.npz"]
+        # and the intact file still restores
+        r = qt.createQureg(3, env)
+        ckpt.load_npz(r, path)
+        np.testing.assert_allclose(np.asarray(r.state),
+                                   np.asarray(q.state), atol=0)
+
     @pytest.mark.chaos
     def test_checkpointed_sweep_recovers_and_resumes(self, env, rng,
                                                      tmp_path):

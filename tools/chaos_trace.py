@@ -19,11 +19,23 @@ boundaries, and prints everything an incident review needs:
   sequential fault-free loop, asserting NO silent wrong answers;
 - the full service metrics snapshot.
 
+With ``--replicas N`` (N >= 2) the trace runs through a
+:class:`~quest_tpu.serve.router.ServiceRouter` instead of a bare
+service, and the replica-level fault kinds come alive:
+``replica_crash`` / ``replica_stall`` fire at the ``router.route``
+boundary and are applied to the replica the router was about to pick
+(the supervisor must quarantine it, fail traffic over, restart it, and
+readmit it through the half-open probe). The dump then carries the
+router metrics, per-replica service snapshots, and the router event
+timeline next to the per-replica ones.
+
 Usage::
 
     python tools/chaos_trace.py --requests 64 --fault-rate 0.05
     python tools/chaos_trace.py --kinds transient,oom,nan --seed 11
     python tools/chaos_trace.py --requests 128 --sites 'serve.*' --oracle
+    python tools/chaos_trace.py --replicas 2 --kinds replica_crash \
+        --sites router.route --at-calls 9 --oracle
 
 Deterministic: same arguments -> same schedule -> same timeline shape.
 Runs on the CPU backend by default (``--backend default`` uses whatever
@@ -42,9 +54,12 @@ def build_trace(args) -> dict:
     import numpy as np
     import quest_tpu as qt
     from quest_tpu.circuits import Circuit
-    from quest_tpu.resilience import FaultInjector, FaultSpec, inject
-    from quest_tpu.serve import SimulationService
+    from quest_tpu.resilience import (FaultInjector, FaultSpec,
+                                      SupervisorPolicy, inject)
+    from quest_tpu.serve import ServiceRouter, SimulationService, \
+        replica_envs
 
+    replicated = args.replicas > 1
     env = qt.createQuESTEnv(num_devices=args.devices, seed=[args.seed])
     n = args.qubits
     c = Circuit(n)
@@ -78,18 +93,40 @@ def build_trace(args) -> dict:
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=0.05, degrade_after=args.degrade_after,
         degrade_cooldown_s=0.1, watchdog_timeout_s=args.watchdog_s)
-    svc = SimulationService(
-        env, max_batch=args.max_batch, max_wait_s=2e-3,
+    svc_kwargs = dict(
+        max_batch=args.max_batch, max_wait_s=2e-3,
         max_queue=args.requests + args.max_batch,
         request_timeout_s=args.timeout_s, max_retries=args.max_retries,
         resilience=policy, record_events=4 * args.requests + 64)
+    if replicated:
+        envs = replica_envs(args.replicas,
+                            devices_per_replica=args.devices,
+                            seed=[args.seed])
+        svc = ServiceRouter(
+            envs, supervisor=SupervisorPolicy(
+                poll_s=0.01, stall_timeout_s=max(0.4, 4 * args.stall_s),
+                restart_backoff_s=0.02),
+            warm_cache=False, **svc_kwargs)
+        # warm every bucket the trace can hit so only injected faults
+        # perturb the schedule (and failover dispatches stay cheap)
+        bs, sizes = 1, []
+        while bs <= args.max_batch:
+            sizes.append(bs)
+            bs *= 2
+        svc.warm(c, batch_sizes=sizes, observables=ham)
+        submit_to = c          # route by the recorded circuit
+    else:
+        svc = SimulationService(env, **svc_kwargs)
+        submit_to = cc
 
     outcomes = []
     with inject(inj):
-        svc.pause()
-        futs = [svc.submit(cc, dict(zip(cc.param_names, row)),
+        if not replicated:
+            svc.pause()
+        futs = [svc.submit(submit_to, dict(zip(cc.param_names, row)),
                            observables=ham) for row in pm]
-        svc.resume()
+        if not replicated:
+            svc.resume()
         for f in futs:
             try:
                 outcomes.append(("ok", float(f.result(
@@ -109,7 +146,8 @@ def build_trace(args) -> dict:
     doc = {
         "config": {
             "requests": args.requests, "qubits": n,
-            "devices": args.devices, "seed": args.seed,
+            "devices": args.devices, "replicas": args.replicas,
+            "seed": args.seed,
             "fault_rate": args.fault_rate, "kinds": args.kinds,
             "sites": args.sites, "max_batch": args.max_batch,
             "max_retries": args.max_retries,
@@ -121,10 +159,14 @@ def build_trace(args) -> dict:
             "unaccounted": args.requests - completed
             - sum(by_error.values()),
         },
-        "service": stats.get("service", {}),
-        "resilience": stats.get("resilience", {}),
         "timeline": timeline,
     }
+    if replicated:
+        doc["router"] = stats.get("router", {})
+        doc["replicas"] = stats.get("replicas", [])
+    else:
+        doc["service"] = stats.get("service", {})
+        doc["resilience"] = stats.get("resilience", {})
 
     if args.oracle:
         # sequential fault-free loop: injector is uninstalled here, so
@@ -159,7 +201,12 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=64)
     p.add_argument("--qubits", type=int, default=4)
-    p.add_argument("--devices", type=int, default=1)
+    p.add_argument("--devices", type=int, default=1,
+                   help="devices per env (with --replicas: per replica)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help=">= 2 routes the trace through a ServiceRouter "
+                        "(replica_crash/replica_stall fault kinds need "
+                        "this)")
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--fault-rate", type=float, default=0.05,
                    help="per-dispatch injection probability per kind")
@@ -168,10 +215,11 @@ def main(argv=None) -> int:
                         "(deterministic schedule, round-robin over "
                         "--kinds; composes with --fault-rate)")
     p.add_argument("--kinds", default="transient,nan",
-                   help="comma list of transient|oom|nan|stall")
+                   help="comma list of transient|oom|nan|stall|"
+                        "replica_crash|replica_stall")
     p.add_argument("--sites", default="serve.execute",
                    help="fnmatch pattern over fault sites "
-                        "(e.g. '*', 'circuits.*')")
+                        "(e.g. '*', 'circuits.*', 'router.route')")
     p.add_argument("--stall-s", type=float, default=0.02)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-retries", type=int, default=3)
