@@ -22,6 +22,14 @@
 //      compose into one exchange when the intervening ops stay executable
 //      under the composed permutation and the composed collective is
 //      modeled no slower than the pair.
+//   5. (multi-host mode, qsched_set_cost_model2) two-tier pricing — a
+//      separate (alpha, beta) for collectives whose exchanged device
+//      bits include one of the top host_bits inter-host positions — and
+//      the mpiQulacs hot-qubit reordering pass: each relayout's evicted
+//      qubits are re-paired with the vacated device slots so the coldest
+//      victim (fewest remaining paired uses, then farthest next use)
+//      takes the most-inter-host slot. host_bits == 0 reproduces the
+//      single-host plans bit-for-bit.
 //
 // Output is a schedule of items — ops at physical positions, relayout
 // permutations, cross-shard exchanges — that the Python/JAX side lowers
@@ -86,6 +94,11 @@ struct Sched {
   double alpha = 0.0;          // per-collective latency, seconds
   double beta = 0.0;           // seconds per byte
   double chunk_bytes = 0.0;    // per-device chunk payload
+  // multi-host (two-tier) mode: negative inter values = same as intra
+  double inter_alpha = -1.0;   // inter-host per-collective latency
+  double inter_beta = -1.0;    // inter-host seconds per byte
+  int host_bits = 0;           // top device bits crossing the host edge
+  bool reorder = true;         // hot-qubit-local eviction re-pairing
   int num_xshard = 0;
   int swaps_absorbed = 0;
   int fused_collectives = 0;
@@ -208,34 +221,56 @@ std::vector<int> relayout_sigma(const std::vector<int>& before,
   return sigma;
 }
 
-double a2a_seconds(const Sched& s, int k) {
+// (alpha, beta) of one pricing tier: the inter-host values when the
+// collective crosses hosts and a tier is calibrated, else intra
+// (mirrors CommCostModel.tier)
+void tier_of(const Sched& s, bool inter, double* alpha, double* beta) {
+  *alpha = (inter && s.inter_alpha >= 0.0) ? s.inter_alpha : s.alpha;
+  *beta = (inter && s.inter_beta >= 0.0) ? s.inter_beta : s.beta;
+}
+
+double a2a_seconds(const Sched& s, int k, bool inter = false) {
   if (k <= 0) return 0.0;
-  return s.alpha + s.beta * (s.chunk_bytes *
-                             ((double)((1 << k) - 1) / (double)(1 << k)));
+  double a, b;
+  tier_of(s, inter, &a, &b);
+  return a + b * (s.chunk_bytes *
+                  ((double)((1 << k) - 1) / (double)(1 << k)));
 }
 
-double ppermute_seconds(const Sched& s) {
-  return s.alpha + s.beta * s.chunk_bytes;
+double ppermute_seconds(const Sched& s, bool inter = false) {
+  double a, b;
+  tier_of(s, inter, &a, &b);
+  return a + b * s.chunk_bytes;
 }
 
-// modeled seconds for one relayout, mirroring layout.py::relayout_comm:
-// one all_to_all over the k exchanged bits plus a whole-chunk ppermute
-// iff a residual device-bit permutation remains
+// modeled seconds for one relayout, mirroring
+// layout.py::relayout_comm_tiered: one all_to_all over the k exchanged
+// bits (inter tier iff an exchanged device slot is one of the top
+// host_bits positions) plus a whole-chunk ppermute iff a residual
+// device-bit permutation remains (inter tier, conservatively, iff ANY
+// inter-host slot participates in the relayout)
 double relayout_seconds(const Sched& s, const std::vector<int>& sigma,
                         int lt) {
   int n = (int)sigma.size();
+  int hb = std::max(0, std::min(s.host_bits, n - lt));
+  int inter_lo = n - hb;
   int k = 0;
-  bool residual = false;
+  bool residual = false, a2a_inter = false, res_inter = false;
   for (int p = 0; p < lt; ++p)
     if (sigma[p] >= lt) {
       ++k;
       if (sigma[sigma[p]] >= lt) residual = true;
     }
-  for (int d = lt; d < n; ++d)
+  for (int d = lt; d < n; ++d) {
     if (sigma[d] >= lt && sigma[d] != d) residual = true;
+    if (hb > 0 && sigma[d] < lt && d >= inter_lo) a2a_inter = true;
+  }
+  if (hb > 0)
+    for (int p = inter_lo; p < n; ++p)
+      if (sigma[p] != p) res_inter = true;
   double sec = 0.0;
-  if (k) sec += a2a_seconds(s, k);
-  if (residual) sec += ppermute_seconds(s);
+  if (k) sec += a2a_seconds(s, k, a2a_inter);
+  if (residual) sec += ppermute_seconds(s, res_inter);
   return sec;
 }
 
@@ -417,6 +452,20 @@ void plan(Sched& s, int lookahead) {
       for (int q : used_qubits(ops[i])) next_use[i][q] = i;
   }
 
+  // upcoming-use counts (the reordering pass's hotness metric):
+  // rem_uses[i][q] = paired uses of q at ops >= i (layout.py mirror)
+  const int hb = std::max(0, std::min(s.host_bits, S));
+  const bool reorder_on = comm_aware && hb > 0 && s.reorder;
+  std::vector<std::vector<int64_t>> rem_uses;
+  if (reorder_on) {
+    rem_uses.assign(ops.size() + 1, std::vector<int64_t>(n, 0));
+    for (int64_t i = static_cast<int64_t>(ops.size()) - 1; i >= 0; --i) {
+      rem_uses[i] = rem_uses[i + 1];
+      if (!absorbable[i])
+        for (int q : used_qubits(ops[i])) ++rem_uses[i][q];
+    }
+  }
+
   auto contains = [](const std::vector<int>& v, int q) {
     return std::find(v.begin(), v.end(), q) != v.end();
   };
@@ -460,7 +509,12 @@ void plan(Sched& s, int lookahead) {
             break;
           }
       }
-      if (!sole || ppermute_seconds(s) > 2.0 * a2a_seconds(s, 1))
+      // both candidates ride the same device bit, so both price at that
+      // bit's tier (inter when the position crosses hosts)
+      int hb = std::max(0, std::min(s.host_bits, S));
+      bool x_inter = hb > 0 && perm[t] >= n - hb;
+      if (!sole || ppermute_seconds(s, x_inter) >
+                       2.0 * a2a_seconds(s, 1, x_inter))
         return false;
       Item it;
       it.kind = ITEM_XSHARD;
@@ -528,25 +582,56 @@ void plan(Sched& s, int lookahead) {
       for (int q : need_now) bring.emplace_back(q, int64_t{-1});
       for (auto& h : window_hot) bring.push_back(h);
 
-      std::vector<int> new_perm = perm;
-      size_t vi = 0;
+      // phase 1 — victim selection (Belady order, layout.py mirror)
+      std::vector<std::pair<int, int>> pairs_sel;  // (incoming q, victim)
       for (auto [q, nu_q] : bring) {
-        if (vi >= locals_.size()) break;
-        auto [nu_victim, victim] = locals_[vi];
+        if (pairs_sel.size() >= locals_.size()) break;
+        auto [nu_victim, victim] = locals_[pairs_sel.size()];
         if (!contains(need_now, q) && nu_q >= nu_victim) continue;
-        // three-way rotation landing the incoming qubit at a TOP local
-        // position (the all_to_all staging slot): q -> stage, the qubit at
-        // stage -> the victim's slot, victim -> q's device position — so
-        // the exchange's post-transpose vanishes (layout.py mirror).
+        pairs_sel.emplace_back(q, victim);
+      }
+      // device-slot assignment for the evicted victims: by default
+      // victim i takes the slot its incoming qubit vacates; the
+      // hot-qubit reordering pass re-pairs so the COLDEST victim
+      // (fewest remaining paired uses, then farthest next use, then
+      // label) takes the most-inter-host slot (layout.py mirror)
+      std::vector<int> vacated;
+      for (auto& [q, v] : pairs_sel) vacated.push_back(perm[q]);
+      std::vector<int> dest(n, -1);
+      for (size_t j = 0; j < pairs_sel.size(); ++j)
+        dest[pairs_sel[j].second] = vacated[j];
+      if (reorder_on && pairs_sel.size() > 1) {
+        std::vector<int> cold_first;
+        for (auto& [q, v] : pairs_sel) cold_first.push_back(v);
+        std::sort(cold_first.begin(), cold_first.end(),
+                  [&](int a, int b) {
+                    if (rem_uses[i][a] != rem_uses[i][b])
+                      return rem_uses[i][a] < rem_uses[i][b];
+                    if (next_use[i][a] != next_use[i][b])
+                      return next_use[i][a] > next_use[i][b];
+                    return a < b;
+                  });
+        std::vector<int> slots = vacated;
+        std::sort(slots.begin(), slots.end(), std::greater<int>());
+        for (size_t j = 0; j < cold_first.size(); ++j)
+          dest[cold_first[j]] = slots[j];
+      }
+      // phase 2 — three-way rotation landing each incoming qubit at a
+      // TOP local position (the all_to_all staging slot): q -> stage,
+      // the qubit at stage -> the victim's slot, victim -> its assigned
+      // device position — so the exchange's post-transpose vanishes
+      // (layout.py mirror).
+      std::vector<int> new_perm = perm;
+      for (size_t vi = 0; vi < pairs_sel.size(); ++vi) {
+        auto [q, victim] = pairs_sel[vi];
         int stage = local_top - 1 - static_cast<int>(vi);
         int x = -1;
         for (int l = 0; l < n; ++l)
           if (new_perm[l] == stage) { x = l; break; }
-        int dev_pos = new_perm[q], vic_pos = new_perm[victim];
+        int vic_pos = new_perm[victim];
         new_perm[q] = stage;
         if (x != victim) new_perm[x] = vic_pos;
-        new_perm[victim] = dev_pos;
-        ++vi;
+        new_perm[victim] = dest[victim];
       }
       Item r;
       r.kind = ITEM_RELAYOUT;
@@ -624,6 +709,29 @@ void qsched_set_cost_model(void* h, double alpha, double beta,
   s.alpha = alpha;
   s.beta = beta;
   s.chunk_bytes = chunk_bytes;
+  s.inter_alpha = -1.0;
+  s.inter_beta = -1.0;
+  s.host_bits = 0;
+  s.reorder = true;
+}
+
+// two-tier (multi-host) cost model: separate (alpha, beta) for
+// collectives crossing the host boundary (negative inter values fall
+// back to the intra tier), the number of inter-host device bits, and
+// the hot-qubit reordering switch
+void qsched_set_cost_model2(void* h, double alpha, double beta,
+                            double inter_alpha, double inter_beta,
+                            double chunk_bytes, int host_bits,
+                            int reorder) {
+  Sched& s = *static_cast<Sched*>(h);
+  s.cost_aware = true;
+  s.alpha = alpha;
+  s.beta = beta;
+  s.inter_alpha = inter_alpha;
+  s.inter_beta = inter_beta;
+  s.chunk_bytes = chunk_bytes;
+  s.host_bits = host_bits;
+  s.reorder = reorder != 0;
 }
 
 // run fusion + planning; returns 0 on success, nonzero on error

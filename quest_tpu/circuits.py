@@ -757,7 +757,8 @@ class Circuit:
                 lookahead: int = 32, pallas: Optional[object] = None,
                 supergate_k: int = 4, fusion: Optional[object] = None,
                 density: bool = False, comm_planner: Optional[bool] = None,
-                overlap: bool = False) -> "CompiledCircuit":
+                overlap: bool = False,
+                reorder: Optional[bool] = None) -> "CompiledCircuit":
         """Compile to one XLA program; ``lookahead`` is the layout planner's
         relayout-batching window (quest_tpu.parallel.layout); ``pallas``
         controls the fused-layer kernel pass (None=auto on TPU,
@@ -779,7 +780,15 @@ class Circuit:
         each relayout with the dense kernel it serves (slab-pipelined
         ``all_to_all``, :func:`quest_tpu.parallel.exchange.
         run_exchange_overlapped`) so collective and gate math can overlap
-        on backends with async collectives."""
+        on backends with async collectives.
+
+        ``reorder`` (default on; only meaningful when the mesh spans
+        controller processes — :mod:`quest_tpu.parallel.multihost`)
+        gates the hot-qubit-local reordering pass: collectives price at
+        the interconnect tier they cross and each relayout evicts its
+        coldest qubits to the inter-host device positions, keeping
+        upcoming work on the fast tier; ``False`` plans tier-priced but
+        tier-blind (the bench's reordering-off rows)."""
         if density:
             from . import validation as val
             for op in self.ops:
@@ -797,7 +806,8 @@ class Circuit:
         cc = CompiledCircuit(circ, env, donate=donate, fuse=fuse,
                              lookahead=lookahead, pallas=pallas,
                              supergate_k=supergate_k, fusion=fusion,
-                             comm_planner=comm_planner, overlap=overlap)
+                             comm_planner=comm_planner, overlap=overlap,
+                             reorder=reorder)
         cc.is_density = density
         return cc
 
@@ -1197,7 +1207,8 @@ def _collect_layers(ops: list, num_qubits: int,
 def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
               lookahead: int, fuse_flag: bool,
               diag_row_cap: int = -1, cost_model=None,
-              chunk_bytes: float = 0.0):
+              chunk_bytes: float = 0.0, host_bits: int = 0,
+              reorder: bool = True):
     """Peephole-fuse + layout-plan the op stream (which the gate-fusion
     pass of :mod:`quest_tpu.core.fusion` has usually already contracted).
 
@@ -1205,16 +1216,63 @@ def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
     scheduler.cc); falls back to the pure-Python passes (_peephole_fused +
     quest_tpu.parallel.plan_layout). Both produce identical schedules.
     ``cost_model``/``chunk_bytes`` switch both planners to the
-    communication-aware mode (quest_tpu/parallel/layout.py module docs).
+    communication-aware mode (quest_tpu/parallel/layout.py module docs);
+    ``host_bits``/``reorder`` the two-tier multi-host mode (top
+    ``host_bits`` device positions priced at the inter-host tier, evicted
+    qubits re-paired hot-intra/cold-inter).
+
+    The reordering pass is a greedy eviction re-pairing that usually —
+    not always — lowers the inter-host traffic (composition interactions
+    can flip its sign on adversarial op streams), so ``reorder=True`` on
+    a multi-host mesh plans BOTH variants and keeps the one with the
+    lower modeled comm seconds (ties: fewer inter-host bytes, then fewer
+    launches). Selection sits ABOVE the native/Python planner pair, so
+    either backend yields the same chosen plan and bit-for-bit parity is
+    preserved per variant. Single-host (``host_bits == 0``) plans are
+    untouched: one pass, no selection.
 
     Returns (ops_table, LayoutPlan).
     """
+    if cost_model is not None and host_bits > 0 and reorder:
+        from .parallel.layout import reorder_plan_score
+
+        def score(plan):
+            return reorder_plan_score(plan, chunk_bytes, cost_model,
+                                      host_bits)
+
+        ops_on, plan_on = _schedule_once(
+            recorded, num_qubits, shard_bits, lookahead, fuse_flag,
+            diag_row_cap, cost_model, chunk_bytes, host_bits, True)
+        ops_off, plan_off = _schedule_once(
+            recorded, num_qubits, shard_bits, lookahead, fuse_flag,
+            diag_row_cap, cost_model, chunk_bytes, host_bits, False)
+        if score(plan_off) < score(plan_on):
+            return ops_off, plan_off
+        return ops_on, plan_on
+    return _schedule_once(recorded, num_qubits, shard_bits, lookahead,
+                          fuse_flag, diag_row_cap, cost_model,
+                          chunk_bytes, host_bits, reorder)
+
+
+def _schedule_once(recorded: Sequence[_Op], num_qubits: int,
+                   shard_bits: int, lookahead: int, fuse_flag: bool,
+                   diag_row_cap: int = -1, cost_model=None,
+                   chunk_bytes: float = 0.0, host_bits: int = 0,
+                   reorder: bool = True):
+    """One planner pass at a fixed ``reorder`` flag (no best-of-both
+    selection; :func:`_schedule` is the public entry)."""
     from .parallel.layout import LayoutPlan
 
+    # only host_bits > 0 needs the two-tier native ABI: at host count 1
+    # the inter fields (now always present on DEFAULT_COMM_MODEL) are
+    # never consulted, so a pre-pod-scale scheduler library still plans
+    # bit-identically and must not be bypassed
+    two_tier = cost_model is not None and host_bits > 0
     try:
         from . import native as nat
         use_native = nat.available() and (
-            cost_model is None or nat.supports_cost_model())
+            cost_model is None or nat.supports_cost_model()) and (
+            not two_tier or nat.supports_two_tier())
     except Exception:
         use_native = False
 
@@ -1231,8 +1289,13 @@ def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
             sch.add_op(kind, op.targets, op.ctrl_mask, op.flip_mask,
                        data, i)
         if cost_model is not None:
-            sch.set_cost_model(cost_model.alpha_s,
-                               cost_model.beta_s_per_byte, chunk_bytes)
+            sch.set_cost_model(
+                cost_model.alpha_s, cost_model.beta_s_per_byte,
+                chunk_bytes,
+                inter_alpha_s=getattr(cost_model, "inter_alpha_s", None),
+                inter_beta_s_per_byte=getattr(
+                    cost_model, "inter_beta_s_per_byte", None),
+                host_bits=host_bits, reorder=reorder)
         sch.compile(num_qubits, shard_bits, lookahead, fuse_flag,
                     diag_row_cap)
         ops_table: list[_Op] = []
@@ -1256,7 +1319,8 @@ def _schedule(recorded: Sequence[_Op], num_qubits: int, shard_bits: int,
     return ops_table, plan_layout(ops_table, num_qubits, shard_bits,
                                   lookahead=lookahead,
                                   cost_model=cost_model,
-                                  chunk_bytes=chunk_bytes)
+                                  chunk_bytes=chunk_bytes,
+                                  host_bits=host_bits, reorder=reorder)
 
 
 class _BoundedExecutableCache:
@@ -1320,7 +1384,8 @@ class CompiledCircuit:
                  lookahead: int = 32, pallas: Optional[object] = None,
                  supergate_k: int = 4, fusion: Optional[object] = None,
                  comm_planner: Optional[bool] = None,
-                 overlap: bool = False):
+                 overlap: bool = False,
+                 reorder: Optional[bool] = None):
         self.circuit = circuit
         self.env = env
         self.num_qubits = circuit.num_qubits
@@ -1330,7 +1395,7 @@ class CompiledCircuit:
         self._compile_opts = {"fuse": fuse, "lookahead": lookahead,
                               "supergate_k": supergate_k, "fusion": fusion,
                               "comm_planner": comm_planner,
-                              "overlap": overlap}
+                              "overlap": overlap, "reorder": reorder}
         n = circuit.num_qubits
         if (1 << n) < env.num_devices:   # register smaller than the mesh
             sharding = None
@@ -1368,6 +1433,23 @@ class CompiledCircuit:
         self._chunk_bytes = chunk_bytes
         self._cost_model = cost_model
 
+        # multi-host geometry (parallel/multihost.py): the top host_bits
+        # of the shard positions cross the process (DCN) boundary, so
+        # the planner prices those collectives at the cost model's inter
+        # tier and the reordering pass keeps hot qubits off them.
+        # host_bits == 0 (single process, the common case) makes both
+        # mechanisms inert — plans stay bit-for-bit the single-host
+        # plans.
+        from .parallel.multihost import host_topology
+        topo = host_topology(getattr(env, "mesh", None)) if shard_bits \
+            else None
+        host_bits = min(topo.host_bits, shard_bits) if topo else 0
+        if not comm_on:
+            host_bits = 0
+        self._host_bits = host_bits
+        self._num_hosts = topo.num_hosts if topo else 1
+        self._reorder = True if reorder is None else bool(reorder)
+
         # gate-fusion pass (core/fusion.py): record -> FUSE -> plan ->
         # lower. Runs of adjacent gates contract into single dense
         # kernels / folded diagonal factors BEFORE layout planning, so
@@ -1390,10 +1472,14 @@ class CompiledCircuit:
                 return is_swap_op
             return lambda op: is_swap_op(op) or base(op)
 
-        def build_pipeline(comm: bool):
+        def build_pipeline(comm: bool, reorder_on: Optional[bool] = None):
             """fuse -> schedule -> supergate -> replan, under one planner
-            mode. Returns (ops_table, plan, fusion_stats)."""
+            mode (``reorder_on`` overrides the compile's reordering flag
+            — the reorder-off baseline of the inter-host accounting).
+            Returns (ops_table, plan, fusion_stats)."""
             cm = cost_model if comm else None
+            hb = host_bits if comm else 0
+            ro = self._reorder if reorder_on is None else reorder_on
             recorded = list(circuit.ops)
             fstats = None
             k_fuse = resolve_fusion_k(fusion, n - shard_bits)
@@ -1407,7 +1493,8 @@ class CompiledCircuit:
             ops, plan = _schedule(recorded, n, shard_bits,
                                   lookahead, fuse,
                                   diag_row_cap=3 if use_layers else -1,
-                                  cost_model=cm, chunk_bytes=chunk_bytes)
+                                  cost_model=cm, chunk_bytes=chunk_bytes,
+                                  host_bits=hb, reorder=ro)
 
             # super-gate grouping: consecutive static gates collapse into
             # one k-qubit pass. Layer-eligible gates are fenced off
@@ -1431,7 +1518,20 @@ class CompiledCircuit:
             if replan:
                 from .parallel import plan_layout
                 plan = plan_layout(ops, n, shard_bits, lookahead=lookahead,
-                                   cost_model=cm, chunk_bytes=chunk_bytes)
+                                   cost_model=cm, chunk_bytes=chunk_bytes,
+                                   host_bits=hb, reorder=ro)
+                if cm is not None and hb > 0 and ro:
+                    # the replan must uphold _schedule's best-of-both
+                    # selection: the greedy re-pairing can lose on the
+                    # supergate-contracted stream too
+                    from .parallel.layout import reorder_plan_score
+                    alt = plan_layout(ops, n, shard_bits,
+                                      lookahead=lookahead, cost_model=cm,
+                                      chunk_bytes=chunk_bytes,
+                                      host_bits=hb, reorder=False)
+                    if reorder_plan_score(alt, chunk_bytes, cm, hb) < \
+                            reorder_plan_score(plan, chunk_bytes, cm, hb):
+                        plan = alt
             return ops, plan, fstats
 
         from .parallel import apply_relayout
@@ -1444,6 +1544,9 @@ class CompiledCircuit:
         # closure is retained for that deferred replan.
         self._comm_bytes_planned = None
         self._comm_bytes_saved = 0.0
+        self._comm_inter_planned = 0.0
+        self._comm_inter_saved = 0.0
+        self._inter_launches = 0
         self._baseline_pipeline = build_pipeline if comm_on else None
 
         if use_layers:
@@ -1807,21 +1910,45 @@ class CompiledCircuit:
                 # after the first call)
                 planned = 0.0
                 saved = 0.0
+                inter_planned = 0.0
+                inter_saved = 0.0
+                inter_launches = 0
                 if self.plan.shard_bits:
                     from .parallel.layout import plan_comm_stats
                     from .profiling import DEFAULT_COMM_MODEL
                     model = self._cost_model or DEFAULT_COMM_MODEL
-                    planned = plan_comm_stats(
+                    hb = self._host_bits
+                    tot = plan_comm_stats(
                         self.plan, self._chunk_bytes, model,
-                        self.env.num_devices)["bytes"]
+                        self.env.num_devices, host_bits=hb)
+                    planned = tot["bytes"]
+                    inter_planned = tot["inter_bytes"]
+                    inter_launches = tot["inter_launches"]
                     if self._baseline_pipeline is not None:
                         _, base_plan, _ = self._baseline_pipeline(False)
                         base = plan_comm_stats(base_plan,
                                                self._chunk_bytes, model,
-                                               self.env.num_devices)
+                                               self.env.num_devices,
+                                               host_bits=hb)
                         saved = max(0.0, base["bytes"] - planned)
+                    if (hb > 0 and self._reorder
+                            and self._baseline_pipeline is not None):
+                        # the reordering pass's primary observable:
+                        # inter-host bytes vs the same comm-planned
+                        # pipeline with reordering off
+                        _, roff_plan, _ = self._baseline_pipeline(
+                            True, reorder_on=False)
+                        roff = plan_comm_stats(roff_plan,
+                                               self._chunk_bytes, model,
+                                               self.env.num_devices,
+                                               host_bits=hb)
+                        inter_saved = max(
+                            0.0, roff["inter_bytes"] - inter_planned)
                 self._comm_bytes_planned = planned
                 self._comm_bytes_saved = saved
+                self._comm_inter_planned = inter_planned
+                self._comm_inter_saved = inter_saved
+                self._inter_launches = inter_launches
             bs = dict(self._batch_stats or {})
             cache_evictions = self._batched_cache.evictions
             cache_size = len(self._batched_cache)
@@ -1838,6 +1965,10 @@ class CompiledCircuit:
             collectives_fused=self.plan.collectives_fused,
             comm_bytes_planned=self._comm_bytes_planned,
             comm_bytes_saved=self._comm_bytes_saved,
+            num_hosts=self._num_hosts,
+            inter_host_collectives=self._inter_launches,
+            comm_bytes_inter_planned=self._comm_inter_planned,
+            comm_bytes_inter_saved=self._comm_inter_saved,
             batch_size=bs.get("batch_size", 0),
             host_syncs_avoided=bs.get("host_syncs_avoided", 0),
             batch_sharding_mode=bs.get("batch_sharding_mode", "none"),
@@ -2018,7 +2149,8 @@ class CompiledCircuit:
         return choose_batch_sharding(
             self.num_qubits, batch, self.env.num_devices,
             np.dtype(self.env.precision.real_dtype).itemsize,
-            self.plan.num_relayouts, cost_model=self._cost_model)
+            self.plan.num_relayouts, cost_model=self._cost_model,
+            host_bits=self._host_bits)
 
     def _batch_constraint(self, mode: str):
         """Amplitude-axis sharding constraint for the in-engine

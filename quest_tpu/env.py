@@ -172,19 +172,23 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     """Join a multi-controller (multi-host) run BEFORE creating the env —
     the analogue of ``MPI_Init`` (``QuEST_cpu_distributed.c:128-157``).
 
-    Thin wrapper over ``jax.distributed.initialize``: on TPU pods all
-    arguments auto-detect from the runtime; on CPU/GPU clusters pass the
+    Thin wrapper over :func:`quest_tpu.parallel.multihost.bootstrap`
+    (``jax.distributed.initialize``): on TPU pods all arguments
+    auto-detect from the runtime; on CPU/GPU clusters pass the
     coordinator endpoint and process coordinates. After this,
     ``jax.devices()`` spans every host's chips, ``create_quest_env()``
     meshes over all of them, and the amplitude axis shards across the pod
     with XLA collectives riding ICI/DCN — no further code changes; the
-    same SPMD program runs on every process. Exercised end-to-end by
+    same SPMD program runs on every process, and the layout planner
+    prices each collective by the interconnect tier it crosses
+    (``parallel/multihost.py`` + the two-tier
+    :class:`~quest_tpu.profiling.CommCostModel`). Exercised end-to-end by
     ``tests/test_multihost.py``: 2- and 4-process coordinator-connected
     CPU runs building one global mesh (sharded circuit, psum reductions,
     broadcast seed agreement, allgathered reads)."""
-    jax.distributed.initialize(coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    from .parallel.multihost import bootstrap
+    bootstrap(coordinator_address, num_processes=num_processes,
+              process_id=process_id)
 
 
 def destroy_quest_env(env: QuESTEnv) -> None:

@@ -121,6 +121,13 @@ def load() -> Optional[ctypes.CDLL]:
             fn = getattr(lib, name)
             fn.restype = ctypes.c_int
             fn.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "qsched_set_cost_model2"):
+        # two-tier multi-host ABI (absent from pre-pod-scale builds)
+        lib.qsched_set_cost_model2.restype = None
+        lib.qsched_set_cost_model2.argtypes = [
+            ctypes.c_void_p, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int, ctypes.c_int]
     lib.qsched_item_info.restype = ctypes.c_int
     lib.qsched_item_info.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
@@ -145,6 +152,13 @@ def supports_cost_model() -> bool:
     communication-aware planner ABI (``qsched_set_cost_model``)."""
     lib = load()
     return lib is not None and hasattr(lib, "qsched_set_cost_model")
+
+
+def supports_two_tier() -> bool:
+    """True when the loaded scheduler library exposes the two-tier
+    multi-host planner ABI (``qsched_set_cost_model2``)."""
+    lib = load()
+    return lib is not None and hasattr(lib, "qsched_set_cost_model2")
 
 
 class NativeScheduler:
@@ -181,10 +195,29 @@ class NativeScheduler:
             source_index)
 
     def set_cost_model(self, alpha_s: float, beta_s_per_byte: float,
-                       chunk_bytes: float) -> None:
+                       chunk_bytes: float,
+                       inter_alpha_s=None, inter_beta_s_per_byte=None,
+                       host_bits: int = 0, reorder: bool = True) -> None:
         """Enable the communication-aware planner (call before
         :meth:`compile`); parameters mirror
-        :class:`quest_tpu.profiling.CommCostModel`."""
+        :class:`quest_tpu.profiling.CommCostModel`. ``host_bits > 0``
+        switches on the two-tier multi-host mode; ``reorder`` gates the
+        hot-qubit eviction re-pairing there. At ``host_bits == 0`` the
+        inter values are never consulted, so the single-tier ABI is used
+        and pre-pod-scale libraries stay compatible."""
+        two_tier = host_bits > 0
+        if two_tier:
+            if not hasattr(self._lib, "qsched_set_cost_model2"):
+                raise RuntimeError(
+                    "scheduler library predates the two-tier multi-host "
+                    "ABI; rebuild native/src/scheduler.cc")
+            self._lib.qsched_set_cost_model2(
+                self._h, float(alpha_s), float(beta_s_per_byte),
+                float(-1.0 if inter_alpha_s is None else inter_alpha_s),
+                float(-1.0 if inter_beta_s_per_byte is None
+                      else inter_beta_s_per_byte),
+                float(chunk_bytes), int(host_bits), int(bool(reorder)))
+            return
         if not hasattr(self._lib, "qsched_set_cost_model"):
             raise RuntimeError("scheduler library predates the cost-model "
                                "ABI; rebuild native/src/scheduler.cc")

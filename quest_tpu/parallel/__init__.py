@@ -12,5 +12,7 @@ all-to-all over ICI) instead of per-gate swap storms. See
 """
 
 from .layout import LayoutPlan, plan_layout, apply_relayout
+from .multihost import HostTopology, host_topology
 
-__all__ = ["LayoutPlan", "plan_layout", "apply_relayout"]
+__all__ = ["LayoutPlan", "plan_layout", "apply_relayout",
+           "HostTopology", "host_topology"]

@@ -58,6 +58,28 @@ standalone relayout costs at least ``alpha + chunk/2`` — marginal prefetch
 is monotonically cheaper for every k, so the Belady window rule is already
 the cost model's optimum and is kept bit-for-bit identical to the
 count-based mode.
+
+**Multi-host mode** (``host_bits > 0``): the mesh spans controller
+processes and its top ``host_bits`` device positions cross the host
+boundary (:mod:`quest_tpu.parallel.multihost`), so every pricing
+decision above uses the :class:`~quest_tpu.profiling.CommCostModel`
+tier the collective actually rides — a relayout whose exchanged bits
+include an inter-host position is priced (and accounted) at the DCN
+tier. On top of the pricing, the **hot-qubit reordering pass**
+(``reorder=True``; the mpiQulacs trick, arXiv:2203.16044) re-pairs each
+relayout's evicted qubits with the device slots it vacates: the COLDEST
+victim — fewest upcoming paired uses, then farthest next use — takes
+the most-inter-host slot, the hottest stays on an intra-host bit. The
+re-pairing moves zero extra bytes (the exchanged bit set is unchanged;
+victims land on vacated slots either way) but keeps the qubits that
+will be pulled back soonest off the slow tier, so future exchanges stay
+intra-host — cross-host relayouts become rare and batched. The
+re-pairing is greedy (composition interactions can flip its sign on
+adversarial op streams), so the compile path (``circuits._schedule``)
+plans both variants on a multi-host mesh and keeps the cheaper by
+modeled comm seconds — reordering never ships bytes it does not pay
+back. With ``host_bits == 0`` both mechanisms are inert and plans are
+bit-for-bit the single-host plans.
 """
 
 from __future__ import annotations
@@ -70,7 +92,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["LayoutPlan", "plan_layout", "apply_relayout", "is_swap_op",
-           "plan_comm_stats", "relayout_comm", "choose_batch_sharding"]
+           "plan_comm_stats", "relayout_comm", "relayout_comm_tiered",
+           "choose_batch_sharding"]
 
 _SWAP_MAT = np.array([[1, 0, 0, 0], [0, 0, 1, 0],
                       [0, 1, 0, 0], [0, 0, 0, 1]], dtype=np.complex128)
@@ -141,7 +164,8 @@ def _phys_diag_order(op_targets_desc_logical: tuple[int, ...],
 
 def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
                 lookahead: int = 32, cost_model=None,
-                chunk_bytes: float = 0.0) -> LayoutPlan:
+                chunk_bytes: float = 0.0, host_bits: int = 0,
+                reorder: bool = True) -> LayoutPlan:
     """Schedule ``ops`` (quest_tpu.circuits._Op sequence) over a mesh that
     shards the top ``shard_bits`` physical positions.
 
@@ -162,10 +186,18 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
     (the per-device chunk payload; defaults to 16 B/amplitude when not
     given). ``cost_model=None`` reproduces the count-based planner
     bit-for-bit.
+
+    ``host_bits`` marks the top device positions as inter-host (two-tier
+    pricing; see module docstring) and ``reorder`` enables the
+    hot-qubit-local eviction re-pairing on that mesh shape — both inert
+    at ``host_bits=0``.
     """
     n = num_qubits
     local_top = n - shard_bits  # phys positions >= local_top are sharded
     comm_aware = cost_model is not None and shard_bits > 0
+    host_bits = max(0, min(int(host_bits), shard_bits)) if comm_aware else 0
+    inter_lo = n - host_bits          # positions >= inter_lo cross hosts
+    reorder_on = comm_aware and host_bits > 0 and reorder
     if comm_aware and chunk_bytes <= 0.0:
         chunk_bytes = 16.0 * (1 << local_top)
     if shard_bits == 0:
@@ -204,6 +236,17 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
         if not absorbable[i]:
             for q in used_qubits(ops[i]):
                 next_use[i, q] = i
+
+    # upcoming-use counts (the reordering pass's hotness metric,
+    # mpiQulacs §IV): rem_uses[i, q] = paired uses of q at ops >= i
+    rem_uses = None
+    if reorder_on:
+        rem_uses = np.zeros((len(ops) + 1, n), dtype=np.int64)
+        for i in range(len(ops) - 1, -1, -1):
+            rem_uses[i] = rem_uses[i + 1]
+            if not absorbable[i]:
+                for q in used_qubits(ops[i]):
+                    rem_uses[i, q] += 1
 
     perm = np.arange(n)  # perm[logical] = physical
     items: list = []
@@ -249,8 +292,13 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
                         break
                 if not sole:
                     break
-            if (sole and cost_model.ppermute_seconds(chunk_bytes)
-                    <= 2.0 * cost_model.all_to_all_seconds(chunk_bytes, 1)):
+            # both candidates ride the same device bit, so both price at
+            # that bit's tier (inter when the position crosses hosts)
+            x_inter = host_bits > 0 and int(perm[t]) >= inter_lo
+            if (sole and cost_model.ppermute_seconds(chunk_bytes,
+                                                     inter=x_inter)
+                    <= 2.0 * cost_model.all_to_all_seconds(
+                        chunk_bytes, 1, inter=x_inter)):
                 cm, fm = _phys_masks_of(op, perm)
                 items.append(("xshard", i, (int(perm[t]),), cm, fm, None))
                 n_xshard += 1
@@ -289,28 +337,44 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
             locals_.sort(reverse=True)
             need_set = set(need_now)
             new_perm = perm.copy()
-            vi = 0
+            pairs_sel = []       # (incoming qubit, victim) in stage order
             for q, nu_q in [(q, -1) for q in need_now] + window_hot:
-                if vi >= len(locals_):
+                if len(pairs_sel) >= len(locals_):
                     break
-                nu_victim, victim = locals_[vi]
+                nu_victim, victim = locals_[len(pairs_sel)]
                 # window prefetches must not evict a sooner-used qubit
                 if q not in need_set and nu_q >= nu_victim:
                     continue
+                pairs_sel.append((q, victim))
+            # device-slot assignment for the evicted victims: by default
+            # victim i takes the slot its incoming qubit vacates; the
+            # hot-qubit reordering pass re-pairs so the COLDEST victim
+            # (fewest remaining paired uses, then farthest next use)
+            # takes the most-inter-host slot — zero extra bytes, and the
+            # soonest-returning qubits stay off the DCN tier
+            vacated = [int(perm[q]) for q, _ in pairs_sel]
+            dest = {v: s for (_, v), s in zip(pairs_sel, vacated)}
+            if reorder_on and len(pairs_sel) > 1:
+                cold_first = sorted(
+                    (v for _, v in pairs_sel),
+                    key=lambda v: (int(rem_uses[i, v]),
+                                   -int(next_use[i, v]), v))
+                dest = dict(zip(cold_first, sorted(vacated, reverse=True)))
+            for vi, (q, victim) in enumerate(pairs_sel):
                 # three-way rotation landing the incoming qubit at a TOP
                 # local position (the all_to_all staging slot,
                 # parallel/exchange.py): q -> stage, the qubit at stage ->
-                # the victim's slot, victim -> q's device position. Landing
-                # at the staging slot makes the exchange's post-transpose
-                # vanish — one local pass per relayout instead of two.
+                # the victim's slot, victim -> its assigned device
+                # position. Landing at the staging slot makes the
+                # exchange's post-transpose vanish — one local pass per
+                # relayout instead of two.
                 stage = local_top - 1 - vi
                 x = int(np.nonzero(new_perm == stage)[0][0])
-                dev_pos, vic_pos = new_perm[q], new_perm[victim]
+                vic_pos = new_perm[victim]
                 new_perm[q] = stage
                 if x != victim:
                     new_perm[x] = vic_pos
-                new_perm[victim] = dev_pos
-                vi += 1
+                new_perm[victim] = dest[victim]
             items.append(("relayout", perm.copy(), new_perm.copy()))
             n_relayouts += 1
             perm = new_perm
@@ -323,7 +387,8 @@ def plan_layout(ops: Sequence, num_qubits: int, shard_bits: int,
     n_fused = 0
     if comm_aware:
         items, n_merged, n_dropped = _compose_relayouts(
-            items, n, local_top, cost_model, chunk_bytes)
+            items, n, local_top, cost_model, chunk_bytes,
+            host_bits=host_bits)
         n_relayouts -= n_dropped
         n_fused = n_merged
 
@@ -389,34 +454,80 @@ def _relayout_sigma(perm_before, perm_after, n: int) -> np.ndarray:
     return sigma
 
 
-def relayout_comm(sigma: np.ndarray, local_top: int,
-                  chunk_bytes: float, cost_model) -> tuple[float, float, int]:
-    """(seconds, per-device bytes, collective launches) for one relayout
-    realizing physical permutation ``sigma``, under the closed-form
-    choreography of :func:`quest_tpu.parallel.exchange.plan_exchange`:
-    one ``all_to_all`` over the ``k`` exchanged bits plus a whole-chunk
-    ``ppermute`` iff a residual device-bit permutation remains (a staying
-    device bit moves, or an exchanged bit cannot land in its destined
-    slot — ``sigma(sigma(p))`` still a device bit)."""
+def relayout_comm_tiered(sigma: np.ndarray, local_top: int,
+                         chunk_bytes: float, cost_model,
+                         host_bits: int = 0) -> dict:
+    """Full two-tier accounting for one relayout realizing physical
+    permutation ``sigma``, under the closed-form choreography of
+    :func:`quest_tpu.parallel.exchange.plan_exchange`: one ``all_to_all``
+    over the ``k`` exchanged bits plus a whole-chunk ``ppermute`` iff a
+    residual device-bit permutation remains (a staying device bit moves,
+    or an exchanged bit cannot land in its destined slot —
+    ``sigma(sigma(p))`` still a device bit).
+
+    A collective crosses hosts — inter tier — when it involves any of
+    the top ``host_bits`` device positions: the ``all_to_all`` iff an
+    exchanged device slot is inter-host; the residual ``ppermute``
+    (conservatively) iff ANY inter-host slot participates in the
+    relayout at all. Returns ``{"seconds", "bytes", "inter_bytes",
+    "launches", "inter_launches"}`` (per-device bytes)."""
     n = len(sigma)
     lt = local_top
+    inter_lo = n - max(0, min(host_bits, n - lt))
     A = [p for p in range(lt) if sigma[p] >= lt]
     k = len(A)
+    xbits = [p for p in range(lt, n) if sigma[p] < lt]
     residual = any(sigma[d] != d and sigma[d] >= lt
                    for d in range(lt, n) if sigma[d] >= lt) \
         or any(sigma[sigma[p]] >= lt for p in A)
-    seconds = 0.0
-    nbytes = 0.0
-    launches = 0
+    a2a_inter = host_bits > 0 and any(p >= inter_lo for p in xbits)
+    res_inter = host_bits > 0 and any(
+        sigma[p] != p for p in range(inter_lo, n))
+    seconds = nbytes = inter_bytes = 0.0
+    launches = inter_launches = 0
     if k:
-        seconds += cost_model.all_to_all_seconds(chunk_bytes, k)
-        nbytes += cost_model.all_to_all_bytes(chunk_bytes, k)
+        seconds += cost_model.all_to_all_seconds(chunk_bytes, k,
+                                                 inter=a2a_inter)
+        b = cost_model.all_to_all_bytes(chunk_bytes, k)
+        nbytes += b
         launches += 1
+        if a2a_inter:
+            inter_bytes += b
+            inter_launches += 1
     if residual:
-        seconds += cost_model.ppermute_seconds(chunk_bytes)
-        nbytes += cost_model.ppermute_bytes(chunk_bytes)
+        seconds += cost_model.ppermute_seconds(chunk_bytes,
+                                               inter=res_inter)
+        b = cost_model.ppermute_bytes(chunk_bytes)
+        nbytes += b
         launches += 1
-    return seconds, nbytes, launches
+        if res_inter:
+            inter_bytes += b
+            inter_launches += 1
+    return {"seconds": seconds, "bytes": nbytes,
+            "inter_bytes": inter_bytes, "launches": launches,
+            "inter_launches": inter_launches}
+
+
+def reorder_plan_score(plan, chunk_bytes: float, cost_model,
+                       host_bits: int) -> tuple:
+    """The best-of-both reorder selection's ordering key for one plan:
+    (modeled comm seconds, inter-host bytes, collective launches) —
+    shared by ``circuits._schedule`` and the post-supergate replan so
+    the 'reorder=True never models slower' invariant holds on every
+    path."""
+    s = plan_comm_stats(plan, chunk_bytes, cost_model,
+                        host_bits=host_bits)
+    return (s["seconds"], s["inter_bytes"], s["launches"])
+
+
+def relayout_comm(sigma: np.ndarray, local_top: int,
+                  chunk_bytes: float, cost_model,
+                  host_bits: int = 0) -> tuple[float, float, int]:
+    """(seconds, per-device bytes, collective launches) for one relayout
+    — the single-total view of :func:`relayout_comm_tiered`."""
+    t = relayout_comm_tiered(sigma, local_top, chunk_bytes, cost_model,
+                             host_bits=host_bits)
+    return t["seconds"], t["bytes"], t["launches"]
 
 
 def _remap_mask(mask: int, delta: np.ndarray) -> int:
@@ -447,13 +558,17 @@ def _remap_item(item, delta: np.ndarray):
 
 
 def _compose_relayouts(items: list, n: int, local_top: int,
-                       cost_model, chunk_bytes: float):
+                       cost_model, chunk_bytes: float,
+                       host_bits: int = 0):
     """Merge adjacent relayouts: for each consecutive pair (R1, R2), R2's
     permutation ``delta`` is applied early (composed into R1) when every
     item between stays executable under ``delta`` — dense targets stay
     chunk-local, pair-exchange positions stay device bits, diagonals run
     anywhere — and the composed collective is modeled no slower than the
-    pair. A composition that cancels to the identity drops the relayout
+    pair (each leg priced at its interconnect tier when ``host_bits``
+    marks inter-host positions: merging two intra exchanges into one
+    host-crossing exchange must pay its way at DCN prices). A
+    composition that cancels to the identity drops the relayout
     entirely. Returns ``(items, merges, relayouts_removed)``."""
     merges = 0
     removed = 0
@@ -486,9 +601,12 @@ def _compose_relayouts(items: list, n: int, local_top: int,
                                  dtype=np.int64)
             s1 = _relayout_sigma(before, after, n)
             sc = _relayout_sigma(before, new_after, n)
-            c1 = relayout_comm(s1, local_top, chunk_bytes, cost_model)[0]
-            c2 = relayout_comm(delta, local_top, chunk_bytes, cost_model)[0]
-            cc = relayout_comm(sc, local_top, chunk_bytes, cost_model)[0]
+            c1 = relayout_comm(s1, local_top, chunk_bytes, cost_model,
+                               host_bits)[0]
+            c2 = relayout_comm(delta, local_top, chunk_bytes, cost_model,
+                               host_bits)[0]
+            cc = relayout_comm(sc, local_top, chunk_bytes, cost_model,
+                               host_bits)[0]
             if cc > c1 + c2:
                 continue
             mid = [_remap_item(items[j], delta) for j in range(a + 1, b)]
@@ -506,29 +624,46 @@ def _compose_relayouts(items: list, n: int, local_top: int,
 
 
 def plan_comm_stats(plan: LayoutPlan, chunk_bytes: float, cost_model,
-                    num_devices: Optional[int] = None) -> dict:
+                    num_devices: Optional[int] = None,
+                    host_bits: int = 0) -> dict:
     """Modeled communication totals for a plan: per-execution collective
     bytes (mesh-total when ``num_devices`` given, else per-device),
-    modeled seconds, and collective launch count."""
+    modeled seconds, collective launch count, and — under a two-tier
+    mesh (``host_bits > 0``) — the inter-host share of both bytes and
+    launches (the reordering pass's primary observable)."""
     if plan.shard_bits == 0:
-        return {"bytes": 0.0, "seconds": 0.0, "launches": 0}
-    lt = plan.num_qubits - plan.shard_bits
-    total_b = total_s = 0.0
-    launches = 0
+        return {"bytes": 0.0, "seconds": 0.0, "launches": 0,
+                "inter_bytes": 0.0, "inter_launches": 0}
+    n = plan.num_qubits
+    lt = n - plan.shard_bits
+    host_bits = max(0, min(host_bits, plan.shard_bits))
+    inter_lo = n - host_bits
+    total_b = total_s = inter_b = 0.0
+    launches = inter_launches = 0
     for it in plan.items:
         if it[0] == "relayout":
-            sigma = _relayout_sigma(it[1], it[2], plan.num_qubits)
-            s, b, l = relayout_comm(sigma, lt, chunk_bytes, cost_model)
-            total_s += s
-            total_b += b
-            launches += l
+            sigma = _relayout_sigma(it[1], it[2], n)
+            t = relayout_comm_tiered(sigma, lt, chunk_bytes, cost_model,
+                                     host_bits=host_bits)
+            total_s += t["seconds"]
+            total_b += t["bytes"]
+            inter_b += t["inter_bytes"]
+            launches += t["launches"]
+            inter_launches += t["inter_launches"]
         elif it[0] == "xshard":
-            total_s += cost_model.ppermute_seconds(chunk_bytes)
-            total_b += cost_model.ppermute_bytes(chunk_bytes)
+            x_inter = host_bits > 0 and int(it[2][0]) >= inter_lo
+            total_s += cost_model.ppermute_seconds(chunk_bytes,
+                                                   inter=x_inter)
+            b = cost_model.ppermute_bytes(chunk_bytes)
+            total_b += b
             launches += 1
+            if x_inter:
+                inter_b += b
+                inter_launches += 1
     scale = num_devices if num_devices else 1
     return {"bytes": total_b * scale, "seconds": total_s,
-            "launches": launches}
+            "launches": launches, "inter_bytes": inter_b * scale,
+            "inter_launches": inter_launches}
 
 
 # Per-device working-set budget for the batch-parallel mode's feasibility
@@ -541,7 +676,8 @@ DEFAULT_BATCH_MEM_BYTES = 2 << 30
 def choose_batch_sharding(num_qubits: int, batch: int, num_devices: int,
                           itemsize: int, num_relayouts: int,
                           cost_model=None,
-                          mem_limit_bytes: Optional[int] = None) -> dict:
+                          mem_limit_bytes: Optional[int] = None,
+                          host_bits: int = 0) -> dict:
     """Pick the batched ensemble engine's sharding axis on a mesh.
 
     An ensemble of ``batch`` independent states can shard the BATCH axis
@@ -564,6 +700,12 @@ def choose_batch_sharding(num_qubits: int, batch: int, num_devices: int,
     and the cost model quantifies what crossing it costs (the returned
     ``amp_comm_seconds``; docs/tpu.md "Batched execution & observables").
 
+    ``host_bits > 0`` (the mesh spans controller processes): the amp
+    mode's relayout all-to-alls span the whole mesh — host boundary
+    included — so they price at the cost model's INTER tier; the batch
+    mode keeps whole states per device and stays collective-free even
+    when the batch axis spans processes.
+
     Returns ``{"mode": "none"|"batch"|"amp", "amp_comm_seconds": float,
     "per_device_bytes": float}``.
     """
@@ -583,7 +725,8 @@ def choose_batch_sharding(num_qubits: int, batch: int, num_devices: int,
     batch_mode_bytes = per_dev_batch * 2.0 * state_bytes
     amp_comm = (batch * num_relayouts
                 * cost_model.all_to_all_seconds(state_bytes / num_devices,
-                                                shard_bits))
+                                                shard_bits,
+                                                inter=host_bits > 0))
     if batch_mode_bytes <= mem_limit_bytes:
         return {"mode": "batch", "amp_comm_seconds": amp_comm,
                 "per_device_bytes": batch_mode_bytes}

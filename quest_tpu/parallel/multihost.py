@@ -1,0 +1,172 @@
+"""Multi-host (pod-scale) execution: bootstrap + host topology.
+
+One process per host is the JAX multi-controller model (the analogue of
+the reference's one-MPI-rank-per-node layout,
+``QuEST_cpu_distributed.c:128-157``): after
+:func:`bootstrap` every process sees the GLOBAL device list, a
+``create_quest_env`` mesh spans the pod, and the same SPMD program runs
+everywhere. What changes for the *planner* is the interconnect: device
+pairs on one host talk over ICI/shared memory, pairs on different hosts
+over DCN — one to two orders of magnitude apart in both latency and
+bandwidth (mpiQulacs, arXiv:2203.16044 §IV; Lightning-MPI,
+arXiv:2508.13615). This module derives the *host topology* of a mesh —
+which amplitude-sharding device bits cross the host boundary — so the
+layout planner (:mod:`quest_tpu.parallel.layout`) can price every
+collective at the tier it actually rides and keep hot qubits off the
+slow tier.
+
+Bit geometry: with ``D = 2^s`` mesh devices ordered process-by-process
+(``jax.devices()`` sorts by process index) and ``H = 2^h`` hosts of
+``D/H`` devices each, a device's host index is its device index's top
+``h`` bits. Device bit ``j`` holds physical qubit position
+``(n-s)+j`` (``parallel/exchange.py`` module docs), so the top ``h``
+physical positions — ``n-h .. n-1`` — are the *inter-host* positions: a
+collective exchanging any of them crosses DCN.
+
+``QUEST_TPU_FORCE_HOSTS=H`` overrides the detected process grouping —
+single-process tooling (``tools/comm_trace.py --hosts``, the planner
+test-suite) plans *as if* the mesh spanned ``H`` hosts without paying a
+real multi-process launch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["HostTopology", "SINGLE_HOST", "host_topology", "bootstrap",
+           "inter_host_positions"]
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    """Process grouping of one device mesh, as the planner sees it."""
+
+    num_hosts: int        # controller processes the mesh spans
+    num_devices: int      # devices in the mesh
+    host_bits: int        # device-index bits selecting the host (top bits)
+
+    @property
+    def devices_per_host(self) -> int:
+        return self.num_devices // max(self.num_hosts, 1)
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.host_bits > 0
+
+    def inter_positions(self, num_qubits: int) -> tuple[int, ...]:
+        """The physical qubit positions whose exchange crosses hosts."""
+        return inter_host_positions(num_qubits, self.host_bits,
+                                    self.host_bits)
+
+
+SINGLE_HOST = HostTopology(num_hosts=1, num_devices=1, host_bits=0)
+
+
+def _forced_hosts() -> Optional[int]:
+    raw = os.environ.get("QUEST_TPU_FORCE_HOSTS")
+    if not raw:
+        return None
+    try:
+        h = int(raw)
+    except ValueError:
+        return None
+    return h if h >= 1 else None
+
+
+def host_topology(mesh, num_hosts: Optional[int] = None) -> HostTopology:
+    """The :class:`HostTopology` of ``mesh``.
+
+    ``num_hosts`` overrides detection (``QUEST_TPU_FORCE_HOSTS`` does the
+    same from the environment — explicit argument wins); otherwise the
+    hosts are the distinct ``process_index`` values of the mesh devices.
+    The two-tier split needs the amplitude-sharding bit geometry to hold:
+    a power-of-two host count, equal devices per host, and devices
+    grouped host-contiguously in mesh order (true for every
+    ``jax.devices()``-ordered mesh — the device list sorts by process).
+    A grouping that breaks those invariants degrades safely to *every*
+    device bit priced at the inter-host tier (``host_bits = shard
+    bits``): pessimistic pricing, never a wrong plan.
+    """
+    if mesh is None:
+        return SINGLE_HOST
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    n_dev = len(devs)
+    if num_hosts is None:
+        num_hosts = _forced_hosts()
+    if num_hosts is None:
+        try:
+            procs = [int(getattr(d, "process_index", 0)) for d in devs]
+        except Exception:
+            procs = [0] * n_dev
+        num_hosts = len(set(procs))
+        if num_hosts > 1:
+            # the geometric invariants, checked on the REAL grouping
+            per = n_dev // num_hosts
+            contiguous = (
+                n_dev % num_hosts == 0
+                and num_hosts & (num_hosts - 1) == 0
+                and all(procs[i] == procs[(i // per) * per]
+                        for i in range(n_dev))
+                and len({procs[h * per] for h in range(num_hosts)})
+                == num_hosts)
+            if not contiguous:
+                shard_bits = max(n_dev.bit_length() - 1, 0)
+                return HostTopology(num_hosts=num_hosts,
+                                    num_devices=n_dev,
+                                    host_bits=shard_bits)
+    num_hosts = max(1, min(int(num_hosts), n_dev))
+    if num_hosts & (num_hosts - 1):          # forced non-power-of-two
+        shard_bits = max(n_dev.bit_length() - 1, 0)
+        return HostTopology(num_hosts=num_hosts, num_devices=n_dev,
+                            host_bits=shard_bits)
+    return HostTopology(num_hosts=num_hosts, num_devices=n_dev,
+                        host_bits=num_hosts.bit_length() - 1)
+
+
+def inter_host_positions(num_qubits: int, shard_bits: int,
+                         host_bits: int) -> tuple[int, ...]:
+    """Physical positions priced at the inter-host tier: the top
+    ``host_bits`` of the ``shard_bits`` device positions."""
+    h = max(0, min(host_bits, shard_bits))
+    return tuple(range(num_qubits - h, num_qubits))
+
+
+def bootstrap(coordinator_address: Optional[str] = None,
+              num_processes: Optional[int] = None,
+              process_id: Optional[int] = None) -> None:
+    """Join a multi-controller run BEFORE creating any env or touching a
+    backend — the ``MPI_Init`` analogue. Thin wrapper over
+    ``jax.distributed.initialize``: on TPU pods all arguments auto-detect
+    from the runtime; on CPU/GPU clusters pass the coordinator endpoint
+    and process coordinates (``quest_tpu.testing.multiprocess`` spawns
+    exactly this shape for the CPU test harness). After this,
+    ``jax.devices()`` spans every host's chips and
+    ``create_quest_env()`` meshes over all of them.
+
+    On a CPU backend the XLA client needs a real collectives transport
+    for cross-process computations ("Multiprocess computations aren't
+    implemented on the CPU backend" otherwise) — gloo ships with jaxlib,
+    so it is selected here, before the backend initializes. TPU/GPU
+    platforms keep their native transports untouched."""
+    import os
+
+    import jax
+    platforms = str(getattr(jax.config, "jax_platforms", None)
+                    or os.environ.get("JAX_PLATFORMS", "")).strip()
+    # the knob configures only the CPU client, so set it unless the
+    # platform selection EXPLICITLY excludes cpu — on autodetected
+    # CPU-only machines (platforms unset) the transport is exactly what
+    # a distributed run needs, and on TPU/GPU pods it is inert
+    if not platforms or "cpu" in platforms.split(","):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            pass    # older jax/jaxlib without the knob: best effort
+    jax.distributed.initialize(coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)

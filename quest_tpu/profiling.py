@@ -35,8 +35,9 @@ __all__ = ["trace", "GateStats", "DispatchStats", "probe_gate",
 
 @dataclasses.dataclass(frozen=True)
 class CommCostModel:
-    """Linear latency/bandwidth model for one mesh collective:
-    ``seconds = alpha + beta * bytes_on_the_wire`` per device.
+    """Two-tier linear latency/bandwidth model for one mesh collective:
+    ``seconds = alpha + beta * bytes_on_the_wire`` per device, with a
+    separate (alpha, beta) for collectives that cross the HOST boundary.
 
     The layout planner (:mod:`quest_tpu.parallel.layout`) prices every
     candidate data movement with this model and minimizes modeled comm
@@ -50,16 +51,41 @@ class CommCostModel:
     - a cross-shard 1q pair exchange (``apply_1q_cross_shard``) ships the
       whole chunk once: ``bytes = chunk_bytes``.
 
+    **Tiers**: intra-host collectives ride ICI/shared memory
+    (``alpha_s``/``beta_s_per_byte``); any collective whose exchanged
+    device bits include an *inter-host* bit (the top ``host_bits``
+    positions — :mod:`quest_tpu.parallel.multihost`) rides DCN and is
+    priced with ``inter_alpha_s``/``inter_beta_s_per_byte``. The inter
+    fields default to ``None`` = same as intra, so every single-host
+    model (and every pre-two-tier caller) behaves exactly as before.
+
     ``alpha``/``beta`` default to a conservative interconnect model
     (:data:`DEFAULT_COMM_MODEL`); :func:`measure_comm_model` calibrates
-    them per mesh with a tiny collective microbenchmark and caches the
-    fit. Decisions only depend on cost *ratios*, so plans stay
-    deterministic for any non-degenerate (alpha >= 0, beta > 0) fit.
+    each tier per mesh with a tiny collective microbenchmark and caches
+    the fit per ``(mesh fingerprint, tier)``. Decisions only depend on
+    cost *ratios*, so plans stay deterministic for any non-degenerate
+    (alpha >= 0, beta > 0) fit.
     """
 
     alpha_s: float              # per-collective launch latency (seconds)
     beta_s_per_byte: float      # per-byte transfer time (seconds/byte)
     source: str = "default"     # "default" | "measured"
+    # inter-host (DCN) tier; None = fall back to the intra values, which
+    # keeps every single-tier construction/call site bit-identical
+    inter_alpha_s: Optional[float] = None
+    inter_beta_s_per_byte: Optional[float] = None
+
+    def tier(self, inter: bool = False) -> tuple[float, float]:
+        """(alpha, beta) of one tier; the inter tier falls back to intra
+        when uncalibrated."""
+        if inter and self.inter_alpha_s is not None:
+            return (self.inter_alpha_s,
+                    self.inter_beta_s_per_byte
+                    if self.inter_beta_s_per_byte is not None
+                    else self.beta_s_per_byte)
+        if inter and self.inter_beta_s_per_byte is not None:
+            return (self.alpha_s, self.inter_beta_s_per_byte)
+        return (self.alpha_s, self.beta_s_per_byte)
 
     @staticmethod
     def all_to_all_bytes(chunk_bytes: float, k: int) -> float:
@@ -73,50 +99,61 @@ class CommCostModel:
         """Per-device bytes shipped by a whole-chunk pair exchange."""
         return float(chunk_bytes)
 
-    def all_to_all_seconds(self, chunk_bytes: float, k: int) -> float:
+    def all_to_all_seconds(self, chunk_bytes: float, k: int,
+                           inter: bool = False) -> float:
         if k <= 0:
             return 0.0
-        return self.alpha_s + self.beta_s_per_byte * \
-            self.all_to_all_bytes(chunk_bytes, k)
+        alpha, beta = self.tier(inter)
+        return alpha + beta * self.all_to_all_bytes(chunk_bytes, k)
 
-    def ppermute_seconds(self, chunk_bytes: float) -> float:
-        return self.alpha_s + self.beta_s_per_byte * \
-            self.ppermute_bytes(chunk_bytes)
+    def ppermute_seconds(self, chunk_bytes: float,
+                         inter: bool = False) -> float:
+        alpha, beta = self.tier(inter)
+        return alpha + beta * self.ppermute_bytes(chunk_bytes)
 
 
 # ~50 GB/s per-link bandwidth with a few-microsecond launch cost: the
-# shape of both ICI links and a shared-memory host "mesh". The planner's
-# decisions are ratio-based, so the default is safe wherever no
-# measurement has run.
-DEFAULT_COMM_MODEL = CommCostModel(alpha_s=5e-6, beta_s_per_byte=2e-11)
+# shape of both ICI links and a shared-memory host "mesh". The inter-host
+# tier models DCN: ~25 GB/s effective per host pair with tens of
+# microseconds of launch+routing latency — the order-of-magnitude gap
+# mpiQulacs measures between Tofu-D intra-group and inter-group hops
+# (arXiv:2203.16044 §IV). The planner's decisions are ratio-based, so the
+# default is safe wherever no measurement has run.
+DEFAULT_COMM_MODEL = CommCostModel(alpha_s=5e-6, beta_s_per_byte=2e-11,
+                                   inter_alpha_s=5e-5,
+                                   inter_beta_s_per_byte=4e-10)
 
+# calibration cache, keyed (mesh device fingerprint, tier). A FAILED or
+# degenerate fit caches the default-tier values too — the microbenchmark
+# must never silently re-run on every compile (the pre-two-tier code
+# returned the default UNCACHED on failure, re-paying the bench each
+# call on boxes where the fit degenerates).
 _COMM_MODEL_CACHE: dict = {}
 
 
-def _mesh_cache_key(mesh) -> tuple:
+def _mesh_cache_key(mesh, tier: str = "intra") -> tuple:
     devs = mesh.devices.reshape(-1)
     return (len(devs), devs[0].platform,
-            getattr(devs[0], "device_kind", ""))
+            getattr(devs[0], "device_kind", ""), tier)
 
 
-def measure_comm_model(mesh, probe_bytes=(1 << 14, 1 << 19),
-                       trials: int = 5) -> CommCostModel:
-    """Fit (alpha, beta) from a tiny ``ppermute`` ring microbenchmark at
-    two payload sizes on ``mesh``; the result is cached per mesh
-    fingerprint so the calibration runs once per process. Falls back to
-    :data:`DEFAULT_COMM_MODEL` (uncached) if the measurement fails or
-    produces a degenerate fit."""
+def _model_pinned() -> bool:
+    """``QUEST_TPU_COMM_MODEL=default`` pins :data:`DEFAULT_COMM_MODEL`
+    deterministically — no microbenchmark ever runs (the escape hatch
+    for test processes and reproducible planning)."""
+    import os
+    return os.environ.get("QUEST_TPU_COMM_MODEL", "") == "default"
+
+
+def _measure_tier(mesh, pairs, probe_bytes, trials) -> Optional[tuple]:
+    """(alpha, beta) fitted from a ppermute microbench over ``pairs``,
+    or None on failure/degenerate fit."""
     import numpy as np
-    key = _mesh_cache_key(mesh)
-    if key in _COMM_MODEL_CACHE:
-        return _COMM_MODEL_CACHE[key]
     try:
         from jax.sharding import PartitionSpec as P
         from .compat import shard_map
         from .env import AMP_AXIS
         n_dev = int(np.prod(mesh.devices.shape))
-        pairs = tuple((i, (i + 1) % n_dev) for i in range(n_dev))
-
         times = []
         for nbytes in probe_bytes:
             n_f32 = max(n_dev, (nbytes // 4) * n_dev)
@@ -140,13 +177,94 @@ def measure_comm_model(mesh, probe_bytes=(1 << 14, 1 << 19),
         beta = (t1_ - t0_) / (b1 - b0)
         alpha = t0_ - beta * b0
         if beta <= 0.0 or not np.isfinite(alpha) or not np.isfinite(beta):
-            return DEFAULT_COMM_MODEL
-        model = CommCostModel(alpha_s=max(alpha, 0.0),
-                              beta_s_per_byte=beta, source="measured")
-        _COMM_MODEL_CACHE[key] = model
-        return model
+            return None
+        return (max(alpha, 0.0), beta)
     except Exception:
+        return None
+
+
+def measure_comm_model(mesh, probe_bytes=(1 << 14, 1 << 19),
+                       trials: int = 5) -> CommCostModel:
+    """Fit (alpha, beta) per interconnect tier from tiny ``ppermute``
+    microbenchmarks on ``mesh``.
+
+    The *intra* tier times a neighbour ring inside each host group; when
+    the mesh spans processes (:func:`quest_tpu.parallel.multihost.
+    host_topology`), the *inter* tier additionally times a cross-host
+    pairing. Each tier's fit is cached per ``(mesh fingerprint, tier)``
+    — including failed fits, which pin that tier's DEFAULT values — so
+    the microbenchmark runs at most once per process per tier, never
+    again. ``QUEST_TPU_COMM_MODEL=default`` skips measurement entirely
+    and returns :data:`DEFAULT_COMM_MODEL`."""
+    import numpy as np
+    if _model_pinned():
         return DEFAULT_COMM_MODEL
+    from .parallel.multihost import host_topology
+    n_dev = int(np.prod(mesh.devices.shape))
+    topo = host_topology(mesh)
+    per_host = max(1, topo.devices_per_host)
+    # the host grouping shapes both the pairings and which tiers exist,
+    # so it is part of every cache key — flipping QUEST_TPU_FORCE_HOSTS
+    # mid-process must not serve a stale single-tier model
+    hosttag = f":h{topo.num_hosts}"
+    mkey = _mesh_cache_key(mesh, "model" + hosttag)
+    if mkey in _COMM_MODEL_CACHE:
+        return _COMM_MODEL_CACHE[mkey]
+
+    ikey = _mesh_cache_key(mesh, "intra" + hosttag)
+    if ikey not in _COMM_MODEL_CACHE:
+        if per_host > 1:
+            # neighbour ring inside each host group: (i -> i+1) mod group
+            pairs = tuple(
+                (i, (i // per_host) * per_host + (i + 1) % per_host)
+                for i in range(n_dev))
+            fit = _measure_tier(mesh, pairs, probe_bytes, trials)
+        else:
+            # one device per host: every link crosses hosts, there is
+            # nothing intra to time (and host_bits == shard bits means
+            # the intra tier is never consulted) — pin the default
+            fit = None
+        _COMM_MODEL_CACHE[ikey] = fit if fit is not None else (
+            DEFAULT_COMM_MODEL.alpha_s, DEFAULT_COMM_MODEL.beta_s_per_byte,
+            "default")
+    intra = _COMM_MODEL_CACHE[ikey]
+
+    inter = None
+    if topo.is_multihost and topo.num_hosts > 1:
+        xkey = _mesh_cache_key(mesh, "inter" + hosttag)
+        if xkey not in _COMM_MODEL_CACHE:
+            pairs = tuple((i, (i + per_host) % n_dev) for i in range(n_dev))
+            fit = _measure_tier(mesh, pairs, probe_bytes, trials)
+            if fit is None:
+                # derive the pinned inter tier FROM the intra fit at the
+                # default DCN/ICI ratios rather than using the absolute
+                # default values: a measured intra alpha above the
+                # default inter alpha would otherwise invert the tiers
+                # and make the planner PREFER host-crossing collectives
+                ra = DEFAULT_COMM_MODEL.inter_alpha_s \
+                    / DEFAULT_COMM_MODEL.alpha_s
+                rb = DEFAULT_COMM_MODEL.inter_beta_s_per_byte \
+                    / DEFAULT_COMM_MODEL.beta_s_per_byte
+                fit_d = (intra[0] * ra, intra[1] * rb, "default")
+                _COMM_MODEL_CACHE[xkey] = fit_d
+            else:
+                # clamp a measured inter fit to no FASTER than intra —
+                # timing noise must never invert the tier ordering
+                _COMM_MODEL_CACHE[xkey] = (max(fit[0], intra[0]),
+                                           max(fit[1], intra[1]))
+        inter = _COMM_MODEL_CACHE[xkey]
+
+    measured = len(intra) == 2 or (inter is not None and len(inter) == 2)
+    if not measured:
+        model = DEFAULT_COMM_MODEL
+    else:
+        model = CommCostModel(
+            alpha_s=intra[0], beta_s_per_byte=intra[1],
+            source="measured",
+            inter_alpha_s=inter[0] if inter is not None else None,
+            inter_beta_s_per_byte=inter[1] if inter is not None else None)
+    _COMM_MODEL_CACHE[mkey] = model
+    return model
 
 
 def comm_model(env=None, measure: Optional[bool] = None) -> CommCostModel:
@@ -159,15 +277,22 @@ def comm_model(env=None, measure: Optional[bool] = None) -> CommCostModel:
     model cannot know — and keeps the default on host (CPU) meshes,
     where the virtual devices timeshare one memory system and a timing
     fit adds cross-process nondeterminism for no information.
-    ``QUEST_TPU_COMM_CALIBRATE=1``/``0`` overrides either way; the fit
-    runs once per process per mesh fingerprint (cached)."""
+    ``QUEST_TPU_COMM_CALIBRATE=1``/``0`` overrides either way;
+    ``QUEST_TPU_COMM_MODEL=default`` pins the default model
+    unconditionally (tests, reproducible planning). The fit runs once
+    per process per ``(mesh fingerprint, tier)`` (cached, failures
+    included)."""
     import os
     mesh = getattr(env, "mesh", None) if env is not None else None
     if mesh is None:
         return DEFAULT_COMM_MODEL
-    key = _mesh_cache_key(mesh)
-    if key in _COMM_MODEL_CACHE:
-        return _COMM_MODEL_CACHE[key]
+    if _model_pinned():
+        return DEFAULT_COMM_MODEL
+    from .parallel.multihost import host_topology
+    mkey = _mesh_cache_key(
+        mesh, f"model:h{host_topology(mesh).num_hosts}")
+    if mkey in _COMM_MODEL_CACHE:
+        return _COMM_MODEL_CACHE[mkey]
     if measure is None:
         flag = os.environ.get("QUEST_TPU_COMM_CALIBRATE")
         if flag is not None:
@@ -201,6 +326,11 @@ class DispatchStats:
     collectives_fused: int = 0   # relayout pairs merged into one exchange
     comm_bytes_planned: float = 0.0  # mesh-total collective bytes per run
     comm_bytes_saved: float = 0.0    # vs the count-based planner's plan
+    # multi-host (two-tier) accounting (quest_tpu/parallel/multihost.py):
+    num_hosts: int = 1               # controller processes the mesh spans
+    inter_host_collectives: int = 0  # planned collectives crossing hosts
+    comm_bytes_inter_planned: float = 0.0  # mesh-total DCN bytes per run
+    comm_bytes_inter_saved: float = 0.0    # vs the reordering-off plan
     # batched ensemble engine accounting (set by the last sweep /
     # expectation_sweep / sample_sweep on the compiled circuit):
     batch_size: int = 0              # points in the last batched run
@@ -242,6 +372,10 @@ class DispatchStats:
                 "collective_launches": self.collective_launches,
                 "comm_bytes_planned": self.comm_bytes_planned,
                 "comm_bytes_saved": self.comm_bytes_saved,
+                "num_hosts": self.num_hosts,
+                "inter_host_collectives": self.inter_host_collectives,
+                "comm_bytes_inter_planned": self.comm_bytes_inter_planned,
+                "comm_bytes_inter_saved": self.comm_bytes_inter_saved,
                 "batch_size": self.batch_size,
                 "host_syncs_avoided": self.host_syncs_avoided,
                 "batch_sharding_mode": self.batch_sharding_mode,

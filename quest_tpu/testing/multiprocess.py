@@ -1,0 +1,210 @@
+"""Multi-process CPU test harness: hermetic child spawning for the
+multi-controller (``jax.distributed``) and virtual-mesh paths.
+
+The reference proves its distributed build by launching the SAME test
+suite under ``mpiexec`` (``utilities/CMakeLists.txt:40-42``); our
+analogue launches real OS processes — each a separate JAX controller —
+that rendezvous through a ``jax.distributed`` coordinator and build ONE
+global mesh spanning every process's CPU devices
+(:func:`quest_tpu.parallel.multihost.bootstrap`). This module owns the
+mechanics every such test needs and previously hand-rolled:
+
+- **Hermetic child environments** (:func:`hermetic_child_env`): the
+  parent's ``JAX_*`` / ``QUEST_TPU_*`` / ``XLA_FLAGS`` state must not
+  leak into children — a parent pinned to an 8-device virtual mesh (the
+  test suite's conftest) or carrying ``QUEST_TPU_FORCE_HOSTS`` from a
+  planner test would silently reshape every child mesh. Children start
+  from a scrubbed environment with exactly the platform/device-count
+  variables the caller asked for.
+- **Coordinator port picking** (:func:`free_port`): each
+  ``jax.distributed`` rendezvous needs a fresh localhost port; binding
+  port 0 and reading the assignment back avoids collisions between
+  concurrently running tests.
+- **Worker fan-out** (:func:`spawn_workers`): N coordinator-connected
+  children running one worker script, each handed ``(process_id,
+  num_processes, port, *extra)`` on ``argv``, results collected from
+  per-process ``RESULT {json}`` lines. On ANY failure every remaining
+  worker is killed — a crashed rank must not leave its peers blocked in
+  the ``jax.distributed`` barrier.
+- **Single-child re-exec** (:func:`run_child`): the one-process variant
+  ``__graft_entry__.dryrun_multichip`` uses to get a fresh interpreter
+  whose CPU device count is set *before the first JAX import*.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from typing import Optional, Sequence
+
+__all__ = ["hermetic_child_env", "free_port", "spawn_workers",
+           "run_child", "repo_root"]
+
+# parent state that must never leak into a hermetically spawned child:
+# backend selection, virtual device counts, multihost forcing, planner
+# pins, dry-run child markers
+_SCRUB_PREFIXES = ("JAX_", "QUEST_TPU_", "_QUEST_")
+_SCRUB_EXACT = ("XLA_FLAGS", "XLA_PYTHON_CLIENT_PREALLOCATE",
+                "XLA_PYTHON_CLIENT_MEM_FRACTION")
+
+
+def repo_root() -> str:
+    """The directory containing the ``quest_tpu`` package — children
+    spawned with ``python -c`` need it on ``PYTHONPATH`` regardless of
+    the parent's CWD."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def hermetic_child_env(num_devices: int,
+                       extra: Optional[dict] = None) -> dict:
+    """A child-process environment with the CPU platform and
+    ``num_devices`` virtual devices selected BEFORE the child's first
+    JAX import, and no inherited ``JAX_*`` / ``QUEST_TPU_*`` /
+    ``XLA_FLAGS`` state.
+
+    Both ``JAX_NUM_CPU_DEVICES`` (jax>=0.4.34) and the older
+    ``XLA_FLAGS --xla_force_host_platform_device_count`` are set so the
+    child works across the JAX versions this repo supports. ``extra``
+    entries are applied last (a caller CAN reintroduce a scrubbed
+    variable deliberately, e.g. ``QUEST_TPU_COMM_MODEL=default`` for
+    deterministic planning in workers)."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(_SCRUB_PREFIXES) and k not in _SCRUB_EXACT}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_NUM_CPU_DEVICES"] = str(num_devices)
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={num_devices}"
+    root = repo_root()
+    pp = env.get("PYTHONPATH", "")
+    if root not in pp.split(os.pathsep):
+        env["PYTHONPATH"] = root + (os.pathsep + pp if pp else "")
+    if extra:
+        env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def free_port() -> int:
+    """A currently free localhost TCP port for the ``jax.distributed``
+    coordinator rendezvous."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def spawn_workers(worker: str, num_processes: int,
+                  devices_per_process: int,
+                  extra_argv: Sequence = (),
+                  extra_env: Optional[dict] = None,
+                  timeout_s: float = 420.0) -> list[dict]:
+    """Launch ``num_processes`` coordinator-connected workers and
+    collect one ``RESULT {json}`` line from each.
+
+    ``worker`` is a Python source string executed as ``python -c``; it
+    receives ``argv = [process_id, num_processes, coordinator_port,
+    *extra_argv]`` and is expected to call ``quest_tpu.
+    initialize_multihost(f"localhost:{port}", num_processes=...,
+    process_id=...)`` before creating an env, then print exactly one
+    ``RESULT``-prefixed JSON line. Each child gets a hermetic
+    environment (:func:`hermetic_child_env`) with
+    ``devices_per_process`` CPU devices, so the global mesh spans
+    ``num_processes * devices_per_process`` devices.
+
+    On ANY failure (crash, timeout, nonzero exit, missing RESULT line)
+    every remaining worker is killed before the error propagates — and
+    promptly: a monitor loop kills the peers the moment ANY rank exits
+    nonzero, so a crashed rank fails the spawn in seconds instead of
+    leaving its peers wedged in the ``jax.distributed`` barrier for the
+    full timeout. Every worker's pipes are drained CONCURRENTLY — a
+    sequential drain would let a not-yet-waited rank fill its 64KB
+    stderr pipe (verbose XLA warnings) and block mid-run."""
+    import threading
+    import time
+
+    port = free_port()
+    env = hermetic_child_env(devices_per_process, extra=extra_env)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", worker, str(i), str(num_processes),
+         str(port), *map(str, extra_argv)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for i in range(num_processes)]
+    outs: list = [None] * num_processes
+
+    def drain(i: int) -> None:
+        try:
+            outs[i] = procs[i].communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            pass                          # outs[i] stays None -> failure
+
+    threads = [threading.Thread(target=drain, args=(i,), daemon=True)
+               for i in range(num_processes)]
+    results = []
+    try:
+        for t in threads:
+            t.start()
+        crashed = None                    # first rank to die nonzero
+        deadline = time.monotonic() + timeout_s + 30.0
+        while any(t.is_alive() for t in threads) \
+                and time.monotonic() < deadline:
+            if crashed is None:
+                for i, p in enumerate(procs):
+                    rc = p.poll()
+                    if rc is not None and rc != 0:
+                        crashed = i       # fail fast: release the peers
+                        for pp in procs:
+                            if pp.poll() is None:
+                                pp.kill()
+                        break
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=5.0)
+        if crashed is not None:
+            _, err = outs[crashed] or ("", "")
+            raise AssertionError(
+                f"worker {crashed} rc={procs[crashed].returncode} "
+                f"(peers killed):\n{(err or '')[-3000:]}")
+        for i, p in enumerate(procs):
+            if outs[i] is None:
+                raise AssertionError(
+                    f"worker {i} timed out after {timeout_s:.0f}s "
+                    "(rank wedged in the distributed barrier?)")
+            out, err = outs[i]
+            if p.returncode != 0:
+                raise AssertionError(
+                    f"worker rc={p.returncode}:\n{err[-3000:]}")
+            line = next((l for l in out.splitlines()
+                         if l.startswith("RESULT ")), None)
+            if line is None:
+                raise AssertionError(
+                    f"worker produced no RESULT line:\n{out[-1000:]}\n"
+                    f"{err[-2000:]}")
+            results.append(json.loads(line[len("RESULT "):]))
+    finally:
+        for pp in procs:
+            if pp.poll() is None:
+                pp.kill()
+    return results
+
+
+def run_child(code: str, num_devices: int, timeout_s: float = 900.0,
+              extra_env: Optional[dict] = None) -> None:
+    """Run ``code`` in ONE fresh interpreter whose CPU device count is
+    set before the first JAX import (hermetic environment). Raises
+    ``RuntimeError`` on timeout or nonzero exit — the single-process
+    analogue of :func:`spawn_workers`, kept for
+    ``__graft_entry__.dryrun_multichip``."""
+    env = hermetic_child_env(num_devices, extra=extra_env)
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              timeout=timeout_s, capture_output=True,
+                              text=True)
+    except subprocess.TimeoutExpired as e:
+        raise RuntimeError(
+            f"multiprocess child (n={num_devices}) timed out after "
+            f"{timeout_s:.0f}s (backend hang?)") from e
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"multiprocess child (n={num_devices}) failed "
+            f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}")

@@ -301,3 +301,89 @@ def test_sink_captures_first_real_row_and_reemit(capsys):
     assert last["value"] == 7.0
     bench._reemit_headline([])           # empty: emits nothing
     assert capsys.readouterr().out == ""
+
+
+def test_multihost_rows_required(monkeypatch):
+    """The bench must deliver the ISSUE-7 multihost rows: single-process
+    baseline, 2-process reorder-off/on gates/sec with the inter-host
+    accounting, and the reordering bytes-saved row. The worker spawn is
+    stubbed (the REAL spawn is covered by the slow-tier test below), so
+    this checks the delivery contract, not the measurement."""
+    for k, v in (("QUEST_BENCH_MULTIHOST_QUBITS", "8"),
+                 ("QUEST_BENCH_MULTIHOST_PROCS", "2"),
+                 ("QUEST_BENCH_MULTIHOST_DEVS", "1"),
+                 ("QUEST_BENCH_MULTIHOST_DEPTH", "8"),
+                 ("QUEST_BENCH_TRIALS", "3")):
+        monkeypatch.setenv(k, v)
+    stats = {"num_hosts": 2, "dispatches": 9, "collective_launches": 3,
+             "inter_host_collectives": 2, "comm_bytes_planned": 4096.0,
+             "comm_bytes_inter_planned": 2048.0,
+             "comm_bytes_inter_saved": 0.0}
+    canned = {"rank": 0, "devices": 2,
+              "qft": {"off": {"dt": 0.01, "n_gates": 40, **stats},
+                      "on": {"dt": 0.008, "n_gates": 40, **stats,
+                             "comm_bytes_inter_planned": 1536.0}},
+              "rand": {"off": {**stats,
+                               "comm_bytes_inter_planned": 8192.0},
+                       "on": {**stats,
+                              "comm_bytes_inter_planned": 6144.0,
+                              "comm_bytes_inter_saved": 2048.0}}}
+    seen = {}
+
+    def stub_spawn(worker, nprocs, devs, extra_argv=(), extra_env=None,
+                   timeout_s=0.0):
+        seen.update(nprocs=nprocs, devs=devs, argv=tuple(extra_argv),
+                    env=dict(extra_env or {}))
+        assert "initialize_multihost" in worker
+        return [canned, {**canned, "rank": 1}]
+
+    from quest_tpu.testing import multiprocess as mp
+    monkeypatch.setattr(mp, "spawn_workers", stub_spawn)
+    import quest_tpu as qt
+    rows = bench.bench_multihost(qt, "cpu")
+    assert seen["nprocs"] == 2 and seen["devs"] == 1
+    assert seen["argv"] == (8, 8, 1)
+    assert seen["env"]["QUEST_TPU_COMM_MODEL"] == "default"
+    assert len(rows) == 4
+    single, off, on, delta = rows
+    assert "single process" in single["metric"]
+    assert single["value"] > 0.0 and single["num_hosts"] == 1
+    assert "reorder-off" in off["metric"] and "reorder-on" in on["metric"]
+    for row in (off, on):
+        assert row["unit"] == "gates/sec" and row["value"] > 0.0
+        assert row["num_hosts"] == 2
+        assert row["comm_bytes_inter_planned"] <= row["comm_bytes_planned"]
+    assert on["speedup_vs_reorder_off"] > 0.0
+    assert on["inter_bytes_vs_reorder_off"] == 512.0
+    assert delta["unit"] == "bytes" and delta["value"] == 2048.0
+    assert delta["inter_bytes_reorder_on"] == 6144.0
+    # bench_sharded_mesh must carry the rows too (the acceptance mesh)
+    import inspect
+    src = inspect.getsource(bench.bench_sharded_mesh)
+    assert "bench_multihost" in src
+
+
+@pytest.mark.slow
+@pytest.mark.multihost
+def test_multihost_rows_real_spawn_tiny(monkeypatch):
+    """The same delivery contract through a REAL 2-process
+    jax.distributed spawn (tiny workload)."""
+    for k, v in (("QUEST_BENCH_MULTIHOST_QUBITS", "8"),
+                 ("QUEST_BENCH_MULTIHOST_PROCS", "2"),
+                 ("QUEST_BENCH_MULTIHOST_DEVS", "1"),
+                 ("QUEST_BENCH_MULTIHOST_DEPTH", "10"),
+                 ("QUEST_BENCH_TRIALS", "2")):
+        monkeypatch.setenv(k, v)
+    import quest_tpu as qt
+    rows = bench.bench_multihost(qt, "cpu")
+    assert len(rows) == 4
+    single, off, on, delta = rows
+    assert single["value"] > 0.0
+    for row in (off, on):
+        assert row["value"] > 0.0
+        assert row["num_hosts"] == 2
+        assert row["inter_host_collectives"] >= 1
+    # reordering never plans MORE inter-host bytes than its baseline
+    assert on["comm_bytes_inter_planned"] <= \
+        off["comm_bytes_inter_planned"]
+    assert delta["value"] >= 0.0

@@ -45,6 +45,33 @@ def test_trace_planner_off_baseline(mesh_env):
     assert on["totals"]["bytes"] <= off["totals"]["bytes"]
 
 
+def test_trace_two_tier_hosts(mesh_env, monkeypatch):
+    """--hosts analogue in-process: a forced 2-host split annotates
+    every event with its interconnect tier and splits the totals, in
+    agreement with the plan's own tiered accounting."""
+    monkeypatch.setenv("QUEST_TPU_FORCE_HOSTS", "2")
+    cc = alg.qft(12).compile(mesh_env, pallas="off")
+    doc = json.loads(json.dumps(comm_trace.trace_schedule(cc)))
+    assert doc["num_hosts"] == 2 and doc["host_bits"] == 1
+    assert doc["cost_model"]["inter_alpha_s"] > \
+        doc["cost_model"]["alpha_s"]
+    for e in doc["events"]:
+        assert e["tier"] in ("intra", "inter")
+        assert e["inter_mesh_bytes"] <= e["mesh_bytes"]
+        assert (e["tier"] == "inter") == (e["inter_collectives"] > 0)
+    t = doc["totals"]
+    assert t["inter_bytes"] == pytest.approx(
+        sum(e["inter_mesh_bytes"] for e in doc["events"]))
+    assert t["intra_bytes"] == pytest.approx(
+        t["bytes"] - t["inter_bytes"])
+    assert t["inter_launches"] == sum(e["inter_collectives"]
+                                      for e in doc["events"])
+    ds = doc["dispatch_stats"]
+    assert t["inter_bytes"] == pytest.approx(
+        ds["comm_bytes_inter_planned"])
+    assert ds["num_hosts"] == 2
+
+
 def test_cli_end_to_end():
     tool = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
                         "comm_trace.py")
@@ -52,10 +79,13 @@ def test_cli_end_to_end():
            if k not in ("XLA_FLAGS",)}
     proc = subprocess.run(
         [sys.executable, tool, "--qubits", "10", "--devices", "8",
-         "--circuit", "qft"],
+         "--circuit", "qft", "--hosts", "2"],
         capture_output=True, text=True, timeout=120, env=env)
     assert proc.returncode == 0, proc.stderr[-1500:]
     doc = json.loads(proc.stdout)
     assert doc["num_qubits"] == 10
+    assert doc["num_hosts"] == 2
     assert doc["events"], "no collectives traced"
+    assert {e["tier"] for e in doc["events"]} <= {"intra", "inter"}
+    assert doc["totals"]["inter_bytes"] > 0.0
     assert "dispatch_stats" in doc

@@ -13,8 +13,15 @@ Usage::
 
     python tools/comm_trace.py --qubits 18 --devices 8 --circuit qft
     python tools/comm_trace.py --circuit grover --planner off
+    python tools/comm_trace.py --hosts 2 --reorder off
 
 ``--planner off`` traces the count-based legacy plan for comparison.
+``--hosts H`` plans as if the mesh spanned ``H`` controller processes
+(``QUEST_TPU_FORCE_HOSTS``; quest_tpu/parallel/multihost.py): every
+collective is annotated with the interconnect tier it rides
+(``intra``/``inter`` host) and the dump carries per-tier byte totals —
+the observable the hot-qubit reordering pass (``--reorder off`` for its
+baseline) is graded on.
 """
 
 from __future__ import annotations
@@ -26,8 +33,12 @@ import sys
 
 def trace_schedule(cc) -> dict:
     """The planned collective schedule of a CompiledCircuit as a plain
-    dict (JSON-ready): one event per plan item that moves data."""
-    from quest_tpu.parallel.layout import (_relayout_sigma, relayout_comm,
+    dict (JSON-ready): one event per plan item that moves data. On a
+    multi-host mesh (or under ``--hosts``) every event carries the
+    interconnect tier it rides (``intra``/``inter``) and the totals
+    split per tier."""
+    from quest_tpu.parallel.layout import (_relayout_sigma,
+                                           relayout_comm_tiered,
                                            plan_comm_stats)
     from quest_tpu.profiling import DEFAULT_COMM_MODEL
 
@@ -37,6 +48,9 @@ def trace_schedule(cc) -> dict:
     model = getattr(cc, "_cost_model", None) or DEFAULT_COMM_MODEL
     chunk_bytes = getattr(cc, "_chunk_bytes", 16.0 * (1 << lt))
     num_devices = cc.env.num_devices
+    host_bits = getattr(cc, "_host_bits", 0)
+    from quest_tpu.parallel.multihost import inter_host_positions
+    inter_pos = set(inter_host_positions(n, plan.shard_bits, host_bits))
 
     def serves(idx: int):
         """Index (into plan.items) of the first op the collective
@@ -50,19 +64,23 @@ def trace_schedule(cc) -> dict:
     for idx, it in enumerate(plan.items):
         if it[0] == "relayout":
             sigma = _relayout_sigma(it[1], it[2], n)
-            sec, per_dev, launches = relayout_comm(sigma, lt, chunk_bytes,
-                                                   model)
+            t = relayout_comm_tiered(sigma, lt, chunk_bytes, model,
+                                     host_bits=host_bits)
             k = sum(1 for p in range(lt) if sigma[p] >= lt)
             events.append({
                 "item": idx, "kind": "relayout",
                 "exchanged_bits": int(k),
-                "collectives": int(launches),
-                "bytes_per_device": per_dev,
-                "mesh_bytes": per_dev * num_devices,
-                "modeled_seconds": sec,
+                "collectives": int(t["launches"]),
+                "bytes_per_device": t["bytes"],
+                "mesh_bytes": t["bytes"] * num_devices,
+                "modeled_seconds": t["seconds"],
+                "tier": "inter" if t["inter_launches"] else "intra",
+                "inter_collectives": int(t["inter_launches"]),
+                "inter_mesh_bytes": t["inter_bytes"] * num_devices,
                 "fused_group": serves(idx),
             })
         elif it[0] == "xshard":
+            x_inter = int(it[2][0]) in inter_pos
             events.append({
                 "item": idx, "kind": "pair_exchange",
                 "exchanged_bits": 1,
@@ -70,19 +88,31 @@ def trace_schedule(cc) -> dict:
                 "bytes_per_device": model.ppermute_bytes(chunk_bytes),
                 "mesh_bytes": model.ppermute_bytes(chunk_bytes)
                 * num_devices,
-                "modeled_seconds": model.ppermute_seconds(chunk_bytes),
+                "modeled_seconds": model.ppermute_seconds(
+                    chunk_bytes, inter=x_inter),
+                "tier": "inter" if x_inter else "intra",
+                "inter_collectives": int(x_inter),
+                "inter_mesh_bytes": model.ppermute_bytes(chunk_bytes)
+                * num_devices if x_inter else 0.0,
                 "fused_group": idx,
                 "op_index": it[1],
                 "position": int(it[2][0]),
             })
-    totals = plan_comm_stats(plan, chunk_bytes, model, num_devices)
+    totals = plan_comm_stats(plan, chunk_bytes, model, num_devices,
+                             host_bits=host_bits)
+    totals["intra_bytes"] = totals["bytes"] - totals["inter_bytes"]
+    inter_a, inter_b = model.tier(inter=True)
     return {
         "num_qubits": n,
         "shard_bits": plan.shard_bits,
         "num_devices": num_devices,
+        "num_hosts": getattr(cc, "_num_hosts", 1),
+        "host_bits": host_bits,
         "chunk_bytes": chunk_bytes,
         "cost_model": {"alpha_s": model.alpha_s,
                        "beta_s_per_byte": model.beta_s_per_byte,
+                       "inter_alpha_s": inter_a,
+                       "inter_beta_s_per_byte": inter_b,
                        "source": model.source},
         "events": events,
         "totals": totals,
@@ -97,6 +127,14 @@ def main(argv=None) -> int:
     ap.add_argument("--circuit", choices=("qft", "grover", "bench"),
                     default="qft")
     ap.add_argument("--planner", choices=("on", "off"), default="on")
+    ap.add_argument("--hosts", type=int, default=None,
+                    help="plan as if the mesh spanned H controller "
+                         "processes (QUEST_TPU_FORCE_HOSTS): events gain "
+                         "intra/inter tier annotations and per-tier "
+                         "totals")
+    ap.add_argument("--reorder", choices=("on", "off"), default="on",
+                    help="hot-qubit-local reordering pass (off = the "
+                         "tier-priced but tier-blind baseline)")
     ap.add_argument("--lookahead", type=int, default=32)
     ap.add_argument("--fusion", type=int, default=None,
                     help="gate-fusion cap k (default: compile default)")
@@ -111,6 +149,10 @@ def main(argv=None) -> int:
             flags + f" --xla_force_host_platform_device_count="
             f"{args.devices}").strip()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.hosts is not None:
+        # deterministic two-tier planning without a multi-process launch
+        os.environ["QUEST_TPU_FORCE_HOSTS"] = str(args.hosts)
+        os.environ.setdefault("QUEST_TPU_COMM_MODEL", "default")
 
     repo_root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              os.pardir)
@@ -134,6 +176,7 @@ def main(argv=None) -> int:
         kw["fusion"] = args.fusion
     cc = circ.compile(env, pallas="off",
                       comm_planner=(args.planner == "on"),
+                      reorder=(args.reorder == "on"),
                       lookahead=args.lookahead, **kw)
     json.dump(trace_schedule(cc), sys.stdout, indent=2)
     print()
