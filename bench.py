@@ -782,6 +782,16 @@ def bench_sharded_mesh(qt, platform: str) -> dict:
               "unit": "points/sec", "vs_baseline": 0.0,
               "errors": [f"{type(e).__name__}: {e}"]})
 
+    # precision-tier row (ISSUE 8 acceptance mesh): the same ensemble
+    # sweep at the FAST / SINGLE-compensated / QUAD rungs, with the
+    # seeded precision-fault escalation pass
+    try:
+        emit(bench_precision_tiers(_qt, env, platform))
+    except Exception as e:
+        emit({"metric": "precision tiers (bench error)", "value": 0.0,
+              "unit": "points/sec", "vs_baseline": 0.0,
+              "errors": [f"{type(e).__name__}: {e}"]})
+
     # serving rows (ISSUE 4 acceptance: the 8-device mesh is where the
     # coalesced-dispatch requests/sec comparison is graded): the same
     # 1024-request mixed trace one-at-a-time vs through the service
@@ -1185,6 +1195,165 @@ def bench_ensemble_sweep_config(qt, env, platform: str) -> dict:
     for row in rows[:-1]:
         emit(row)
     return rows[-1]
+
+
+def _bound_hea(num_qubits: int, layers: int, values: dict):
+    """build_hea_circuit with the parameters BOUND to static angles —
+    the dd-compilable (QUAD-tier) form of the same workload."""
+    from quest_tpu.circuits import Circuit
+    c = Circuit(num_qubits)
+    for layer in range(layers):
+        for q_ in range(num_qubits):
+            c.ry(q_, float(values[f"y{layer}_{q_}"]))
+            c.rz(q_, float(values[f"z{layer}_{q_}"]))
+        for q_ in range(num_qubits):
+            c.cnot(q_, (q_ + 1) % num_qubits)
+    return c
+
+
+def _pauli_energy_host(state: np.ndarray, codes: np.ndarray,
+                       coeffs: np.ndarray) -> float:
+    """<z|H|z> evaluated on the host in f64 (the oracle-side reduction:
+    xor-gather per Pauli term, numpy)."""
+    nq = codes.shape[1]
+    idx = np.arange(state.shape[0], dtype=np.int64)
+
+    def popcount(a):
+        a = a.copy()
+        c_ = np.zeros_like(a)
+        for _ in range(nq):
+            c_ += a & 1
+            a >>= 1
+        return c_
+
+    total = 0.0
+    bits = np.int64(1) << np.arange(nq, dtype=np.int64)
+    for t in range(codes.shape[0]):
+        xm = int(((codes[t] == 1) * bits).sum())
+        ym = int(((codes[t] == 2) * bits).sum())
+        zm = int(((codes[t] == 3) * bits).sum())
+        j = idx ^ (xm | ym)
+        sign = 1.0 - 2.0 * (popcount(j & (ym | zm)) & 1)
+        acc = np.sum(np.conj(state) * state[j] * sign)
+        phase = 1j ** bin(ym).count("1")
+        total += float(coeffs[t]) * float(np.real(phase * acc))
+    return total
+
+
+def bench_precision_tiers(qt, env, platform: str) -> dict:
+    """The precision-tier ladder on the SAME ensemble workload: the
+    hardware-efficient-ansatz expectation sweep at the FAST tier
+    (bf16/DEFAULT-precision matmuls, naive reductions), the
+    SINGLE-compensated tier (HIGHEST matmuls + pair-path Pauli-term
+    reductions), and the QUAD (double-double) rung as the f64-class
+    accuracy oracle — points/sec per rung, max |Δ| of each fast rung
+    against the dd oracle, and a seeded precision-fault pass through the
+    serving runtime proving violations ESCALATE one tier up instead of
+    reaching callers wrong (zero surviving budget violations is the
+    graded invariant)."""
+    num_qubits = int(os.environ.get("QUEST_BENCH_TIER_QUBITS", "16"))
+    batch = int(os.environ.get("QUEST_BENCH_TIER_BATCH", "64"))
+    num_terms = int(os.environ.get("QUEST_BENCH_TIER_TERMS", "24"))
+    layers = int(os.environ.get("QUEST_BENCH_TIER_LAYERS", "2"))
+    opoints = int(os.environ.get("QUEST_BENCH_TIER_ORACLE_POINTS", "3"))
+    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 3)
+    from quest_tpu import FAST_TIER, SINGLE_TIER
+    from quest_tpu.profiling import modeled_tier_error, tier_runtime_tol
+    rng = np.random.default_rng(2026)
+    circ, n_gates, names = build_hea_circuit(num_qubits, layers)
+    codes = rng.integers(0, 4, size=(num_terms, num_qubits))
+    coeffs = rng.normal(size=num_terms)
+    terms = [[(q_, int(codes[t, q_])) for q_ in range(num_qubits)]
+             for t in range(num_terms)]
+    ham = (terms, coeffs)
+    pm = rng.uniform(0.0, 2.0 * np.pi, size=(batch, len(names)))
+    dev_desc = (f"single {platform} chip" if env.num_devices == 1
+                else f"{env.num_devices} {platform} devices")
+    cc = circ.compile(env, pallas="off")
+
+    # FAST and SINGLE rungs through the batched engine (tier-keyed
+    # executables), best-of-trials like every sweep row
+    rates, energies = {}, {}
+    for tier in (FAST_TIER, SINGLE_TIER):
+        en = np.asarray(cc.expectation_sweep(pm, ham, tier=tier))
+        dts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            en = np.asarray(cc.expectation_sweep(pm, ham, tier=tier))
+            dts.append(time.perf_counter() - t0)
+        rates[tier.name] = batch / min(dts)
+        energies[tier.name] = en
+
+    # QUAD rung: the dd (double-double) path on statically bound points
+    # — each point is its own compiled program (dd rejects Params), so
+    # this rung's points/sec INCLUDES its compile cost: the honest price
+    # of reference-grade accuracy, and the f64-class oracle the fast
+    # rungs' deviation is graded against
+    t0 = time.perf_counter()
+    quad_en = []
+    for b in range(opoints):
+        bound = _bound_hea(num_qubits, layers, dict(zip(names, pm[b])))
+        dd = bound.compile_dd(env)
+        state = dd.unpack(dd.run(dd.init_zero()))
+        quad_en.append(_pauli_energy_host(state, codes, coeffs))
+    quad_rate = opoints / max(time.perf_counter() - t0, 1e-9)
+    quad_en = np.asarray(quad_en)
+    dev_fast = float(np.max(np.abs(energies["fast"][:opoints] - quad_en)))
+    dev_single = float(np.max(np.abs(energies["single"][:opoints]
+                                     - quad_en)))
+    modeled_fast = modeled_tier_error(FAST_TIER, n_gates)
+
+    # escalation pass: the serving runtime under ONE injected precision
+    # fault (a drifted result row) on FAST-tier state requests — the
+    # violation must re-execute one tier up, never reach a caller wrong
+    from quest_tpu.resilience import FaultInjector, FaultSpec, inject
+    from quest_tpu.serve import SimulationService
+    esc_requests = min(batch, 32)
+    ref_planes = np.asarray(cc.sweep(pm[:esc_requests]))
+    tol = tier_runtime_tol(FAST_TIER, n_gates)
+    inj = FaultInjector([FaultSpec(kind="precision",
+                                   site="serve.execute", at_calls=(0,))],
+                        seed=7)
+    with inject(inj):
+        with SimulationService(env, max_batch=16,
+                               max_wait_s=2e-3) as svc:
+            futs = [svc.submit(cc, dict(zip(names, pm[b])),
+                               tier=FAST_TIER)
+                    for b in range(esc_requests)]
+            results = [f.result(timeout=300) for f in futs]
+            stats = svc.dispatch_stats()["service"]
+    surviving = 0
+    for b, planes in enumerate(results):
+        if float(np.max(np.abs(np.asarray(planes)
+                               - ref_planes[b]))) > tol:
+            surviving += 1
+
+    itemsize = np.dtype(env.precision.real_dtype).itemsize
+    baseline = _roofline_baseline(num_qubits, itemsize) \
+        / max(n_gates + num_terms, 1)
+    return {
+        "metric": f"precision tiers FAST vs SINGLE vs QUAD, "
+                  f"hardware-efficient-ansatz-{num_qubits} "
+                  f"{batch}-point ensemble sweep, {num_terms}-term "
+                  f"Pauli sum, {dev_desc}",
+        "value": round(rates["fast"], 2),
+        "unit": "points/sec",
+        "vs_baseline": round(rates["fast"] / baseline, 4),
+        "speedup_fast_vs_single": round(
+            rates["fast"] / max(rates["single"], 1e-9), 3),
+        "single_points_per_sec": round(rates["single"], 2),
+        "quad_points_per_sec": round(quad_rate, 4),
+        "oracle_points": opoints,
+        "max_abs_dev_fast_vs_quad": dev_fast,
+        "max_abs_dev_single_vs_quad": dev_single,
+        "modeled_fast_error": modeled_fast,
+        "fast_within_modeled_budget": bool(dev_fast <= modeled_fast),
+        "fast_tier_dispatches": stats["fast_tier_dispatches"],
+        "tier_violations": stats["tier_violations"],
+        "tier_escalations": stats["tier_escalations"],
+        "injected_precision_faults": inj.counts("precision"),
+        "budget_violations_surviving": surviving,
+    }
 
 
 def bench_serving(qt, env, platform: str) -> list:
@@ -1956,6 +2125,7 @@ def main() -> None:
         ("paulisum", 45, lambda: bench_pauli_sum(qt, env, platform)),
         ("sweep", 45, lambda: bench_ensemble_sweep_config(qt, env,
                                                           platform)),
+        ("tiers", 45, lambda: bench_precision_tiers(qt, env, platform)),
         ("serve", 45, lambda: bench_serving_config(qt, env, platform)),
         ("chaos", 45, lambda: bench_serving_chaos(qt, env, platform)),
         ("router", 45, lambda: bench_replicated_serving(qt, platform)),

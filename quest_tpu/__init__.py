@@ -36,14 +36,19 @@ The public API mirrors the reference's function names and argument orders
 (``QuEST.h``); C count-parameters are inferred from Python sequence lengths.
 """
 
-from .config import Precision, SINGLE, DOUBLE, QUAD, QUAD64, default_precision
+from .config import (Precision, SINGLE, DOUBLE, QUAD, QUAD64,
+                     default_precision, PrecisionTier, FAST_TIER,
+                     SINGLE_TIER, DOUBLE_TIER, QUAD_TIER, TIER_LADDER,
+                     tier_by_name)
+from .profiling import (choose_tier, modeled_tier_error, engine_tiers,
+                        tier_runtime_tol)
 from .types import (
     PauliOpType, PAULI_I, PAULI_X, PAULI_Y, PAULI_Z,
     QuESTError, invalid_quest_input_error, invalidQuESTInputError,
     set_input_error_handler,
 )
 from .env import (QuESTEnv, create_quest_env, destroy_quest_env,
-                  initialize_multihost)
+                  initialize_multihost, default_compensated)
 from .qureg import Qureg
 from .circuits import Circuit, CompiledCircuit, Param
 from .qasm_import import ParsedQASM, parse_qasm, load_qasm_file
@@ -62,6 +67,10 @@ __version__ = "0.1.0"
 __all__ = (
     [
         "Precision", "SINGLE", "DOUBLE", "QUAD", "QUAD64", "default_precision",
+        "PrecisionTier", "FAST_TIER", "SINGLE_TIER", "DOUBLE_TIER",
+        "QUAD_TIER", "TIER_LADDER", "tier_by_name", "choose_tier",
+        "modeled_tier_error", "engine_tiers", "tier_runtime_tol",
+        "default_compensated",
         "PauliOpType", "PAULI_I", "PAULI_X", "PAULI_Y", "PAULI_Z",
         "QuESTError", "invalid_quest_input_error",
         "invalidQuESTInputError", "set_input_error_handler",

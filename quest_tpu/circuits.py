@@ -758,7 +758,9 @@ class Circuit:
                 supergate_k: int = 4, fusion: Optional[object] = None,
                 density: bool = False, comm_planner: Optional[bool] = None,
                 overlap: bool = False,
-                reorder: Optional[bool] = None) -> "CompiledCircuit":
+                reorder: Optional[bool] = None,
+                error_budget: Optional[float] = None,
+                tier=None) -> "CompiledCircuit":
         """Compile to one XLA program; ``lookahead`` is the layout planner's
         relayout-batching window (quest_tpu.parallel.layout); ``pallas``
         controls the fused-layer kernel pass (None=auto on TPU,
@@ -788,7 +790,19 @@ class Circuit:
         the interconnect tier they cross and each relayout evicts its
         coldest qubits to the inter-host device positions, keeping
         upcoming work on the fast tier; ``False`` plans tier-priced but
-        tier-blind (the bench's reordering-off rows)."""
+        tier-blind (the bench's reordering-off rows).
+
+        ``error_budget`` is the precision-tier dial (ROADMAP item 4):
+        instead of choosing a dtype, state the max amplitude error this
+        program's results may carry and the engine picks the CHEAPEST
+        :class:`~quest_tpu.config.PrecisionTier` whose modeled error
+        (drift-per-gate x depth, :func:`quest_tpu.profiling.
+        modeled_tier_error`) fits — FAST (bf16-input MXU matmuls with
+        compensated f32 accumulation) when the budget allows, up the
+        ladder otherwise; an unmeetable budget raises ``ValueError``
+        here, never a silently-wrong answer later. ``tier`` pins a rung
+        explicitly (a :class:`~quest_tpu.config.PrecisionTier` or its
+        name); both default to the legacy per-environment precision."""
         if density:
             from . import validation as val
             for op in self.ops:
@@ -803,12 +817,17 @@ class Circuit:
                     "circuit contains Kraus channels; compile with "
                     "density=True and run on a density register")
             circ = self
+        if tier is None and error_budget is not None:
+            from .profiling import choose_tier
+            tier = choose_tier(float(error_budget), max(len(circ.ops), 1),
+                               env)
         cc = CompiledCircuit(circ, env, donate=donate, fuse=fuse,
                              lookahead=lookahead, pallas=pallas,
                              supergate_k=supergate_k, fusion=fusion,
                              comm_planner=comm_planner, overlap=overlap,
-                             reorder=reorder)
+                             reorder=reorder, tier=tier)
         cc.is_density = density
+        cc.error_budget = error_budget
         return cc
 
     def compile_native(self, threads: Optional[int] = None,
@@ -1385,17 +1404,27 @@ class CompiledCircuit:
                  supergate_k: int = 4, fusion: Optional[object] = None,
                  comm_planner: Optional[bool] = None,
                  overlap: bool = False,
-                 reorder: Optional[bool] = None):
+                 reorder: Optional[bool] = None,
+                 tier=None):
         self.circuit = circuit
         self.env = env
         self.num_qubits = circuit.num_qubits
         self.param_names = circuit.param_names
+        # precision tier (config.PrecisionTier; None = the legacy
+        # per-environment precision): decides the matmul precision every
+        # gate contraction runs at, whether observable reductions take
+        # the compensated pair path, and the plane dtype the EXECUTION
+        # computes in (a FAST/SINGLE-tier program on an f64 env runs
+        # f32 inside the executable; callers still see env-dtype planes)
+        self.tier = self._resolve_tier(tier)
+        self._gate_prec, self._pallas_fast = self._tier_exec_mode(self.tier)
         # recorded for the layer-free twin (_xla_only): it must differ
         # from this program ONLY in the Pallas pass
         self._compile_opts = {"fuse": fuse, "lookahead": lookahead,
                               "supergate_k": supergate_k, "fusion": fusion,
                               "comm_planner": comm_planner,
-                              "overlap": overlap, "reorder": reorder}
+                              "overlap": overlap, "reorder": reorder,
+                              "tier": self.tier}
         n = circuit.num_qubits
         if (1 << n) < env.num_devices:   # register smaller than the mesh
             sharding = None
@@ -1573,6 +1602,8 @@ class CompiledCircuit:
         self._overlapped_pairs = 0
         plan_items = self.plan.items
         flat_sharding = env.sharding_flat() if shard_bits else None
+        gate_prec = self._gate_prec
+        pallas_fast = self._pallas_fast
 
         def run_plan_seq(state, params):
             """Sequential (single-trace) form: relayouts as plain
@@ -1593,12 +1624,14 @@ class CompiledCircuit:
                 if op.kind == "layer":
                     from .ops import pallas_kernels as pk
                     state = pk.apply_layer(
-                        state, n, op, interpret=self._pallas_interpret)
+                        state, n, op, interpret=self._pallas_interpret,
+                        fast=pallas_fast)
                 elif op.kind == "u":
                     u = op.mat_fn(params) if op.mat_fn is not None \
                         else op.mat
                     state = apply_unitary(state, n, u, phys_targets,
-                                          cmask, fmask)
+                                          cmask, fmask,
+                                          precision=gate_prec)
                 else:
                     d = op.diag_fn(params) if op.diag_fn is not None \
                         else op.diag
@@ -1659,7 +1692,8 @@ class CompiledCircuit:
                             u = op.mat_fn(params) if op.mat_fn is not None \
                                 else op.mat
                             local = run_exchange_overlapped(
-                                local, expl, AMP_AXIS, u, pt, cmask, fmask)
+                                local, expl, AMP_AXIS, u, pt, cmask, fmask,
+                                precision=gate_prec)
                             consumed = True
                             continue
                         local = run_exchange(local, expl, AMP_AXIS)
@@ -1676,12 +1710,14 @@ class CompiledCircuit:
                         from .ops import pallas_kernels as pk
                         local = pk.apply_layer(
                             local, lt, op,
-                            interpret=self._pallas_interpret)
+                            interpret=self._pallas_interpret,
+                            fast=pallas_fast)
                     elif op.kind == "u":
                         u = op.mat_fn(params) if op.mat_fn is not None \
                             else op.mat
                         local = apply_op_local(local, "u", u, phys_targets,
-                                               cmask, fmask, lt, AMP_AXIS)
+                                               cmask, fmask, lt, AMP_AXIS,
+                                               precision=gate_prec)
                     else:
                         d = op.diag_fn(params) if op.diag_fn is not None \
                             else op.diag
@@ -1704,10 +1740,23 @@ class CompiledCircuit:
         self._run_plan = run_plan
         self._flat_sharding = flat_sharding
 
+        env_rdt = np.dtype(env.precision.real_dtype)
+        tier_rdt, tier_cdt = self._tier_dtypes(self.tier, env)
+        self._run_rdtype = tier_rdt
+
         def apply_fn(state_f, param_vec):
             params = {name: param_vec[i]
                       for i, name in enumerate(self.param_names)}
-            out = pack(run_plan(unpack(state_f), params))
+            z = unpack(state_f)
+            # tier execution dtype: a FAST/SINGLE-tier program on an f64
+            # env computes in f32 (half the memory traffic — part of
+            # what the budget bought); callers keep env-dtype planes
+            if z.dtype != tier_cdt:
+                z = z.astype(tier_cdt)
+            z = run_plan(z, params)
+            out = pack(z)
+            if out.dtype != env_rdt:
+                out = out.astype(env_rdt)
             if sharding is not None:
                 out = jax.lax.with_sharding_constraint(out, sharding)
             return out
@@ -1743,6 +1792,75 @@ class CompiledCircuit:
         # which ticks actually pay a check
         self._health_counter = 0
 
+    def _resolve_tier(self, tier):
+        """Validate a tier request for engine execution (None passes
+        through). QUAD rides the DDProgram path, not the engine; the
+        DOUBLE tier's f64 planes need x64 (without it JAX silently
+        downcasts — the QUAD64 env guard, one ladder down) AND an f64
+        STORAGE env — results leave the engine as env-dtype planes, so
+        on an f32 env a DOUBLE execution would round straight back to
+        f32 on exit and quietly deliver SINGLE-tier accuracy."""
+        if tier is None:
+            return None
+        from .config import tier_by_name
+        tier = tier_by_name(tier)
+        if tier.name == "quad":
+            raise ValueError(
+                "the QUAD tier holds double-double planes; compile with "
+                "Circuit.compile_dd (static circuits) — the batched "
+                "engine ladder tops out at DOUBLE")
+        if tier.real_dtype == jnp.dtype("float64"):
+            if not jax.config.jax_enable_x64:
+                raise ValueError(
+                    "the DOUBLE tier needs jax_enable_x64; without it "
+                    "JAX silently downcasts the f64 planes and the tier "
+                    "quietly degrades to SINGLE")
+            if np.dtype(self.env.precision.real_dtype) != \
+                    np.dtype(np.float64):
+                raise ValueError(
+                    "the DOUBLE tier needs an f64-storage environment: "
+                    "results are returned as env-dtype planes, so on "
+                    "this f32 env the f64 execution would round back "
+                    "to f32 on exit — create the env with "
+                    "precision=DOUBLE (or use compile_dd)")
+        return tier
+
+    def _effective_tier(self, tier):
+        """The tier one engine dispatch runs at: the per-call override
+        (serving submits per-request tiers against one compiled
+        program), else the compile-time tier, else None (legacy env
+        precision)."""
+        if tier is None:
+            return self.tier
+        return self._resolve_tier(tier)
+
+    @staticmethod
+    def _tier_exec_mode(tier) -> tuple:
+        """(matmul precision override, pallas fast flag) for one tier —
+        the ONE definition of the tier -> execution-mode rule, shared by
+        the compile-time program (``__init__``) and the per-dispatch
+        batched runners."""
+        fast = tier is not None and tier.matmul_precision == "default"
+        return (jax.lax.Precision.DEFAULT if fast else None), fast
+
+    @staticmethod
+    def _tier_token(tier) -> str:
+        """The executable-cache key component for a tier: tier name, or
+        ``"env"`` for the legacy per-environment precision. Shared by
+        the batched cache, the warm-form keys, and (through those) the
+        persistent WarmCache — a tier mismatch is always a MISS, never
+        a wrong program."""
+        return tier.name if tier is not None else "env"
+
+    @staticmethod
+    def _tier_dtypes(tier, env) -> tuple:
+        """(real, complex) EXECUTION dtypes for one dispatch."""
+        rdt = np.dtype(tier.real_dtype) if tier is not None \
+            else np.dtype(env.precision.real_dtype)
+        cdt = jnp.complex64 if rdt == np.dtype(np.float32) \
+            else jnp.complex128
+        return rdt, cdt
+
     def _param_vec(self, params: Optional[dict]) -> jnp.ndarray:
         if params is None:
             params = {}
@@ -1762,9 +1880,19 @@ class CompiledCircuit:
             return self._empty_vec
         return jnp.asarray(vals, dtype=self.env.precision.real_dtype)
 
+    def _modeled_tier_error(self) -> float:
+        """The budget model's per-run error bound for this program's
+        compile-time tier (0.0 when no tier is selected)."""
+        if self.tier is None:
+            return 0.0
+        from .profiling import modeled_tier_error
+        return float(modeled_tier_error(self.tier,
+                                        max(self.circuit.depth, 1)))
+
     # -- execution ---------------------------------------------------------
 
     is_density = False   # set by Circuit.compile(density=True)
+    error_budget = None  # set by Circuit.compile(error_budget=...)
     _aot = None          # set by precompile()
 
     def precompile(self) -> "CompiledCircuit":
@@ -1849,7 +1977,7 @@ class CompiledCircuit:
         return self._jitted(state_f, vec)
 
     def _health_tick(self, planes, *, is_density: bool, num_qubits: int,
-                     where: str):
+                     where: str, tier=None):
         """Numerical health guard at the dispatch boundary: every
         ``cadence``-th guarded dispatch (global config,
         :func:`quest_tpu.resilience.health.configure` /
@@ -1857,7 +1985,15 @@ class CompiledCircuit:
         NaN/Inf, statevector norm, density trace — as one tiny jitted
         reduction, raising a typed ``NumericalFault`` or renormalizing
         in the degraded mode. Free when the guard is off (one int
-        compare)."""
+        compare).
+
+        With a precision tier active the check is the tier's FIDELITY
+        MONITOR: the drift threshold widens to the tier's runtime
+        tolerance (:func:`quest_tpu.profiling.tier_runtime_tol` — the
+        modeled per-run error with headroom, so an in-budget FAST run
+        never trips) and a violation carries the ``"precision"`` fault
+        kind, which the serving recovery policy answers by re-executing
+        one tier up instead of retrying the same rung."""
         cfg = _health.get_config()
         if cfg.cadence <= 0:
             return planes
@@ -1866,9 +2002,19 @@ class CompiledCircuit:
             due = (self._health_counter % cfg.cadence) == 0
         if not due:
             return planes
+        drift_kind = None
+        if tier is None:
+            tier = self.tier
+        if tier is not None:
+            from .profiling import tier_runtime_tol
+            tol = tier_runtime_tol(tier, max(self.circuit.depth, 1))
+            if tol > cfg.norm_tol:
+                cfg = dataclasses.replace(cfg, norm_tol=tol)
+            drift_kind = "precision"
         return _health.check_planes(
             planes, is_density=is_density, num_qubits=num_qubits,
-            config=cfg, where=f"{where} ({self.num_qubits}q program)")
+            config=cfg, where=f"{where} ({self.num_qubits}q program)",
+            drift_kind=drift_kind)
 
     def _aot_accepts(self, state_f) -> bool:
         """True when the precompiled executable can take this input as
@@ -1973,7 +2119,9 @@ class CompiledCircuit:
             host_syncs_avoided=bs.get("host_syncs_avoided", 0),
             batch_sharding_mode=bs.get("batch_sharding_mode", "none"),
             batched_cache_size=cache_size,
-            batched_cache_evictions=cache_evictions)
+            batched_cache_evictions=cache_evictions,
+            precision_tier=self._tier_token(self.tier),
+            modeled_tier_error=self._modeled_tier_error())
 
     def _xla_only(self) -> "CompiledCircuit":
         """This program with the Pallas layer pass off (cached twin).
@@ -2097,13 +2245,15 @@ class CompiledCircuit:
             segs.append(("seq", tuple(cur)))
         return segs
 
-    def _run_plan_batched(self, states, pm):
+    def _run_plan_batched(self, states, pm, gate_prec=None,
+                          pallas_fast: bool = False):
         """(batch, 2^n) complex states + (batch, P) params -> same shape.
         Mirrors ``run_plan_seq`` (relayouts as plain transposes; a
         cross-shard pair-exchange item is just the unitary at its
         physical position — the full-state form reaches any bit), with
         the batch axis vmapped per segment and fused layers applied by
-        the batch-gridded Pallas kernel."""
+        the batch-gridded Pallas kernel. ``gate_prec``/``pallas_fast``
+        carry one dispatch's precision-tier matmul mode."""
         from .parallel import apply_relayout
         n = self.num_qubits
         ops = self._ops
@@ -2113,7 +2263,8 @@ class CompiledCircuit:
                 from .ops import pallas_kernels as pk
                 states = pk.apply_layer_batched(
                     states, n, ops[payload],
-                    interpret=self._pallas_interpret)
+                    interpret=self._pallas_interpret,
+                    fast=pallas_fast)
                 continue
 
             def seg_fn(state, vec, _items=payload):
@@ -2130,7 +2281,8 @@ class CompiledCircuit:
                         u = op.mat_fn(params) if op.mat_fn is not None \
                             else op.mat
                         state = apply_unitary(state, n, u, phys_targets,
-                                              cmask, fmask)
+                                              cmask, fmask,
+                                              precision=gate_prec)
                     else:
                         d = op.diag_fn(params) if op.diag_fn is not None \
                             else op.diag
@@ -2163,7 +2315,7 @@ class CompiledCircuit:
         sh = NamedSharding(self.env.mesh, P(None, AMP_AXIS))
         return lambda z: jax.lax.with_sharding_constraint(z, sh)
 
-    def _batched_runner(self, mode: str):
+    def _batched_runner(self, mode: str, tier=None):
         """The plan executor for a policy mode. In ``amp`` mode the
         ensemble is amplitude-sharded under GSPMD, which has no
         partitioning rule for a ``pallas_call`` (it would replicate the
@@ -2171,10 +2323,17 @@ class CompiledCircuit:
         chosen for memory), so the layer-free XLA twin's plan runs
         there; every other mode keeps the fused layers (batch mode wraps
         the call in shard_map, where the kernel sees only the per-device
-        sub-batch)."""
+        sub-batch). ``tier`` (already effective) sets the dispatch's
+        matmul precision and Pallas fast mode."""
         src = self._xla_only() if (mode == "amp"
                                    and self.env.mesh is not None) else self
-        return src._run_plan_batched
+        prec, fast = self._tier_exec_mode(tier)
+
+        def run(states, pm):
+            return src._run_plan_batched(states, pm, gate_prec=prec,
+                                         pallas_fast=fast)
+
+        return run
 
     def _validated_param_matrix(self, param_matrix):
         """Shared (B, P) coercion/validation for the engine entries."""
@@ -2201,26 +2360,36 @@ class CompiledCircuit:
         return shard_map(fn, mesh=self.env.mesh, in_specs=in_specs,
                          out_specs=out_specs, check_vma=False)
 
-    def _batched_fn(self, broadcast: bool, donate: bool, mode: str):
-        """The batched executable for one (form, mode) combination.
-        Keyed cache — dtype and batch-sharding mode are part of the key,
-        so a precision or mesh-policy change compiles fresh instead of
-        reusing a stale program (the round-7 code cached one executable
-        under a bare ``hasattr``)."""
+    def _batched_fn(self, broadcast: bool, donate: bool, mode: str,
+                    tier=None):
+        """The batched executable for one (form, mode, tier) combination.
+        Keyed cache — dtype, batch-sharding mode, AND precision tier are
+        part of the key, so a precision, tier, or mesh-policy change
+        compiles fresh instead of reusing a stale program (a FAST-tier
+        executable must never serve a SINGLE-tier dispatch)."""
         key = (broadcast, donate, mode,
-               str(np.dtype(self.env.precision.real_dtype)))
+               str(np.dtype(self.env.precision.real_dtype)),
+               self._tier_token(tier))
         with self._stats_lock:
             fn = self._batched_cache.get(key)
         if fn is not None:
             return fn
         constrain = self._batch_constraint(mode)
-        run_batched = self._batched_runner(mode)
+        run_batched = self._batched_runner(mode, tier)
+        env_rdt, tier_cdt = np.dtype(self.env.precision.real_dtype), \
+            self._tier_dtypes(tier, self.env)[1]
 
         def body(states, pm):
+            if states.dtype != tier_cdt:
+                # tier execution dtype (FAST/SINGLE on an f64 env runs
+                # f32 inside the executable; callers keep env planes)
+                states = states.astype(tier_cdt)
             states = constrain(states)
             states = run_batched(states, pm)
             out = constrain(states)
-            return jnp.stack([jnp.real(out), jnp.imag(out)], axis=1)
+            planes = jnp.stack([jnp.real(out), jnp.imag(out)], axis=1)
+            return planes.astype(env_rdt) if planes.dtype != env_rdt \
+                else planes
 
         if broadcast:
             def apply_fn(state_f, pm):
@@ -2324,35 +2493,44 @@ class CompiledCircuit:
             codes.reshape(-1), nq, coeffs)
         return nq, T, xm, ym, zm, coeffs
 
-    def _energy_fn(self, mode: str):
-        """The batched-energy jit wrapper for one sharding mode (masks
-        and coefficients are ARGUMENTS, so one executable serves every
-        Hamiltonian of the same bucketed term shape). Cached in the
-        keyed executable cache; also the lowering source for the warm
-        cache's ``energy`` artifacts."""
+    def _energy_fn(self, mode: str, tier=None):
+        """The batched-energy jit wrapper for one (sharding mode, tier)
+        (masks and coefficients are ARGUMENTS, so one executable serves
+        every Hamiltonian of the same bucketed term shape). Cached in
+        the keyed executable cache; also the lowering source for the
+        warm cache's ``energy`` artifacts. A compensated tier
+        (SINGLE/QUAD) routes each Pauli-term reduction through the
+        TwoSum/Veltkamp pair path (:mod:`quest_tpu.ops.reductions`) —
+        ~4x the per-term memory traffic, exact to the state's true sum;
+        the FAST tier keeps the naive reduce its budget already covers."""
         from .ops import reductions as red
         key = ("energy", mode,
-               str(np.dtype(self.env.precision.real_dtype)))
+               str(np.dtype(self.env.precision.real_dtype)),
+               self._tier_token(tier))
         with self._stats_lock:
             fn = self._batched_cache.get(key)
         if fn is not None:
             return fn
         constrain = self._batch_constraint(mode)
-        run_batched = self._batched_runner(mode)
+        run_batched = self._batched_runner(mode, tier)
         is_density = self.is_density
         nq = self.num_qubits // 2 if is_density else self.num_qubits
+        tier_cdt = self._tier_dtypes(tier, self.env)[1]
+        comp = tier is not None and tier.compensated
 
         def energy(state_f_, pm_, xm_, ym_, zm_, cf_):
             z = unpack(state_f_)
+            if z.dtype != tier_cdt:
+                z = z.astype(tier_cdt)
             states = jnp.broadcast_to(z, (pm_.shape[0],) + z.shape)
             states = constrain(states)
             states = run_batched(states, pm_)
             states = constrain(states)
             if is_density:
                 return jax.vmap(lambda s: red.pauli_sum_total_dm(
-                    s, nq, xm_, ym_, zm_, cf_))(states)
+                    s, nq, xm_, ym_, zm_, cf_, compensated=comp))(states)
             return jax.vmap(lambda s: red.pauli_sum_total_sv(
-                s, xm_, ym_, zm_, cf_))(states)
+                s, xm_, ym_, zm_, cf_, compensated=comp))(states)
 
         from jax.sharding import PartitionSpec as P
         from .env import AMP_AXIS
@@ -2367,18 +2545,23 @@ class CompiledCircuit:
 
     # -- warm-start AOT hooks (serve/warmcache.py) -------------------------
 
-    def _warm_form_key(self, kind: str, mode: str) -> tuple:
+    def _warm_form_key(self, kind: str, mode: str, tier=None) -> tuple:
         """The AOT form key shared by :meth:`lower_batched` (the store/
         install side) and the ``sweep``/``expectation_sweep`` dispatch
         lookups — one definition, so a key-shape edit cannot decouple
         install from lookup and silently turn every warm restart back
         into a full recompile. The ``sweep`` booleans pin the form the
-        serving dispatcher uses: shared start state, not donated."""
+        serving dispatcher uses: shared start state, not donated. The
+        precision-tier token is part of the form, so a FAST-tier
+        artifact (in-memory AOT slot or persistent WarmCache entry) is
+        NEVER served to a request compiled at another tier — a tier
+        mismatch is a miss, not a wrong program."""
         dtstr = str(np.dtype(self.env.precision.real_dtype))
+        tok = self._tier_token(tier)
         if kind == "sweep":
-            return ("sweep", True, False, mode, dtstr)
+            return ("sweep", True, False, mode, dtstr, tok)
         if kind == "energy":
-            return ("energy", mode, dtstr)
+            return ("energy", mode, dtstr, tok)
         raise ValueError(f"unknown warm form kind {kind!r}")
 
     @staticmethod
@@ -2408,7 +2591,7 @@ class CompiledCircuit:
                 self._batched_aot.pop(next(iter(self._batched_aot)))
 
     def lower_batched(self, kind: str, batch: int, hamiltonian=None,
-                      lower: bool = True):
+                      lower: bool = True, tier=None):
         """Lower (no compile, no execution) the batched executable one
         warm form would run: ``kind`` is ``"sweep"`` (broadcast start
         state — the serving dispatcher's state/sample form) or
@@ -2423,6 +2606,7 @@ class CompiledCircuit:
         they are covered by the XLA disk-cache layer instead."""
         if batch < 1:
             raise ValueError("batch must be >= 1")
+        tier = self._effective_tier(tier)
         mode = self._batch_policy(int(batch))["mode"]
         if mode != "none":
             raise ValueError(
@@ -2433,22 +2617,22 @@ class CompiledCircuit:
         state = jax.ShapeDtypeStruct((2, 1 << n), dt)
         pm = jax.ShapeDtypeStruct((int(batch), len(self.param_names)), dt)
         if kind == "sweep":
-            form = self._warm_form_key("sweep", mode)
+            form = self._warm_form_key("sweep", mode, tier)
             args = (state, pm)
-            fn_builder = lambda: self._batched_fn(True, False, mode)
+            fn_builder = lambda: self._batched_fn(True, False, mode, tier)
         elif kind == "energy":
             if hamiltonian is None:
                 raise ValueError("kind='energy' needs hamiltonian=")
             _, _, xm, ym, zm, coeffs = self._pauli_operands(hamiltonian)
             xm, ym, zm = jnp.asarray(xm), jnp.asarray(ym), jnp.asarray(zm)
             cf = jnp.asarray(coeffs, dtype=dt)
-            form = self._warm_form_key("energy", mode)
+            form = self._warm_form_key("energy", mode, tier)
             args = (state, pm,
                     jax.ShapeDtypeStruct(xm.shape, xm.dtype),
                     jax.ShapeDtypeStruct(ym.shape, ym.dtype),
                     jax.ShapeDtypeStruct(zm.shape, zm.dtype),
                     jax.ShapeDtypeStruct(cf.shape, cf.dtype))
-            fn_builder = lambda: self._energy_fn(mode)
+            fn_builder = lambda: self._energy_fn(mode, tier)
         else:
             raise ValueError(f"unknown warm form kind {kind!r}")
         shapes = tuple(a.shape for a in args)
@@ -2456,7 +2640,7 @@ class CompiledCircuit:
             return form, shapes, None
         return form, shapes, fn_builder().lower(*args)
 
-    def sweep(self, param_matrix, state_f=None):
+    def sweep(self, param_matrix, state_f=None, tier=None):
         """Run a whole batch of parameter vectors through ONE executable.
 
         ``param_matrix``: ``(B, len(param_names))``. ``state_f``: either
@@ -2471,7 +2655,14 @@ class CompiledCircuit:
         axis shards per :func:`quest_tpu.parallel.layout.
         choose_batch_sharding` — batch-parallel while the per-device
         working set fits, amplitude-sharded past the memory wall — and
-        non-divisible batches are padded and masked."""
+        non-divisible batches are padded and masked.
+
+        ``tier`` runs this dispatch at one precision-tier rung
+        (:class:`~quest_tpu.config.PrecisionTier` or name; default: the
+        compile-time tier, else the env precision) — the serving layer
+        passes per-request tiers against one compiled program, and each
+        tier compiles and caches its OWN executable."""
+        tier = self._effective_tier(tier)
         pm = self._validated_param_matrix(param_matrix)
         poison = _faults.fire("circuits.sweep")
         n = self.num_qubits
@@ -2499,7 +2690,7 @@ class CompiledCircuit:
                 state_f = jnp.zeros((2, 1 << n),
                                     dtype=self.env.precision.real_dtype
                                     ).at[0, 0].set(1.0)
-            form = self._warm_form_key("sweep", mode)
+            form = self._warm_form_key("sweep", mode, tier)
             aot = self._aot_lookup(form, (state_f, pm_run))
             out = None
             if aot is not None:
@@ -2508,7 +2699,8 @@ class CompiledCircuit:
                 except (TypeError, ValueError):
                     out = None   # layout/placement drift: retrace via jit
             if out is None:
-                out = self._batched_fn(True, False, mode)(state_f, pm_run)
+                out = self._batched_fn(True, False, mode,
+                                       tier)(state_f, pm_run)
         else:
             planes = state_f
             if planes.shape != (B, 2, 1 << n):
@@ -2520,16 +2712,17 @@ class CompiledCircuit:
                     [planes, jnp.zeros((pm_run.shape[0] - B,) +
                                        planes.shape[1:], planes.dtype)])
             planes = self._place_batch(planes, mode, amp_shardable=True)
-            out = self._batched_fn(False, True, mode)(planes, pm_run)
+            out = self._batched_fn(False, True, mode, tier)(planes, pm_run)
         self._record_batch_stats(B, mode, B - 1)
         out = out[:B] if out.shape[0] != B else out
         out = _faults.poison_output(poison, out)
         return self._health_tick(
             out, is_density=self.is_density,
             num_qubits=(self.num_qubits // 2 if self.is_density
-                        else self.num_qubits), where="sweep")
+                        else self.num_qubits), where="sweep", tier=tier)
 
-    def expectation_sweep(self, param_matrix, hamiltonian, state_f=None):
+    def expectation_sweep(self, param_matrix, hamiltonian, state_f=None,
+                          tier=None):
         """``(B,)`` energies ``<H>(params_b)`` from ONE executable and
         ONE device->host transfer.
 
@@ -2542,18 +2735,27 @@ class CompiledCircuit:
         reference pays one per TERM per point,
         ``QuEST_common.c:464-491``). Works on density-compiled circuits
         too: the value is ``Tr(H rho(params))`` through the program's
-        channels."""
+        channels. ``tier`` as in :meth:`sweep`; compensated tiers
+        additionally run each Pauli term through the pair-path
+        reduction."""
+        tier = self._effective_tier(tier)
         nq, T, xm, ym, zm, coeffs = self._pauli_operands(hamiltonian)
         n = self.num_qubits
 
         pm = self._validated_param_matrix(param_matrix)
         poison = _faults.fire("circuits.expectation_sweep")
+        if poison == "precision":
+            # energies carry no unit-norm invariant for any monitor to
+            # check, so a drifted energy would be UNDETECTABLE silent
+            # corruption — degrade the injected fault to the NaN form
+            # the screens catch (same rule as the serving boundary)
+            poison = "nan"
         B = pm.shape[0]
         mode = self._batch_policy(B)["mode"]
         pm_run, B = self._padded_params(pm, mode)
         pm_run = self._place_batch(pm_run, mode)
 
-        fn = self._energy_fn(mode)
+        fn = self._energy_fn(mode, tier)
         if state_f is None:
             state_f = jnp.zeros((2, 1 << n),
                                 dtype=self.env.precision.real_dtype
@@ -2569,7 +2771,8 @@ class CompiledCircuit:
         args = (state_f, pm_run, jnp.asarray(xm), jnp.asarray(ym),
                 jnp.asarray(zm),
                 jnp.asarray(coeffs, dtype=self.env.precision.real_dtype))
-        aot = self._aot_lookup(self._warm_form_key("energy", mode), args)
+        aot = self._aot_lookup(self._warm_form_key("energy", mode, tier),
+                               args)
         out = None
         if aot is not None:
             try:
@@ -2585,7 +2788,8 @@ class CompiledCircuit:
         out = out[:B] if out.shape[0] != B else out
         return _faults.poison_output(poison, out)
 
-    def sample_sweep(self, param_matrix, num_shots: int, key=None):
+    def sample_sweep(self, param_matrix, num_shots: int, key=None,
+                     tier=None):
         """Shot batches over a parameter sweep: run the batched program
         and draw ``num_shots`` basis outcomes per point (one vmapped
         sampling pass, :func:`quest_tpu.parallel.sampling.
@@ -2597,7 +2801,7 @@ class CompiledCircuit:
                 "sample_sweep draws from |amp|^2 of statevector "
                 "programs; sample density registers via sampleOutcomes")
         from .parallel.sampling import sample_batched
-        planes = self.sweep(param_matrix)
+        planes = self.sweep(param_matrix, tier=tier)
         if key is None:
             key = self.env.next_key()
         idx, totals = sample_batched(planes, key, int(num_shots))

@@ -17,7 +17,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["Precision", "SINGLE", "DOUBLE", "QUAD", "QUAD64",
-           "default_precision"]
+           "default_precision", "PrecisionTier", "FAST_TIER",
+           "SINGLE_TIER", "DOUBLE_TIER", "QUAD_TIER", "TIER_LADDER",
+           "tier_by_name"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,3 +59,74 @@ QUAD64 = Precision(4, jnp.dtype("float64"), jnp.dtype("complex128"), 1e-14)
 def default_precision() -> Precision:
     """DOUBLE when x64 is enabled (CPU test rigs), else SINGLE (TPU)."""
     return DOUBLE if jax.config.jax_enable_x64 else SINGLE
+
+
+# ---------------------------------------------------------------------------
+# precision tiers (the per-REQUEST performance dial; ROADMAP item 4)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionTier:
+    """One rung of the execution-precision ladder.
+
+    Where :class:`Precision` is the REGISTER's storage format (a
+    per-environment choice, the ``QuEST_PREC`` analogue), a tier is a
+    per-request EXECUTION mode: it decides the matmul precision the gate
+    contractions run at, whether scalar/observable reductions use the
+    compensated (TwoSum/Veltkamp pair) path, and the plane dtype the
+    engine computes in. The ladder is ordered by ``rank`` — higher rank
+    = more accurate and slower — and the budget API
+    (:func:`quest_tpu.profiling.choose_tier`) picks the LOWEST rank
+    whose modeled error fits a caller-stated budget.
+
+    ``drift_per_gate`` seeds the tier error model: the worst-case max
+    amplitude deviation one gate pass adds at this tier (measured
+    constants, docs/accuracy.md — the bf16 MXU figure for FAST, the f32
+    rounding envelope for SINGLE).
+    """
+
+    name: str                # "fast" | "single" | "double" | "quad"
+    rank: int                # ladder position (0 = fastest)
+    drift_per_gate: float    # seed error-model constant (docs/accuracy.md)
+    matmul_precision: str    # "default" (bf16 MXU inputs) | "highest"
+    compensated: bool        # compensated (pair-path) reductions
+    real_dtype: jnp.dtype    # plane dtype the tier executes in
+
+
+# FAST: Precision.DEFAULT matmuls — on the TPU MXU that is ONE bf16-input
+# pass where HIGHEST pays six — with bf16-split compensated f32 lane
+# accumulation in the Pallas layer kernel (ops/pallas_kernels.py).
+# Seeded WELL ABOVE every measured figure (3.3e-5 per lane matmul,
+# 7.0e-5 per layer on r5 silicon — docs/accuracy.md) because FAST
+# dispatches are not all compensated lane matmuls: plain dense gates on
+# the XLA path run raw Precision.DEFAULT, whose uncompensated worst
+# case approaches ~1e-3/gate (core/apply.py). 5e-4 covers both forms on
+# every backend; the per-backend microbench can only tighten it.
+FAST_TIER = PrecisionTier("fast", 0, 5e-4, "default", False,
+                          jnp.dtype("float32"))
+# SINGLE-compensated: full-f32 (HIGHEST) matmuls plus the compensated
+# pair-path reductions (ops/reductions.py) for scalar observables — the
+# ~1e-7/gate worst-case f32 envelope (observed ~5e-9, docs/accuracy.md).
+SINGLE_TIER = PrecisionTier("single", 1, 1e-7, "highest", True,
+                            jnp.dtype("float32"))
+# DOUBLE: f64 planes (x64-capable backends only).
+DOUBLE_TIER = PrecisionTier("double", 2, 1e-15, "highest", False,
+                            jnp.dtype("float64"))
+# QUAD: double-double planes (ops/doubledouble.py) — measured 6.3e-15
+# over 1000 gates on dd-f32 (docs/accuracy.md), i.e. ~1e-17/gate. Rides
+# the DDProgram path (static circuits), not the batched engine.
+QUAD_TIER = PrecisionTier("quad", 3, 1e-17, "highest", True,
+                          jnp.dtype("float32"))
+
+TIER_LADDER = (FAST_TIER, SINGLE_TIER, DOUBLE_TIER, QUAD_TIER)
+
+
+def tier_by_name(name) -> PrecisionTier:
+    """Resolve a tier by its name (accepts a PrecisionTier unchanged)."""
+    if isinstance(name, PrecisionTier):
+        return name
+    for t in TIER_LADDER:
+        if t.name == str(name).lower():
+            return t
+    raise ValueError(f"unknown precision tier {name!r}; expected one of "
+                     f"{[t.name for t in TIER_LADDER]}")

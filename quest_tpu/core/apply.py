@@ -126,6 +126,7 @@ def apply_unitary(
     targets: Sequence[int],
     ctrl_mask: int = 0,
     flip_mask: int = 0,
+    precision=None,
 ) -> jnp.ndarray:
     """Apply a ``2^k x 2^k`` operator to target qubits of a flat state.
 
@@ -134,8 +135,18 @@ def apply_unitary(
     the mask/flip-mask semantics of ``statevec_multiControlledUnitary``
     (``QuEST_cpu.c:2146``) and multiStateControlledUnitary.
 
+    ``precision`` sets the matmul precision of the contraction (default
+    ``HIGHEST``, the full-f32 MXU passes; the FAST precision tier passes
+    ``Precision.DEFAULT`` — bf16 MXU inputs — through the compiled-
+    circuit executors, trading the ~1e-4/gate drift the tier error
+    model budgets for one MXU pass instead of six).
+
     All arguments except ``state`` and ``u`` must be static under jit.
     """
+    # HIGHEST keeps the MXU in full-f32 passes: the TPU default (bf16
+    # operands) loses ~1e-3 per gate worst case, far outside simulation
+    # tolerance unless a caller-stated error budget opted into it
+    prec = jax.lax.Precision.HIGHEST if precision is None else precision
     targets = tuple(int(t) for t in targets)
     k = len(targets)
     controls = tuple(q for q in range(num_qubits) if (ctrl_mask >> q) & 1)
@@ -154,7 +165,7 @@ def apply_unitary(
                 perm_asc = permutation_to_order(targets, tuple(range(k)))
                 u = u[perm_asc][:, perm_asc]
             s = state.reshape(-1, 1 << k)
-            out = jnp.matmul(s, u.T, precision=jax.lax.Precision.HIGHEST)
+            out = jnp.matmul(s, u.T, precision=prec)
             return out.reshape(-1)
         lo = min(targets) if targets else 0
         if not controls and set(targets) == set(range(lo, lo + k)):
@@ -167,7 +178,7 @@ def apply_unitary(
                 perm_o = permutation_to_order(targets, order)
                 u = u[perm_o][:, perm_o]
             s = state.reshape(-1, 1 << k, 1 << lo)
-            out = jnp.matmul(u, s, precision=jax.lax.Precision.HIGHEST)
+            out = jnp.matmul(u, s, precision=prec)
             return out.reshape(-1)
 
         pos_desc = tuple(sorted(targets + controls, reverse=True))
@@ -191,12 +202,7 @@ def apply_unitary(
         if not np.array_equal(row_perm, np.arange(1 << k)):
             u = u[row_perm][:, row_perm]
 
-        # HIGHEST keeps the MXU in full-f32 passes: the TPU default (bf16
-        # operands) loses ~1e-3 per gate, far outside simulation tolerance,
-        # and these tall-skinny matmuls are HBM-bound anyway so the extra MXU
-        # passes are free
-        new = jnp.matmul(u, sub.reshape(1 << k, -1),
-                         precision=jax.lax.Precision.HIGHEST)
+        new = jnp.matmul(u, sub.reshape(1 << k, -1), precision=prec)
         new = new.reshape((2,) * k + rest_shape)
         arr = arr.at[ctrl_idx].set(new) if controls else new
 

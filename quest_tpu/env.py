@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .config import Precision, default_precision
 
 __all__ = ["QuESTEnv", "create_quest_env", "destroy_quest_env",
-           "initialize_multihost"]
+           "initialize_multihost", "default_compensated"]
 
 AMP_AXIS = "amps"
 
@@ -124,6 +124,17 @@ class QuESTEnv:
         return "\n".join(lines)
 
 
+def default_compensated(precision: Precision) -> bool:
+    """The ONE definition of the compensated-reductions default: on for
+    single precision (where naive f32 accumulation falls ~5 decades
+    short of the reference's 1e-10 scalar tolerance), off for double
+    and the dd tiers (already exact enough). Shared by
+    :func:`create_quest_env` and the router's replica-env builder
+    (:func:`quest_tpu.serve.router.replica_envs`) so replica
+    environments can never drift from the primary's default."""
+    return precision.quest_prec == 1
+
+
 def create_quest_env(
     num_devices: Optional[int] = None,
     precision: Optional[Precision] = None,
@@ -147,7 +158,7 @@ def create_quest_env(
             "downcasts the f64 planes and the quad tier quietly "
             "degrades — use QUAD (f32 planes) on x64-less backends")
     if compensated is None:
-        compensated = precision.quest_prec == 1
+        compensated = default_compensated(precision)
     devices = jax.devices()
     n = len(devices) if num_devices is None else num_devices
     if n > len(devices):

@@ -177,7 +177,7 @@ def _global_row(base, shape, axis):
 
 def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, tre_ref, tim_ref,
                   ore_ref, oim_ref, *, stages, block_rows,
-                  batched: bool = False):
+                  batched: bool = False, fast: bool = False):
     from jax.experimental import pallas as pl
 
     # batched form: the grid grows a LEADING batch dimension and state
@@ -206,16 +206,44 @@ def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, tre_ref, tim_ref,
             # via 4 real MXU matmuls on (rows,128)x(128,128).
             # Precision.HIGHEST: the TPU MXU defaults to bf16 inputs,
             # which costs ~1e-4 per layer (measured 7.0e-5 amp deviation
-            # on the r5 silicon smoke); HIGHEST selects the f32 passes
-            hp = jax.lax.Precision.HIGHEST
-            new_re = (jnp.dot(re, mre_t, preferred_element_type=acc,
-                              precision=hp)
-                      - jnp.dot(im, mim_t, preferred_element_type=acc,
-                                precision=hp))
-            new_im = (jnp.dot(re, mim_t, preferred_element_type=acc,
-                              precision=hp)
-                      + jnp.dot(im, mre_t, preferred_element_type=acc,
-                                precision=hp))
+            # on the r5 silicon smoke); HIGHEST selects the f32 passes.
+            # FAST tier: Precision.DEFAULT (one bf16-input MXU pass
+            # where HIGHEST pays six) with bf16-split compensated
+            # accumulation — the STATE operand splits error-free into a
+            # bf16 hi plane plus the f32 residual, each rides its own
+            # cheap pass, and the small residual partial sums combine
+            # FIRST so their correction lands in one f32 add instead of
+            # drowning term-by-term in the dominant sums. The remaining
+            # drift is the per-gate MATRIX rounding the tier error
+            # model budgets conservatively at 5e-4/gate
+            # (docs/accuracy.md "Precision tiers").
+            if fast:
+                lp = jax.lax.Precision.DEFAULT
+
+                def _fdot(v, m):
+                    hi = v.astype(jnp.bfloat16).astype(acc)
+                    lo = (v - hi).astype(acc)
+                    return (jnp.dot(hi, m, preferred_element_type=acc,
+                                    precision=lp),
+                            jnp.dot(lo, m, preferred_element_type=acc,
+                                    precision=lp))
+
+                rr_h, rr_l = _fdot(re, mre_t)
+                ii_h, ii_l = _fdot(im, mim_t)
+                ri_h, ri_l = _fdot(re, mim_t)
+                ir_h, ir_l = _fdot(im, mre_t)
+                new_re = (rr_h - ii_h) + (rr_l - ii_l)
+                new_im = (ri_h + ir_h) + (ri_l + ir_l)
+            else:
+                hp = jax.lax.Precision.HIGHEST
+                new_re = (jnp.dot(re, mre_t, preferred_element_type=acc,
+                                  precision=hp)
+                          - jnp.dot(im, mim_t, preferred_element_type=acc,
+                                    precision=hp))
+                new_im = (jnp.dot(re, mim_t, preferred_element_type=acc,
+                                  precision=hp)
+                          + jnp.dot(im, mre_t, preferred_element_type=acc,
+                                    precision=hp))
             new_re = new_re.astype(re.dtype)
             new_im = new_im.astype(im.dtype)
             if row_mask:
@@ -490,9 +518,16 @@ def _compiler_kwargs(interpret: bool, vmem_limit: int) -> dict:
 
 def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
                 block_rows: int = DEFAULT_BLOCK_ROWS,
-                interpret: bool = False) -> jnp.ndarray:
+                interpret: bool = False,
+                fast: bool = False) -> jnp.ndarray:
     """Apply a fused layer to a flat complex state (traceable; call under
-    jit — the pallas_call compiles into the surrounding program)."""
+    jit — the pallas_call compiles into the surrounding program).
+
+    ``fast=True`` selects the FAST precision tier's lane stage:
+    bf16-input (``Precision.DEFAULT``) MXU matmuls with bf16-split
+    compensated f32 accumulation instead of the full-f32 ``HIGHEST``
+    passes — the per-tier trade the budget API prices
+    (:func:`quest_tpu.profiling.choose_tier`)."""
     from jax.experimental import pallas as pl
 
     rdtype = jnp.float32 if state.dtype == jnp.complex64 else jnp.float64
@@ -502,7 +537,7 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
     re = jnp.real(state).astype(rdtype).reshape(total_rows, 128)
     im = jnp.imag(state).astype(rdtype).reshape(total_rows, 128)
     kernel = functools.partial(_layer_kernel, stages=tuple(kstages),
-                               block_rows=block_rows)
+                               block_rows=block_rows, fast=fast)
     state_spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
     mat_spec = pl.BlockSpec(mstack.shape, lambda i: (0, 0, 0))
     tab_spec = pl.BlockSpec(tstack.shape, lambda i: (0, 0))
@@ -522,7 +557,8 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
 
 def apply_layer_batched(states: jnp.ndarray, num_qubits: int, layer: LayerOp,
                         block_rows: int = DEFAULT_BLOCK_ROWS,
-                        interpret: bool = False) -> jnp.ndarray:
+                        interpret: bool = False,
+                        fast: bool = False) -> jnp.ndarray:
     """Apply a fused layer to a BATCH of flat complex states
     ``(batch, 2^n)`` in one ``pallas_call``.
 
@@ -543,7 +579,8 @@ def apply_layer_batched(states: jnp.ndarray, num_qubits: int, layer: LayerOp,
     re = jnp.real(states).astype(rdtype).reshape(batch, total_rows, 128)
     im = jnp.imag(states).astype(rdtype).reshape(batch, total_rows, 128)
     kernel = functools.partial(_layer_kernel, stages=tuple(kstages),
-                               block_rows=block_rows, batched=True)
+                               block_rows=block_rows, batched=True,
+                               fast=fast)
     state_spec = pl.BlockSpec((1, block_rows, 128), lambda b, i: (b, i, 0))
     mat_spec = pl.BlockSpec(mstack.shape, lambda b, i: (0, 0, 0))
     tab_spec = pl.BlockSpec(tstack.shape, lambda b, i: (0, 0))

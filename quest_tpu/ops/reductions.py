@@ -188,31 +188,54 @@ def _phase_weight(ymask, dtype):
     return wr, wi
 
 
-def pauli_sum_expvals_sv(z, xmask, ymask, zmask):
+def pauli_sum_expvals_sv(z, xmask, ymask, zmask, compensated: bool = False):
     """Per-term <z|P_t|z> for a flat complex statevector ``z`` and mask
     arrays of shape ``(T,)``. Returns a real ``(T,)`` vector; traceable,
-    masks are data. Each term is one xor-gather pass over the state."""
+    masks are data. Each term is one xor-gather pass over the state.
+
+    ``compensated=True`` accumulates each term through the
+    Veltkamp-split/TwoSum pair machinery (:func:`dot_pair`) instead of a
+    naive f32 reduce — the SINGLE-compensated precision tier's
+    observable path (~4x the memory traffic per term; exact to the f32
+    state's true sum, docs/accuracy.md §1). The FAST tier takes the
+    naive branch: its budget already absorbs the ~1e-7 reduction error."""
     idx = jnp.arange(z.shape[0])
     rdtype = jnp.real(z).dtype
+    zr, zi = jnp.real(z), jnp.imag(z)
 
     def one(masks):
         xm, ym, zm = (m.astype(idx.dtype) for m in masks)
         j = idx ^ (xm | ym)
         sign = (1 - 2 * (lax.population_count(j & (ym | zm)) & 1)
                 ).astype(rdtype)
-        acc = jnp.sum(jnp.conj(z) * z[j] * sign)
+        if compensated:
+            # acc = sum(conj(z) * z[j] * sign), each real dot error-free
+            zjr, zji = zr[j] * sign, zi[j] * sign
+            re_s1, re_e1 = dot_pair(zr, zjr)
+            re_s2, re_e2 = dot_pair(zi, zji)
+            im_s1, im_e1 = dot_pair(zr, zji)
+            im_s2, im_e2 = dot_pair(zi, zjr)
+            acc_re = (re_s1 + re_s2) + (re_e1 + re_e2)
+            acc_im = (im_s1 - im_s2) + (im_e1 - im_e2)
+        else:
+            acc = jnp.sum(jnp.conj(z) * z[j] * sign)
+            acc_re, acc_im = jnp.real(acc), jnp.imag(acc)
         wr, wi = _phase_weight(ym, rdtype)
-        return wr * jnp.real(acc) - wi * jnp.imag(acc)
+        return wr * acc_re - wi * acc_im
 
     return lax.map(one, (xmask, ymask, zmask))
 
 
-def pauli_sum_expvals_dm(flat, num_qubits: int, xmask, ymask, zmask):
+def pauli_sum_expvals_dm(flat, num_qubits: int, xmask, ymask, zmask,
+                         compensated: bool = False):
     """Per-term Tr(P_t rho) for a flat density vector
     (``flat[r + c*2^n]``, columns on the high bits). Each term reads only
     the ``2^n`` entries ``rho[r^m, r]`` — a diagonal-sized gather, NOT a
     full ``2^(2n)`` pass (the round-2 path applied P as gates to the
-    whole flat vector per term)."""
+    whole flat vector per term). ``compensated=True`` runs the
+    diagonal-sized sum through the TwoSum cascade (:func:`sum_pair`;
+    the SINGLE-compensated tier — no split products needed: the gather
+    entries are used unmultiplied)."""
     dim = 1 << num_qubits
     mat = flat.reshape(dim, dim)      # mat[c, r] = rho[r, c]
     rows = jnp.arange(dim)
@@ -223,20 +246,31 @@ def pauli_sum_expvals_dm(flat, num_qubits: int, xmask, ymask, zmask):
         j = rows ^ (xm | ym)          # r ^ m: the paired row index
         sign = (1 - 2 * (lax.population_count(j & (ym | zm)) & 1)
                 ).astype(rdtype)
-        acc = jnp.sum(mat[rows, j] * sign)    # sum_r rho[r^m, r] * sign
+        picked = mat[rows, j] * sign          # sum_r rho[r^m, r] * sign
+        if compensated:
+            re_s, re_e = sum_pair(jnp.real(picked))
+            im_s, im_e = sum_pair(jnp.imag(picked))
+            acc_re, acc_im = re_s + re_e, im_s + im_e
+        else:
+            acc = jnp.sum(picked)
+            acc_re, acc_im = jnp.real(acc), jnp.imag(acc)
         wr, wi = _phase_weight(ym, rdtype)
-        return wr * jnp.real(acc) - wi * jnp.imag(acc)
+        return wr * acc_re - wi * acc_im
 
     return lax.map(one, (xmask, ymask, zmask))
 
 
-def pauli_sum_total_sv(z, xmask, ymask, zmask, coeffs):
+def pauli_sum_total_sv(z, xmask, ymask, zmask, coeffs,
+                       compensated: bool = False):
     """sum_t coeffs[t] * <z|P_t|z> (real scalar, device-resident)."""
-    vals = pauli_sum_expvals_sv(z, xmask, ymask, zmask)
+    vals = pauli_sum_expvals_sv(z, xmask, ymask, zmask,
+                                compensated=compensated)
     return jnp.sum(vals.astype(coeffs.dtype) * coeffs)
 
 
-def pauli_sum_total_dm(flat, num_qubits: int, xmask, ymask, zmask, coeffs):
+def pauli_sum_total_dm(flat, num_qubits: int, xmask, ymask, zmask, coeffs,
+                       compensated: bool = False):
     """sum_t coeffs[t] * Tr(P_t rho) (real scalar, device-resident)."""
-    vals = pauli_sum_expvals_dm(flat, num_qubits, xmask, ymask, zmask)
+    vals = pauli_sum_expvals_dm(flat, num_qubits, xmask, ymask, zmask,
+                                compensated=compensated)
     return jnp.sum(vals.astype(coeffs.dtype) * coeffs)

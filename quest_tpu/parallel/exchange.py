@@ -202,13 +202,16 @@ def run_exchange(local: jnp.ndarray, plan: ExchangePlan,
 
 def apply_op_local(local: jnp.ndarray, kind: str, operand: jnp.ndarray,
                    phys_targets: tuple, ctrl_mask: int, flip_mask: int,
-                   local_top: int, axis_name: str) -> jnp.ndarray:
+                   local_top: int, axis_name: str,
+                   precision=None) -> jnp.ndarray:
     """Apply one planned op to the per-device chunk.
 
     Targets must be chunk-local (< local_top) for dense ops — the planner
     guarantees it. Controls and diagonal-op qubits may sit on device bits:
     device controls gate the whole chunk update on ``lax.axis_index``
     (``lax.cond``), device diagonal bits slice the factor tensor.
+    ``precision`` threads the precision-tier matmul mode into
+    :func:`~quest_tpu.core.apply.apply_unitary` (None = HIGHEST).
     """
     lt = local_top
     if kind == "u":
@@ -222,10 +225,12 @@ def apply_op_local(local: jnp.ndarray, kind: str, operand: jnp.ndarray,
             return lax.cond(
                 pred,
                 lambda st: apply_unitary(st, lt, operand, phys_targets,
-                                         loc_c, loc_f),
+                                         loc_c, loc_f,
+                                         precision=precision),
                 lambda st: st,
                 local)
-        return apply_unitary(local, lt, operand, phys_targets, loc_c, loc_f)
+        return apply_unitary(local, lt, operand, phys_targets, loc_c, loc_f,
+                             precision=precision)
 
     # diagonal: phys_targets sorted descending, so device positions are the
     # leading tensor axes — index them with this device's bits
@@ -290,8 +295,8 @@ def _slab_mask(mask: int, lt: int, k: int, slab_bits: int) -> int:
 def run_exchange_overlapped(local: jnp.ndarray, plan: ExchangePlan,
                             axis_name: str, u: jnp.ndarray,
                             phys_targets: tuple, ctrl_mask: int,
-                            flip_mask: int, slab_bits: int = 1
-                            ) -> jnp.ndarray:
+                            flip_mask: int, slab_bits: int = 1,
+                            precision=None) -> jnp.ndarray:
     """One relayout fused with the dense gate it serves, double-buffered
     over ``2^slab_bits`` slabs of the chunk.
 
@@ -322,7 +327,7 @@ def run_exchange_overlapped(local: jnp.ndarray, plan: ExchangePlan,
         if plan.device_perm is not None:
             slab = lax.ppermute(slab, axis_name, plan.device_perm)
         z = apply_op_local(slab.reshape(-1), "u", u, tgt, cm, fm,
-                           lt_slab, axis_name)
+                           lt_slab, axis_name, precision=precision)
         outs.append(z.reshape(1 << k, m))
     return jnp.concatenate(outs, axis=1).reshape(-1)
 

@@ -54,13 +54,13 @@ RELAYOUT_COUNT = 0
 def _maybe_inject(qureg, site: str) -> None:
     """Fault-injection boundary for the imperative sharded path
     (:mod:`quest_tpu.resilience.faults`; no-op unless an injector is
-    installed). A drawn ``nan`` fault poisons the INPUT planes — the
+    installed). A drawn output-corrupting fault (``nan`` poisons, a
+    ``precision`` fault norm-drifts) corrupts the INPUT planes — the
     corruption then propagates through the dispatch exactly like a bad
     kernel output would."""
     poison = _faults.fire(site)
-    inj = _faults.active()
-    if poison and inj is not None:
-        qureg.state = inj.poison_array(qureg.state)
+    if poison:
+        qureg.state = _faults.poison_output(poison, qureg.state)
 
 
 def overlap_enabled() -> bool:

@@ -20,13 +20,15 @@ import contextlib
 import dataclasses
 import time
 from collections import defaultdict
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence
 
 import jax
 
 __all__ = ["trace", "GateStats", "DispatchStats", "probe_gate",
            "CommCostModel", "DEFAULT_COMM_MODEL", "comm_model",
-           "measure_comm_model"]
+           "measure_comm_model", "TierErrorModel", "DEFAULT_TIER_MODEL",
+           "tier_error_model", "measure_tier_model", "modeled_tier_error",
+           "engine_tiers", "choose_tier", "tier_runtime_tol"]
 
 
 # ---------------------------------------------------------------------------
@@ -305,6 +307,217 @@ def comm_model(env=None, measure: Optional[bool] = None) -> CommCostModel:
     return DEFAULT_COMM_MODEL
 
 
+# ---------------------------------------------------------------------------
+# precision-tier error model (the budget API's objective function)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TierErrorModel:
+    """Calibrated per-tier drift model: the modeled max amplitude error
+    of one program execution at a tier is ``drift_per_gate[tier] *
+    num_gates`` (floored at ``floor`` — shallow circuits still carry one
+    rounding). Linear-in-depth is deliberately conservative: the
+    measured tables (docs/accuracy.md) grow sublinearly because
+    rotation-phase errors largely cancel.
+
+    ``drift_per_gate`` maps tier name -> per-gate constant, seeded from
+    the ladder's measured figures (:data:`quest_tpu.config.TIER_LADDER`)
+    and refined per backend by :func:`measure_tier_model` (a cached
+    microbenchmark, the :func:`measure_comm_model` pattern). A refined
+    fit is clamped to never fall BELOW the measurement — the model may
+    over-estimate error (choosing a slower tier than strictly needed)
+    but must never promise accuracy the backend cannot deliver.
+    """
+
+    drift_per_gate: dict
+    floor: float = 1e-15
+    source: str = "default"      # "default" | "measured"
+
+    def error(self, tier, num_gates: int) -> float:
+        from .config import tier_by_name
+        tier = tier_by_name(tier)
+        per_gate = self.drift_per_gate.get(tier.name,
+                                           tier.drift_per_gate)
+        return max(per_gate * max(int(num_gates), 1), self.floor)
+
+
+def _default_tier_model() -> TierErrorModel:
+    from .config import TIER_LADDER
+    return TierErrorModel(
+        drift_per_gate={t.name: t.drift_per_gate for t in TIER_LADDER})
+
+
+DEFAULT_TIER_MODEL = _default_tier_model()
+
+# calibration cache, keyed on the backend fingerprint — the microbench
+# must run at most once per process per backend (failed fits pin the
+# default seeds, the _COMM_MODEL_CACHE discipline). Locked: unlike the
+# comm-model cache (compile-time only), this one is reachable from
+# SimulationService.submit(error_budget=...) — a documented thread-safe
+# entry — so concurrent first submits must not each pay the bench
+import threading as _threading
+_TIER_MODEL_CACHE: dict = {}
+_TIER_MODEL_LOCK = _threading.Lock()
+
+
+def _tier_model_pinned() -> bool:
+    """``QUEST_TPU_TIER_MODEL=default`` pins the seed constants
+    deterministically — no microbenchmark ever runs (tests,
+    reproducible tier selection)."""
+    import os
+    return os.environ.get("QUEST_TPU_TIER_MODEL", "") == "default"
+
+
+def measure_tier_model(env, num_qubits: int = 8,
+                       layers: int = 4) -> TierErrorModel:
+    """Refine the per-tier drift constants with a tiny fixed-workload
+    microbenchmark: a seeded brickwork circuit runs at each
+    engine-executable tier and its state is compared against the most
+    accurate tier available; the measured max|Δ|/gate refines each
+    tier's constant (never below the measurement; never below the
+    model floor). Cached per backend fingerprint — including failures,
+    which pin the seeds — so the bench runs at most once per process."""
+    import numpy as np_
+    import jax as jax_
+    if _tier_model_pinned():
+        return DEFAULT_TIER_MODEL
+    key = (jax_.default_backend(),
+           str(np_.dtype(env.precision.real_dtype)))
+    with _TIER_MODEL_LOCK:
+        if key in _TIER_MODEL_CACHE:
+            return _TIER_MODEL_CACHE[key]
+        return _measure_tier_model_locked(env, key, num_qubits, layers)
+
+
+def _measure_tier_model_locked(env, key, num_qubits, layers):
+    import numpy as np_
+    try:
+        from .circuits import Circuit
+        from .config import TIER_LADDER
+        rng = np_.random.default_rng(20260803)
+        c = Circuit(num_qubits)
+        n_gates = 0
+        for _ in range(layers):
+            for q in range(num_qubits):
+                c.ry(q, float(rng.uniform(0, 2 * np_.pi)))
+                n_gates += 1
+            for q in range(0, num_qubits - 1, 2):
+                c.cnot(q, q + 1)
+                n_gates += 1
+        cc = c.compile(env, pallas=False)
+        tiers = engine_tiers(env)
+        pm = np_.zeros((1, 0))
+        states = {t.name: np_.asarray(cc.sweep(pm, tier=t))[0]
+                  for t in tiers}
+        oracle = states[tiers[-1].name]
+        drift = dict(DEFAULT_TIER_MODEL.drift_per_gate)
+        for t in tiers[:-1]:
+            meas = float(np_.max(np_.abs(states[t.name] - oracle)))
+            # 4x headroom over the measurement; never promise better
+            # than the seed claims the hardware can do... the seed may
+            # only be LOWERED when the backend measures cleaner by a
+            # decade (e.g. FAST on CPU, where DEFAULT matmuls stay f32)
+            refined = max(4.0 * meas / n_gates, DEFAULT_TIER_MODEL.floor)
+            drift[t.name] = max(refined, drift[t.name] / 10.0) \
+                if refined < drift[t.name] else refined
+        model = TierErrorModel(drift_per_gate=drift, source="measured")
+    except Exception:
+        model = DEFAULT_TIER_MODEL
+    _TIER_MODEL_CACHE[key] = model
+    return model
+
+
+def tier_error_model(env=None, measure: Optional[bool] = None
+                     ) -> TierErrorModel:
+    """The tier error model for ``env``: the cached per-backend
+    calibration when one exists, measuring one when asked, else the
+    seed constants. ``measure=None`` auto-calibrates only on TPU-class
+    backends (real MXUs whose bf16 drift the seeds cannot know exactly);
+    host (CPU) runs keep the deterministic defaults.
+    ``QUEST_TPU_TIER_MODEL=default`` pins the seeds unconditionally."""
+    import os
+    import jax as jax_
+    if env is None or _tier_model_pinned():
+        return DEFAULT_TIER_MODEL
+    if measure is None:
+        flag = os.environ.get("QUEST_TPU_TIER_CALIBRATE")
+        if flag is not None:
+            measure = flag not in ("0", "", "off")
+        else:
+            measure = jax_.default_backend() in ("tpu", "axon")
+    if measure:
+        return measure_tier_model(env)
+    return DEFAULT_TIER_MODEL
+
+
+def modeled_tier_error(tier, num_gates: int, model: Optional[
+        TierErrorModel] = None) -> float:
+    """Modeled max amplitude error of one ``num_gates``-gate program
+    execution at ``tier``."""
+    return (model or DEFAULT_TIER_MODEL).error(tier, num_gates)
+
+
+def engine_tiers(env) -> tuple:
+    """The ladder rungs the BATCHED ENGINE can execute on this env, in
+    rank order. FAST and SINGLE always run (f32 planes); DOUBLE needs
+    x64 (without it JAX would silently downcast the f64 planes — the
+    same guard as the QUAD64 env check) AND an f64 STORAGE precision —
+    results leave the engine as env-dtype planes, so on an f32 env a
+    DOUBLE-tier execution would round straight back to f32 on exit and
+    silently violate the budget that selected it; QUAD rides the
+    separate DDProgram path (static circuits only) and is never
+    engine-selected."""
+    import jax as jax_
+    import numpy as np_
+    from .config import DOUBLE_TIER, FAST_TIER, SINGLE_TIER
+    tiers = [FAST_TIER, SINGLE_TIER]
+    if jax_.config.jax_enable_x64 and env is not None and \
+            np_.dtype(env.precision.real_dtype) == np_.dtype(np_.float64):
+        tiers.append(DOUBLE_TIER)
+    return tuple(tiers)
+
+
+def choose_tier(error_budget: float, num_gates: int, env=None,
+                model: Optional[TierErrorModel] = None,
+                tiers: Optional[Sequence] = None):
+    """The budget API's selector: the CHEAPEST (lowest-rank) tier whose
+    modeled error fits ``error_budget``, over the engine-executable
+    ladder for ``env`` (or an explicit ``tiers`` subset).
+
+    Monotone by construction: the ladder is rank-ordered with
+    non-increasing drift, so a tighter budget can only move the choice
+    UP the ladder, never to a faster tier. Raises ``ValueError`` when
+    no available tier fits — an unmeetable budget is a caller error the
+    submit/compile boundary must surface, not a silently-wrong answer."""
+    if not (error_budget > 0.0):
+        raise ValueError(f"error_budget must be > 0, got {error_budget!r}")
+    model = model or (tier_error_model(env) if env is not None
+                      else DEFAULT_TIER_MODEL)
+    ladder = tuple(tiers) if tiers is not None else engine_tiers(env)
+    for t in sorted(ladder, key=lambda t: t.rank):
+        if model.error(t, num_gates) <= error_budget:
+            return t
+    best = min((model.error(t, num_gates) for t in ladder), default=None)
+    raise ValueError(
+        f"error budget {error_budget:g} is unmeetable on this "
+        f"environment: the most accurate available tier models "
+        f"{best:g} over {num_gates} gates (enable x64 for the DOUBLE "
+        f"tier, or use the double-double compile_dd path)")
+
+
+def tier_runtime_tol(tier, num_gates: int,
+                     model: Optional[TierErrorModel] = None,
+                     headroom: float = 8.0) -> float:
+    """The runtime fidelity monitor's norm/trace drift threshold for one
+    tier: ``headroom`` times the modeled per-run error, floored at the
+    health guard's default 1e-6 (shallow f64 programs must not trip on
+    benign rounding) and capped at 2e-2 (a drift past two percent is
+    never in-budget at ANY tier — it is a numerical fault whatever the
+    model says)."""
+    err = modeled_tier_error(tier, num_gates, model)
+    return float(min(max(headroom * err, 1e-6), 2e-2))
+
+
 @dataclasses.dataclass
 class DispatchStats:
     """Compile-time dispatch accounting for one compiled program: how
@@ -337,11 +550,15 @@ class DispatchStats:
     host_syncs_avoided: int = 0      # device->host transfers vs per-point
     batch_sharding_mode: str = "none"  # "none" | "batch" | "amp"
     # keyed executable cache accounting (serving workloads cycle
-    # (form, donation, mode, dtype) keys; the cache is LRU-bounded —
-    # QUEST_TPU_BATCH_CACHE — so long-lived services can't pin one
+    # (form, donation, mode, dtype, tier) keys; the cache is LRU-bounded
+    # — QUEST_TPU_BATCH_CACHE — so long-lived services can't pin one
     # executable per key forever):
     batched_cache_size: int = 0        # live entries in the bounded cache
     batched_cache_evictions: int = 0   # executables dropped by the bound
+    # precision-tier accounting (config.PrecisionTier; "env" = the
+    # legacy per-environment precision, no tier selected):
+    precision_tier: str = "env"        # compile-time tier of this program
+    modeled_tier_error: float = 0.0    # the budget model's per-run bound
 
     @property
     def dispatches(self) -> int:
@@ -380,7 +597,9 @@ class DispatchStats:
                 "host_syncs_avoided": self.host_syncs_avoided,
                 "batch_sharding_mode": self.batch_sharding_mode,
                 "batched_cache_size": self.batched_cache_size,
-                "batched_cache_evictions": self.batched_cache_evictions}
+                "batched_cache_evictions": self.batched_cache_evictions,
+                "precision_tier": self.precision_tier,
+                "modeled_tier_error": self.modeled_tier_error}
 
 
 @contextlib.contextmanager

@@ -20,6 +20,12 @@ Fault kinds:
 - ``"nan"`` — the dispatch RUNS, then its output is NaN-poisoned in one
   deterministic row (:meth:`FaultInjector.poison_array`) — the silent
   corruption the numerical health guards exist to catch;
+- ``"precision"`` — the dispatch runs, then its output is NORM-DRIFTED
+  (uniformly scaled by a few percent,
+  :meth:`FaultInjector.drift_array`) — the in-budget-looking-but-wrong
+  result the precision-tier fidelity monitor exists to catch; the
+  serving recovery must re-execute the affected requests one tier up,
+  not retry the same rung;
 - ``"stall"`` — the dispatch runs after sleeping ``stall_s`` seconds (a
   slow device / wedged collective; the serving watchdog's prey);
 - ``"replica_crash"`` / ``"replica_stall"`` — replica-level failure
@@ -51,7 +57,7 @@ import numpy as np
 __all__ = ["InjectedFault", "SimulatedOOM", "FaultSpec", "FaultInjector",
            "install", "uninstall", "active", "inject", "fire",
            "fire_router", "poison_output", "SITES", "KINDS",
-           "REPLICA_KINDS"]
+           "REPLICA_KINDS", "POISON_KINDS"]
 
 # the dispatch boundaries that call fire() (site names are stable API —
 # tools/chaos_trace.py and the chaos tests target them by pattern)
@@ -65,8 +71,12 @@ SITES = (
     "router.route",                # ServiceRouter placement decision
 )
 
-KINDS = ("transient", "oom", "nan", "stall",
+KINDS = ("transient", "oom", "nan", "precision", "stall",
          "replica_crash", "replica_stall")
+
+# the output-corrupting subset: fire() returns the kind for the caller
+# to apply to its dispatch RESULT via poison_output()
+POISON_KINDS = ("nan", "precision")
 
 # the replica-scoped subset: returned by fire_router() for the router
 # to apply to its chosen replica, inert at every other boundary
@@ -173,6 +183,19 @@ class FaultInjector:
             return out
         return arr.at[idx].set(np.nan)
 
+    DRIFT_SCALE = 1.05   # 5% norm inflation: outside every tier budget
+
+    def drift_array(self, arr):
+        """Return the WHOLE ``arr`` scaled by :data:`DRIFT_SCALE` — a
+        finite, plausible-looking result whose norm/trace violates every
+        tier's runtime tolerance (the fidelity-monitor analogue of
+        :meth:`poison_array`'s NaN). Uniform on purpose: this boundary
+        cannot know which axis (if any) is a batch axis, and a per-row
+        scale on packed ``(2, 2^n)`` planes or a flat state could land
+        on an all-zero plane and silently inject NOTHING — a chaos run
+        must never count a fault that produced no corruption."""
+        return arr * self.DRIFT_SCALE
+
     # -- accounting --------------------------------------------------------
 
     @property
@@ -241,12 +264,14 @@ def inject(injector: FaultInjector):
         uninstall()
 
 
-def fire(site: str) -> bool:
-    """The dispatch-boundary hook. No-op (False) when no injector is
+def fire(site: str):
+    """The dispatch-boundary hook. No-op (falsy) when no injector is
     installed. Otherwise: raises for ``transient``/``oom`` faults,
-    sleeps for ``stall`` faults, and returns True when the CALLER must
-    NaN-poison this dispatch's output (``nan`` faults poison results,
-    not inputs — the corruption the health guards must catch)."""
+    sleeps for ``stall`` faults, and returns the corruption KIND
+    (``"nan"`` | ``"precision"``, truthy) when the CALLER must corrupt
+    this dispatch's output via :func:`poison_output` (output faults
+    poison results, not inputs — the corruption the health guards and
+    the tier fidelity monitor must catch)."""
     inj = _ACTIVE
     if inj is None:
         return False
@@ -263,7 +288,7 @@ def fire(site: str) -> bool:
         return False
     if kind in REPLICA_KINDS:
         return False    # replica faults only mean something to the router
-    return True     # "nan": caller poisons its output
+    return kind     # "nan"/"precision": caller corrupts its output
 
 
 def fire_router(site: str) -> Optional[str]:
@@ -271,13 +296,14 @@ def fire_router(site: str) -> Optional[str]:
     only the router knows its replicas, so ``"replica_crash"`` /
     ``"replica_stall"`` are RETURNED for the caller to apply to the
     replica it was about to pick. Every other kind behaves exactly as
-    at the engine boundaries (transient/oom raise, stall sleeps); nan
-    has no router meaning and is dropped. None = clean routing."""
+    at the engine boundaries (transient/oom raise, stall sleeps); the
+    output-corrupting kinds (nan/precision) have no router meaning and
+    are dropped. None = clean routing."""
     inj = _ACTIVE
     if inj is None:
         return None
     kind = inj.draw(site)
-    if kind is None or kind == "nan":
+    if kind is None or kind in POISON_KINDS:
         return None
     if kind in REPLICA_KINDS:
         return kind
@@ -290,13 +316,16 @@ def fire_router(site: str) -> Optional[str]:
     return None
 
 
-def poison_output(poison: bool, arr):
-    """Apply a drawn ``nan`` fault to a dispatch output: pass
-    :func:`fire`'s return value and the output array. One helper so
-    every boundary shares the same semantics — including the edge where
-    the injector was uninstalled between ``fire()`` and the dispatch
-    completing (the chaos scope ended: the poison is dropped)."""
+def poison_output(poison, arr):
+    """Apply a drawn output fault to a dispatch output: pass
+    :func:`fire`'s return value (``"nan"`` | ``"precision"`` | falsy)
+    and the output array. One helper so every boundary shares the same
+    semantics — including the edge where the injector was uninstalled
+    between ``fire()`` and the dispatch completing (the chaos scope
+    ended: the poison is dropped)."""
     inj = _ACTIVE
     if poison and inj is not None:
+        if poison == "precision":
+            return inj.drift_array(arr)
         return inj.poison_array(arr)
     return arr

@@ -35,14 +35,21 @@ import numpy as np
 
 __all__ = ["NumericalFault", "HealthConfig", "configure", "get_config",
            "guarded", "check_planes", "bad_plane_rows", "bad_value_rows",
-           "health_stats", "reset_stats"]
+           "plane_norms", "drifted_rows", "health_stats", "reset_stats"]
 
 
 class NumericalFault(RuntimeError):
     """A state invariant failed: NaN/Inf amplitudes, statevector norm
     drift, or density-matrix trace drift. ``kind`` is one of
-    ``("nan", "norm", "trace")``; ``rows`` names the offending batch
-    rows (empty for an unbatched state)."""
+    ``("nan", "norm", "trace", "precision")``; ``rows`` names the
+    offending batch rows (empty for an unbatched state).
+
+    ``"precision"`` is the precision-tier fidelity monitor's kind: the
+    drift exceeded the TIER's runtime tolerance (:func:`quest_tpu.
+    profiling.tier_runtime_tol`) — the result is outside the error
+    budget the caller stated, and the recovery policy answers by
+    re-executing one tier up the ladder rather than retrying the same
+    rung (:mod:`quest_tpu.serve.engine`)."""
 
     def __init__(self, message: str, kind: str = "nan", rows: tuple = ()):
         super().__init__(message)
@@ -149,7 +156,7 @@ def _invariant_fn(is_density: bool, nq: int, batched: bool):
 def check_planes(planes, *, is_density: bool = False,
                  num_qubits: Optional[int] = None,
                  config: Optional[HealthConfig] = None,
-                 where: str = "state"):
+                 where: str = "state", drift_kind: Optional[str] = None):
     """Verify the invariants of packed float planes — ``(2, 2^n)`` or a
     batched ``(B, 2, 2^n)`` — and return them (possibly renormalized in
     degraded mode). ``num_qubits`` is the LOGICAL qubit count for
@@ -158,7 +165,10 @@ def check_planes(planes, *, is_density: bool = False,
     Raises :class:`NumericalFault` on NaN/Inf always, and on norm/trace
     drift beyond ``config.norm_tol`` unless ``config.mode ==
     "renormalize"`` (then the drifting states are rescaled and a
-    ``UserWarning`` names the drift)."""
+    ``UserWarning`` names the drift). ``drift_kind`` overrides the
+    fault kind a drift raises with (the precision-tier fidelity monitor
+    passes ``"precision"`` so its violations classify for tier
+    escalation, not quarantine)."""
     cfg = config or _config
     batched = getattr(planes, "ndim", 2) == 3
     if is_density and num_qubits is None:
@@ -208,7 +218,8 @@ def check_planes(planes, *, is_density: bool = False,
     raise NumericalFault(
         f"{where}: {label} drifted to {vals[:4]} (tol {cfg.norm_tol})"
         + (f" in batch rows {list(rows)}" if rows else ""),
-        kind=("trace" if is_density else "norm"), rows=rows)
+        kind=(drift_kind or ("trace" if is_density else "norm")),
+        rows=rows)
 
 
 # ---------------------------------------------------------------------------
@@ -226,3 +237,32 @@ def bad_value_rows(values) -> np.ndarray:
     """Indices of non-finite scalars in a 1-D result vector (energies,
     sampling norms)."""
     return np.nonzero(~np.isfinite(np.asarray(values, dtype=np.float64)))[0]
+
+
+def plane_norms(planes: np.ndarray, is_density: bool = False,
+                num_qubits: Optional[int] = None) -> np.ndarray:
+    """Per-row norm (statevector 2-norm) or trace of a host
+    ``(B, 2, 2^n)`` plane batch — the serving layer's tier fidelity
+    observable (non-finite rows report NaN; screen those with
+    :func:`bad_plane_rows` first)."""
+    p = np.asarray(planes)
+    if is_density:
+        if num_qubits is None:
+            raise ValueError("density-plane norms need num_qubits "
+                             "(logical)")
+        diag = np.arange(1 << num_qubits) * ((1 << num_qubits) + 1)
+        return p[:, 0, diag].sum(axis=1, dtype=np.float64)
+    # einsum with a forced f64 accumulator: no full-size f64 copy of
+    # the batch (a 25q x16 batch would spike ~17 GB of temporaries the
+    # upcast-then-square form allocates to produce 16 scalars)
+    flat = p.reshape(p.shape[0], -1)
+    return np.sqrt(np.einsum("bi,bi->b", flat, flat,
+                             dtype=np.float64))
+
+
+def drifted_rows(values, tol: float) -> np.ndarray:
+    """Indices of FINITE entries in a 1-D norm/trace vector that drift
+    from 1 by more than ``tol`` (the per-request precision-violation
+    screen; NaN rows are the NaN screen's business, not this one's)."""
+    v = np.asarray(values, dtype=np.float64)
+    return np.nonzero(np.isfinite(v) & (np.abs(v - 1.0) > float(tol)))[0]

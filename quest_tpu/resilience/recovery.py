@@ -32,12 +32,18 @@ from typing import Optional
 from .faults import InjectedFault, SimulatedOOM
 from .health import NumericalFault
 
-__all__ = ["TRANSIENT", "POISON", "FATAL", "classify", "ResiliencePolicy",
-           "SupervisorPolicy", "CircuitBreaker"]
+__all__ = ["TRANSIENT", "POISON", "FATAL", "PRECISION", "classify",
+           "ResiliencePolicy", "SupervisorPolicy", "CircuitBreaker"]
 
 TRANSIENT = "transient"
 POISON = "poison"
 FATAL = "fatal"
+# the precision-tier fidelity monitor's class (NumericalFault with
+# kind="precision"): the result drifted past the TIER's error budget —
+# retrying the same rung is pointless, but unlike POISON the request is
+# salvageable: the recovery policy re-executes it one tier UP the
+# ladder (bounded by the top available rung)
+PRECISION = "precision"
 
 # caller errors: retrying cannot help and hides the bug from the caller
 _FATAL_TYPES = (ValueError, TypeError, KeyError, IndexError,
@@ -52,7 +58,7 @@ def classify(exc: BaseException) -> str:
     tunneled backends) are RuntimeError-shaped, while the fatal set is
     the closed family of caller errors."""
     if isinstance(exc, NumericalFault):
-        return POISON
+        return PRECISION if exc.kind == "precision" else POISON
     if isinstance(exc, (InjectedFault, SimulatedOOM)):
         return TRANSIENT
     if isinstance(exc, _FATAL_TYPES):
@@ -74,7 +80,10 @@ class ResiliencePolicy:
     poisoned batch member can't keep failing its companions);
     ``watchdog_timeout_s`` bounds how long the dispatcher may go
     without a heartbeat before the watchdog thread counts a stall
-    (0 disables the thread)."""
+    (0 disables the thread). ``escalate_tiers`` gates the precision-
+    tier recovery move: a request whose result violates its tier's
+    runtime fidelity tolerance re-executes one tier up the ladder
+    (off: the violation fails typed like any poison)."""
 
     backoff_base_s: float = 2e-3
     backoff_cap_s: float = 0.25
@@ -88,6 +97,7 @@ class ResiliencePolicy:
     degrade_after: int = 3
     degrade_cooldown_s: float = 5.0
     watchdog_timeout_s: float = 30.0
+    escalate_tiers: bool = True
 
     def __post_init__(self):
         if self.backoff_base_s < 0 or self.backoff_cap_s < 0:

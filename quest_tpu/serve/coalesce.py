@@ -57,15 +57,25 @@ def batch_bucket(n: int, floor: int = 1) -> int:
     return max(b, int(floor))
 
 
-def coalesce_key(compiled, kind: str, obs_key=(), shots: int = 0) -> tuple:
+def coalesce_key(compiled, kind: str, obs_key=(), shots: int = 0,
+                 tier=None) -> tuple:
     """The compatibility class of one request: requests sharing this key
     dispatch through one executable. ``obs_key`` is the canonical
     hashable Hamiltonian form (terms + coeffs); shots enter via their
-    power-of-two bucket, not the raw count."""
+    power-of-two bucket, not the raw count; ``tier`` is the request's
+    precision tier (:class:`~quest_tpu.config.PrecisionTier` or None) —
+    a FAST sweep must never pad into (or share an executable with) a
+    batch compiled at another tier, so the tier is a full coalescing
+    dimension, not a dispatch-time detail."""
     import numpy as np
+    from ..circuits import CompiledCircuit
     return (id(compiled), kind, obs_key,
             shot_bucket(int(shots)) if kind == KIND_SAMPLE else 0,
-            str(np.dtype(compiled.env.precision.real_dtype)))
+            str(np.dtype(compiled.env.precision.real_dtype)),
+            # the SAME token that keys the executable/warm caches — one
+            # definition, so coalescing and executable isolation can
+            # never disagree about what counts as "the same tier"
+            CompiledCircuit._tier_token(tier))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -64,8 +64,9 @@ from ..circuits import Circuit, CompiledCircuit, _BoundedExecutableCache
 from ..resilience import faults as _faults
 from ..resilience import health as _health
 from ..resilience.health import NumericalFault
-from ..resilience.recovery import (FATAL, POISON, TRANSIENT, CircuitBreaker,
-                                   ResiliencePolicy, classify)
+from ..resilience.recovery import (FATAL, POISON, PRECISION, TRANSIENT,
+                                   CircuitBreaker, ResiliencePolicy,
+                                   classify)
 from .coalesce import (KIND_EXPECTATION, KIND_SAMPLE, KIND_STATE,
                        CoalescePolicy, coalesce_key, split_ready)
 from .metrics import ServiceMetrics
@@ -103,10 +104,12 @@ class _Request:
 
     __slots__ = ("compiled", "param_vec", "kind", "observables", "shots",
                  "submit_t", "deadline", "future", "retries_left", "key",
-                 "not_before", "attempts")
+                 "not_before", "attempts", "tier", "escalations",
+                 "obs_key")
 
     def __init__(self, compiled, param_vec, kind, observables, shots,
-                 submit_t, deadline, future, retries_left, key):
+                 submit_t, deadline, future, retries_left, key,
+                 tier=None, obs_key=()):
         self.compiled = compiled
         self.param_vec = param_vec
         self.kind = kind
@@ -119,6 +122,9 @@ class _Request:
         self.key = key
         self.not_before = 0.0    # retry backoff: ineligible before this
         self.attempts = 0        # executor attempts already failed
+        self.tier = tier         # precision tier (None = env precision)
+        self.escalations = 0     # tier bumps already taken
+        self.obs_key = obs_key   # canonical observable key (rekeying)
 
 
 def _canonical_observables(compiled, observables) -> tuple:
@@ -230,6 +236,7 @@ class SimulationService:
         self._retry_rng = np.random.default_rng(rp.seed)
         self._consec_faults: dict = {}     # program key -> fault streak
         self._degraded_until: dict = {}    # program key -> monotonic time
+        self._tier_observed: dict = {}     # tier name -> max |norm - 1|
         self._program_refs: dict = {}      # program key -> weakref(cc)
         self._t0 = time.monotonic()
         self.events: collections.deque = collections.deque(
@@ -290,7 +297,9 @@ class SimulationService:
 
     def submit(self, circuit, params: Optional[dict] = None, *,
                observables=None, shots: Optional[int] = None,
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               error_budget: Optional[float] = None,
+               tier=None) -> Future:
         """Enqueue one simulation request; returns its Future.
 
         ``circuit``: a :class:`CompiledCircuit` (preferred — submissions
@@ -311,6 +320,17 @@ class SimulationService:
         :class:`DeadlineExceeded` instead of running stale. A
         non-positive deadline raises immediately; a full admission
         queue raises :class:`QueueFull`.
+
+        ``error_budget`` states the max amplitude error this request
+        may carry; the service picks the cheapest
+        :class:`~quest_tpu.config.PrecisionTier` whose modeled error
+        fits (an unmeetable budget raises ``ValueError`` here).
+        ``tier`` pins a rung explicitly. The tier is a coalescing
+        dimension — a FAST sweep never pads into a batch at another
+        tier — and the runtime fidelity monitor re-executes a request
+        whose result drifts outside its tier's tolerance ONE TIER UP
+        (``tier_escalations`` in the metrics) rather than returning an
+        out-of-budget answer.
         """
         if self._closed:
             raise ServiceClosed("service is closed")
@@ -342,10 +362,21 @@ class SimulationService:
             ham, obs_key = _canonical_observables(compiled, observables)
         else:
             kind, ham, obs_key = KIND_STATE, None, ()
-        key = coalesce_key(compiled, kind, obs_key, int(shots or 0))
+        if tier is not None:
+            req_tier = compiled._resolve_tier(tier)
+        elif error_budget is not None:
+            from ..profiling import choose_tier
+            req_tier = choose_tier(
+                float(error_budget),
+                max(compiled.circuit.depth, 1), self.env)
+        else:
+            req_tier = compiled.tier     # the compile-time tier, if any
+        key = coalesce_key(compiled, kind, obs_key, int(shots or 0),
+                           req_tier)
         fut: Future = Future()
         req = _Request(compiled, vec, kind, ham, int(shots or 0), now,
-                       abs_deadline, fut, self.max_retries, key)
+                       abs_deadline, fut, self.max_retries, key,
+                       tier=req_tier, obs_key=obs_key)
         with self._cond:
             if self._closed:
                 raise ServiceClosed("service is closed")
@@ -361,8 +392,8 @@ class SimulationService:
         return fut
 
     def warm(self, circuit, batch_sizes: Optional[Sequence[int]] = None,
-             observables=None, shots: Optional[int] = None
-             ) -> CompiledCircuit:
+             observables=None, shots: Optional[int] = None,
+             tier=None) -> CompiledCircuit:
         """Pre-compile the executables the given traffic will hit, so
         first requests pay dispatch latency, not compiles.
 
@@ -376,9 +407,12 @@ class SimulationService:
         metrics; the throwaway dispatch then rides the loaded
         executable) and compiled-and-stored otherwise
         (``warm_cache_misses``) — restart-to-ready stops paying
-        recompiles. Returns the compiled circuit (submit it back for
-        guaranteed coalescing)."""
+        recompiles. ``tier`` warms the executables of one precision
+        tier (tier-keyed forms; the traffic's ``submit(tier=...)`` /
+        ``error_budget`` rung). Returns the compiled circuit (submit it
+        back for guaranteed coalescing)."""
         compiled = self._resolve(circuit)
+        tier = compiled._effective_tier(tier)
         sizes = tuple(batch_sizes) if batch_sizes is not None \
             else (self.policy.max_batch,)
         mult = self._device_multiple(compiled)
@@ -390,7 +424,7 @@ class SimulationService:
             if self.warm_cache is not None:
                 kind = "energy" if observables is not None else "sweep"
                 status = self.warm_cache.warm_form(
-                    compiled, kind, padded, hamiltonian=ham)
+                    compiled, kind, padded, hamiltonian=ham, tier=tier)
                 if status == "hit":
                     self.metrics.incr("warm_cache_hits")
                 elif status == "miss":
@@ -398,11 +432,11 @@ class SimulationService:
             pm = np.zeros((padded, len(compiled.param_names)),
                           dtype=np.float64)
             if observables is not None:
-                np.asarray(compiled.expectation_sweep(pm, ham))
+                np.asarray(compiled.expectation_sweep(pm, ham, tier=tier))
             elif shots is not None:
-                compiled.sample_sweep(pm, int(shots))
+                compiled.sample_sweep(pm, int(shots), tier=tier)
             else:
-                np.asarray(compiled.sweep(pm))
+                np.asarray(compiled.sweep(pm, tier=tier))
         self._last_cc = compiled
         return compiled
 
@@ -502,6 +536,10 @@ class SimulationService:
                 k for k, t in degraded.items() if t > now),
             "health": _health.health_stats(),
             "events_recorded": len(self.events),
+            # modeled-vs-observed per tier: the compile-time model's
+            # bound sits in the engine stats (modeled_tier_error); this
+            # is the fidelity monitor's measured counterpart
+            "tier_observed_drift": dict(self._tier_observed),
         }
         inj = _faults.active()
         if inj is not None:
@@ -770,11 +808,12 @@ class SimulationService:
         """Execute one compatible group as a single engine dispatch; on
         a classified fault, quarantine by bisection (halves re-execute
         independently — log2(B) extra dispatches isolate one poisoned
-        request) or retry/fail each request per the policy."""
+        request), escalate precision-tier violations one tier up, or
+        retry/fail each request per the policy."""
         self._heartbeat = time.monotonic()
         rp = self.resilience
         try:
-            results, bad_rows, t_dispatch, padded = \
+            results, bad_rows, viol_rows, t_dispatch, padded = \
                 self._dispatch_batch(batch)
         except Exception as e:  # noqa: BLE001 — classified fault barrier
             self._heartbeat = time.monotonic()
@@ -782,6 +821,14 @@ class SimulationService:
             self._event("fault", program=pkey, kind=kind,
                         error=type(e).__name__, requests=len(batch),
                         depth=depth)
+            if kind == PRECISION:
+                # the engine-level fidelity monitor tripped on the whole
+                # dispatch: every member is out of budget at its tier —
+                # escalation, not retry/quarantine, is the recovery
+                self._breaker.release(pkey)
+                for req in batch:
+                    self._escalate_or_fail(req, e)
+                return
             if kind == FATAL:
                 # caller error (ValueError / TypeError / validation):
                 # fail fast with the ORIGINAL exception — retrying
@@ -815,14 +862,36 @@ class SimulationService:
         self._heartbeat = time.monotonic()
         self._breaker.record_success(pkey)
         self._consec_faults.pop(pkey, None)
-        self._fan_out(batch, results, bad_rows, t_dispatch, padded)
+        self._fan_out(batch, results, bad_rows, viol_rows, t_dispatch,
+                      padded)
+
+    def _tier_tol(self, cc: CompiledCircuit, tier) -> float:
+        """The runtime fidelity tolerance for one tiered dispatch."""
+        from ..profiling import tier_runtime_tol
+        return tier_runtime_tol(tier, max(cc.circuit.depth, 1))
+
+    @staticmethod
+    def _next_tier(cc: CompiledCircuit, tier):
+        """The next rung UP the engine-executable ladder for this env
+        (None at the top — escalation is bounded by the ladder)."""
+        from ..profiling import engine_tiers
+        if tier is None:
+            return None      # legacy env precision carries no ladder
+        for t in engine_tiers(cc.env):
+            if t.rank > tier.rank:
+                return t
+        return None
 
     def _dispatch_batch(self, batch: list):
         """One engine dispatch for one group. Returns ``(results,
-        bad_rows, t_dispatch, padded)`` where ``bad_rows`` indexes
-        result rows screened out as non-finite (NaN poisoning — those
-        requests get a typed failure; their batchmates are unaffected)."""
+        bad_rows, viol_rows, t_dispatch, padded)`` where ``bad_rows``
+        indexes result rows screened out as non-finite (NaN poisoning —
+        those requests get a typed failure; their batchmates are
+        unaffected) and ``viol_rows`` indexes FINITE rows whose
+        norm/trace drifts past the batch tier's runtime tolerance (the
+        fidelity monitor — those requests escalate one tier up)."""
         cc = batch[0].compiled
+        tier = batch[0].tier
         B = len(batch)
         padded = self.policy.bucket_size(B, self._device_multiple(cc))
         pm = np.zeros((padded, len(cc.param_names)), dtype=np.float64)
@@ -830,27 +899,63 @@ class SimulationService:
             pm[i] = req.param_vec
         t_dispatch = time.monotonic()
         kind = batch[0].kind
+        if tier is not None and tier.name == "fast":
+            self.metrics.incr("fast_tier_dispatches")
         poison = _faults.fire("serve.execute")
         guard = self.resilience.guard_outputs
+        viol = ()
+        norms = None
+        if poison == "precision" and (tier is None
+                                      or kind == KIND_EXPECTATION):
+            # a drifted result is UNDETECTABLE silent corruption
+            # wherever the fidelity monitor cannot see it — energies
+            # carry no unit-norm invariant, and UNTIERED requests have
+            # no tier tolerance (and no escalation rung) to screen
+            # against. Degrade the injected fault to the NaN form the
+            # value/plane screens catch: the request still fails typed,
+            # never wrong — the one thing chaos runs must never produce.
+            poison = "nan"
         if kind == KIND_EXPECTATION:
             out = _faults.poison_output(poison, np.asarray(
-                cc.expectation_sweep(pm, batch[0].observables))[:B])
+                cc.expectation_sweep(pm, batch[0].observables,
+                                     tier=tier))[:B])
             results = [float(v) for v in out]
             bad = _health.bad_value_rows(out) if guard else ()
+            # energies carry no unit-norm invariant: only the NaN
+            # screen applies (docs/accuracy.md "Precision tiers")
         elif kind == KIND_SAMPLE:
             shots = max(req.shots for req in batch)
-            idx, totals = cc.sample_sweep(pm, shots)
+            idx, totals = cc.sample_sweep(pm, shots, tier=tier)
             totals = _faults.poison_output(poison,
                                            np.asarray(totals)[:B])
             results = [(np.asarray(idx[i, :req.shots]), float(totals[i]))
                        for i, req in enumerate(batch)]
             bad = _health.bad_value_rows(totals) if guard else ()
+            # the pre-sampling totals are the SQUARED 2-norm (sum of
+            # |amp|^2); the fidelity contract (|norm - 1| <= tol) is on
+            # the norm itself, same root as health.check_planes takes
+            norms = np.sqrt(np.maximum(
+                np.asarray(totals, dtype=np.float64), 0.0))
         else:
-            planes = _faults.poison_output(poison,
-                                           np.asarray(cc.sweep(pm))[:B])
+            planes = _faults.poison_output(
+                poison, np.asarray(cc.sweep(pm, tier=tier))[:B])
             results = [np.array(planes[i]) for i in range(B)]
             bad = _health.bad_plane_rows(planes) if guard else ()
-        return results, {int(r) for r in bad}, t_dispatch, padded
+            if guard and tier is not None:
+                norms = _health.plane_norms(
+                    planes, is_density=cc.is_density,
+                    num_qubits=(cc.num_qubits // 2 if cc.is_density
+                                else cc.num_qubits))
+        if guard and tier is not None and norms is not None:
+            viol = _health.drifted_rows(norms, self._tier_tol(cc, tier))
+            arr = np.asarray(norms, dtype=np.float64)
+            arr = arr[np.isfinite(arr)]    # NaN rows are the NaN screen's
+            m = float(np.max(np.abs(arr - 1.0), initial=0.0))
+            with self._cond:
+                obs = self._tier_observed.setdefault(tier.name, 0.0)
+                self._tier_observed[tier.name] = max(obs, m)
+        return (results, {int(r) for r in bad}, {int(r) for r in viol},
+                t_dispatch, padded)
 
     def _fail_or_retry(self, req: _Request, exc: BaseException,
                        kind: str) -> None:
@@ -897,12 +1002,45 @@ class SimulationService:
         if req.future.set_running_or_notify_cancel():
             req.future.set_exception(exc)
 
+    def _escalate_or_fail(self, req: _Request, exc: BaseException) -> None:
+        """Precision-violation recovery: re-enqueue the request ONE TIER
+        UP the ladder (the coalesce key is recomputed — the escalated
+        request joins the higher tier's batches), bounded by the top
+        engine-executable rung; at the top (or with escalation off) the
+        request fails typed — an out-of-budget answer never reaches the
+        caller silently."""
+        self.metrics.incr("tier_violations")
+        nxt = self._next_tier(req.compiled, req.tier) \
+            if self.resilience.escalate_tiers else None
+        if nxt is None:
+            self.metrics.incr("failed")
+            self._event("tier_violation_failed",
+                        tier=req.tier.name if req.tier else "env",
+                        error=type(exc).__name__)
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(exc)
+            return
+        prev = req.tier
+        req.tier = nxt
+        req.escalations += 1
+        req.key = coalesce_key(req.compiled, req.kind, req.obs_key,
+                               req.shots, nxt)
+        self.metrics.incr("tier_escalations")
+        self._event("tier_escalation", from_tier=prev.name,
+                    to_tier=nxt.name, escalations=req.escalations)
+        with self._cond:
+            self._backlog += 1
+            self._queue.append(req)
+            self._cond.notify_all()
+
     def _fan_out(self, batch: list, results: list, bad_rows: set,
-                 t_dispatch: float, padded: int) -> None:
+                 viol_rows: set, t_dispatch: float, padded: int) -> None:
         cc = batch[0].compiled
         B = len(batch)
         self._last_cc = cc
         done_t = time.monotonic()
+        viol_rows = viol_rows - bad_rows   # NaN screen wins: nothing to
+        # escalate in a non-finite row
         # metrics BEFORE resolving any future: a caller blocked on the
         # last result may read dispatch_stats() the instant it unblocks,
         # and must see this batch's accounting
@@ -913,8 +1051,14 @@ class SimulationService:
             self.metrics.incr("failed", len(bad_rows))
             self._event("poisoned_rows", rows=sorted(bad_rows),
                         requests=B)
+        if viol_rows:
+            self.metrics.incr("health_failures", len(viol_rows))
+            self._event("tier_violation_rows", rows=sorted(viol_rows),
+                        requests=B,
+                        tier=batch[0].tier.name if batch[0].tier
+                        else "env")
         for i, req in enumerate(batch):
-            if i in bad_rows:
+            if i in bad_rows or i in viol_rows:
                 continue
             self.metrics.incr("completed")
             self.metrics.record_latency(done_t - req.submit_t,
@@ -927,5 +1071,13 @@ class SimulationService:
                     f"unaffected", kind="nan", rows=(i,))
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_exception(err)
+            elif i in viol_rows:
+                tol = self._tier_tol(cc, req.tier)
+                err = NumericalFault(
+                    f"request result drifted outside its "
+                    f"{req.tier.name if req.tier else 'env'}-tier "
+                    f"runtime tolerance ({tol:g}) in row {i} of a "
+                    f"{B}-request batch", kind="precision", rows=(i,))
+                self._escalate_or_fail(req, err)
             elif req.future.set_running_or_notify_cancel():
                 req.future.set_result(res)

@@ -49,6 +49,10 @@ _COUNTERS = (
     # warm-start compile cache (serve/warmcache.py; ISSUE 6):
     "warm_cache_hits",       # warm() forms loaded from the persistent cache
     "warm_cache_misses",     # warm() forms compiled fresh (and stored)
+    # precision-tier execution (config.PrecisionTier; ISSUE 8):
+    "fast_tier_dispatches",  # engine dispatches run at the FAST tier
+    "tier_violations",       # result rows outside their tier's tolerance
+    "tier_escalations",      # requests re-executed one tier up
 )
 
 

@@ -88,7 +88,7 @@ def replica_envs(num_replicas: int,
     chaos tests exercise."""
     import jax
     from ..config import default_precision
-    from ..env import AMP_AXIS, QuESTEnv
+    from ..env import AMP_AXIS, QuESTEnv, default_compensated
     from jax.sharding import Mesh
     if num_replicas < 1:
         raise ValueError("num_replicas must be >= 1")
@@ -105,7 +105,7 @@ def replica_envs(num_replicas: int,
             raise ValueError("devices_per_replica must be a power of 2 "
                              "(amplitude sharding halves per device)")
     precision = precision or default_precision()
-    compensated = precision.quest_prec == 1
+    compensated = default_compensated(precision)
     disjoint = num_replicas * k <= len(devices)
     envs = []
     for i in range(num_replicas):

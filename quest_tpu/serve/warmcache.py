@@ -260,16 +260,20 @@ class WarmCache:
     # -- the warm entry point ----------------------------------------------
 
     def warm_form(self, cc, kind: str, batch: int,
-                  hamiltonian=None) -> str:
+                  hamiltonian=None, tier=None) -> str:
         """Make one warm form's executable resident in ``cc``:
         ``"hit"`` — deserialized from disk and installed (no compile);
         ``"miss"`` — compiled fresh, stored, installed; ``"skip"`` —
         this form cannot be cached here (mesh batch mode, unprobeable
         circuit, serialization unsupported) and the caller should warm
-        it by dispatch (the XLA layer still helps)."""
+        it by dispatch (the XLA layer still helps). ``tier`` selects a
+        precision tier's form: the tier token rides the form key (and
+        therefore this cache's content address), so a FAST-tier
+        artifact can never be served to another tier — a tier mismatch
+        is a miss, never a wrong program."""
         try:
             form, shapes, _ = cc.lower_batched(kind, batch, hamiltonian,
-                                               lower=False)
+                                               lower=False, tier=tier)
         except ValueError:
             self._incr("skipped")
             return "skip"
@@ -283,7 +287,8 @@ class WarmCache:
             self._incr("hits")
             return "hit"
         try:
-            _, _, lowered = cc.lower_batched(kind, batch, hamiltonian)
+            _, _, lowered = cc.lower_batched(kind, batch, hamiltonian,
+                                             tier=tier)
             compiled = lowered.compile()
         except Exception:
             self._incr("skipped")

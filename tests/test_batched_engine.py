@@ -256,7 +256,7 @@ class TestSweepEngine:
         got = np.asarray(cc.sweep(pm, state_f=planes))
         np.testing.assert_allclose(got, ref, atol=1e-12)
         assert (False, True, "none",
-                str(np.dtype(env.precision.real_dtype))) \
+                str(np.dtype(env.precision.real_dtype)), "env") \
             in cc._batched_cache
 
     def test_nondivisible_batch_warns_once_and_masks(self, mesh_env, env,
@@ -296,8 +296,8 @@ class TestSweepEngine:
         keys1 = set(cc._batched_cache)
         assert keys1 > keys0
         dt = str(np.dtype(mesh_env.precision.real_dtype))
-        assert (True, False, "batch", dt) in keys1
-        assert (False, True, "batch", dt) in keys1
+        assert (True, False, "batch", dt, "env") in keys1
+        assert (False, True, "batch", dt, "env") in keys1
 
     def test_sample_sweep(self, env, rng):
         """Shot batches: basis-state programs yield deterministic shots;

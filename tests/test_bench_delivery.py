@@ -162,6 +162,55 @@ def test_serving_rows_required():
     assert "bench_serving" in src
 
 
+def test_precision_tier_row_required():
+    """The bench must deliver the ISSUE-8 precision-tier row: the same
+    ensemble sweep at FAST vs SINGLE vs QUAD points/sec, max |Δ| of the
+    fast rungs against the dd oracle, and the forced-violation
+    escalation pass with zero budget violations surviving to callers.
+    Run tiny (6 qubits, batch 8, 1 oracle point) so the delivery
+    contract is tested, not the measurement."""
+    env_overrides = {
+        "QUEST_BENCH_TIER_QUBITS": "6",
+        "QUEST_BENCH_TIER_BATCH": "8",
+        "QUEST_BENCH_TIER_TERMS": "4",
+        "QUEST_BENCH_TIER_LAYERS": "1",
+        "QUEST_BENCH_TIER_ORACLE_POINTS": "1",
+        "QUEST_BENCH_TRIALS": "3",
+    }
+    old = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        import quest_tpu as qt
+        env = qt.createQuESTEnv(num_devices=1, seed=[2026])
+        row = bench.bench_precision_tiers(qt, env, "cpu")
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    assert row["unit"] == "points/sec"
+    assert row["value"] > 0.0
+    assert "FAST vs SINGLE vs QUAD" in row["metric"]
+    assert "hardware-efficient-ansatz-6" in row["metric"]
+    assert row["speedup_fast_vs_single"] > 0.0
+    assert row["single_points_per_sec"] > 0.0
+    assert row["quad_points_per_sec"] > 0.0
+    # the fast rungs stay inside the modeled budget vs the dd oracle
+    assert row["max_abs_dev_fast_vs_quad"] <= row["modeled_fast_error"]
+    assert row["fast_within_modeled_budget"] is True
+    # the forced-violation pass demonstrably escalated, and no
+    # out-of-budget answer reached a caller
+    assert row["injected_precision_faults"] >= 1
+    assert row["fast_tier_dispatches"] >= 1
+    assert row["tier_violations"] >= 1
+    assert row["tier_escalations"] >= 1
+    assert row["budget_violations_surviving"] == 0
+    assert "errors" not in row
+    # the acceptance mesh child must carry the row too
+    import inspect
+    src = inspect.getsource(bench.bench_sharded_mesh)
+    assert "bench_precision_tiers" in src
+
+
 def test_chaos_row_required():
     """The bench must deliver the ISSUE-5 chaos row: the serving trace
     under seeded transient fault injection, with requests/sec

@@ -106,7 +106,7 @@ class TestFaultInjector:
             t0 = time.monotonic()
             assert rz.fire("s") is False             # stall: sleeps
             assert time.monotonic() - t0 >= 0.009
-            assert rz.fire("s") is True              # nan: caller poisons
+            assert rz.fire("s") == "nan"             # nan: caller poisons
         assert inj.counts("oom") == 1
         assert inj.counts() == 3
 

@@ -334,9 +334,9 @@ class TestWarmAndCache:
             cc = svc.warm(c, batch_sizes=(8,),
                           observables=(terms, coeffs))
             dt = str(np.dtype(env.precision.real_dtype))
-            assert ("energy", "none", dt) in cc._batched_cache
+            assert ("energy", "none", dt, "env") in cc._batched_cache
             svc.warm(cc, batch_sizes=(4,))
-            assert (True, False, "none", dt) in cc._batched_cache
+            assert (True, False, "none", dt, "env") in cc._batched_cache
             svc.warm(cc, batch_sizes=(2,), shots=8)
 
     def test_cache_is_lru_bounded_with_eviction_counter(self, env,
@@ -364,8 +364,8 @@ class TestWarmAndCache:
         assert len(cc._batched_cache) == 2
         # LRU order: the oldest (broadcast) key is the one that left
         dt = str(np.dtype(env.precision.real_dtype))
-        assert (True, False, "none", dt) not in cc._batched_cache
-        assert ("energy", "none", dt) in cc._batched_cache
+        assert (True, False, "none", dt, "env") not in cc._batched_cache
+        assert ("energy", "none", dt, "env") in cc._batched_cache
         # as_dict carries the counters for the bench rows
         d = st.as_dict()
         assert d["batched_cache_evictions"] == 1

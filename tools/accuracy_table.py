@@ -1,13 +1,24 @@
-"""Measure single-precision accuracy vs circuit depth (VERDICT r1 #6).
+"""Measure single-precision accuracy vs circuit depth (VERDICT r1 #6),
+plus the FAST-tier (bf16-input matmul) drift envelope that seeds the
+precision-tier error model (ISSUE 8).
 
-Runs the same random brickwork circuit (bench.py's workload) at f32 and f64
-on CPU, and reports per-depth:
+Table 1 — the same random brickwork circuit (bench.py's workload) at f32
+and f64 on CPU, reporting per-depth:
   - max |amp_f32 - amp_f64| over the full state (per-gate rounding drift);
   - calcTotalProb absolute error in f32, naive vs compensated reduction,
     against the f64 value.
 
+Table 2 — the FAST tier's lane-matmul drift, measured on the Pallas
+layer kernel's exact lane-stage shape ((rows, 128) state x 128x128
+unitaries): bf16-rounded inputs emulate the MXU's Precision.DEFAULT
+passes on any host, comparing NAIVE bf16 accumulation against the FAST
+tier's bf16-split COMPENSATED form (state split error-free into a bf16
+hi plane plus residual, two bf16 passes, residual partial sums combined
+small-to-large in f32 — ops/pallas_kernels.py). The per-gate constants
+in quest_tpu/config.TIER_LADDER are seeded from this table.
+
 Usage: python tools/accuracy_table.py [num_qubits] [depths...]
-Writes a markdown table to stdout (pasted into docs/accuracy.md).
+Writes markdown tables to stdout (pasted into docs/accuracy.md).
 """
 
 import os
@@ -20,6 +31,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 import quest_tpu as qt  # noqa: E402
@@ -36,9 +48,7 @@ def run(num_qubits: int, layers: int, precision, compensated: bool):
     return q.to_numpy(), qt.calcTotalProb(q), n_gates
 
 
-def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 20
-    layer_list = [int(a) for a in sys.argv[2:]] or [2, 8, 32, 64]
+def f32_table(n: int, layer_list) -> None:
     print(f"| gates (at {n}q) | max state |Δ| f32 vs f64 "
           f"| reduction err, naive f32 | reduction err, compensated f32 "
           f"| totalProb err vs f64 golden (comp) |")
@@ -55,6 +65,103 @@ def main():
               f"| {abs(p_naive - p_exact_f32):.2e} "
               f"| {abs(p_comp - p_exact_f32):.2e} "
               f"| {abs(p_comp - p_ref):.2e} |")
+
+
+# ---------------------------------------------------------------------------
+# FAST-tier (bf16 lane matmul) drift — the tier error model's seed
+# ---------------------------------------------------------------------------
+
+def _bf16(x):
+    """Round f32 operands to bf16 — the rounding the MXU applies to
+    Precision.DEFAULT inputs, reproducible on any backend."""
+    return x.astype(jnp.bfloat16)
+
+
+def _lane_step(re, im, mr, mi, mode):
+    """One lane-stage complex matmul (ops/pallas_kernels._layer_kernel's
+    math) at one precision mode."""
+    f32 = jnp.float32
+    if mode == "f64":
+        return re @ mr - im @ mi, re @ mi + im @ mr
+    if mode == "naive":
+        def dot(a, b):
+            return jnp.dot(_bf16(a), _bf16(b), preferred_element_type=f32)
+        return (dot(re, mr) - dot(im, mi), dot(re, mi) + dot(im, mr))
+
+    # "compensated": the FAST tier's bf16-split form — the state operand
+    # splits error-free into a bf16 hi plane plus the f32 residual (two
+    # bf16 passes whose f32 partial sums recover the state's value),
+    # and the small residual partials combine FIRST so their correction
+    # lands in one f32 add (ops/pallas_kernels.py's fast lane stage)
+    def cdot(v, m):
+        hi = _bf16(v).astype(f32)
+        lo = v - hi
+        mb = _bf16(m)
+        return (jnp.dot(_bf16(hi), mb, preferred_element_type=f32),
+                jnp.dot(_bf16(lo), mb, preferred_element_type=f32))
+
+    rr_h, rr_l = cdot(re, mr)
+    ii_h, ii_l = cdot(im, mi)
+    ri_h, ri_l = cdot(re, mi)
+    ir_h, ir_l = cdot(im, mr)
+    return ((rr_h - ii_h) + (rr_l - ii_l),
+            (ri_h + ir_h) + (ri_l + ir_l))
+
+
+def fast_tier_table(num_qubits: int, layer_list) -> None:
+    """Per-depth max amplitude drift of the bf16 lane stage, naive vs
+    FAST-tier compensated, against the f64 run of the SAME unitaries."""
+    rng = np.random.default_rng(2026)
+    rows = (1 << num_qubits) // 128
+    z = rng.normal(size=(rows, 128)) + 1j * rng.normal(size=(rows, 128))
+    z /= np.linalg.norm(z)
+    print(f"| lane matmuls (at {num_qubits}q) "
+          f"| max amp |Δ| bf16 naive | bf16-split compensated (FAST) "
+          f"| naive/gate | compensated/gate |")
+    print("|---|---|---|---|---|")
+    max_layers = max(layer_list)
+    states = {
+        "f64": (jnp.asarray(z.real), jnp.asarray(z.imag)),
+        "naive": (jnp.asarray(z.real, jnp.float32),
+                  jnp.asarray(z.imag, jnp.float32)),
+        "comp": (jnp.asarray(z.real, jnp.float32),
+                 jnp.asarray(z.imag, jnp.float32)),
+    }
+    done = 0
+    for layers in sorted(layer_list):
+        for _ in range(layers - done):
+            u = np.linalg.qr(rng.normal(size=(128, 128))
+                             + 1j * rng.normal(size=(128, 128)))[0]
+            ops = {"f64": (jnp.asarray(u.real), jnp.asarray(u.imag))}
+            ops["naive"] = ops["comp"] = (
+                jnp.asarray(u.real, jnp.float32),
+                jnp.asarray(u.imag, jnp.float32))
+            for mode, (re, im) in states.items():
+                mr, mi = ops[mode]
+                states[mode] = _lane_step(
+                    re, im, mr, mi,
+                    "compensated" if mode == "comp" else mode)
+        done = layers
+        ref = (np.asarray(states["f64"][0])
+               + 1j * np.asarray(states["f64"][1]))
+        devs = {}
+        for mode in ("naive", "comp"):
+            got = (np.asarray(states[mode][0], np.float64)
+                   + 1j * np.asarray(states[mode][1], np.float64))
+            devs[mode] = float(np.max(np.abs(got - ref)))
+        print(f"| {layers} | {devs['naive']:.2e} | {devs['comp']:.2e} "
+              f"| {devs['naive'] / layers:.2e} "
+              f"| {devs['comp'] / layers:.2e} |")
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    layer_list = [int(a) for a in sys.argv[2:]] or [2, 8, 32, 64]
+    f32_table(n, layer_list)
+    print()
+    print("FAST tier (bf16-input lane matmuls), same depth ladder:")
+    print()
+    fast_tier_table(min(n, 16), layer_list)
 
 
 if __name__ == "__main__":

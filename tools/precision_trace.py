@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Dump the planned precision-tier decision for a circuit/budget as JSON.
+
+Offline inspection for the precision-tier budget API (quest_tpu/config
+``PrecisionTier`` + quest_tpu/profiling ``choose_tier``): for a given
+circuit and error budget, print the full ladder with each tier's modeled
+per-run error, availability on this environment, and runtime fidelity
+tolerance; the chosen tier; and the bounded escalation path the serving
+runtime would walk on repeated fidelity violations. No device work:
+tier selection is a host-side model evaluation, so the tool runs
+anywhere (the ``comm_trace``/``chaos_trace`` pattern).
+
+Usage::
+
+    python tools/precision_trace.py --qubits 16 --circuit hea --budget 1e-2
+    python tools/precision_trace.py --circuit qft --budget 1e-6
+    python tools/precision_trace.py --circuit grover --tier fast
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def trace_tiers(circ, env, budget=None, tier=None) -> dict:
+    """The tier decision for one recorded circuit as a plain dict
+    (JSON-ready): the modeled ladder, the budget's choice (or the
+    pinned tier), and the escalation path up the engine ladder."""
+    import quest_tpu as qt
+    from quest_tpu.profiling import (choose_tier, engine_tiers,
+                                     modeled_tier_error, tier_error_model,
+                                     tier_runtime_tol)
+
+    num_gates = max(len(circ.ops), 1)
+    # ONE resolved model for the whole report: the ladder rows, the
+    # selection, and the tolerances must all use the same constants
+    # (the env-calibrated model when one exists), or a row could show
+    # modeled_error <= budget for a tier the selector rejected
+    model = tier_error_model(env)
+    avail = engine_tiers(env)
+    avail_names = {t.name for t in avail}
+    ladder = []
+    for t in qt.TIER_LADDER:
+        ladder.append({
+            "tier": t.name,
+            "rank": t.rank,
+            "drift_per_gate": model.drift_per_gate.get(
+                t.name, t.drift_per_gate),
+            "modeled_error": modeled_tier_error(t, num_gates, model),
+            "matmul_precision": t.matmul_precision,
+            "compensated": t.compensated,
+            "real_dtype": str(t.real_dtype),
+            "engine_available": t.name in avail_names,
+            "runtime_tol": tier_runtime_tol(t, num_gates, model),
+        })
+    chosen = None
+    rejected = None
+    if tier is not None:
+        chosen = qt.tier_by_name(tier)
+    elif budget is not None:
+        try:
+            chosen = choose_tier(float(budget), num_gates, env,
+                                 model=model)
+        except ValueError as e:
+            rejected = str(e)
+    escalation = []
+    if chosen is not None:
+        escalation = [t.name for t in avail if t.rank > chosen.rank]
+    out = {
+        "num_qubits": circ.num_qubits,
+        "num_gates": num_gates,
+        "error_budget": budget,
+        "tier_model_source": model.source,
+        "ladder": ladder,
+        "chosen_tier": chosen.name if chosen is not None else None,
+        "modeled_error": (modeled_tier_error(chosen, num_gates, model)
+                          if chosen is not None else None),
+        "runtime_tol": (tier_runtime_tol(chosen, num_gates, model)
+                        if chosen is not None else None),
+        # the serving runtime's bounded recovery walk: one rung per
+        # fidelity violation, typed failure past the top
+        "escalation_path": escalation,
+    }
+    if rejected is not None:
+        out["budget_rejected"] = rejected
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--qubits", type=int, default=16)
+    ap.add_argument("--circuit", choices=("qft", "grover", "hea"),
+                    default="hea")
+    ap.add_argument("--budget", type=float, default=None,
+                    help="error budget (max amplitude error); the tool "
+                         "reports the cheapest tier whose modeled error "
+                         "fits, or the typed rejection")
+    ap.add_argument("--tier", default=None,
+                    help="pin a tier by name instead of budget-selecting")
+    ap.add_argument("--layers", type=int, default=2,
+                    help="HEA layers (hea circuit only)")
+    args = ap.parse_args(argv)
+    if args.budget is None and args.tier is None:
+        args.budget = 1e-2
+
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("QUEST_TPU_TIER_MODEL", "default")
+    repo_root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             os.pardir)
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+
+    import quest_tpu as qt
+    from quest_tpu import algorithms as alg
+
+    env = qt.createQuESTEnv(num_devices=1, seed=[0])
+    if args.circuit == "qft":
+        circ = alg.qft(args.qubits)
+    elif args.circuit == "grover":
+        circ = alg.grover(args.qubits, marked=(1 << args.qubits) - 3,
+                          num_iterations=2)
+    else:
+        from bench import build_hea_circuit
+        circ, _, _ = build_hea_circuit(args.qubits, args.layers)
+    json.dump(trace_tiers(circ, env, budget=args.budget, tier=args.tier),
+              sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
