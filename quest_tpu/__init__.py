@@ -59,6 +59,8 @@ from .serve import (SimulationService, CoalescePolicy, ServeError,
 from .resilience import (FaultInjector, FaultSpec, HealthConfig,
                          NumericalFault, ResiliencePolicy,
                          SupervisorPolicy)
+from .telemetry import (Tracer, TraceContext, metrics_registry,
+                        prometheus_text, start_http_exporter)
 from .api import *  # noqa: F401,F403  (the QuEST-compatible surface)
 from .api import __all__ as _api_all
 
@@ -83,6 +85,8 @@ __all__ = (
         "WarmCache",
         "FaultInjector", "FaultSpec", "HealthConfig", "NumericalFault",
         "ResiliencePolicy", "SupervisorPolicy",
+        "Tracer", "TraceContext", "metrics_registry",
+        "prometheus_text", "start_http_exporter",
     ]
     + list(_api_all)
 )

@@ -618,10 +618,12 @@ def createSimulationService(env: QuESTEnv, **kwargs):
     (:class:`quest_tpu.serve.SimulationService`; TPU-native addition,
     no reference counterpart). Keyword arguments are the service knobs:
     ``max_queue``, ``max_batch``, ``max_wait_s``, ``request_timeout_s``,
-    ``max_retries``, and ``resilience`` (a
+    ``max_retries``, ``resilience`` (a
     :class:`quest_tpu.resilience.ResiliencePolicy` — retry backoff,
-    circuit breaker, batch quarantine, watchdog). Destroy with
-    ``service.close()`` (or use it as a context manager)."""
+    circuit breaker, batch quarantine, watchdog), and
+    ``trace_sample_rate`` (request-scoped tracing,
+    :mod:`quest_tpu.telemetry`). Destroy with ``service.close()`` (or
+    use it as a context manager)."""
     from .serve import SimulationService
     return SimulationService(env, **kwargs)
 

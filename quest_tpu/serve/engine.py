@@ -67,6 +67,9 @@ from ..resilience.health import NumericalFault
 from ..resilience.recovery import (FATAL, POISON, PRECISION, TRANSIENT,
                                    CircuitBreaker, ResiliencePolicy,
                                    classify)
+from ..telemetry.events import make_event, read_timeline
+from ..telemetry.metrics import metrics_registry
+from ..telemetry.tracing import Tracer, dispatch_annotation
 from .coalesce import (KIND_EXPECTATION, KIND_SAMPLE, KIND_STATE,
                        CoalescePolicy, coalesce_key, split_ready)
 from .metrics import ServiceMetrics
@@ -105,7 +108,7 @@ class _Request:
     __slots__ = ("compiled", "param_vec", "kind", "observables", "shots",
                  "submit_t", "deadline", "future", "retries_left", "key",
                  "not_before", "attempts", "tier", "escalations",
-                 "obs_key")
+                 "obs_key", "trace", "trace_owned", "qspan", "dspan")
 
     def __init__(self, compiled, param_vec, kind, observables, shots,
                  submit_t, deadline, future, retries_left, key,
@@ -125,6 +128,10 @@ class _Request:
         self.tier = tier         # precision tier (None = env precision)
         self.escalations = 0     # tier bumps already taken
         self.obs_key = obs_key   # canonical observable key (rekeying)
+        self.trace = None        # TraceContext when the request sampled
+        self.trace_owned = False  # this service created the trace
+        self.qspan = None        # open "queue" span (per attempt)
+        self.dspan = None        # open "dispatch" span
 
 
 def _canonical_observables(compiled, observables) -> tuple:
@@ -170,8 +177,29 @@ class SimulationService:
         watchdog timeout. Defaults to the standard policy.
     record_events : int
         Ring-buffer bound on the recovery timeline
-        (:attr:`SimulationService.events`; ``tools/chaos_trace.py``
-        dumps it). 0 disables recording.
+        (:attr:`SimulationService.events`; read it with
+        :meth:`timeline` — ``tools/chaos_trace.py`` and
+        ``tools/obs_console.py`` dump it). 0 disables recording
+        entirely: the trace-consuming tools then warn once and render
+        an empty timeline, so leave the default unless the per-event
+        cost has been measured to matter.
+    trace_sample_rate : float
+        Fraction of requests that record a full request-scoped trace
+        (:mod:`quest_tpu.telemetry.tracing`): spans for submit, queue,
+        coalesce, dispatch, retry, escalation, and resolve, exported
+        from :attr:`tracer` as JSON or Chrome trace events. 0 (default)
+        disables tracing; 1.0 traces everything (measured overhead
+        budget: <= 3% serving throughput, bench.py telemetry rows).
+        Sampling is a deterministic stride, not a random draw.
+    tracer : Tracer | None
+        An explicit :class:`~quest_tpu.telemetry.tracing.Tracer` to
+        record into (shared across services); None builds one from
+        ``trace_sample_rate``.
+    name : str | None
+        The service's name in the process-global metrics registry
+        (:func:`quest_tpu.telemetry.metrics.metrics_registry`), where
+        its full ``dispatch_stats()`` document is registered for the
+        Prometheus/JSON exporters. None auto-generates a unique name.
     warm_cache : WarmCache | False | None
         The persistent warm-start compile cache
         (:class:`quest_tpu.serve.warmcache.WarmCache`). Default None
@@ -187,7 +215,10 @@ class SimulationService:
                  max_retries: int = 1, latency_window: int = 4096,
                  max_circuits: int = 32,
                  resilience: Optional[ResiliencePolicy] = None,
-                 record_events: int = 256, warm_cache=None):
+                 record_events: int = 256, warm_cache=None,
+                 trace_sample_rate: float = 0.0,
+                 tracer: Optional[Tracer] = None,
+                 name: Optional[str] = None):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if request_timeout_s <= 0.0:
@@ -241,6 +272,15 @@ class SimulationService:
         self._t0 = time.monotonic()
         self.events: collections.deque = collections.deque(
             maxlen=max(0, int(record_events)))
+        # unified telemetry (quest_tpu/telemetry): request-scoped traces
+        # behind a deterministic sampler, and the service's combined
+        # dispatch_stats() document registered (weakly) for the
+        # Prometheus/JSON exporters
+        self.name = name or metrics_registry().unique_name("service")
+        self.tracer = tracer if tracer is not None else Tracer(
+            sample_rate=trace_sample_rate, name=self.name)
+        self._registry_token = metrics_registry().register(
+            self.name, self.dispatch_stats, kind="service", owner=self)
         self._heartbeat = time.monotonic()
         self._stall_flagged = False
         self._watchdog_stop = threading.Event()
@@ -299,7 +339,7 @@ class SimulationService:
                observables=None, shots: Optional[int] = None,
                deadline: Optional[float] = None,
                error_budget: Optional[float] = None,
-               tier=None) -> Future:
+               tier=None, _trace=None) -> Future:
         """Enqueue one simulation request; returns its Future.
 
         ``circuit``: a :class:`CompiledCircuit` (preferred — submissions
@@ -377,17 +417,46 @@ class SimulationService:
         req = _Request(compiled, vec, kind, ham, int(shots or 0), now,
                        abs_deadline, fut, self.max_retries, key,
                        tier=req_tier, obs_key=obs_key)
-        with self._cond:
-            if self._closed:
-                raise ServiceClosed("service is closed")
-            if self._backlog >= self.max_queue:
-                self.metrics.incr("rejected_queue_full")
-                raise QueueFull(
-                    f"admission queue is at capacity ({self.max_queue}); "
-                    "retry later or raise max_queue")
-            self._backlog += 1
-            self._queue.append(req)
-            self._cond.notify_all()
+        # request-scoped tracing: a router-propagated context rides in
+        # via _trace (the router owns + finishes it); otherwise the
+        # service's own sampler decides, and the service finishes the
+        # trace at future resolution (one done-callback catches EVERY
+        # resolution path — fan-out, expiry, breaker, quarantine)
+        ctx = _trace if _trace is not None else self.tracer.start(
+            service=self.name)
+        if ctx is not None:
+            req.trace = ctx
+            req.trace_owned = _trace is None
+            ctx.add("submit", service=self.name, kind=kind,
+                    program=self._program_key_str(compiled),
+                    tier=req_tier.name if req_tier is not None else "env",
+                    deadline_s=round(abs_deadline - now, 6))
+            req.qspan = ctx.begin("queue")
+            if req.trace_owned:
+                fut.add_done_callback(
+                    lambda f, c=ctx: self._finish_trace(c, f))
+        try:
+            with self._cond:
+                if self._closed:
+                    raise ServiceClosed("service is closed")
+                if self._backlog >= self.max_queue:
+                    self.metrics.incr("rejected_queue_full")
+                    raise QueueFull(
+                        f"admission queue is at capacity "
+                        f"({self.max_queue}); retry later or raise "
+                        "max_queue")
+                self._backlog += 1
+                self._queue.append(req)
+                self._cond.notify_all()
+        except ServeError as e:
+            # admission rejected: the future will never resolve, so a
+            # service-owned trace must be closed HERE or it leaks
+            # unfinished (a router-owned one lives on — the router
+            # re-places the work and finishes it)
+            if ctx is not None and req.trace_owned:
+                ctx.add("resolve", status=type(e).__name__)
+                ctx.finish(type(e).__name__)
+            raise
         self.metrics.incr("submitted")
         return fut
 
@@ -545,7 +614,8 @@ class SimulationService:
         if inj is not None:
             res["fault_injection"] = inj.snapshot()
         out = {**base, "service": self.metrics.snapshot(),
-               "resilience": res}
+               "resilience": res,
+               "telemetry": self.tracer.stats()}
         if self.warm_cache is not None:
             out["warm_cache"] = self.warm_cache.stats()
         return out
@@ -566,6 +636,7 @@ class SimulationService:
         if threading.current_thread() is not self._thread:
             self._thread.join(timeout)
         self._watchdog_stop.set()
+        metrics_registry().unregister(self._registry_token)
 
     def __enter__(self) -> "SimulationService":
         return self
@@ -717,13 +788,35 @@ class SimulationService:
                         self._degraded_until.pop(k, None)
         return key
 
-    def _event(self, _name: str, **detail) -> None:
-        """Append one recovery-timeline event (bounded ring;
-        ``tools/chaos_trace.py`` dumps it as JSON)."""
+    def _event(self, _name: str, _trace=None, **detail) -> None:
+        """Append one recovery-timeline event (bounded ring; read via
+        :meth:`timeline`). Records the unified schema
+        (:mod:`quest_tpu.telemetry.events`): monotonic offset ``t``
+        (compat), wall-clock epoch ``wall``, and the trace id when the
+        event belongs to one traced request."""
         if self.events.maxlen:
-            self.events.append({
-                "t": round(time.monotonic() - self._t0, 6),
-                "event": _name, **detail})
+            self.events.append(make_event(
+                _name, self._t0,
+                trace_id=_trace.trace_id if _trace is not None else None,
+                **detail))
+
+    def timeline(self) -> list:
+        """The recovery-event timeline as a plain list (warns once per
+        process when this service was built with ``record_events=0`` —
+        the ring is then disabled and always empty)."""
+        return read_timeline(self, tool="timeline()")
+
+    @staticmethod
+    def _finish_trace(ctx, fut) -> None:
+        """Future done-callback for service-owned traces: record the
+        resolve span with the outcome and close the trace."""
+        if fut.cancelled():
+            status = "cancelled"
+        else:
+            exc = fut.exception()
+            status = "ok" if exc is None else type(exc).__name__
+        ctx.add("resolve", status=status)
+        ctx.finish(status)
 
     def _watchdog_loop(self) -> None:
         """Heartbeat watchdog: the dispatcher stamps ``_heartbeat``
@@ -852,6 +945,11 @@ class SimulationService:
                 self.metrics.incr("quarantine_splits")
                 self._event("quarantine_split", program=pkey,
                             requests=len(batch), depth=depth)
+                for req in batch:
+                    if req.trace is not None:
+                        req.trace.add("quarantine_split",
+                                      requests=len(batch), depth=depth,
+                                      error=type(e).__name__)
                 mid = len(batch) // 2
                 self._run_group(batch[:mid], pkey, depth + 1)
                 self._run_group(batch[mid:], pkey, depth + 1)
@@ -899,6 +997,49 @@ class SimulationService:
             pm[i] = req.param_vec
         t_dispatch = time.monotonic()
         kind = batch[0].kind
+        tier_name = tier.name if tier is not None else "env"
+        traced = [r for r in batch if r.trace is not None]
+        for i, req in enumerate(batch):
+            ctx = req.trace
+            if ctx is None:
+                continue
+            if req.qspan is not None:
+                ctx.end(req.qspan, queue_wait_s=round(
+                    t_dispatch - req.submit_t, 6))
+                req.qspan = None
+            ctx.add("coalesce", batch=B, bucket=padded, row=i,
+                    kind=kind, tier=tier_name)
+            req.dspan = ctx.begin("dispatch", batch=B, bucket=padded,
+                                  kind=kind, tier=tier_name,
+                                  service=self.name)
+        try:
+            out = self._dispatch_batch_inner(batch, cc, tier, B, padded,
+                                             pm, kind)
+        except BaseException as e:
+            for req in traced:
+                if req.dspan is not None:
+                    req.trace.end(req.dspan, status=type(e).__name__)
+                    req.dspan = None
+            raise
+        mode = ""
+        if traced:
+            try:
+                mode = cc.dispatch_stats().batch_sharding_mode
+            except Exception:
+                mode = ""
+            for req in traced:
+                if req.dspan is not None:
+                    req.trace.end(req.dspan, sharding=mode)
+                    req.dspan = None
+        return out
+
+    def _dispatch_batch_inner(self, batch, cc, tier, B, padded, pm,
+                              kind):
+        """The engine execution of one group, wrapped in a
+        ``jax.profiler`` annotation so a device profile captured with
+        :func:`quest_tpu.profiling.trace` lines up with the host-side
+        dispatch spans."""
+        t_dispatch = time.monotonic()
         if tier is not None and tier.name == "fast":
             self.metrics.incr("fast_tier_dispatches")
         poison = _faults.fire("serve.execute")
@@ -915,17 +1056,26 @@ class SimulationService:
             # value/plane screens catch: the request still fails typed,
             # never wrong — the one thing chaos runs must never produce.
             poison = "nan"
+        # the annotation name carries kind + bucket + tier, so a device
+        # profile (profiling.trace -> Perfetto) shows which serving
+        # dispatch each XLA region belongs to, aligned with the host
+        # "dispatch" spans the request traces record
+        ann = dispatch_annotation(
+            f"quest_tpu.serve.dispatch:{kind}:b{padded}:"
+            f"{tier.name if tier is not None else 'env'}")
         if kind == KIND_EXPECTATION:
-            out = _faults.poison_output(poison, np.asarray(
-                cc.expectation_sweep(pm, batch[0].observables,
-                                     tier=tier))[:B])
+            with ann:
+                out = _faults.poison_output(poison, np.asarray(
+                    cc.expectation_sweep(pm, batch[0].observables,
+                                         tier=tier))[:B])
             results = [float(v) for v in out]
             bad = _health.bad_value_rows(out) if guard else ()
             # energies carry no unit-norm invariant: only the NaN
             # screen applies (docs/accuracy.md "Precision tiers")
         elif kind == KIND_SAMPLE:
             shots = max(req.shots for req in batch)
-            idx, totals = cc.sample_sweep(pm, shots, tier=tier)
+            with ann:
+                idx, totals = cc.sample_sweep(pm, shots, tier=tier)
             totals = _faults.poison_output(poison,
                                            np.asarray(totals)[:B])
             results = [(np.asarray(idx[i, :req.shots]), float(totals[i]))
@@ -937,8 +1087,9 @@ class SimulationService:
             norms = np.sqrt(np.maximum(
                 np.asarray(totals, dtype=np.float64), 0.0))
         else:
-            planes = _faults.poison_output(
-                poison, np.asarray(cc.sweep(pm, tier=tier))[:B])
+            with ann:
+                planes = _faults.poison_output(
+                    poison, np.asarray(cc.sweep(pm, tier=tier))[:B])
             results = [np.array(planes[i]) for i in range(B)]
             bad = _health.bad_plane_rows(planes) if guard else ()
             if guard and tier is not None:
@@ -987,8 +1138,13 @@ class SimulationService:
                 return
             req.not_before = now + delay
             self.metrics.incr("retries")
-            self._event("retry", attempt=req.attempts,
+            self._event("retry", _trace=req.trace, attempt=req.attempts,
                         delay_s=round(delay, 6))
+            if req.trace is not None:
+                req.trace.add("retry", attempt=req.attempts,
+                              delay_s=round(delay, 6),
+                              error=type(exc).__name__)
+                req.qspan = req.trace.begin("queue", retry=req.attempts)
             with self._cond:
                 self._backlog += 1
                 self._queue.append(req)
@@ -997,8 +1153,8 @@ class SimulationService:
         self.metrics.incr("failed")
         if kind == POISON:
             self.metrics.incr("quarantined")
-        self._event("request_failed", error=type(exc).__name__,
-                    kind=kind)
+        self._event("request_failed", _trace=req.trace,
+                    error=type(exc).__name__, kind=kind)
         if req.future.set_running_or_notify_cancel():
             req.future.set_exception(exc)
 
@@ -1026,8 +1182,15 @@ class SimulationService:
         req.key = coalesce_key(req.compiled, req.kind, req.obs_key,
                                req.shots, nxt)
         self.metrics.incr("tier_escalations")
-        self._event("tier_escalation", from_tier=prev.name,
-                    to_tier=nxt.name, escalations=req.escalations)
+        self._event("tier_escalation", _trace=req.trace,
+                    from_tier=prev.name, to_tier=nxt.name,
+                    escalations=req.escalations)
+        if req.trace is not None:
+            req.trace.add("escalate", from_tier=prev.name,
+                          to_tier=nxt.name,
+                          escalations=req.escalations)
+            req.qspan = req.trace.begin("queue",
+                                        escalations=req.escalations)
         with self._cond:
             self._backlog += 1
             self._queue.append(req)

@@ -1,25 +1,32 @@
-"""Per-service metrics registry for the serving runtime.
+"""Per-service metrics registries for the serving runtime.
 
 Every :class:`quest_tpu.serve.SimulationService` owns one
-:class:`ServiceMetrics`: thread-safe counters for the request lifecycle
-(submitted / completed / rejected / timed out / retried), per-batch
-coalescing accounting (occupancy, padded rows), and a bounded latency
-reservoir from which the snapshot derives p50/p99. The registry is
-deliberately dependency-free — plain counters under one lock — because
-it is updated from BOTH the caller threads (submit-side rejections) and
-the service's background dispatcher thread.
+:class:`ServiceMetrics` and every :class:`~quest_tpu.serve.router.
+ServiceRouter` one :class:`RouterMetrics`, both built on the typed
+primitives in :mod:`quest_tpu.telemetry.metrics`: named
+:class:`~quest_tpu.telemetry.metrics.Counter` objects for the request
+lifecycle, and fixed-bucket :class:`~quest_tpu.telemetry.metrics.
+Histogram` latency distributions (constant memory, replica-mergeable,
+Prometheus-exportable) where bounded raw-sample reservoirs used to sit.
+The registries stay dependency-free and thread-safe — they are updated
+from BOTH the caller threads (submit-side rejections) and the service's
+background dispatcher thread.
 
 :meth:`ServiceMetrics.snapshot` returns a plain dict;
 ``SimulationService.dispatch_stats()`` folds that snapshot in next to
 the engine-level :class:`quest_tpu.profiling.DispatchStats` fields, so
 one call answers both "what did the compiler do" and "what did the
-serving layer do".
+serving layer do" — and the service registers that combined document
+into the process-global :func:`~quest_tpu.telemetry.metrics.
+metrics_registry`, which is what the Prometheus/JSON exporters
+(:mod:`quest_tpu.telemetry.export`) scrape.
 """
 
 from __future__ import annotations
 
-import collections
 import threading
+
+from ..telemetry.metrics import Counter, Histogram
 
 __all__ = ["ServiceMetrics", "RouterMetrics"]
 
@@ -57,62 +64,79 @@ _COUNTERS = (
 
 
 class ServiceMetrics:
-    """Thread-safe counters + bounded latency reservoir for one service.
+    """Typed counters + fixed-bucket latency histograms for one service.
 
-    ``latency_window`` bounds the reservoir (ring buffer of the most
-    recent completions): percentiles stay O(window) to compute and the
-    registry's memory is constant regardless of how long the service
-    lives. ``queue_depth_fn`` is an optional gauge callback installed by
-    the owning service (the queue lives there, not here).
+    ``latency_window`` is accepted for backward compatibility (it
+    bounded the old raw-sample reservoirs); the histograms are
+    constant-memory regardless, so it is unused. ``queue_depth_fn`` is
+    an optional gauge callback installed by the owning service (the
+    queue lives there, not here).
     """
 
     def __init__(self, latency_window: int = 4096):
-        self._lock = threading.Lock()
-        self._latencies = collections.deque(maxlen=latency_window)
-        self._queue_waits = collections.deque(maxlen=latency_window)
-        self._c = {name: 0 for name in _COUNTERS}
+        # ONE reentrant lock shared by every counter: a snapshot must
+        # read the whole counter family atomically w.r.t. record_batch,
+        # or a reader can see shared_batch_requests from after an
+        # update and coalesced_requests from before it (the torn-read
+        # class the router-level coherence test hunts)
+        self._lock = threading.RLock()
+        self._latency = Histogram(
+            "request_latency_s", "submit-to-result seconds")
+        self._queue_wait = Histogram(
+            "queue_wait_s", "submit-to-dispatch seconds")
+        self._c = {name: Counter(name, lock=self._lock)
+                   for name in _COUNTERS}
         self._max_occupancy = 0
         self.queue_depth_fn = None
 
     # -- recording ---------------------------------------------------------
 
     def incr(self, name: str, k: int = 1) -> None:
-        if name not in self._c:
+        c = self._c.get(name)
+        if c is None:
             raise KeyError(f"unknown service counter {name!r}")
-        with self._lock:
-            self._c[name] += k
+        c.inc(k)
 
     def get(self, name: str) -> int:
         """One counter, cheaply (no full snapshot — the router's
         supervisor polls this per replica per tick)."""
-        with self._lock:
-            return self._c[name]
+        return self._c[name].value
 
     def record_batch(self, size: int, padded_size: int) -> None:
         """One coalesced dispatch of ``size`` live requests, executed at
-        ``padded_size`` rows (the batch bucket the executable ran at)."""
+        ``padded_size`` rows (the batch bucket the executable ran at).
+        One atomic update: a concurrent snapshot sees the whole batch's
+        accounting or none of it."""
         with self._lock:
-            self._c["batches"] += 1
-            self._c["coalesced_requests"] += size
+            self._c["batches"].inc()
+            self._c["coalesced_requests"].inc(size)
             if size > 1:
-                self._c["shared_batch_requests"] += size
-            self._c["padded_rows"] += max(0, padded_size - size)
+                self._c["shared_batch_requests"].inc(size)
+            self._c["padded_rows"].inc(max(0, padded_size - size))
             self._max_occupancy = max(self._max_occupancy, size)
 
     def record_latency(self, total_s: float, queue_wait_s: float) -> None:
-        with self._lock:
-            self._latencies.append(float(total_s))
-            self._queue_waits.append(float(queue_wait_s))
+        self._latency.observe(total_s)
+        self._queue_wait.observe(queue_wait_s)
 
     # -- reading -----------------------------------------------------------
 
     @staticmethod
     def _pct(sorted_vals, p: float) -> float:
+        """Percentile of a raw SORTED sample list — the convention the
+        offline tools (``tools/serve_trace.py``, bench rows built from
+        wall-clock lists) share with the live histograms."""
         if not sorted_vals:
             return 0.0
         i = min(len(sorted_vals) - 1,
                 max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
         return float(sorted_vals[i])
+
+    def latency_histograms(self) -> dict:
+        """The raw histogram snapshots (Prometheus-shaped cumulative
+        buckets) next to the derived percentiles in :meth:`snapshot`."""
+        return {"request_latency_s": self._latency.snapshot(),
+                "queue_wait_s": self._queue_wait.snapshot()}
 
     def snapshot(self) -> dict:
         """Point-in-time view as a plain dict (JSON-ready).
@@ -120,12 +144,13 @@ class ServiceMetrics:
         ``batch_occupancy`` is mean live requests per dispatch — the
         number the coalescer exists to raise above 1. ``coalesce_ratio``
         is the fraction of dispatched requests that shared their batch
-        with at least one other request.
+        with at least one other request. Percentiles are estimated from
+        the fixed-bucket histograms (interpolated inside the owning
+        bucket, clamped to the observed max).
         """
         with self._lock:
-            c = dict(self._c)
-            lat = sorted(self._latencies)
-            waits = sorted(self._queue_waits)
+            # atomic family read (the RLock is the counters' own lock)
+            c = {name: cnt.value for name, cnt in self._c.items()}
             max_occ = self._max_occupancy
         batches = c["batches"]
         dispatched = c["coalesced_requests"]
@@ -144,10 +169,10 @@ class ServiceMetrics:
             if dispatched else 0.0,
             "padded_fraction": c["padded_rows"]
             / max(1, c["padded_rows"] + dispatched),
-            "p50_latency_s": self._pct(lat, 50.0),
-            "p99_latency_s": self._pct(lat, 99.0),
-            "p50_queue_wait_s": self._pct(waits, 50.0),
-            "p99_queue_wait_s": self._pct(waits, 99.0),
+            "p50_latency_s": self._latency.percentile(50.0),
+            "p99_latency_s": self._latency.percentile(99.0),
+            "p50_queue_wait_s": self._queue_wait.percentile(50.0),
+            "p99_queue_wait_s": self._queue_wait.percentile(99.0),
         }
 
 
@@ -168,33 +193,36 @@ _ROUTER_COUNTERS = (
 
 
 class RouterMetrics:
-    """Thread-safe counters + latency reservoir for one
+    """Typed counters + a latency histogram for one
     :class:`~quest_tpu.serve.router.ServiceRouter` (the replica-level
     view; each replica's own :class:`ServiceMetrics` stays the
     per-service truth). Same shape as :class:`ServiceMetrics` so the
     bench rows and chaos traces read both uniformly."""
 
     def __init__(self, latency_window: int = 4096):
-        self._lock = threading.Lock()
-        self._c = {name: 0 for name in _ROUTER_COUNTERS}
-        self._latencies = collections.deque(maxlen=latency_window)
+        self._lock = threading.RLock()
+        self._c = {name: Counter(name, lock=self._lock)
+                   for name in _ROUTER_COUNTERS}
+        self._latency = Histogram(
+            "router_latency_s", "router submit-to-result seconds")
 
     def incr(self, name: str, k: int = 1) -> None:
-        if name not in self._c:
+        c = self._c.get(name)
+        if c is None:
             raise KeyError(f"unknown router counter {name!r}")
-        with self._lock:
-            self._c[name] += k
+        c.inc(k)
 
     def record_latency(self, total_s: float) -> None:
-        with self._lock:
-            self._latencies.append(float(total_s))
+        self._latency.observe(total_s)
+
+    def latency_histograms(self) -> dict:
+        return {"router_latency_s": self._latency.snapshot()}
 
     def snapshot(self) -> dict:
         with self._lock:
-            c = dict(self._c)
-            lat = sorted(self._latencies)
+            c = {name: cnt.value for name, cnt in self._c.items()}
         return {
             **c,
-            "p50_latency_s": ServiceMetrics._pct(lat, 50.0),
-            "p99_latency_s": ServiceMetrics._pct(lat, 99.0),
+            "p50_latency_s": self._latency.percentile(50.0),
+            "p99_latency_s": self._latency.percentile(99.0),
         }

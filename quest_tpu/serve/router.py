@@ -59,6 +59,9 @@ from ..circuits import Circuit, CompiledCircuit
 from ..resilience import faults as _faults
 from ..resilience.recovery import (FATAL, POISON, TRANSIENT,
                                    SupervisorPolicy, classify)
+from ..telemetry.events import make_event, read_timeline
+from ..telemetry.metrics import metrics_registry
+from ..telemetry.tracing import Tracer
 from .engine import (CircuitBreakerOpen, DeadlineExceeded, QueueFull,
                      ServeError, ServiceClosed, SimulationService)
 from .metrics import RouterMetrics
@@ -145,7 +148,7 @@ class _Work:
     __slots__ = ("circuit", "params", "observables", "shots", "submit_t",
                  "deadline", "future", "failovers_left", "lock", "done",
                  "tried", "active", "last_route_t", "hedged",
-                 "park_logged")
+                 "park_logged", "trace")
 
     def __init__(self, circuit, params, observables, shots, submit_t,
                  deadline, failovers_left):
@@ -164,6 +167,7 @@ class _Work:
         self.last_route_t = submit_t
         self.hedged = False
         self.park_logged = False
+        self.trace = None               # TraceContext when sampled
 
 
 class _Replica:
@@ -215,6 +219,19 @@ class ServiceRouter:
         One persistent warm-start cache SHARED by all replicas (same
         programs, same artifacts — replica 1's stores are replica 2's
         loads). None resolves ``QUEST_TPU_WARM_CACHE_DIR``.
+    trace_sample_rate : float
+        Fraction of router submissions that record a request-scoped
+        trace (:mod:`quest_tpu.telemetry.tracing`). The router CREATES
+        the trace and propagates it into whichever replica serves each
+        hop, so one trace follows the request across failovers and
+        hedges; the router finishes it at resolution. 0 disables.
+    tracer : Tracer | None
+        Explicit tracer to record into; None builds one from
+        ``trace_sample_rate``.
+    name : str | None
+        The router's name in the process-global metrics registry
+        (replicas register as ``<name>-replica<i>``). None
+        auto-generates a unique name.
     **service_kwargs :
         Forwarded to every replica's :class:`SimulationService`
         (max_batch, max_wait_s, max_queue, request_timeout_s,
@@ -227,6 +244,9 @@ class ServiceRouter:
                  max_failovers: Optional[int] = None,
                  hedge_after_s: Optional[float] = None,
                  warm_cache=None, record_events: int = 1024,
+                 trace_sample_rate: float = 0.0,
+                 tracer: Optional[Tracer] = None,
+                 name: Optional[str] = None,
                  **service_kwargs):
         if envs is None:
             envs = replica_envs(num_replicas or 2, devices_per_replica)
@@ -249,13 +269,21 @@ class ServiceRouter:
         self.events: collections.deque = collections.deque(
             maxlen=max(0, int(record_events)))
         self._t0 = time.monotonic()
+        # unified telemetry: router-owned request traces (propagated
+        # into whichever replica serves each hop) + the router's
+        # dispatch_stats() document in the process-global registry
+        self.name = name or metrics_registry().unique_name("router")
+        self.tracer = tracer if tracer is not None else Tracer(
+            sample_rate=trace_sample_rate, name=self.name)
+        self._registry_token = metrics_registry().register(
+            self.name, self.dispatch_stats, kind="router", owner=self)
         self._lock = threading.RLock()
         self._closed = False
         self._warm_specs: list = []
         self._outstanding: dict = {}    # id(work) -> work
         self._parked: list = []         # work waiting for a ready replica
         self._replicas = [
-            _Replica(i, env, self._new_service(env))
+            _Replica(i, env, self._new_service(env, index=i))
             for i, env in enumerate(envs)]
         self._stop = threading.Event()
         self._supervisor = threading.Thread(
@@ -265,19 +293,35 @@ class ServiceRouter:
 
     # -- construction ------------------------------------------------------
 
-    def _new_service(self, env) -> SimulationService:
+    def _new_service(self, env,
+                     index: Optional[int] = None) -> SimulationService:
+        # every service generation gets a UNIQUE registry name (the
+        # replica slot rides in the label-friendly prefix): a restarted
+        # replica must never unregister its replacement's entry
+        prefix = f"{self.name}-replica{index}" if index is not None \
+            else f"{self.name}-replica"
         return SimulationService(env, warm_cache=self.warm_cache or False,
+                                 name=metrics_registry().unique_name(
+                                     prefix),
                                  **self._service_kwargs)
 
     @property
     def num_replicas(self) -> int:
         return len(self._replicas)
 
-    def _event(self, _name: str, **detail) -> None:
+    def _event(self, _name: str, _trace=None, **detail) -> None:
+        """One unified-schema timeline event (monotonic offset + wall
+        epoch + optional trace id; :mod:`quest_tpu.telemetry.events`)."""
         if self.events.maxlen:
-            self.events.append({
-                "t": round(time.monotonic() - self._t0, 6),
-                "event": _name, **detail})
+            self.events.append(make_event(
+                _name, self._t0,
+                trace_id=_trace.trace_id if _trace is not None else None,
+                **detail))
+
+    def timeline(self) -> list:
+        """The router-event timeline as a plain list (warns once per
+        process when built with ``record_events=0``)."""
+        return read_timeline(self, tool="timeline()")
 
     # -- routing -----------------------------------------------------------
 
@@ -351,6 +395,11 @@ class ServiceRouter:
             abs_deadline = min(abs_deadline, now + float(deadline))
         work = _Work(route, params, observables, shots, now, abs_deadline,
                      self.max_failovers)
+        ctx = self.tracer.start(router=self.name)
+        if ctx is not None:
+            work.trace = ctx
+            ctx.add("submit", router=self.name,
+                    deadline_s=round(abs_deadline - now, 6))
         kind = _faults.fire_router("router.route")
         if kind is not None:
             self._apply_replica_fault(kind)
@@ -397,8 +446,11 @@ class ServiceRouter:
                             # once per work: the supervisor re-places
                             # every poll and would flood the ring
                             work.park_logged = True
-                            self._event("parked",
+                            self._event("parked", _trace=work.trace,
                                         tried=sorted(work.tried))
+                            if work.trace is not None:
+                                work.trace.add(
+                                    "park", tried=sorted(work.tried))
                         return
                 self.metrics.incr("failed_unroutable")
                 self._resolve(work, exc=AllReplicasUnavailable(
@@ -409,7 +461,7 @@ class ServiceRouter:
                 fut = h.service.submit(
                     work.circuit, work.params,
                     observables=work.observables, shots=work.shots,
-                    deadline=remaining)
+                    deadline=remaining, _trace=work.trace)
             except QueueFull:
                 self.metrics.incr("rerouted_full")
                 exclude = set(exclude) | {h.index}
@@ -429,6 +481,8 @@ class ServiceRouter:
                 exclude = set(exclude) | {h.index}
                 continue
             hedge = bool(work.active)
+            if work.trace is not None:
+                work.trace.add("route", replica=h.index, hedge=hedge)
             with work.lock:
                 work.tried.add(h.index)
                 # entry carries ITS OWN dispatch timestamp: a later
@@ -492,10 +546,13 @@ class ServiceRouter:
         if eligible and work.failovers_left > 0 and not self._closed:
             work.failovers_left -= 1
             self.metrics.incr("failovers")
-            self._event("failover", replica=h.index,
+            self._event("failover", _trace=work.trace, replica=h.index,
                         error=type(exc).__name__,
                         remaining_s=round(
                             work.deadline - time.monotonic(), 6))
+            if work.trace is not None:
+                work.trace.add("failover", replica=h.index,
+                               error=type(exc).__name__)
             self._place(work, set(work.tried))
             return
         if not work.active:     # no other hop can still save it
@@ -518,6 +575,12 @@ class ServiceRouter:
                 work.future.set_result(result)
         if exc is None:
             self.metrics.record_latency(time.monotonic() - work.submit_t)
+        if work.trace is not None:
+            status = "ok" if exc is None else type(exc).__name__
+            work.trace.add("resolve", status=status,
+                           failovers=self.max_failovers
+                           - work.failovers_left)
+            work.trace.finish(status)
 
     # -- warm + probe ------------------------------------------------------
 
@@ -669,8 +732,11 @@ class ServiceRouter:
             if w.failovers_left > 0:
                 w.failovers_left -= 1
                 self.metrics.incr("failovers")
-                self._event("failover", replica=h.index,
+                self._event("failover", _trace=w.trace, replica=h.index,
                             error="replica_quarantined")
+                if w.trace is not None:
+                    w.trace.add("failover", replica=h.index,
+                                error="replica_quarantined")
                 self._place(w, set(w.tried))
             elif not w.active:
                 self._resolve(w, exc=AllReplicasUnavailable(
@@ -745,7 +811,10 @@ class ServiceRouter:
             if landed:
                 w.hedged = True
                 self.metrics.incr("hedged_dispatches")
-                self._event("hedge", tried=sorted(w.tried))
+                self._event("hedge", _trace=w.trace,
+                            tried=sorted(w.tried))
+                if w.trace is not None:
+                    w.trace.add("hedge", tried=sorted(w.tried))
 
     def _maybe_restart(self, h: _Replica) -> None:
         sp = self.supervisor
@@ -783,7 +852,7 @@ class ServiceRouter:
             h.service.close(drain=graceful, timeout=2.0)
         except Exception:
             pass
-        svc = self._new_service(h.env)
+        svc = self._new_service(h.env, index=h.index)
         with self._lock:
             specs = list(self._warm_specs)
         try:
@@ -896,6 +965,7 @@ class ServiceRouter:
                        "parked": parked,
                        "outstanding": outstanding},
             "replicas": per,
+            "telemetry": self.tracer.stats(),
         }
         if self.warm_cache is not None:
             out["warm_cache"] = self.warm_cache.stats()
@@ -916,6 +986,7 @@ class ServiceRouter:
             parked = list(self._parked)
             self._parked.clear()
         self._stop.set()
+        metrics_registry().unregister(self._registry_token)
         if threading.current_thread() is not self._supervisor:
             self._supervisor.join(timeout)
         for w in parked:

@@ -1,0 +1,51 @@
+"""quest_tpu.telemetry — unified tracing, metrics, and event schema.
+
+The serving stack (PRs 4-8) grew its observability piecemeal: per-service
+counter registries, two separate bounded event rings with *relative
+monotonic* timestamps, ``dispatch_stats()`` dictionaries, and standalone
+``tools/*_trace.py`` dumpers. This package is the one subsystem they all
+plug into — zero external dependencies, cheap enough to leave on:
+
+- :mod:`~quest_tpu.telemetry.tracing` — request-scoped spans: a
+  :class:`TraceContext` is created at ``submit`` (service or router),
+  rides the request through queueing, coalescing, dispatch, retries,
+  failovers, quarantine bisection, and precision-tier escalations, and
+  closes at future resolution. Traces export as self-contained JSON and
+  as Perfetto-compatible Chrome trace events, and every engine dispatch
+  is wrapped in a ``jax.profiler`` annotation so device profiles line up
+  with the host spans. ``trace_sample_rate`` bounds per-request cost.
+- :mod:`~quest_tpu.telemetry.metrics` — typed :class:`Counter` /
+  :class:`Gauge` / :class:`Histogram` primitives (fixed-bucket latency
+  histograms replace the raw latency reservoirs) and a process-global
+  :class:`MetricsRegistry` that services, routers, and their engine
+  ``DispatchStats`` register snapshot providers into.
+- :mod:`~quest_tpu.telemetry.events` — the single versioned event
+  record shape (wall-clock epoch + monotonic offset + optional trace
+  id) shared by service, router, resilience, and supervisor timelines.
+- :mod:`~quest_tpu.telemetry.export` — Prometheus-text and JSON
+  exporters over the registry: one-shot snapshots, file snapshots, and
+  an opt-in local HTTP endpoint (``/metrics``, ``/metrics.json``).
+
+See docs/tpu.md ("Observability & tracing") for the span model and the
+measured overhead budget.
+"""
+
+from .events import EVENT_SCHEMA, make_event, read_timeline
+from .metrics import (Counter, Gauge, Histogram, LATENCY_BUCKETS_S,
+                      MetricsRegistry, metrics_registry)
+from .export import (METRICS_SCHEMA, MetricsServer, json_snapshot,
+                     prometheus_text, start_http_exporter,
+                     validate_prometheus_text, write_snapshot)
+from .tracing import (TRACE_SCHEMA, Span, TraceContext, Tracer,
+                      dispatch_annotation)
+
+__all__ = [
+    "TRACE_SCHEMA", "Span", "TraceContext", "Tracer",
+    "dispatch_annotation",
+    "Counter", "Gauge", "Histogram", "LATENCY_BUCKETS_S",
+    "MetricsRegistry", "metrics_registry",
+    "METRICS_SCHEMA", "MetricsServer", "json_snapshot",
+    "prometheus_text", "start_http_exporter",
+    "validate_prometheus_text", "write_snapshot",
+    "EVENT_SCHEMA", "make_event", "read_timeline",
+]

@@ -25,6 +25,10 @@ def test_cli_end_to_end_accounts_for_everything():
     assert proc.returncode == 0, proc.stderr[-2000:]
     doc = json.loads(proc.stdout)
 
+    # shared versioned dump header (tools/_trace_io.py, ISSUE 9)
+    assert doc["schema"] == "quest_tpu.trace/1"
+    assert doc["kind"] == "chaos"
+
     # every request is accounted for: completed or typed failure
     out = doc["outcomes"]
     assert out["unaccounted"] == 0

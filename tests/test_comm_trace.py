@@ -83,6 +83,9 @@ def test_cli_end_to_end():
         capture_output=True, text=True, timeout=120, env=env)
     assert proc.returncode == 0, proc.stderr[-1500:]
     doc = json.loads(proc.stdout)
+    # shared versioned dump header (tools/_trace_io.py, ISSUE 9)
+    assert doc["schema"] == "quest_tpu.trace/1"
+    assert doc["kind"] == "comm"
     assert doc["num_qubits"] == 10
     assert doc["num_hosts"] == 2
     assert doc["events"], "no collectives traced"

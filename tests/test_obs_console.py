@@ -1,0 +1,122 @@
+"""Fast-tier smoke tests for the engine console (tools/obs_console.py):
+render a live stub service (no mesh), render a router-shaped dump, and
+the no-JAX ``--stats-file`` CLI path with the shared schema header."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _load_obs_console():
+    spec = importlib.util.spec_from_file_location(
+        "obs_console", os.path.join(ROOT, "tools", "obs_console.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def obs():
+    return _load_obs_console()
+
+
+def test_render_live_stub_service(obs, env):
+    """One real (tiny, single-device) service through the renderer:
+    every console section the ISSUE names shows up."""
+    import quest_tpu as qt
+    from quest_tpu.serve import SimulationService
+    c = qt.Circuit(2)
+    c.ry(0, c.parameter("a"))
+    c.cnot(0, 1)
+    cc = c.compile(env, pallas="off")
+    svc = SimulationService(env, max_batch=4, max_wait_s=1e-3,
+                            trace_sample_rate=1.0, record_events=32)
+    try:
+        futs = [svc.submit(cc, {"a": 0.2 * i},
+                           observables=([[(0, 3)]], [1.0]))
+                for i in range(4)]
+        for f in futs:
+            f.result(timeout=60)
+        svc._event("unit_probe", detail=1)
+        frame = obs.render(svc.dispatch_stats(), svc.timeline(),
+                           title="stub")
+    finally:
+        svc.close()
+    for section in ("SERVICE", "TIERS", "RESILIENCE", "TRACING",
+                    "EVENTS"):
+        assert section in frame, frame
+    assert "queue=" in frame and "p99=" in frame
+    assert "completed=4" in frame
+    assert "sampled=4" in frame
+    assert "unit_probe" in frame
+
+
+def test_render_router_shape(obs):
+    """Router-shaped stats render the replica table + per-replica
+    service blocks (pure formatting — a canned dump, no JAX)."""
+    stats = {
+        "router": {"replicas": 2, "routed": 7, "failovers": 1,
+                   "hedged_dispatches": 0, "parked": 0,
+                   "outstanding": 0, "failed_unroutable": 0,
+                   "p99_latency_s": 0.12},
+        "replicas": [
+            {"replica": 0, "state": "ready", "alive": True,
+             "devices": 4, "queue_depth": 1, "inflight": 2,
+             "restarts": 0, "ema_request_s": 0.004,
+             "quarantine_reason": "",
+             "service": {"queue_depth": 1, "batch_occupancy": 3.5,
+                         "p99_latency_s": 0.1, "completed": 5,
+                         "fast_tier_dispatches": 2}},
+            {"replica": 1, "state": "quarantined", "alive": False,
+             "devices": 4, "queue_depth": 0, "inflight": 0,
+             "restarts": 1, "ema_request_s": 0.0,
+             "quarantine_reason": "heartbeat stall (0.52s)",
+             "service": {"completed": 2}},
+        ],
+        "telemetry": {"sample_rate": 1.0, "requests_seen": 7,
+                      "traces_sampled": 7, "traces_finished": 7,
+                      "traces_retained": 7},
+    }
+    frame = obs.render(stats, [], title="router")
+    assert "ROUTER" in frame and "REPLICAS" in frame
+    assert "quarantined" in frame and "heartbeat stall" in frame
+    assert "failovers=1" in frame
+    assert "REPLICA 0 SERVICE" in frame
+    assert "EVENTS (none recorded)" in frame
+
+
+def test_cli_stats_file_no_jax(tmp_path):
+    """The --stats-file path renders without importing JAX (< 2 s), and
+    --json emits the shared quest_tpu.trace/1 header."""
+    stats = {"service": {"queue_depth": 0, "batch_occupancy": 2.0,
+                         "completed": 3, "p99_latency_s": 0.01},
+             "resilience": {"breaker": {"trips": 0, "programs": {}}}}
+    sf = tmp_path / "stats.json"
+    sf.write_text(json.dumps(stats))
+    ef = tmp_path / "events.json"
+    ef.write_text(json.dumps(
+        [{"t": 0.1, "wall": 1700000000.0, "event": "retry",
+          "attempt": 1}]))
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_console.py"),
+         "--stats-file", str(sf), "--events-file", str(ef)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "SERVICE" in out.stdout and "retry" in out.stdout
+
+    jpath = tmp_path / "snap.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_console.py"),
+         "--stats-file", str(sf), "--json", "--out", str(jpath)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(jpath.read_text())
+    assert doc["schema"] == "quest_tpu.trace/1"
+    assert doc["kind"] == "console"
+    assert doc["stats"]["service"]["completed"] == 3

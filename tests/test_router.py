@@ -641,3 +641,91 @@ class TestReplicaChaosStorm:
         events = [e["event"] for e in router.events]
         assert "injected_replica_crash" in events
         assert "injected_replica_stall" in events
+
+
+class TestRouterStatsCoherence:
+    def test_router_dispatch_stats_coherent_under_live_trace(self, rng):
+        """Satellite (ISSUE 9): concurrent ``router.dispatch_stats()``
+        snapshot coherence at the ROUTER level — per-replica aggregation
+        read continuously while a live trace runs, mirroring the
+        engine-level torn-read test. Readers must never see a torn or
+        impossible snapshot: fixed replica set, derived ratios in
+        range, and per-replica counters monotone non-decreasing."""
+        n = 4
+        c = _hea(n, ring=False)
+        ham = _z_ham(n)
+        pm = rng.uniform(0, 2 * np.pi, size=(48, len(c.param_names)))
+        envs = replica_envs(2, devices_per_replica=1, seed=[31])
+        # stall timeout ABOVE any first-dispatch compile: a supervisor
+        # restart mid-trace legitimately zeroes a replica's counters,
+        # which is not the torn-read this test hunts (warm() below
+        # removes the compiles from the traced window too)
+        router = ServiceRouter(envs, warm_cache=False, max_batch=8,
+                               max_wait_s=1e-3,
+                               supervisor=_fast_supervisor(
+                                   stall_timeout_s=30.0),
+                               trace_sample_rate=1.0)
+        router.warm(c, batch_sizes=[8], observables=ham)
+        bad = []
+        stop = threading.Event()
+
+        def reader():
+            last = {}            # replica index -> (restarts, counters)
+            while not stop.is_set():
+                try:
+                    stats = router.dispatch_stats()
+                except Exception as e:   # a torn read raising IS the bug
+                    bad.append(("raised", type(e).__name__, str(e)))
+                    return
+                reps = stats["replicas"]
+                if len(reps) != 2:
+                    bad.append(("replica_count", len(reps)))
+                    continue
+                for rep in reps:
+                    svc = rep["service"]
+                    for ratio in ("coalesce_ratio", "padded_fraction"):
+                        if not 0.0 <= svc[ratio] <= 1.0:
+                            bad.append((ratio, svc[ratio]))
+                    if svc["max_batch_occupancy"] > 8:
+                        bad.append(("occupancy", svc[
+                            "max_batch_occupancy"]))
+                    if svc["shared_batch_requests"] > svc[
+                            "coalesced_requests"]:
+                        bad.append(("shared>coalesced", svc))
+                    prev_restarts, prev = last.get(
+                        rep["replica"], (rep["restarts"], {}))
+                    if rep["restarts"] == prev_restarts:
+                        for key in ("batches", "completed",
+                                    "coalesced_requests"):
+                            if svc[key] < prev.get(key, 0):
+                                bad.append(("regressed",
+                                            rep["replica"], key,
+                                            prev.get(key), svc[key]))
+                    last[rep["replica"]] = (rep["restarts"], svc)
+                tel = stats["telemetry"]
+                if tel["traces_sampled"] > tel["requests_seen"]:
+                    bad.append(("tracer", tel))
+
+        threads = [threading.Thread(target=reader) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            futs = [router.submit(c, dict(zip(c.param_names, row)),
+                                  observables=ham) for row in pm]
+            got = np.asarray([f.result(timeout=120) for f in futs])
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            final = router.dispatch_stats()
+            router.close()
+        assert not bad, bad[:5]
+        # the aggregation adds up after the trace drains (no replica
+        # restarted, so no counters were lost): every request was
+        # routed once and completed on exactly one replica
+        assert final["router"]["replica_restarts"] == 0
+        assert final["router"]["routed"] == len(pm)
+        assert sum(rep["service"]["completed"]
+                   for rep in final["replicas"]) == len(pm)
+        want = _oracle_energies(c, pm, ham)
+        assert np.max(np.abs(got - want)) <= 1e-12

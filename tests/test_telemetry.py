@@ -1,0 +1,417 @@
+"""Unified telemetry (ISSUE 9): request-scoped tracing, typed metrics,
+the process-global registry + exporters, and the unified event schema.
+
+The acceptance test is the router trace: ONE request through a
+2-replica ServiceRouter with one injected transient fault must produce
+ONE trace whose spans cover submit -> queue -> coalesce -> dispatch ->
+retry/failover -> resolve, all sharing the trace id, exported to both
+the self-contained JSON document and Chrome trace events.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.serve import ServiceRouter, SimulationService, replica_envs
+from quest_tpu.resilience import FaultInjector, FaultSpec, inject
+from quest_tpu.telemetry import (Counter, Gauge, Histogram,
+                                 MetricsRegistry, Tracer, json_snapshot,
+                                 metrics_registry, prometheus_text,
+                                 start_http_exporter,
+                                 validate_prometheus_text,
+                                 write_snapshot)
+from quest_tpu.telemetry import events as tel_events
+from quest_tpu.telemetry.tracing import TRACE_SCHEMA
+
+
+def _tiny_circuit():
+    c = qt.Circuit(2)
+    c.ry(0, c.parameter("a"))
+    c.cnot(0, 1)
+    return c
+
+
+HAM = ([[(0, 3)], [(1, 3)]], [1.0, 0.5])
+
+
+def _wait_finished(tracer, n, timeout=5.0):
+    """Traces finish on the resolving thread a hair after the future
+    resolves; poll instead of sleeping blind."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        done = tracer.finished()
+        if len(done) >= n:
+            return done
+        time.sleep(0.005)
+    return tracer.finished()
+
+
+class TestMetricPrimitives:
+    def test_counter_monotone(self):
+        c = Counter("x")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_callback_and_set(self):
+        g = Gauge("depth", fn=lambda: 7)
+        assert g.value == 7.0
+        g2 = Gauge("manual")
+        g2.set(2.5)
+        assert g2.value == 2.5
+        bad = Gauge("broken", fn=lambda: 1 / 0)
+        assert bad.value == 0.0       # exporter must never raise
+
+    def test_histogram_percentiles_and_snapshot(self):
+        h = Histogram("lat", buckets=(0.001, 0.01, 0.1, 1.0))
+        for v in (0.0005, 0.002, 0.003, 0.5):
+            h.observe(v)
+        assert h.count == 4
+        assert abs(h.sum - 0.5055) < 1e-12
+        p50 = h.percentile(50.0)
+        assert 0.001 <= p50 <= 0.01      # rank-2 sample sits in bucket 2
+        # p99 interpolates inside the top occupied bucket, clamped to
+        # the observed max — it must never exceed it
+        assert h.percentile(99.0) <= 0.5 + 1e-12
+        assert h.percentile(99.0) > 0.1
+        snap = h.snapshot()
+        assert snap["count"] == 4 and snap["max"] == 0.5
+        assert snap["buckets"]["1"] == 4          # cumulative
+        assert snap["buckets"]["0.01"] == 3
+        # one sample still answers a positive percentile
+        h1 = Histogram("one", buckets=(0.001, 0.01))
+        h1.observe(0.004)
+        assert 0.0 < h1.percentile(50.0) <= 0.004
+
+    def test_histogram_rejects_unsorted_buckets(self):
+        with pytest.raises(ValueError):
+            Histogram("bad", buckets=(0.1, 0.01))
+
+    def test_sampling_stride_is_deterministic(self):
+        tr = Tracer(sample_rate=0.25)
+        hits = [i for i in range(40) if tr.start() is not None]
+        assert len(hits) == 10            # exactly rate * N
+        tr2 = Tracer(sample_rate=0.25)
+        assert hits == [i for i in range(40)
+                        if tr2.start() is not None]
+        assert Tracer(sample_rate=0.0).start() is None
+        with pytest.raises(ValueError):
+            Tracer(sample_rate=1.5)
+
+    def test_registry_prunes_dead_owner(self):
+        reg = MetricsRegistry()
+
+        class Src:
+            def snap(self):
+                return {"v": 1}
+
+        s = Src()
+        reg.register("s1", s.snap)
+        assert [x["name"] for x in reg.collect()] == ["s1"]
+        del s
+        import gc
+        gc.collect()
+        assert reg.collect() == []
+        assert "s1" not in reg.names()
+
+
+class TestEventSchema:
+    def test_make_event_carries_both_clocks_and_trace(self):
+        t0 = time.monotonic()
+        ev = tel_events.make_event("retry", t0, trace_id="abc",
+                                   attempt=2)
+        assert ev["event"] == "retry" and ev["attempt"] == 2
+        assert ev["trace"] == "abc"
+        assert abs(ev["wall"] - time.time()) < 5.0
+        assert 0.0 <= ev["t"] < 5.0
+
+    def test_service_events_carry_wall_clock(self, env):
+        svc = SimulationService(env, record_events=16)
+        try:
+            svc._event("unit_test_event", detail=1)
+            ev = svc.timeline()[-1]
+            assert ev["event"] == "unit_test_event"
+            assert "t" in ev               # compat field kept
+            assert abs(ev["wall"] - time.time()) < 5.0
+        finally:
+            svc.close()
+
+    def test_record_events_zero_warns_once(self, env, monkeypatch):
+        monkeypatch.setattr(tel_events, "_warned_eventless", False)
+        svc = SimulationService(env, record_events=0)
+        try:
+            with pytest.warns(RuntimeWarning, match="record_events=0"):
+                assert svc.timeline() == []
+            # once per process: the second read stays quiet
+            import warnings as _w
+            with _w.catch_warnings():
+                _w.simplefilter("error")
+                assert svc.timeline() == []
+        finally:
+            svc.close()
+
+
+class TestServiceTracing:
+    def test_service_trace_spans_and_exports(self, env):
+        cc = _tiny_circuit().compile(env, pallas="off")
+        svc = SimulationService(env, trace_sample_rate=1.0,
+                                max_wait_s=1e-3)
+        try:
+            fut = svc.submit(cc, {"a": 0.3}, observables=HAM)
+            fut.result(timeout=60)
+            traces = _wait_finished(svc.tracer, 1)
+            assert len(traces) == 1
+            t = traces[0]
+            names = t.span_names()
+            for required in ("submit", "queue", "coalesce", "dispatch",
+                            "resolve"):
+                assert required in names, names
+            assert t.status == "ok"
+            doc = t.to_dict()
+            json.loads(json.dumps(doc))            # self-contained JSON
+            assert doc["schema"] == TRACE_SCHEMA
+            assert all(sp["trace_id"] == t.trace_id
+                       for sp in doc["spans"])
+            # the dispatch span carries the batch attribution
+            disp = [sp for sp in doc["spans"] if sp["name"] == "dispatch"]
+            assert disp and disp[0]["attrs"]["bucket"] >= 1
+            assert disp[0]["duration_s"] > 0.0
+        finally:
+            svc.close()
+
+    def test_service_sampling_rate_half(self, env):
+        cc = _tiny_circuit().compile(env, pallas="off")
+        svc = SimulationService(env, trace_sample_rate=0.5,
+                                max_wait_s=1e-4)
+        try:
+            futs = [svc.submit(cc, {"a": 0.1 * i}, observables=HAM)
+                    for i in range(8)]
+            for f in futs:
+                f.result(timeout=60)
+            traces = _wait_finished(svc.tracer, 4)
+            assert len(traces) == 4
+            stats = svc.tracer.stats()
+            assert stats["requests_seen"] == 8
+            assert stats["traces_sampled"] == 4
+        finally:
+            svc.close()
+
+    def test_rejected_submission_finishes_its_trace(self, env):
+        """A QueueFull/ServiceClosed rejection resolves no future, so
+        the service must close the trace itself — a rejected request
+        must not leak an unfinished trace (or silently eat a sampling
+        slot)."""
+        cc = _tiny_circuit().compile(env, pallas="off")
+        svc = SimulationService(env, max_queue=1,
+                                trace_sample_rate=1.0)
+        try:
+            svc.pause()
+            svc.submit(cc, {"a": 0.1}, observables=HAM)
+            from quest_tpu.serve import QueueFull
+            with pytest.raises(QueueFull):
+                svc.submit(cc, {"a": 0.2}, observables=HAM)
+            traces = _wait_finished(svc.tracer, 1)
+            assert len(traces) == 1
+            assert traces[0].status == "QueueFull"
+            assert traces[0].span_names()[-1] == "resolve"
+            svc.resume()
+        finally:
+            svc.close()
+        # after drain-on-close both traces are finished
+        assert len(_wait_finished(svc.tracer, 2)) == 2
+
+    def test_torn_batch_counters_never_observed(self):
+        """Regression: record_batch + snapshot must be mutually atomic
+        — per-counter locks let a reader see shared_batch_requests from
+        after a batch and coalesced_requests from before it."""
+        from quest_tpu.serve.metrics import ServiceMetrics
+        m = ServiceMetrics()
+        stop = threading.Event()
+        bad = []
+
+        def reader():
+            while not stop.is_set():
+                s = m.snapshot()
+                if s["shared_batch_requests"] > s["coalesced_requests"]:
+                    bad.append(s)
+                    return
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            for _ in range(20000):
+                m.record_batch(8, 8)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert not bad, bad[:1]
+
+    def test_untraced_requests_cost_no_trace(self, env):
+        cc = _tiny_circuit().compile(env, pallas="off")
+        svc = SimulationService(env)       # default: tracing off
+        try:
+            svc.submit(cc, {"a": 0.2}, observables=HAM).result(timeout=60)
+            assert svc.tracer.finished() == []
+            assert svc.dispatch_stats()["telemetry"][
+                "traces_sampled"] == 0
+        finally:
+            svc.close()
+
+
+class TestRouterTraceAcceptance:
+    def test_router_trace_with_transient_fault(self):
+        """ISSUE 9 acceptance: one request, 2 replicas, one injected
+        transient fault -> ONE trace holding submit/queue/coalesce/
+        dispatch/(retry|failover)/resolve spans sharing the trace id,
+        exported to JSON and Chrome-trace formats."""
+        envs = replica_envs(2, devices_per_replica=1, seed=[5])
+        c = _tiny_circuit()
+        inj = FaultInjector([FaultSpec(kind="transient",
+                                       site="serve.execute",
+                                       at_calls=(0,))], seed=3)
+        router = ServiceRouter(envs, warm_cache=False, max_retries=2,
+                               trace_sample_rate=1.0,
+                               record_events=128)
+        try:
+            with inject(inj):
+                fut = router.submit(c, {"a": 0.4}, observables=HAM)
+                got = fut.result(timeout=120)
+            # the fault was injected AND recovered — and the answer is
+            # still the oracle answer
+            assert inj.snapshot()["injected_by_kind"]["transient"] == 1
+            q = qt.createQureg(2, envs[0])
+            qt.initZeroState(q)
+            cc = c.compile(envs[0])
+            cc.run(q, {"a": 0.4})
+            want = qt.calcExpecPauliSum(q, [3, 0, 0, 3], [1.0, 0.5])
+            assert abs(got - want) <= 1e-10
+            traces = _wait_finished(router.tracer, 1)
+            assert len(traces) == 1
+            t = traces[0]
+            names = t.span_names()
+            for required in ("submit", "queue", "coalesce", "dispatch",
+                            "resolve"):
+                assert required in names, names
+            assert "retry" in names or "failover" in names, names
+            assert t.status == "ok"
+            # exactly one trace id across every span, in BOTH exports
+            doc = t.to_dict()
+            json.loads(json.dumps(doc))
+            assert doc["schema"] == TRACE_SCHEMA
+            assert {sp["trace_id"] for sp in doc["spans"]} \
+                == {t.trace_id}
+            # the faulted dispatch is visible: one dispatch span closed
+            # with the fault class, a later one closed ok
+            disp_status = [sp["status"] for sp in doc["spans"]
+                           if sp["name"] == "dispatch"]
+            assert len(disp_status) >= 2
+            assert disp_status[-1] == "ok"
+            assert any(s != "ok" for s in disp_status[:-1])
+            chrome = t.chrome_trace()
+            json.loads(json.dumps(chrome))
+            evs = chrome["traceEvents"]
+            assert len(evs) == len(doc["spans"])
+            assert all(ev["ph"] in ("X", "i") and "ts" in ev
+                       and ev["args"]["trace_id"] == t.trace_id
+                       for ev in evs)
+            assert any(ev["ph"] == "X" and ev["dur"] > 0 for ev in evs)
+            # tracer-level export bundles the same spans
+            bundle = router.tracer.export_json()
+            assert bundle["schema"] == TRACE_SCHEMA
+            assert len(bundle["traces"]) == 1
+            assert router.tracer.export_chrome()["traceEvents"]
+        finally:
+            router.close()
+
+
+class TestExporters:
+    def test_prometheus_export_parses_and_names_service(self, env):
+        cc = _tiny_circuit().compile(env, pallas="off")
+        svc = SimulationService(env, name="prom-test-svc")
+        try:
+            svc.submit(cc, {"a": 0.7}, observables=HAM).result(timeout=60)
+            txt = prometheus_text()
+            assert validate_prometheus_text(txt) == []
+            assert '# TYPE quest_tpu_service_completed gauge' in txt
+            assert ('quest_tpu_service_completed{source="prom-test-svc"}'
+                    ' 1') in txt
+            # histograms surfaced as derived percentiles (numeric leaves)
+            assert "quest_tpu_service_p99_latency_s" in txt
+        finally:
+            svc.close()
+        # a closed service unregisters: the next scrape drops it
+        assert 'source="prom-test-svc"' not in prometheus_text()
+
+    def test_prometheus_renders_special_floats(self):
+        """inf/-inf/nan leaves must render as the exposition format's
+        +Inf/-Inf/NaN, not Python's lowercase repr."""
+        reg = MetricsRegistry()
+
+        class Src:
+            def snap(self):
+                return {"hot": float("inf"), "cold": float("-inf"),
+                        "broken": float("nan"), "fine": 1.5}
+
+        s = Src()
+        reg.register("specials", s.snap)
+        txt = prometheus_text(reg)
+        assert validate_prometheus_text(txt) == []
+        assert 'quest_tpu_hot{source="specials"} +Inf' in txt
+        assert 'quest_tpu_cold{source="specials"} -Inf' in txt
+        assert 'quest_tpu_broken{source="specials"} NaN' in txt
+        assert 'quest_tpu_fine{source="specials"} 1.5' in txt
+
+    def test_json_snapshot_and_file_formats(self, env, tmp_path):
+        svc = SimulationService(env, name="snap-test-svc")
+        try:
+            doc = json_snapshot()
+            assert doc["schema"] == "quest_tpu.metrics/1"
+            assert any(s["name"] == "snap-test-svc"
+                       for s in doc["sources"])
+            p1 = write_snapshot(str(tmp_path / "m.json"), "json")
+            assert json.load(open(p1))["schema"] == "quest_tpu.metrics/1"
+            p2 = write_snapshot(str(tmp_path / "m.prom"), "prom")
+            assert validate_prometheus_text(open(p2).read()) == []
+            with pytest.raises(ValueError):
+                write_snapshot(str(tmp_path / "m.x"), "yaml")
+        finally:
+            svc.close()
+
+    def test_http_exporter_round_trip(self, env):
+        svc = SimulationService(env, name="http-test-svc")
+        server = start_http_exporter(port=0)
+        try:
+            raw = urllib.request.urlopen(server.url, timeout=10).read()
+            txt = raw.decode()
+            assert validate_prometheus_text(txt) == []
+            assert 'source="http-test-svc"' in txt
+            jraw = urllib.request.urlopen(server.url + ".json",
+                                          timeout=10).read()
+            jdoc = json.loads(jraw)
+            assert jdoc["schema"] == "quest_tpu.metrics/1"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    server.url.rsplit("/", 1)[0] + "/nope", timeout=10)
+        finally:
+            server.close()
+            svc.close()
+
+    def test_router_registers_replicas_and_router(self):
+        envs = replica_envs(2, devices_per_replica=1, seed=[9])
+        router = ServiceRouter(envs, warm_cache=False,
+                               name="reg-test-router")
+        try:
+            names = metrics_registry().names()
+            assert "reg-test-router" in names
+            assert sum(1 for n in names
+                       if n.startswith("reg-test-router-replica")) == 2
+        finally:
+            router.close()
+        assert "reg-test-router" not in metrics_registry().names()

@@ -1,0 +1,95 @@
+"""The shared trace-dump header (tools/_trace_io.py): every
+``tools/*_trace.py`` dumper emits ``{"schema": "quest_tpu.trace/1",
+"kind": ..., "generated_wall": ...}`` and supports the common ``--out``
+flag."""
+
+import glob
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _load_trace_io():
+    spec = importlib.util.spec_from_file_location(
+        "_trace_io", os.path.join(ROOT, "tools", "_trace_io.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_wrap_prepends_versioned_header():
+    tio = _load_trace_io()
+    doc = tio.wrap({"events": [1, 2], "schema": "spoofed"}, kind="unit")
+    keys = list(doc)
+    assert keys[:3] == ["schema", "kind", "generated_wall"]
+    assert doc["schema"] == tio.TRACE_SCHEMA == "quest_tpu.trace/1"
+    assert doc["kind"] == "unit"          # the header wins over payload
+    assert doc["events"] == [1, 2]
+    assert doc["generated_wall"] > 1.7e9
+
+
+def test_emit_writes_out_file(tmp_path, capsys):
+    tio = _load_trace_io()
+    path = tmp_path / "dump.json"
+    wrapped = tio.emit({"x": 1}, kind="unit", out=str(path))
+    assert capsys.readouterr().out == ""      # --out means no stdout
+    on_disk = json.loads(path.read_text())
+    assert on_disk == wrapped
+    assert on_disk["schema"] == "quest_tpu.trace/1"
+    tio.emit({"x": 2}, kind="unit")
+    assert json.loads(capsys.readouterr().out)["x"] == 2
+
+
+def test_every_trace_tool_is_wired_to_the_shared_header():
+    """Source-level completeness check: every tools/*_trace.py must
+    route its dump through _trace_io.emit (the CLI tests then verify
+    the emitted header end-to-end per tool)."""
+    tools = sorted(glob.glob(os.path.join(ROOT, "tools", "*_trace.py")))
+    assert len(tools) >= 4                # comm/serve/chaos/precision
+    for path in tools:
+        src = open(path).read()
+        assert "import _trace_io" in src, os.path.basename(path)
+        assert "_trace_io.emit(" in src, os.path.basename(path)
+        assert "_trace_io.add_output_argument(" in src, \
+            os.path.basename(path)
+
+
+def test_serve_trace_cli_emits_header_and_out_flag(tmp_path):
+    """The cheapest real CLI round-trip (serve_trace imports no JAX):
+    one run with --out pins the header, the flag, and clean stdout
+    (the stdout emission path is unit-tested above and asserted
+    end-to-end by the chaos/comm CLI tests)."""
+    tool = os.path.join(ROOT, "tools", "serve_trace.py")
+    path = tmp_path / "serve.json"
+    out = subprocess.run(
+        [sys.executable, tool, "--requests", "32", "--no-events",
+         "--out", str(path)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == ""
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == "quest_tpu.trace/1"
+    assert doc["kind"] == "serve"
+    assert doc["totals"]["requests"] == 32
+
+
+def test_precision_trace_cli_emits_header(tmp_path):
+    """precision_trace is host-side-only (no device work): one cheap
+    CLI pass pins its header + --out (comm/chaos CLIs are covered by
+    their own end-to-end tests)."""
+    tool = os.path.join(ROOT, "tools", "precision_trace.py")
+    path = tmp_path / "prec.json"
+    out = subprocess.run(
+        [sys.executable, tool, "--qubits", "4", "--budget", "1e-2",
+         "--out", str(path)],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == "quest_tpu.trace/1"
+    assert doc["kind"] == "precision"
+    assert doc["chosen_tier"] is not None

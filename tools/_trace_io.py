@@ -1,0 +1,58 @@
+"""Shared output helper for the ``tools/*_trace.py`` dumpers.
+
+Every trace tool (comm, serve, chaos, precision, obs console ``--json``)
+emits ONE JSON document. Before this helper each tool invented its own
+top-level shape, so downstream consumers (dashboards, the obs console,
+regression diffs) had no way to tell which tool — or which VERSION of
+which tool — produced a file. Now every dump starts with the same
+versioned header:
+
+``{"schema": "quest_tpu.trace/1", "kind": "<tool>",
+"generated_wall": <epoch seconds>, ...tool payload...}``
+
+and every tool grows the same ``--out FILE`` flag (default: stdout),
+via :func:`add_output_argument` + :func:`emit`. Bump the schema suffix
+when a BREAKING payload change ships; additive keys don't bump it.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+TRACE_SCHEMA = "quest_tpu.trace/1"
+
+__all__ = ["TRACE_SCHEMA", "add_output_argument", "wrap", "emit"]
+
+
+def add_output_argument(parser) -> None:
+    """The shared ``--out`` flag (written atomically enough for a tool:
+    one open/write/close; default stdout)."""
+    parser.add_argument("--out", default=None, metavar="FILE",
+                        help="write the JSON dump to FILE instead of "
+                             "stdout")
+
+
+def wrap(doc: dict, kind: str) -> dict:
+    """The versioned header, prepended (header keys win on collision so
+    a payload can never masquerade as a different schema/kind)."""
+    payload = {k: v for k, v in doc.items()
+               if k not in ("schema", "kind", "generated_wall")}
+    return {"schema": TRACE_SCHEMA, "kind": kind,
+            "generated_wall": round(time.time(), 6), **payload}
+
+
+def emit(doc: dict, kind: str, out=None, indent: int = 2) -> dict:
+    """Wrap ``doc`` with the schema header and write it to ``out``
+    (a path from the ``--out`` flag) or stdout. Returns the wrapped
+    document."""
+    wrapped = wrap(doc, kind)
+    if out:
+        with open(out, "w") as fh:
+            json.dump(wrapped, fh, indent=indent, default=str)
+            fh.write("\n")
+    else:
+        json.dump(wrapped, sys.stdout, indent=indent, default=str)
+        print()
+    return wrapped

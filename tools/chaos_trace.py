@@ -45,7 +45,6 @@ JAX picks).
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 
@@ -134,7 +133,9 @@ def build_trace(args) -> dict:
             except Exception as e:  # typed failure — record its class
                 outcomes.append((type(e).__name__, None))
         stats = svc.dispatch_stats()
-        timeline = list(svc.events)
+        # timeline() warns once when the service was built with
+        # record_events=0 — this tool's whole output is that ring
+        timeline = svc.timeline()
     svc.close()
 
     by_error: dict = {}
@@ -233,6 +234,9 @@ def main(argv=None) -> int:
                         "sequential fault-free loop")
     p.add_argument("--backend", default="cpu",
                    help="'cpu' (default, deterministic) or 'default'")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _trace_io
+    _trace_io.add_output_argument(p)
     args = p.parse_args(argv)
 
     repo_root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -245,8 +249,7 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     doc = build_trace(args)
-    json.dump(doc, sys.stdout, indent=2, default=str)
-    print()
+    _trace_io.emit(doc, kind="chaos", out=args.out)
     return 0
 
 

@@ -27,7 +27,6 @@ baseline) is graded on.
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 
 
@@ -138,11 +137,14 @@ def main(argv=None) -> int:
     ap.add_argument("--lookahead", type=int, default=32)
     ap.add_argument("--fusion", type=int, default=None,
                     help="gate-fusion cap k (default: compile default)")
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _trace_io
+    _trace_io.add_output_argument(ap)
     args = ap.parse_args(argv)
 
     # virtual mesh before the first JAX import, so the tool runs on any
     # host (planning is host-side; no kernels execute)
-    import os
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -178,8 +180,7 @@ def main(argv=None) -> int:
                       comm_planner=(args.planner == "on"),
                       reorder=(args.reorder == "on"),
                       lookahead=args.lookahead, **kw)
-    json.dump(trace_schedule(cc), sys.stdout, indent=2)
-    print()
+    _trace_io.emit(trace_schedule(cc), kind="comm", out=args.out)
     return 0
 
 

@@ -20,7 +20,6 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 
 
@@ -101,11 +100,14 @@ def main(argv=None) -> int:
                     help="pin a tier by name instead of budget-selecting")
     ap.add_argument("--layers", type=int, default=2,
                     help="HEA layers (hea circuit only)")
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _trace_io
+    _trace_io.add_output_argument(ap)
     args = ap.parse_args(argv)
     if args.budget is None and args.tier is None:
         args.budget = 1e-2
 
-    import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("QUEST_TPU_TIER_MODEL", "default")
     repo_root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -125,9 +127,9 @@ def main(argv=None) -> int:
     else:
         from bench import build_hea_circuit
         circ, _, _ = build_hea_circuit(args.qubits, args.layers)
-    json.dump(trace_tiers(circ, env, budget=args.budget, tier=args.tier),
-              sys.stdout, indent=2)
-    print()
+    _trace_io.emit(trace_tiers(circ, env, budget=args.budget,
+                               tier=args.tier),
+                   kind="precision", out=args.out)
     return 0
 
 

@@ -26,7 +26,6 @@ groups at the same total rate.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 
@@ -112,6 +111,9 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=2026)
     ap.add_argument("--no-events", action="store_true",
                     help="totals only (compact output)")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _trace_io
+    _trace_io.add_output_argument(ap)
     args = ap.parse_args(argv)
 
     repo_root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -130,8 +132,7 @@ def main(argv=None) -> int:
     doc = trace_report(arrivals, policy, device_multiple=args.devices)
     if args.no_events:
         doc.pop("events")
-    json.dump(doc, sys.stdout, indent=2)
-    print()
+    _trace_io.emit(doc, kind="serve", out=args.out)
     return 0
 
 
