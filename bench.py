@@ -593,53 +593,231 @@ def bench_grover(qt, env, platform: str) -> dict:
         n_gates, trials, dt, num_qubits, env)
 
 
-def bench_trajectories(qt, env, platform: str) -> dict:
-    """Quantum-trajectory unraveling throughput: T noisy trajectories
-    vmapped through ONE executable. The reference's only noise path is
-    the 2^(2n) density vector; the roofline comparison is therefore the
-    density config's op rate at the same logical width — here each
-    trajectory op streams 2^n amps instead of 2^(2n)."""
-    num_qubits = int(os.environ.get(
-        "QUEST_BENCH_TRAJ_QUBITS", "16" if _is_accel(platform) else "12"))
-    n_traj = int(os.environ.get("QUEST_BENCH_TRAJ_COUNT", "32"))
+def bench_trajectories(qt, env, platform: str) -> list:
+    """Trajectory-parallel noisy execution vs the exact density path at
+    MATCHED sampling error (ISSUE 10): a depolarising+damped HEA whose
+    Pauli-sum observable is computed three ways —
+
+    1. **density path** (the reference's only noise mode): one exact
+       2^(2n)-amplitude superoperator run;
+    2. **trajectory engine-off**: a per-trajectory host loop (one
+       stochastic draw + one device->host energy sync per trajectory)
+       at the same trajectory count the engine executed;
+    3. **trajectory engine-on**: the wave-loop engine — Pauli sums
+       lowered to on-device masks, ONE executable and ONE transfer per
+       wave, convergence-based early stopping against the stated
+       sampling budget.
+
+    A fourth row runs the same noisy workload at a qubit count whose
+    density matrix CANNOT be held on the same memory budget — the
+    scale-out regime only the trajectory mode reaches. Rows carry
+    trajectories/sec, transfers avoided, early-stop accounting, a
+    fixed-seed replay check, and the max qubit count reachable per
+    mode on the per-device memory budget."""
+    import jax as _jax
     from quest_tpu.circuits import Circuit
-    rng = np.random.default_rng(2026)
-    c = Circuit(num_qubits)
-    n_ops = 0
-    for q_ in range(num_qubits):
-        c.rotate(q_, float(rng.uniform(0, 2 * np.pi)), rng.normal(size=3))
-        n_ops += 1
-    for q_ in range(0, num_qubits - 1, 2):
-        c.cnot(q_, q_ + 1)
-        n_ops += 1
-    for q_ in range(num_qubits):
-        c.dephase(q_, 0.05)
-        c.damp(q_, 0.02)
-        n_ops += 2
-    prog = c.compile_trajectories(env)
-    psi = np.zeros(1 << num_qubits, dtype=env.precision.complex_dtype)
-    psi[0] = 1.0
-    from quest_tpu.core.packing import pack
-    planes = pack(psi)
-    out = prog.run_batch(planes, n_traj)           # compile + warm-up
-    out.block_until_ready()
+    from quest_tpu.ops import reductions as red
+
+    num_qubits = int(os.environ.get(
+        "QUEST_BENCH_TRAJ_QUBITS", "14" if _is_accel(platform) else "12"))
+    n_big = int(os.environ.get(
+        "QUEST_BENCH_TRAJ_BIG_QUBITS",
+        "20" if _is_accel(platform) else "16"))
+    max_traj = int(os.environ.get("QUEST_BENCH_TRAJ_COUNT", "2048"))
+    budget = float(os.environ.get("QUEST_BENCH_TRAJ_BUDGET", "0.05"))
+    wave = int(os.environ.get("QUEST_BENCH_TRAJ_WAVE", "0")) or None
+    damping = float(os.environ.get("QUEST_BENCH_TRAJ_DAMPING", "0.01"))
     trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 3)
-    t0 = time.perf_counter()
-    for _ in range(trials):
-        out = prog.run_batch(planes, n_traj)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-    traj_ops = n_ops * n_traj * trials / dt
-    baseline = _roofline_baseline(
-        2 * num_qubits, np.dtype(env.precision.real_dtype).itemsize)
-    return {
-        "metric": f"trajectory noise unraveling, {num_qubits}-qubit "
-                  f"statevector x {n_traj} trajectories, "
-                  f"single {platform} chip",
-        "value": round(traj_ops, 2),
-        "unit": "trajectory-ops/sec",
-        "vs_baseline": round(traj_ops / baseline, 4),
+    itemsize = np.dtype(env.precision.real_dtype).itemsize
+    mem_budget = int(os.environ.get(
+        "QUEST_TPU_BATCH_MEM_BYTES",
+        str(__import__("quest_tpu.parallel.layout",
+                       fromlist=["DEFAULT_BATCH_MEM_BYTES"])
+            .DEFAULT_BATCH_MEM_BYTES)))
+    rng = np.random.default_rng(2026)
+
+    def noisy_hea(n):
+        c = Circuit(n)
+        for q_ in range(n):
+            c.ry(q_, float(rng.uniform(0, 2 * np.pi)))
+        for q_ in range(n - 1):
+            c.cnot(q_, q_ + 1)
+        return c.with_noise(p1=0.03, p2=0.05, damping=damping)
+
+    ham = ([[(0, 3)]], [1.0])              # <Z_0> under the noise model
+    label = (f"{num_qubits}-qubit depolarising HEA, <Z0>, "
+             f"single {platform} chip" if env.num_devices == 1 else
+             f"{num_qubits}-qubit depolarising HEA, <Z0>, "
+             f"{env.num_devices} {platform} devices")
+
+    def max_qubits_on_budget(bytes_per_amp_set):
+        n_ = 1
+        while bytes_per_amp_set(n_ + 1) <= mem_budget:
+            n_ += 1
+        return n_
+
+    # the per-mode reach on the SAME per-device budget: the density
+    # path holds packed 2^(2n) planes; trajectory mode holds one wave
+    # of 2^n states
+    wave_rows = 32
+    max_q_density = max_qubits_on_budget(
+        lambda n_: 2.0 * itemsize * (1 << (2 * n_)))
+    max_q_traj = max_qubits_on_budget(
+        lambda n_: wave_rows * 2.0 * itemsize * (1 << n_))
+
+    # -- 1. exact density path (compile once, best-of-trials run) ----------
+    circ = noisy_hea(num_qubits)
+    cc_d = circ.compile(env, density=True, pallas="off")
+    d = qt.createDensityQureg(num_qubits, env)
+    codes_flat = [3] + [0] * (num_qubits - 1)
+    qt.initZeroState(d)
+    cc_d.run(d)
+    exact = qt.calcExpecPauliSum(d, codes_flat, [1.0])   # warm both
+    den_dts = []
+    for _ in range(max(1, trials // 2)):
+        qt.initZeroState(d)
+        t0 = time.perf_counter()
+        cc_d.run(d)
+        exact = qt.calcExpecPauliSum(d, codes_flat, [1.0])
+        den_dts.append(time.perf_counter() - t0)
+    dt_density = min(den_dts)
+    density_row = {
+        "metric": f"trajectory bench: exact density path, {label}",
+        "value": round(1.0 / dt_density, 4),
+        "unit": "runs/sec",
+        "vs_baseline": 0.0,
+        "wall_clock_s": round(dt_density, 4),
+        "density_amps": 1 << (2 * num_qubits),
+        "observable": float(exact),
+        "sampling_error": 0.0,
+        "max_qubits_in_budget": max_q_density,
     }
+
+    # -- 2/3. trajectory mode (shared program + key) -----------------------
+    prog = circ.compile_trajectories(env)
+    key = _jax.random.PRNGKey(2026)
+    # engine-on: warm-up (compiles the wave executable), then timed
+    mean_on, err_on = prog.expectation(
+        ham[0], ham[1], num_trajectories=max_traj, key=key,
+        sampling_budget=budget, wave_size=wave)
+    info = prog.last_traj_stats
+    on_dts = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        mean_on, err_on = prog.expectation(
+            ham[0], ham[1], num_trajectories=max_traj, key=key,
+            sampling_budget=budget, wave_size=wave)
+        on_dts.append(time.perf_counter() - t0)
+    dt_on = min(on_dts)
+    info = prog.last_traj_stats
+    t_run = info["trajectories_run"]
+    # fixed-seed replay: the early-stop decision and the estimate must
+    # reproduce bit-for-bit
+    mean_replay, err_replay = prog.expectation(
+        ham[0], ham[1], num_trajectories=max_traj, key=key,
+        sampling_budget=budget, wave_size=wave)
+    deterministic = (mean_replay == mean_on and err_replay == err_on
+                     and prog.last_traj_stats["trajectories_run"]
+                     == t_run)
+
+    # engine-off: per-trajectory loop at the SAME trajectory count —
+    # one stochastic draw + one device->host energy sync per trajectory
+    T_terms, xm, ym, zm, cf = prog._pauli_operands(
+        [tuple(t) for t in ham[0]], ham[1])
+    efn = _jax.jit(lambda sf: red.pauli_sum_total_sv(
+        _jax.lax.complex(sf[0], sf[1]), _jax.numpy.asarray(xm),
+        _jax.numpy.asarray(ym), _jax.numpy.asarray(zm),
+        _jax.numpy.asarray(cf, dtype=env.precision.real_dtype)))
+    planes0 = np.zeros((2, 1 << num_qubits),
+                       dtype=env.precision.real_dtype)
+    planes0[0, 0] = 1.0
+    planes0 = _jax.numpy.asarray(planes0)
+    keys_off = _jax.random.split(key, t_run)
+    float(efn(prog.apply(planes0, keys_off[0])))     # warm the pair
+    t0 = time.perf_counter()
+    off_vals = [float(efn(prog.apply(planes0, keys_off[t])))
+                for t in range(t_run)]
+    dt_off = time.perf_counter() - t0
+    mean_off = float(np.mean(off_vals))
+
+    off_row = {
+        "metric": f"trajectory engine-off (per-trajectory loop, "
+                  f"{t_run} draws), {label}",
+        "value": round(t_run / dt_off, 2),
+        "unit": "trajectories/sec",
+        "vs_baseline": 0.0,
+        "wall_clock_s": round(dt_off, 4),
+        "host_syncs": t_run,
+        "observable": mean_off,
+    }
+    stats = prog.dispatch_stats().as_dict()
+    on_row = {
+        "metric": f"trajectory engine-on (wave loop, early stop), "
+                  f"{label}",
+        "value": round(t_run / dt_on, 2),
+        "unit": "trajectories/sec",
+        "vs_baseline": 0.0,
+        "wall_clock_s": round(dt_on, 4),
+        "sampling_budget": budget,
+        "stderr": float(err_on),
+        "observable": float(mean_on),
+        "parity_sigma": round(abs(float(mean_on) - float(exact))
+                              / max(float(err_on), 1e-12), 2),
+        "max_trajectories": max_traj,
+        "trajectories_run": t_run,
+        "early_stopped": bool(info["early_stopped"]),
+        "early_stop_deterministic": bool(deterministic),
+        "waves": info["waves"],
+        "host_syncs": info["waves"],
+        "host_syncs_avoided": stats["host_syncs_avoided"],
+        "batch_sharding_mode": stats["batch_sharding_mode"],
+        "speedup_vs_engine_off": round(dt_off / max(dt_on, 1e-9), 3),
+        "speedup_vs_density": round(dt_density / max(dt_on, 1e-9), 3),
+        "max_qubits_in_budget": max_q_traj,
+    }
+
+    # -- 4. beyond the density wall ----------------------------------------
+    density_bytes = 2.0 * itemsize * (1 << (2 * n_big))
+    circ_big = noisy_hea(n_big)
+    prog_big = circ_big.compile_trajectories(env)
+    T_big = int(os.environ.get("QUEST_BENCH_TRAJ_BIG_COUNT", "64"))
+    mean_b, err_b = prog_big.expectation(
+        ham[0], ham[1], num_trajectories=T_big, key=key,
+        wave_size=min(T_big, 32))
+    t0 = time.perf_counter()
+    mean_b, err_b = prog_big.expectation(
+        ham[0], ham[1], num_trajectories=T_big, key=key,
+        wave_size=min(T_big, 32))
+    dt_big = time.perf_counter() - t0
+    big_row = {
+        "metric": f"trajectory-only reach: {n_big}-qubit depolarising "
+                  f"HEA, density path needs "
+                  f"{density_bytes / (1 << 30):.2f} GiB of the "
+                  f"{mem_budget / (1 << 30):.0f} GiB budget, "
+                  f"{platform}",
+        "value": round(T_big / dt_big, 2),
+        "unit": "trajectories/sec",
+        "vs_baseline": 0.0,
+        "wall_clock_s": round(dt_big, 4),
+        "density_state_bytes": density_bytes,
+        "mem_budget_bytes": float(mem_budget),
+        "density_fits": bool(density_bytes <= mem_budget),
+        "observable": float(mean_b),
+        "stderr": float(err_b),
+        "trajectories_run": T_big,
+    }
+    return [density_row, off_row, on_row, big_row]
+
+
+def bench_trajectories_config(qt, env, platform: str) -> dict:
+    """Config-list adapter: emit every trajectory row, return the
+    headline (engine-on) row last so delivery counts it."""
+    rows = bench_trajectories(qt, env, platform)
+    last = rows[2]                       # engine-on is the headline
+    for row in rows:
+        if row is not last:
+            emit(row)
+    return last
 
 
 def _dispatch_fields(cc) -> dict:
@@ -2270,7 +2448,8 @@ def main() -> None:
         ("qft", 60, lambda: bench_qft(qt, env, platform)),
         ("grover", 45, lambda: bench_grover(qt, env, platform)),
         ("density", 45, lambda: bench_density_noise(qt, env, platform)),
-        ("traj", 45, lambda: bench_trajectories(qt, env, platform)),
+        ("traj", 45, lambda: bench_trajectories_config(qt, env,
+                                                       platform)),
         ("dd", 45, lambda: bench_dd(qt, env, platform)),
         ("paulisum", 45, lambda: bench_pauli_sum(qt, env, platform)),
         ("sweep", 45, lambda: bench_ensemble_sweep_config(qt, env,

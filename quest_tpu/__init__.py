@@ -51,6 +51,8 @@ from .env import (QuESTEnv, create_quest_env, destroy_quest_env,
                   initialize_multihost, default_compensated)
 from .qureg import Qureg
 from .circuits import Circuit, CompiledCircuit, Param
+from .ops.trajectories import (TrajectoryProgram,
+                               DensityMaterialisationError)
 from .qasm_import import ParsedQASM, parse_qasm, load_qasm_file
 from .serve import (SimulationService, CoalescePolicy, ServeError,
                     QueueFull, DeadlineExceeded, ServiceClosed,
@@ -78,6 +80,7 @@ __all__ = (
         "invalidQuESTInputError", "set_input_error_handler",
         "QuESTEnv", "create_quest_env", "destroy_quest_env", "Qureg",
         "Circuit", "CompiledCircuit", "Param",
+        "TrajectoryProgram", "DensityMaterialisationError",
         "ParsedQASM", "parse_qasm", "load_qasm_file",
         "SimulationService", "CoalescePolicy", "ServeError",
         "QueueFull", "DeadlineExceeded", "ServiceClosed",

@@ -340,10 +340,12 @@ class Circuit:
         precision tolerance.
 
         ``ops`` may be a callable ``params_dict -> [K_k]`` (traceable, jnp)
-        for a PARAMETERIZED channel — the density path then differentiates
+        for a PARAMETERIZED channel — the density path differentiates
         straight through the channel strength (noise-model fitting by
-        gradient; no CPTP validation is possible for a function, and the
-        trajectory/native paths reject it)."""
+        gradient) and the trajectory path draws its jump probabilities
+        from the bound stack at call time (noisy-VQE sweeps over channel
+        strengths); no CPTP validation is possible for a function, and
+        the native path rejects it."""
         targets = tuple(int(t) for t in targets)
         self._check(targets)
         if callable(ops):
@@ -503,9 +505,9 @@ class Circuit:
         re-noised. Rates may be Params: every inserted channel shares the
         named strength, so a THREE-parameter uniform device model can be
         fit by gradient on the density path (`examples/noise_fitting.py`
-        shows the per-channel version) — Param rates are density-path
-        only (``compile_trajectories`` needs static jump probabilities
-        and rejects them)."""
+        shows the per-channel version) and swept through
+        ``compile_trajectories`` (the trajectory engine binds channel
+        strengths per call, like the deterministic sweep path)."""
         from . import validation as val
         for name, p, cap in (("p1", p1, 0.75), ("p2", p2, 0.75),
                              ("damping", damping, 1.0)):
@@ -867,9 +869,19 @@ class Circuit:
     def compile_trajectories(self, env: QuESTEnv):
         """Lower to a quantum-trajectory program: channels applied
         stochastically to a STATEVECTOR (Monte-Carlo wavefunction), so a
-        noisy n-qubit circuit costs 2^n amplitudes per trajectory instead
-        of the density path's 2^(2n) (``ops/trajectories.py``). Batch
-        trajectories with ``run_batch`` — one vmapped executable."""
+        noisy n-qubit circuit costs 2^n amplitudes per trajectory
+        instead of the density path's 2^(2n) (``ops/trajectories.py``).
+
+        The trajectory axis is the batched engine's batch axis:
+        ``trajectory_sweep(T)`` runs T draws through one keyed
+        executable with the mesh sharding priced by
+        :func:`quest_tpu.parallel.layout.choose_batch_sharding`;
+        ``expectation(..., sampling_budget=)`` aggregates Pauli-sum
+        observables on device in waves with convergence-based early
+        stopping; Param gates AND Param/callable-Kraus channels bind
+        per call, so noisy parameter sweeps run as (B, T) programs —
+        served via ``SimulationService.submit(..., trajectories=,
+        sampling_budget=)``. docs/tpu.md "Trajectory execution"."""
         from .ops.trajectories import TrajectoryProgram
         return TrajectoryProgram(self, env)
 

@@ -40,7 +40,8 @@ __all__ = ["sum_compensated", "sum_pair", "dot_pair", "vdot_pair",
            "vdot_compensated", "pauli_masks", "pauli_term_bucket",
            "pauli_sum_operands", "pauli_sum_expvals_sv",
            "pauli_sum_expvals_dm", "pauli_sum_total_sv",
-           "pauli_sum_total_dm"]
+           "pauli_sum_total_dm", "welford_wave", "welford_merge",
+           "welford_stderr"]
 
 
 def _two_sum(a, b):
@@ -274,3 +275,55 @@ def pauli_sum_total_dm(flat, num_qubits: int, xmask, ymask, zmask, coeffs,
     vals = pauli_sum_expvals_dm(flat, num_qubits, xmask, ymask, zmask,
                                 compensated=compensated)
     return jnp.sum(vals.astype(coeffs.dtype) * coeffs)
+
+
+# ---------------------------------------------------------------------------
+# device-resident running statistics (trajectory convergence loop)
+# ---------------------------------------------------------------------------
+#
+# The trajectory engine (ops/trajectories.py) runs stochastic ensembles
+# in WAVES and stops when the standard error of the running mean fits the
+# caller's sampling budget. The running (count, mean, M2) triple lives on
+# the device — each wave executable folds its new per-trajectory values
+# in with Chan's parallel-merge rule, so the only device->host traffic
+# per wave is the 3-scalar (per row) carry the stop decision reads.
+# Padded rows (device-multiple wave buckets) carry weight 0 and drop out
+# of the statistics EXACTLY, not approximately.
+
+
+def welford_wave(vals, weights):
+    """(count, mean, M2) of one wave of per-trajectory values under a
+    0/1 ``weights`` mask (padded wave rows contribute nothing). ``vals``
+    may be ``(W,)`` or ``(B, W)`` (reduced over the last axis); weights
+    broadcast against it."""
+    w = jnp.broadcast_to(weights.astype(vals.dtype), vals.shape)
+    n = jnp.sum(w, axis=-1)
+    safe = jnp.maximum(n, 1.0)
+    mean = jnp.sum(vals * w, axis=-1) / safe
+    m2 = jnp.sum(w * (vals - mean[..., None]) ** 2, axis=-1)
+    return n, mean, m2
+
+
+def welford_merge(a, b):
+    """Chan's parallel combine of two (count, mean, M2) triples (scalar
+    or elementwise over matching shapes): exact pooled statistics, no
+    pass over the underlying samples."""
+    na, ma, sa = a
+    nb, mb, sb = b
+    n = na + nb
+    safe = jnp.maximum(n, 1.0)
+    delta = mb - ma
+    mean = ma + delta * nb / safe
+    m2 = sa + sb + delta * delta * na * nb / safe
+    return n, mean, m2
+
+
+def welford_stderr(n, m2):
+    """Standard error of the mean from a (count, M2) pair (inf below two
+    samples — a one-draw ensemble carries no error estimate). Works on
+    scalars or arrays (numpy or jnp)."""
+    n = np.asarray(n, dtype=np.float64)
+    m2 = np.asarray(m2, dtype=np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        se = np.sqrt(m2 / np.maximum(n - 1.0, 1e-300) / np.maximum(n, 1.0))
+    return np.where(n >= 2.0, se, np.inf)

@@ -93,7 +93,7 @@ import jax.numpy as jnp
 
 __all__ = ["LayoutPlan", "plan_layout", "apply_relayout", "is_swap_op",
            "plan_comm_stats", "relayout_comm", "relayout_comm_tiered",
-           "choose_batch_sharding"]
+           "choose_batch_sharding", "traj_cross_shard_ops"]
 
 _SWAP_MAT = np.array([[1, 0, 0, 0], [0, 0, 1, 0],
                       [0, 1, 0, 0], [0, 0, 0, 1]], dtype=np.complex128)
@@ -706,6 +706,14 @@ def choose_batch_sharding(num_qubits: int, batch: int, num_devices: int,
     mode keeps whole states per device and stays collective-free even
     when the batch axis spans processes.
 
+    The same policy prices TRAJECTORY ensembles (``batch`` = the
+    trajectory count): trajectory-parallel mode replicates the start
+    state, splits the PRNG keys, and spends nothing on the wire, while
+    the amplitude-sharded fallback pays one collective per cross-shard
+    op per trajectory (:func:`traj_cross_shard_ops` supplies the
+    ``num_relayouts`` estimate — trajectory programs have no
+    LayoutPlan).
+
     Returns ``{"mode": "none"|"batch"|"amp", "amp_comm_seconds": float,
     "per_device_bytes": float}``.
     """
@@ -732,3 +740,28 @@ def choose_batch_sharding(num_qubits: int, batch: int, num_devices: int,
                 "per_device_bytes": batch_mode_bytes}
     return {"mode": "amp", "amp_comm_seconds": amp_comm,
             "per_device_bytes": 2.0 * state_bytes / num_devices}
+
+
+def traj_cross_shard_ops(op_supports, num_qubits: int,
+                         num_devices: int) -> int:
+    """The ``num_relayouts`` estimate a TRAJECTORY ensemble feeds
+    :func:`choose_batch_sharding` when pricing its amplitude-sharded
+    fallback: the number of paired (non-diagonal) ops whose support
+    touches a sharded physical position, i.e. the per-trajectory
+    collectives GSPMD must schedule when each 2^n state spans the mesh.
+    Trajectory programs carry no LayoutPlan (the stochastic channel
+    draws preclude static relayout batching), so this op-level count is
+    the honest upper bound the policy prices — trajectory-parallel
+    ("batch") mode pays zero of them, which is why it wins whenever
+    the replicated working set fits (docs/tpu.md "Trajectory
+    execution").
+
+    ``op_supports``: an iterable of target-index tuples, one per paired
+    op (diagonal ops commute with the shard split and must be
+    excluded by the caller)."""
+    shard_bits = max(num_devices.bit_length() - 1, 0)
+    if shard_bits <= 0:
+        return 0
+    lo = num_qubits - shard_bits
+    return sum(1 for support in op_supports
+               if any(int(t) >= lo for t in support))

@@ -35,7 +35,8 @@ from jax.sharding import PartitionSpec as P
 from ..compat import shard_map
 from ..env import AMP_AXIS
 
-__all__ = ["sample_sharded", "sample_batched", "shot_bucket"]
+__all__ = ["sample_sharded", "sample_batched", "sample_mixture",
+           "shot_bucket"]
 
 
 # Bounded: an unbounded cache keyed on raw shot counts compiles and pins
@@ -158,3 +159,26 @@ def sample_batched(planes: jax.Array, key, num_samples: int):
     idx, totals = _batch_sampler(bucket)(planes, keys)
     return (np.asarray(idx, dtype=np.int64)[:, :num_samples],
             np.asarray(totals))
+
+
+def sample_mixture(planes: jax.Array, key, num_samples: int):
+    """Draw ``num_samples`` basis outcomes from the uniform MIXTURE of a
+    trajectory ensemble: ``planes`` is the ``(T, 2, N)`` batch a
+    trajectory sweep produced (every trajectory carries weight 1/T —
+    draws are unit-norm by construction), and the shot budget is
+    STRATIFIED evenly over the trajectories (ceil(S/T) iid draws each,
+    interleaved trajectory-major and trimmed to S). Stratification is an
+    unbiased — strictly variance-reduced — sampling of the mixture
+    distribution, and it reuses the bucketed batch sampler, so the whole
+    noisy-circuit shot block costs the same two transfers as a clean
+    ``sample_batched`` call. Returns ``(indices int64[num_samples],
+    totals (T,))``."""
+    if int(num_samples) < 1:
+        raise ValueError("num_samples must be >= 1")
+    num_traj = planes.shape[0]
+    per = -(-int(num_samples) // num_traj)
+    idx, totals = sample_batched(planes, key, per)
+    # interleave (trajectory-major round-robin) so a trimmed prefix
+    # still spreads over all trajectories instead of starving the tail
+    flat = np.asarray(idx, dtype=np.int64).T.reshape(-1)[:num_samples]
+    return flat, np.asarray(totals)

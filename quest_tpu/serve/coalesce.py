@@ -36,12 +36,17 @@ from typing import Optional, Sequence
 from ..parallel.sampling import shot_bucket
 
 __all__ = ["KIND_STATE", "KIND_EXPECTATION", "KIND_SAMPLE",
-           "batch_bucket", "coalesce_key", "CoalescePolicy",
-           "split_ready", "plan_schedule"]
+           "KIND_TRAJECTORY", "batch_bucket", "coalesce_key",
+           "CoalescePolicy", "split_ready", "plan_schedule"]
 
 KIND_STATE = "state"
 KIND_EXPECTATION = "expectation"
 KIND_SAMPLE = "sample"
+# stochastic-unraveling expectation requests (TrajectoryProgram): the
+# observable key additionally carries (max_trajectories,
+# sampling_budget), so a group is homogeneous in its convergence
+# contract and executes as ONE (B, T) wave loop
+KIND_TRAJECTORY = "trajectory"
 
 
 def batch_bucket(n: int, floor: int = 1) -> int:

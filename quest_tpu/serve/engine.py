@@ -61,6 +61,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..circuits import Circuit, CompiledCircuit, _BoundedExecutableCache
+from ..ops.trajectories import TrajectoryProgram
 from ..resilience import faults as _faults
 from ..resilience import health as _health
 from ..resilience.health import NumericalFault
@@ -71,7 +72,8 @@ from ..telemetry.events import make_event, read_timeline
 from ..telemetry.metrics import metrics_registry
 from ..telemetry.tracing import Tracer, dispatch_annotation
 from .coalesce import (KIND_EXPECTATION, KIND_SAMPLE, KIND_STATE,
-                       CoalescePolicy, coalesce_key, split_ready)
+                       KIND_TRAJECTORY, CoalescePolicy, coalesce_key,
+                       split_ready)
 from .metrics import ServiceMetrics
 
 __all__ = ["ServeError", "QueueFull", "DeadlineExceeded", "ServiceClosed",
@@ -108,11 +110,13 @@ class _Request:
     __slots__ = ("compiled", "param_vec", "kind", "observables", "shots",
                  "submit_t", "deadline", "future", "retries_left", "key",
                  "not_before", "attempts", "tier", "escalations",
-                 "obs_key", "trace", "trace_owned", "qspan", "dspan")
+                 "obs_key", "trace", "trace_owned", "qspan", "dspan",
+                 "trajectories", "sampling_budget")
 
     def __init__(self, compiled, param_vec, kind, observables, shots,
                  submit_t, deadline, future, retries_left, key,
-                 tier=None, obs_key=()):
+                 tier=None, obs_key=(), trajectories=0,
+                 sampling_budget=None):
         self.compiled = compiled
         self.param_vec = param_vec
         self.kind = kind
@@ -132,6 +136,8 @@ class _Request:
         self.trace_owned = False  # this service created the trace
         self.qspan = None        # open "queue" span (per attempt)
         self.dspan = None        # open "dispatch" span
+        self.trajectories = trajectories      # max_T (trajectory kind)
+        self.sampling_budget = sampling_budget  # target stderr (or None)
 
 
 def _canonical_observables(compiled, observables) -> tuple:
@@ -297,11 +303,19 @@ class SimulationService:
 
     # -- circuit resolution ------------------------------------------------
 
-    def _resolve(self, circuit) -> CompiledCircuit:
-        """Accept a CompiledCircuit as-is; compile (and cache) a recorded
-        Circuit. The cache is keyed on object identity — the strong ref
-        to the source circuit keeps the id stable for the service's
-        lifetime."""
+    def _resolve(self, circuit, trajectories: bool = False):
+        """Accept a CompiledCircuit / TrajectoryProgram as-is; compile
+        (and cache) a recorded Circuit. The cache is keyed on object
+        identity — the strong ref to the source circuit keeps the id
+        stable for the service's lifetime. ``trajectories=True`` lowers
+        a recorded Circuit through ``compile_trajectories`` instead
+        (its own cache slot: a circuit can be served both ways)."""
+        if isinstance(circuit, TrajectoryProgram):
+            if circuit.env is not self.env:
+                raise ValueError(
+                    "trajectory program was compiled against a "
+                    "different QuESTEnv than this service's")
+            return circuit
         if isinstance(circuit, CompiledCircuit):
             if circuit.env is not self.env:
                 raise ValueError(
@@ -309,12 +323,17 @@ class SimulationService:
                     "than this service's")
             return circuit
         if isinstance(circuit, Circuit):
-            entry = self._compiled.get(id(circuit))
+            cache_key = ("traj", id(circuit)) if trajectories \
+                else id(circuit)
+            entry = self._compiled.get(cache_key)
             if entry is None or entry[0] is not circuit:
-                entry = (circuit, circuit.compile(self.env))
-                self._compiled[id(circuit)] = entry
+                compiled = circuit.compile_trajectories(self.env) \
+                    if trajectories else circuit.compile(self.env)
+                entry = (circuit, compiled)
+                self._compiled[cache_key] = entry
             return entry[1]
-        raise TypeError(f"expected Circuit or CompiledCircuit, got "
+        raise TypeError(f"expected Circuit, CompiledCircuit or "
+                        f"TrajectoryProgram, got "
                         f"{type(circuit).__name__}")
 
     def _param_vec(self, compiled: CompiledCircuit, params) -> np.ndarray:
@@ -337,6 +356,8 @@ class SimulationService:
 
     def submit(self, circuit, params: Optional[dict] = None, *,
                observables=None, shots: Optional[int] = None,
+               trajectories: Optional[int] = None,
+               sampling_budget: Optional[float] = None,
                deadline: Optional[float] = None,
                error_budget: Optional[float] = None,
                tier=None, _trace=None) -> Future:
@@ -361,6 +382,23 @@ class SimulationService:
         non-positive deadline raises immediately; a full admission
         queue raises :class:`QueueFull`.
 
+        ``trajectories=T`` makes this a TRAJECTORY request
+        (``kind="trajectory"``): ``circuit`` is a noisy circuit lowered
+        through ``compile_trajectories`` (a recorded Circuit with
+        channels, compiled and cached here, or a ``TrajectoryProgram``)
+        and the result is the ``(mean, stderr)`` Monte-Carlo estimate
+        of the required ``observables=`` Pauli sum over at most T
+        stochastic draws. ``sampling_budget`` states the target
+        standard error: the dispatcher's wave loop stops as soon as the
+        running estimate fits it, so typical requests execute a
+        fraction of T (``trajectories_run`` / ``trajectories_saved``
+        in the metrics; the dispatch trace span carries
+        ``trajectories_run`` / ``early_stopped``). Requests sharing the
+        program, observables, and (T, budget) contract coalesce into
+        one (B, T) wave loop; a NaN result row is quarantined PER ROW
+        (typed NumericalFault), its batchmates complete. Trajectory
+        requests run at the environment precision (no tier ladder).
+
         ``error_budget`` states the max amplitude error this request
         may carry; the service picks the cheapest
         :class:`~quest_tpu.config.PrecisionTier` whose modeled error
@@ -379,7 +417,41 @@ class SimulationService:
                 "a request returns ONE result: pass observables= for an "
                 "energy or shots= for samples, not both (submit twice "
                 "to get both)")
-        compiled = self._resolve(circuit)
+        if trajectories is not None:
+            if int(trajectories) < 2:
+                raise ValueError("trajectories must be >= 2 (a standard "
+                                 "error needs at least two draws)")
+            if shots is not None:
+                raise ValueError(
+                    "a request returns ONE result: trajectory requests "
+                    "estimate observables=, not shot blocks")
+            if observables is None:
+                raise ValueError(
+                    "trajectory requests estimate a Pauli-sum "
+                    "observable; pass observables=(terms, coeffs)")
+            if tier is not None or error_budget is not None:
+                raise ValueError(
+                    "trajectory requests run at the environment "
+                    "precision; the tier ladder does not apply")
+        elif sampling_budget is not None:
+            raise ValueError("sampling_budget needs trajectories=")
+        if sampling_budget is not None and float(sampling_budget) <= 0.0:
+            raise ValueError("sampling_budget is a target standard "
+                             "error and must be > 0")
+        compiled = self._resolve(circuit,
+                                 trajectories=trajectories is not None)
+        if isinstance(compiled, TrajectoryProgram) \
+                and trajectories is None:
+            raise ValueError(
+                "TrajectoryProgram submissions need trajectories= "
+                "(the ensemble's max draw count)")
+        if trajectories is not None \
+                and not isinstance(compiled, TrajectoryProgram):
+            raise TypeError(
+                "trajectories= needs a trajectory-lowerable circuit: "
+                "pass the recorded noisy Circuit (the service compiles "
+                "and caches it) or a TrajectoryProgram, not a "
+                "CompiledCircuit")
         vec = self._param_vec(compiled, params)
         now = time.monotonic()
         abs_deadline = now + self.request_timeout_s
@@ -389,7 +461,15 @@ class SimulationService:
                 raise DeadlineExceeded(
                     f"deadline {deadline!r} s is already unmeetable")
             abs_deadline = min(abs_deadline, now + float(deadline))
-        if shots is not None:
+        if trajectories is not None:
+            kind = KIND_TRAJECTORY
+            ham, obs_key = _canonical_observables(compiled, observables)
+            # the convergence contract is a coalescing dimension: a
+            # group must agree on (max_T, budget) to share a wave loop
+            obs_key = obs_key + (int(trajectories),
+                                 float(sampling_budget)
+                                 if sampling_budget is not None else -1.0)
+        elif shots is not None:
             if int(shots) < 1:
                 raise ValueError("shots must be >= 1")
             if compiled.is_density:
@@ -416,7 +496,11 @@ class SimulationService:
         fut: Future = Future()
         req = _Request(compiled, vec, kind, ham, int(shots or 0), now,
                        abs_deadline, fut, self.max_retries, key,
-                       tier=req_tier, obs_key=obs_key)
+                       tier=req_tier, obs_key=obs_key,
+                       trajectories=int(trajectories or 0),
+                       sampling_budget=(float(sampling_budget)
+                                        if sampling_budget is not None
+                                        else None))
         # request-scoped tracing: a router-propagated context rides in
         # via _trace (the router owns + finishes it); otherwise the
         # service's own sampler decides, and the service finishes the
@@ -462,7 +546,7 @@ class SimulationService:
 
     def warm(self, circuit, batch_sizes: Optional[Sequence[int]] = None,
              observables=None, shots: Optional[int] = None,
-             tier=None) -> CompiledCircuit:
+             tier=None, trajectories: Optional[int] = None):
         """Pre-compile the executables the given traffic will hit, so
         first requests pay dispatch latency, not compiles.
 
@@ -478,9 +562,34 @@ class SimulationService:
         (``warm_cache_misses``) — restart-to-ready stops paying
         recompiles. ``tier`` warms the executables of one precision
         tier (tier-keyed forms; the traffic's ``submit(tier=...)`` /
-        ``error_budget`` rung). Returns the compiled circuit (submit it
-        back for guaranteed coalescing)."""
-        compiled = self._resolve(circuit)
+        ``error_budget`` rung). ``trajectories`` (with ``observables=``)
+        warms the TRAJECTORY wave executable instead — a recorded noisy
+        circuit lowers through ``compile_trajectories`` and one
+        throwaway wave compiles per batch bucket. Returns the compiled
+        circuit (submit it back for guaranteed coalescing)."""
+        compiled = self._resolve(circuit,
+                                 trajectories=trajectories is not None)
+        if isinstance(compiled, TrajectoryProgram):
+            if observables is None:
+                raise ValueError(
+                    "warming a trajectory program needs observables= "
+                    "(the wave executable embeds the Pauli-sum "
+                    "reduction)")
+            ham, _ = _canonical_observables(compiled, observables)
+            mult = self._device_multiple(compiled)
+            sizes = tuple(batch_sizes) if batch_sizes is not None \
+                else (1,)
+            warm_t = int(trajectories) if trajectories is not None \
+                and int(trajectories) >= 2 \
+                else max(32, mult)   # the live loop's default bucket
+            for bs in sizes:
+                padded = self.policy.bucket_size(int(bs), 1)
+                pm = np.zeros((padded, len(compiled.param_names)),
+                              dtype=np.float64)
+                compiled.expectation_batch(pm, ham, warm_t,
+                                           wave_size=warm_t)
+            self._last_cc = compiled
+            return compiled
         tier = compiled._effective_tier(tier)
         sizes = tuple(batch_sizes) if batch_sizes is not None \
             else (self.policy.max_batch,)
@@ -991,12 +1100,17 @@ class SimulationService:
         cc = batch[0].compiled
         tier = batch[0].tier
         B = len(batch)
-        padded = self.policy.bucket_size(B, self._device_multiple(cc))
+        kind = batch[0].kind
+        # trajectory groups pad only to the power-of-two bucket — the
+        # device multiple lives on the (inner) trajectory axis, and a
+        # padded REQUEST row costs a whole throwaway ensemble
+        padded = self.policy.bucket_size(
+            B, 1 if kind == KIND_TRAJECTORY
+            else self._device_multiple(cc))
         pm = np.zeros((padded, len(cc.param_names)), dtype=np.float64)
         for i, req in enumerate(batch):
             pm[i] = req.param_vec
         t_dispatch = time.monotonic()
-        kind = batch[0].kind
         tier_name = tier.name if tier is not None else "env"
         traced = [r for r in batch if r.trace is not None]
         for i, req in enumerate(batch):
@@ -1027,9 +1141,16 @@ class SimulationService:
                 mode = cc.dispatch_stats().batch_sharding_mode
             except Exception:
                 mode = ""
+            extra = {}
+            if kind == KIND_TRAJECTORY:
+                info = getattr(cc, "last_traj_stats", None) or {}
+                extra = {"trajectories_run":
+                         info.get("trajectories_run", 0),
+                         "early_stopped":
+                         info.get("early_stopped", False)}
             for req in traced:
                 if req.dspan is not None:
-                    req.trace.end(req.dspan, sharding=mode)
+                    req.trace.end(req.dspan, sharding=mode, **extra)
                     req.dspan = None
         return out
 
@@ -1063,7 +1184,30 @@ class SimulationService:
         ann = dispatch_annotation(
             f"quest_tpu.serve.dispatch:{kind}:b{padded}:"
             f"{tier.name if tier is not None else 'env'}")
-        if kind == KIND_EXPECTATION:
+        if kind == KIND_TRAJECTORY:
+            # one (B, T) wave loop with convergence-based early
+            # stopping; live_rows excludes the padded bucket rows from
+            # the stop decision so a throwaway row can't stall the batch
+            with ann:
+                means, errs, info = cc.expectation_batch(
+                    pm, batch[0].observables, batch[0].trajectories,
+                    sampling_budget=batch[0].sampling_budget,
+                    live_rows=B)
+            means = _faults.poison_output(poison,
+                                          np.asarray(means))[:B]
+            results = [(float(means[i]), float(errs[i]))
+                       for i in range(B)]
+            self.metrics.incr("trajectory_dispatches")
+            self.metrics.incr("trajectories_run",
+                              info["trajectories_run"])
+            self.metrics.incr("trajectories_saved",
+                              max(0, info["max_trajectories"]
+                                  - info["trajectories_run"]))
+            # a NaN trajectory poisons ITS row's running mean only:
+            # the per-row screen quarantines that request typed while
+            # its batchmates complete (per-row, never per-batch)
+            bad = _health.bad_value_rows(means) if guard else ()
+        elif kind == KIND_EXPECTATION:
             with ann:
                 out = _faults.poison_output(poison, np.asarray(
                     cc.expectation_sweep(pm, batch[0].observables,

@@ -60,6 +60,10 @@ _COUNTERS = (
     "fast_tier_dispatches",  # engine dispatches run at the FAST tier
     "tier_violations",       # result rows outside their tier's tolerance
     "tier_escalations",      # requests re-executed one tier up
+    # trajectory-parallel noisy execution (ops/trajectories.py; ISSUE 10):
+    "trajectory_dispatches",  # coalesced trajectory wave loops executed
+    "trajectories_run",       # stochastic draws those loops executed
+    "trajectories_saved",     # draws early stopping skipped vs max_T
 )
 
 

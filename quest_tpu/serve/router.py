@@ -56,6 +56,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..circuits import Circuit, CompiledCircuit
+from ..ops.trajectories import TrajectoryProgram
 from ..resilience import faults as _faults
 from ..resilience.recovery import (FATAL, POISON, TRANSIENT,
                                    SupervisorPolicy, classify)
@@ -148,14 +149,18 @@ class _Work:
     __slots__ = ("circuit", "params", "observables", "shots", "submit_t",
                  "deadline", "future", "failovers_left", "lock", "done",
                  "tried", "active", "last_route_t", "hedged",
-                 "park_logged", "trace")
+                 "park_logged", "trace", "trajectories",
+                 "sampling_budget")
 
     def __init__(self, circuit, params, observables, shots, submit_t,
-                 deadline, failovers_left):
+                 deadline, failovers_left, trajectories=None,
+                 sampling_budget=None):
         self.circuit = circuit
         self.params = params
         self.observables = observables
         self.shots = shots
+        self.trajectories = trajectories
+        self.sampling_budget = sampling_budget
         self.submit_t = submit_t
         self.deadline = deadline        # ABSOLUTE (monotonic); immutable
         self.future: Future = Future()
@@ -329,8 +334,11 @@ class ServiceRouter:
     def _route_circuit(circuit):
         """Route by the RECORDED circuit: each replica compiles (and
         caches) its own program, so any replica can serve any request —
-        the precondition for failover."""
-        if isinstance(circuit, CompiledCircuit):
+        the precondition for failover. Trajectory programs route the
+        same way (the replica re-lowers through
+        ``compile_trajectories`` when the request carries
+        ``trajectories=``)."""
+        if isinstance(circuit, (CompiledCircuit, TrajectoryProgram)):
             return circuit.circuit
         if isinstance(circuit, Circuit):
             return circuit
@@ -375,14 +383,18 @@ class ServiceRouter:
 
     def submit(self, circuit, params: Optional[dict] = None, *,
                observables=None, shots: Optional[int] = None,
+               trajectories: Optional[int] = None,
+               sampling_budget: Optional[float] = None,
                deadline: Optional[float] = None) -> Future:
         """Enqueue one request on the healthiest replica; returns a
         router-owned Future. Semantics match
-        :meth:`SimulationService.submit`, plus: replica faults fail the
-        request over to a healthy replica under its ORIGINAL absolute
-        deadline, and a window with no ready replica parks the request
-        for re-placement instead of dropping it (it still expires
-        typed at its deadline)."""
+        :meth:`SimulationService.submit` — including trajectory
+        requests (``trajectories=`` / ``sampling_budget=``; each
+        replica lowers and caches its own trajectory program) — plus:
+        replica faults fail the request over to a healthy replica under
+        its ORIGINAL absolute deadline, and a window with no ready
+        replica parks the request for re-placement instead of dropping
+        it (it still expires typed at its deadline)."""
         if self._closed:
             raise ServiceClosed("router is closed")
         route = self._route_circuit(circuit)
@@ -394,7 +406,8 @@ class ServiceRouter:
                     f"deadline {deadline!r} s is already unmeetable")
             abs_deadline = min(abs_deadline, now + float(deadline))
         work = _Work(route, params, observables, shots, now, abs_deadline,
-                     self.max_failovers)
+                     self.max_failovers, trajectories=trajectories,
+                     sampling_budget=sampling_budget)
         ctx = self.tracer.start(router=self.name)
         if ctx is not None:
             work.trace = ctx
@@ -461,6 +474,8 @@ class ServiceRouter:
                 fut = h.service.submit(
                     work.circuit, work.params,
                     observables=work.observables, shots=work.shots,
+                    trajectories=work.trajectories,
+                    sampling_budget=work.sampling_budget,
                     deadline=remaining, _trace=work.trace)
             except QueueFull:
                 self.metrics.incr("rerouted_full")

@@ -118,6 +118,65 @@ def test_ensemble_sweep_rows_required():
     assert "bench_ensemble_sweep" in src
 
 
+def test_trajectory_rows_required():
+    """The bench must deliver the ISSUE-10 trajectory rows: the exact
+    density path, the per-trajectory engine-off loop, the wave-loop
+    engine-on row (early stop + fixed-seed replay + transfer
+    accounting), and the beyond-density reach row. Run tiny (6/8
+    qubits) so the delivery contract is tested, not the measurement."""
+    env_overrides = {
+        "QUEST_BENCH_TRAJ_QUBITS": "5",
+        "QUEST_BENCH_TRAJ_BIG_QUBITS": "7",
+        "QUEST_BENCH_TRAJ_COUNT": "128",
+        "QUEST_BENCH_TRAJ_BIG_COUNT": "16",
+        "QUEST_BENCH_TRAJ_BUDGET": "0.1",
+        # small traces keep the delivery check inside the lean tier-1
+        # budget: short waves, and no damping channels (halves the
+        # per-trajectory Kraus count the compile pays for)
+        "QUEST_BENCH_TRAJ_WAVE": "16",
+        "QUEST_BENCH_TRAJ_DAMPING": "0",
+        "QUEST_BENCH_TRIALS": "1",
+    }
+    old = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        import quest_tpu as qt
+        env = qt.createQuESTEnv(num_devices=1, seed=[2026])
+        rows = bench.bench_trajectories(qt, env, "cpu")
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    assert len(rows) == 4
+    density, off, on, big = rows
+    assert "density path" in density["metric"]
+    assert density["unit"] == "runs/sec" and density["value"] > 0
+    assert density["sampling_error"] == 0.0
+    assert "engine-off" in off["metric"] and "engine-on" in on["metric"]
+    for row in (off, on, big):
+        assert row["unit"] == "trajectories/sec"
+        assert row["value"] > 0.0
+    # matched sampling error: the engine-on row states its budget and
+    # lands inside it, early-stops below max, replays bit-identically
+    assert on["stderr"] <= on["sampling_budget"]
+    assert on["trajectories_run"] < on["max_trajectories"]
+    assert on["early_stopped"] is True
+    assert on["early_stop_deterministic"] is True
+    # one transfer per wave, not per trajectory
+    assert on["host_syncs"] == on["waves"]
+    assert on["host_syncs_avoided"] > 0
+    assert off["host_syncs"] == on["trajectories_run"]
+    assert on["speedup_vs_engine_off"] > 0.0
+    assert on["speedup_vs_density"] > 0.0
+    # the per-mode reach on the same memory budget orders correctly
+    assert on["max_qubits_in_budget"] > density["max_qubits_in_budget"]
+    assert "density_state_bytes" in big and "density_fits" in big
+    # the headline adapter emits every row
+    import inspect
+    src = inspect.getsource(bench.bench_trajectories_config)
+    assert "bench_trajectories" in src
+
+
 def test_serving_rows_required():
     """The bench must deliver the ISSUE-4 serving rows: service-off and
     service-on requests/sec for the same mixed request trace, with the

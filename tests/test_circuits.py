@@ -493,22 +493,29 @@ class TestParameterizedChannels:
             assert abs(float(f(pv)) - (1 - 2 * pval)) < 1e-12
             assert abs(float(jax.grad(f)(pv)[0]) + 2.0) < 1e-9
 
-    def test_trajectories_and_native_reject(self, env):
+    def test_param_channel_paths(self, env):
+        # the native path still needs static ops; the trajectory path
+        # now BINDS Param channels at call time (ISSUE 10)
         from quest_tpu.circuits import Param
         c = Circuit(2)
         c.h(0).dephase(0, Param("p"))
-        with pytest.raises(ValueError, match="density-path only"):
-            c.compile_trajectories(env)
         with pytest.raises(ValueError, match="static"):
             c.compile_native(density=True)
-        # a raw callable channel with NO declared Param reaches the
-        # dedicated kraus guard in the trajectory compiler
+        prog = c.compile_trajectories(env)
+        import jax
+        out = prog.run_batch(None, 4, key=jax.random.PRNGKey(0),
+                             params={"p": 0.2})
+        assert np.asarray(out).shape == (4, 2, 4)
+        # a raw callable channel with NO declared Param binds too
         c2 = Circuit(2)
         c2.h(0)
         c2.kraus(lambda p: [np.sqrt(0.9) * np.eye(2),
                             np.sqrt(0.1) * np.diag([1.0, -1.0])], (0,))
-        with pytest.raises(ValueError, match="density-path only"):
-            c2.compile_trajectories(env)
+        prog2 = c2.compile_trajectories(env)
+        out2 = prog2.run_batch(None, 4, key=jax.random.PRNGKey(1))
+        norms = np.sum(np.asarray(out2)[:, 0] ** 2
+                       + np.asarray(out2)[:, 1] ** 2, axis=1)
+        np.testing.assert_allclose(norms, 1.0, atol=1e-10)
 
     def test_pauli_and_two_qubit_channel_builders(self, env):
         # new builders match the imperative register channels op-for-op

@@ -1,0 +1,91 @@
+"""tools/traj_trace.py smoke (fast tier): the planned trajectory
+schedule must agree with the engine's own wave planner and sharding
+policy, survive a JSON round trip, and the CLI must produce parseable
+output end-to-end."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import traj_trace  # noqa: E402
+
+
+def test_schedule_matches_plan_waves():
+    from quest_tpu.ops.trajectories import plan_waves
+    doc = json.loads(json.dumps(traj_trace.trace_schedule(
+        16, 100, 32, 1, 8)))
+    waves, bucket = plan_waves(100, 32, 1)
+    assert doc["wave_bucket"] == bucket == 32
+    assert len(doc["events"]) == len(waves) == 4
+    assert [e["start"] for e in doc["events"]] == \
+        [w[0] for w in waves]
+    assert doc["events"][-1]["live"] == 4
+    assert doc["events"][-1]["padded_rows"] == 28
+    assert doc["events"][-1]["cumulative"] == 100
+    assert doc["sharding"]["mode"] == "none"
+    assert doc["early_stop_wave"] is None
+    assert doc["projected_saved"] == 0
+
+
+def test_early_stop_decision_points():
+    doc = traj_trace.trace_schedule(12, 1024, 32, 1, 8,
+                                    sampling_budget=0.05, sigma=0.5)
+    # n* = ceil((0.5/0.05)^2) = 100 -> stops inside wave 3 (cum 128)
+    assert doc["projected_stop_after"] == 100
+    assert doc["early_stop_wave"] == 3
+    assert doc["projected_trajectories"] == 128
+    assert doc["projected_saved"] == 1024 - 128
+    stops = [e for e in doc["events"] if e["early_stop"]]
+    assert len(stops) == 1 and stops[0]["wave"] == 3
+    # stderr projection is monotone decreasing
+    ests = [e["est_stderr"] for e in doc["events"]]
+    assert ests == sorted(ests, reverse=True)
+
+
+def test_device_multiple_and_mode():
+    doc = traj_trace.trace_schedule(16, 64, 10, 8, 8)
+    # wave bucket rounds up to the 8-device multiple
+    assert doc["wave_bucket"] == 16
+    assert doc["sharding"]["mode"] == "batch"
+    # amp collectives priced when the caller states cross-shard ops
+    doc2 = traj_trace.trace_schedule(16, 64, 10, 8, 8,
+                                     cross_shard_ops=3)
+    assert doc2["sharding"]["amp_comm_seconds"] > 0.0
+
+
+def test_cli_end_to_end(tmp_path):
+    tool = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "traj_trace.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}
+    out_file = tmp_path / "traj.json"
+    proc = subprocess.run(
+        [sys.executable, tool, "--qubits", "14", "--trajectories",
+         "256", "--devices", "8", "--budget", "0.05", "--sigma", "0.6",
+         "--out", str(out_file)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    doc = json.loads(out_file.read_text())
+    # shared versioned dump header (tools/_trace_io.py, ISSUE 9)
+    assert doc["schema"] == "quest_tpu.trace/1"
+    assert doc["kind"] == "traj"
+    assert doc["num_qubits"] == 14
+    assert doc["sharding"]["mode"] in ("batch", "amp")
+    assert doc["events"], "no waves planned"
+    assert doc["early_stop_wave"] is not None
+    assert doc["projected_saved"] > 0
+    cums = [e["cumulative"] for e in doc["events"]]
+    assert cums == sorted(cums)
+    assert cums[-1] == 256
+
+
+def test_cli_rejects_bad_args():
+    with pytest.raises(ValueError):
+        traj_trace.trace_schedule(16, 0, 32, 1, 8)

@@ -75,18 +75,17 @@ def test_trajectory_norm_preserved_per_draw(env):
 
 
 def test_trajectory_validation(env):
+    # parameterized circuits COMPILE (ISSUE 10) but must bind every
+    # declared name at call time
     c = Circuit(2)
     th = c.parameter("th")
     c.rz(0, th)
-    with pytest.raises(ValueError):
-        c.compile_trajectories(env)
-
-    # a callable-matrix gate with no registered Param must also be
-    # rejected at compile time, not crash inside the trace
-    cc = Circuit(1)
-    cc.gate(lambda p: np.eye(2), (0,))
-    with pytest.raises(ValueError):
-        cc.compile_trajectories(env)
+    prog = c.compile_trajectories(env)
+    q = qt.createQureg(2, env)
+    qt.initZeroState(q)
+    with pytest.raises(ValueError, match="missing circuit parameters"):
+        prog.run(q)
+    prog.run(q, params={"th": 0.3})
 
     c2 = Circuit(2)
     c2.kraus([np.eye(2) * 0.2], (0,))          # not CPTP
@@ -206,12 +205,32 @@ def test_sharded_trajectory_batch(mesh_env):
     psi0[0] = 1.0
     planes = pack(psi0)
     key = jax.random.PRNGKey(77)
-    plain = np.asarray(prog.run_batch(planes, 16, key=key))
+    plain = np.asarray(prog.run_batch(planes, 16, key=key,
+                                      shard_trajectories=False))
     sharded = prog.run_batch(planes, 16, key=key, shard_trajectories=True)
     assert len(sharded.sharding.device_set) == 8
     np.testing.assert_array_equal(plain, np.asarray(sharded))
-    with pytest.raises(ValueError):
-        prog.run_batch(planes, 15, key=key, shard_trajectories=True)
+    # the priced default policy shards trajectory-parallel here too
+    policy = np.asarray(prog.run_batch(planes, 16, key=key))
+    np.testing.assert_array_equal(plain, policy)
+
+    # ISSUE-10 satellite: a non-divisible count pads-and-masks with a
+    # ONE-TIME warning (matching the PR-3 sweep behaviour) instead of
+    # the old hard ValueError, and the kept rows match the unsharded
+    # draw exactly
+    plain13 = np.asarray(prog.run_batch(planes, 13, key=key,
+                                        shard_trajectories=False))
+    with pytest.warns(UserWarning, match="not divisible"):
+        padded = prog.run_batch(planes, 13, key=key,
+                                shard_trajectories=True)
+    assert np.asarray(padded).shape == (13, 2, 1 << n)
+    np.testing.assert_array_equal(plain13, np.asarray(padded))
+    # the warning is once per program
+    import warnings as _w
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        prog.run_batch(planes, 13, key=key, shard_trajectories=True)
+    assert not [x for x in rec if "not divisible" in str(x.message)]
 
 
 def test_sharded_trajectory_batch_needs_mesh(env):
@@ -258,3 +277,307 @@ def test_trajectory_expectation_validation(env):
         prog.expectation([[(5, 3)]], [1.0], planes, 8)
     with pytest.raises(qt.QuESTError):
         prog.expectation([[(0, 7)]], [1.0], planes, 8)
+    with pytest.raises(ValueError, match="sampling_budget"):
+        prog.expectation([[(0, 3)]], [1.0], planes, 8,
+                         sampling_budget=0.0)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: the trajectory ENGINE — wave-loop observables, early stopping,
+# Param channels, sharding policy, serving integration
+# ---------------------------------------------------------------------------
+
+
+class TestTrajectoryEngine:
+    def test_wave_expectation_matches_density(self, env):
+        """Oracle parity: the wave-loop MC estimate of <Z0> under
+        damping agrees with the exact density path within 5 reported
+        standard errors (seeded, small n/T)."""
+        import jax
+        n = 2
+        c = Circuit(n)
+        c.h(0).cnot(0, 1)
+        c.damp(0, 0.4)
+        rho = _exact_density(c, n, env)
+        z = np.diag([1.0, -1.0])
+        exact = float(np.real(np.trace(np.kron(np.eye(2), z) @ rho)))
+        prog = c.compile_trajectories(env)
+        mean, err = prog.expectation(
+            [[(0, 3)]], [1.0], _zero_planes(n, env), 400,
+            key=jax.random.PRNGKey(11), wave_size=64)
+        assert abs(mean - exact) < max(5 * err, 1e-3), (mean, exact, err)
+        info = prog.last_traj_stats
+        assert info["trajectories_run"] == 400
+        assert not info["early_stopped"]
+
+    def test_early_stop_deterministic_and_in_budget(self, env):
+        import jax
+        c = Circuit(2)
+        c.h(0).cnot(0, 1)
+        c.damp(0, 0.3)
+        prog = c.compile_trajectories(env)
+        key = jax.random.PRNGKey(3)
+        budget = 0.08
+        runs = []
+        for _ in range(2):
+            mean, err = prog.expectation(
+                [[(0, 3)]], [1.0], _zero_planes(2, env), 1024,
+                key=key, sampling_budget=budget, wave_size=32)
+            runs.append((mean, err, prog.last_traj_stats))
+        (m1, e1, i1), (m2, e2, i2) = runs
+        # identical results under a fixed seed — the stop decision is a
+        # pure function of the key stream
+        assert m1 == m2 and e1 == e2
+        assert i1["trajectories_run"] == i2["trajectories_run"]
+        # measurably fewer than max, inside the stated budget
+        assert i1["early_stopped"]
+        assert i1["trajectories_run"] < 1024
+        assert e1 <= budget
+
+    def test_one_executable_one_transfer_per_wave(self, env):
+        """Acceptance: the wave loop is one executable and one
+        device->host transfer per wave — dispatch_stats() counts the
+        per-trajectory syncs avoided and ONE cached wave executable."""
+        import jax
+        c = Circuit(2)
+        c.h(0)
+        c.damp(0, 0.2)
+        prog = c.compile_trajectories(env)
+        prog.expectation([[(0, 3)], [(1, 3)]], [1.0, 0.5],
+                         _zero_planes(2, env), 96,
+                         key=jax.random.PRNGKey(9), wave_size=32)
+        info = prog.last_traj_stats
+        assert info["waves"] == 3 and info["trajectories_run"] == 96
+        ds = prog.dispatch_stats()
+        # engine-off pays one sync per trajectory; the loop paid one
+        # per wave
+        assert ds.host_syncs_avoided == 96 - 3
+        assert ds.batched_cache_size == 1     # ONE wave executable
+        # a second Hamiltonian of the same bucketed term count reuses it
+        prog.expectation([[(0, 1)]], [1.0], _zero_planes(2, env), 32,
+                         key=jax.random.PRNGKey(10), wave_size=32)
+        assert prog.dispatch_stats().batched_cache_size == 1
+
+    def test_param_channel_bind_parity(self, env):
+        """Param gates + Param channels bound at call time draw the
+        SAME trajectories as the pre-bound static circuit under one
+        key."""
+        import jax
+        from quest_tpu.circuits import Param
+        cp = Circuit(2)
+        cp.ry(0, Param("th"))
+        cp.depolarise(0, Param("p"))
+        cp.cnot(0, 1)
+        cp.damp(1, Param("g"))
+        cb = Circuit(2)
+        cb.ry(0, 0.7)
+        cb.depolarise(0, 0.2)
+        cb.cnot(0, 1)
+        cb.damp(1, 0.15)
+        key = jax.random.PRNGKey(21)
+        pp = cp.compile_trajectories(env)
+        pb = cb.compile_trajectories(env)
+        a = np.asarray(pp.run_batch(_zero_planes(2, env), 16, key=key,
+                                    params={"th": 0.7, "p": 0.2,
+                                            "g": 0.15}))
+        b = np.asarray(pb.run_batch(_zero_planes(2, env), 16, key=key))
+        np.testing.assert_allclose(a, b, atol=1e-12)
+        # rebinding the SAME program reuses its cached executable
+        a2 = np.asarray(pp.run_batch(_zero_planes(2, env), 16, key=key,
+                                     params={"th": 0.7, "p": 0.0,
+                                             "g": 0.0}))
+        assert pp.dispatch_stats().batched_cache_size == 1
+        assert not np.allclose(a, a2)       # the binding really changed
+
+    def test_expectation_batch_param_sweep(self, env):
+        """(B, T) noisy sweeps: each parameter row gets its own
+        ensemble; a row's estimate matches its own single-row run."""
+        import jax
+        from quest_tpu.circuits import Param
+        c = Circuit(2)
+        c.ry(0, Param("th"))
+        c.depolarise(0, Param("p"))
+        prog = c.compile_trajectories(env)
+        key = jax.random.PRNGKey(4)
+        pm = np.array([[0.4, 0.1], [1.2, 0.3]])
+        means, errs, info = prog.expectation_batch(
+            pm, ([[(0, 3)]], [1.0]), 64, key=key, wave_size=32)
+        assert means.shape == (2,) and errs.shape == (2,)
+        assert info["trajectories_run"] == 64
+        assert np.all(np.isfinite(means)) and np.all(errs > 0)
+        # rows are statistically sane: <Z0> of ry(th) + depol(p)
+        for b, (th, p) in enumerate(pm):
+            ideal = (1 - 4 * p / 3) * np.cos(th)
+            assert abs(means[b] - ideal) < 5 * errs[b] + 1e-3
+
+    def test_average_density_guard(self, env, monkeypatch):
+        from quest_tpu.ops.trajectories import (
+            DensityMaterialisationError, DENSITY_DEBUG_QUBITS_ENV)
+        c = Circuit(4)
+        c.h(0)
+        c.damp(0, 0.1)
+        prog = c.compile_trajectories(env)
+        monkeypatch.setenv(DENSITY_DEBUG_QUBITS_ENV, "3")
+        with pytest.raises(DensityMaterialisationError,
+                           match="expectation"):
+            prog.average_density(_zero_planes(4, env), 4)
+        monkeypatch.setenv(DENSITY_DEBUG_QUBITS_ENV, "4")
+        rho = prog.average_density(_zero_planes(4, env), 8)
+        assert abs(np.trace(rho) - 1.0) < 1e-6
+        # the typed error is still a ValueError (callers' except clauses)
+        assert issubclass(DensityMaterialisationError, ValueError)
+
+    def test_sample_mixture(self, env):
+        """Noisy shot sampling at statevector cost: stratified draws
+        from the trajectory mixture reproduce the mixture
+        distribution."""
+        import jax
+        c = Circuit(1)
+        c.h(0)
+        c.mid_measure(0)     # per-trajectory collapse -> 50/50 mixture
+        prog = c.compile_trajectories(env)
+        idx, totals = prog.sample(256, 16, key=jax.random.PRNGKey(8))
+        assert idx.shape == (256,)
+        assert totals.shape == (16,)
+        np.testing.assert_allclose(totals, 1.0, atol=1e-6)
+        frac = float(np.mean(idx))
+        assert 0.3 < frac < 0.7      # ~N(0.5, 0.03): a 6-sigma band
+
+    def test_policy_prices_cross_shard_ops(self, mesh_env):
+        """The sharding policy feeds the trajectory program's
+        cross-shard op count into the amp-mode pricing."""
+        from quest_tpu.parallel.layout import traj_cross_shard_ops
+        n = 5
+        c = Circuit(n)
+        c.h(n - 1)                    # sharded position on the 8-dev mesh
+        c.damp(n - 1, 0.1)
+        prog = c.compile_trajectories(mesh_env)
+        paired = [t for k, t, _, _ in prog._ops
+                  if not k.startswith("diag")]
+        assert traj_cross_shard_ops(paired, n, 8) >= 2
+        pol = prog._policy(16)
+        assert pol["mode"] in ("batch", "amp")
+        assert pol["amp_comm_seconds"] > 0.0
+
+    def test_service_trajectory_roundtrip(self, env):
+        """kind="trajectory" through the serving stack: coalesced
+        (B, T) dispatch, per-request (mean, stderr) results in oracle
+        agreement, trajectory metrics, early-stop accounting."""
+        n = 2
+        c = Circuit(n)
+        c.h(0).cnot(0, 1)
+        c.damp(0, 0.4)
+        rho = _exact_density(c, n, env)
+        z = np.diag([1.0, -1.0])
+        exact = float(np.real(np.trace(np.kron(np.eye(2), z) @ rho)))
+        prog = c.compile_trajectories(env)
+        ham = ([[(0, 3)]], [1.0])
+        svc = qt.createSimulationService(env, max_batch=8,
+                                         max_wait_s=0.002)
+        try:
+            futs = [svc.submit(prog, observables=ham, trajectories=512,
+                               sampling_budget=0.1) for _ in range(4)]
+            for f in futs:
+                mean, err = f.result(timeout=120)
+                assert err <= 0.1
+                assert abs(mean - exact) <= 5 * err + 1e-3
+            # a recorded noisy Circuit lowers + caches per service
+            f2 = svc.submit(c, observables=ham, trajectories=32)
+            mean2, err2 = f2.result(timeout=120)
+            assert abs(mean2 - exact) <= 5 * err2 + 1e-3
+            stats = svc.dispatch_stats()
+            sm = stats["service"]
+            assert sm["trajectory_dispatches"] >= 1
+            assert sm["trajectories_run"] >= 32
+            assert sm["trajectories_saved"] > 0     # early stop saved work
+            # invalid combinations are typed at submit
+            with pytest.raises(ValueError, match="observables"):
+                svc.submit(prog, trajectories=16)
+            with pytest.raises(ValueError, match="trajectories="):
+                svc.submit(prog, observables=ham)
+            with pytest.raises(ValueError, match="tier"):
+                svc.submit(prog, observables=ham, trajectories=16,
+                           tier="double")
+            with pytest.raises(ValueError, match="sampling_budget"):
+                svc.submit(c, observables=ham, sampling_budget=0.1)
+        finally:
+            svc.close()
+
+
+TRAJ_WORKER = r"""
+import json, sys
+proc_id = int(sys.argv[1]); nprocs = int(sys.argv[2]); port = sys.argv[3]
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+from quest_tpu.core.packing import pack
+
+qt.initialize_multihost(f"localhost:{port}", num_processes=nprocs,
+                        process_id=proc_id)
+env = qt.createQuESTEnv(num_devices=len(jax.devices()), seed=[7])
+assert env.is_multihost
+n = 6
+c = Circuit(n)
+for q in range(n):
+    c.h(q)
+c.damp(0, 0.3)
+c.cnot(0, n - 1)
+c.dephase(n - 1, 0.2)
+prog = c.compile_trajectories(env)
+psi = np.zeros(1 << n, dtype=np.complex128); psi[0] = 1.0
+key = jax.random.PRNGKey(99)
+sharded = prog.run_batch(pack(psi), 16, key=key,
+                         shard_trajectories=True)
+# shards on the peer process are not addressable: allgather first
+from jax.experimental import multihost_utils
+out = np.asarray(multihost_utils.process_allgather(sharded,
+                                                   tiled=True))
+mean, err = prog.expectation([[(0, 3)]], [1.0], pack(psi), 64, key=key,
+                             wave_size=16)
+print("RESULT " + json.dumps({
+    "rank": proc_id, "devices": env.num_devices,
+    "digest": float(np.sum(out[:, 0] ** 2 + out[:, 1] ** 2)),
+    "first_row": [float(out[0, 0, 0]), float(out[0, 1, 0])],
+    "mean": mean, "err": err,
+    "mode": prog.last_traj_stats["mode"],
+}), flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.multihost
+def test_two_process_trajectory_parity():
+    """Genuine 2-process x 2-device run: the trajectory-parallel batch
+    and the wave-loop expectation agree with the single-process oracle
+    (keys decide draws, placement doesn't — across processes too)."""
+    import jax
+    from quest_tpu.testing.multiprocess import spawn_workers
+    results = spawn_workers(TRAJ_WORKER, 2, 2)
+    assert len(results) == 2
+    assert results[0]["devices"] == 4
+    # both ranks run the same SPMD program and agree exactly
+    assert results[0]["digest"] == pytest.approx(results[1]["digest"])
+    assert results[0]["mean"] == results[1]["mean"]
+
+    # single-process oracle in THIS process
+    n = 6
+    c = Circuit(n)
+    for q_ in range(n):
+        c.h(q_)
+    c.damp(0, 0.3)
+    c.cnot(0, n - 1)
+    c.dephase(n - 1, 0.2)
+    env1 = qt.createQuESTEnv(num_devices=1, seed=[7])
+    prog = c.compile_trajectories(env1)
+    psi = np.zeros(1 << n, dtype=np.complex128)
+    psi[0] = 1.0
+    key = jax.random.PRNGKey(99)
+    out = np.asarray(prog.run_batch(pack(psi), 16, key=key))
+    mean, err = prog.expectation([[(0, 3)]], [1.0], pack(psi), 64,
+                                 key=key, wave_size=16)
+    assert results[0]["first_row"][0] == pytest.approx(
+        float(out[0, 0, 0]), abs=1e-12)
+    assert results[0]["mean"] == pytest.approx(mean, abs=1e-12)
