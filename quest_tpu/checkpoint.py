@@ -45,6 +45,9 @@ def atomic_savez(path: str, **arrays) -> None:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+    # quest: allow-broad-except(cleanup-and-reraise: the temp file must
+    # be unlinked on ANY interruption, including KeyboardInterrupt --
+    # the exception always propagates)
     except BaseException:
         try:
             os.unlink(tmp)

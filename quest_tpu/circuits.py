@@ -53,6 +53,7 @@ from .env import QuESTEnv
 from .qureg import Qureg
 from .resilience import faults as _faults
 from .resilience import health as _health
+from .telemetry.tracing import dispatch_annotation
 from .types import PauliOpType
 
 __all__ = ["Circuit", "CompiledCircuit", "Param"]
@@ -1304,6 +1305,9 @@ def _schedule_once(recorded: Sequence[_Op], num_qubits: int,
         use_native = nat.available() and (
             cost_model is None or nat.supports_cost_model()) and (
             not two_tier or nat.supports_two_tier())
+    # quest: allow-broad-except(native-availability probe: a missing
+    # compiler/toolchain or broken .so falls back to the bit-identical
+    # Python planner)
     except Exception:
         use_native = False
 
@@ -1946,7 +1950,11 @@ class CompiledCircuit:
         fn = self._aot if (self._aot is not None
                            and self._aot_accepts(state)) else self._jitted
         poison = _faults.fire("circuits.run")
-        qureg.state = fn(state, self._param_vec(params))
+        # QL004: every dispatch boundary carries a fault hook AND a
+        # profiler annotation (device profiles align with host spans)
+        with dispatch_annotation(
+                f"quest_tpu.circuits.run:{self.num_qubits}q"):
+            qureg.state = fn(state, self._param_vec(params))
         qureg.state = _faults.poison_output(poison, qureg.state)
         qureg.state = self._health_tick(
             qureg.state, is_density=qureg.is_density_matrix,
@@ -2682,6 +2690,11 @@ class CompiledCircuit:
         mode = self._batch_policy(B)["mode"]
         pm_run, B = self._padded_params(pm, mode)
         pm_run = self._place_batch(pm_run, mode)
+        # ONE annotation label for both dispatch branches (profiler
+        # span names must group); annotations are built fresh per
+        # entry — a TraceMe must not be re-entered after exit
+        ann_name = (f"quest_tpu.circuits.sweep:b{pm_run.shape[0]}:"
+                    f"{tier.name if tier is not None else 'env'}")
         # coerce BEFORE shape-dispatching: a nested list has no .ndim,
         # and a wrong-width or wrong-dtype shared state must fail here
         # with a shaped error, not deep inside the trace
@@ -2707,12 +2720,14 @@ class CompiledCircuit:
             out = None
             if aot is not None:
                 try:
-                    out = aot(state_f, pm_run)
+                    with dispatch_annotation(ann_name):
+                        out = aot(state_f, pm_run)
                 except (TypeError, ValueError):
                     out = None   # layout/placement drift: retrace via jit
             if out is None:
-                out = self._batched_fn(True, False, mode,
-                                       tier)(state_f, pm_run)
+                with dispatch_annotation(ann_name):
+                    out = self._batched_fn(True, False, mode,
+                                           tier)(state_f, pm_run)
         else:
             planes = state_f
             if planes.shape != (B, 2, 1 << n):
@@ -2724,7 +2739,9 @@ class CompiledCircuit:
                     [planes, jnp.zeros((pm_run.shape[0] - B,) +
                                        planes.shape[1:], planes.dtype)])
             planes = self._place_batch(planes, mode, amp_shardable=True)
-            out = self._batched_fn(False, True, mode, tier)(planes, pm_run)
+            with dispatch_annotation(ann_name):
+                out = self._batched_fn(False, True, mode,
+                                       tier)(planes, pm_run)
         self._record_batch_stats(B, mode, B - 1)
         out = out[:B] if out.shape[0] != B else out
         out = _faults.poison_output(poison, out)
@@ -2786,13 +2803,18 @@ class CompiledCircuit:
         aot = self._aot_lookup(self._warm_form_key("energy", mode, tier),
                                args)
         out = None
+        ann_name = (f"quest_tpu.circuits.expectation_sweep:"
+                    f"b{pm_run.shape[0]}:t{T}:"
+                    f"{tier.name if tier is not None else 'env'}")
         if aot is not None:
             try:
-                out = aot(*args)
+                with dispatch_annotation(ann_name):
+                    out = aot(*args)
             except (TypeError, ValueError):
                 out = None     # layout/placement drift: retrace via jit
         if out is None:
-            out = fn(*args)
+            with dispatch_annotation(ann_name):
+                out = fn(*args)
         # the engine-off path is B runs x (>= 1 sync per point; the
         # reference: one per term per point) — the engine's whole sweep
         # is one (B,) transfer

@@ -100,8 +100,8 @@ def host_topology(mesh, num_hosts: Optional[int] = None) -> HostTopology:
     if num_hosts is None:
         try:
             procs = [int(getattr(d, "process_index", 0)) for d in devs]
-        except Exception:
-            procs = [0] * n_dev
+        except (AttributeError, TypeError, ValueError):
+            procs = [0] * n_dev    # backend without process indices
         num_hosts = len(set(procs))
         if num_hosts > 1:
             # the geometric invariants, checked on the REAL grouping
@@ -165,7 +165,7 @@ def bootstrap(coordinator_address: Optional[str] = None,
         try:
             jax.config.update("jax_cpu_collectives_implementation",
                               "gloo")
-        except Exception:
+        except (AttributeError, KeyError, ValueError):
             pass    # older jax/jaxlib without the knob: best effort
     jax.distributed.initialize(coordinator_address,
                                num_processes=num_processes,

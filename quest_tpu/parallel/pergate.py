@@ -37,6 +37,7 @@ from ..compat import shard_map
 from ..core.packing import pack, unpack
 from ..env import AMP_AXIS
 from ..resilience import faults as _faults
+from ..telemetry.tracing import dispatch_annotation
 from .exchange import (plan_exchange, run_exchange, apply_op_local,
                        apply_1q_cross_shard, overlap_eligible,
                        run_exchange_overlapped)
@@ -209,7 +210,8 @@ def canonicalise(qureg) -> None:
     _maybe_inject(qureg, "pergate.relayout")
     global RELAYOUT_COUNT
     RELAYOUT_COUNT += 1
-    qureg.state = fn(qureg.state)
+    with dispatch_annotation("quest_tpu.pergate.relayout"):
+        qureg.state = fn(qureg.state)
     qureg.layout = None
 
 
@@ -266,7 +268,8 @@ def localise_targets(qureg, targets) -> np.ndarray:
     _maybe_inject(qureg, "pergate.relayout")
     global RELAYOUT_COUNT
     RELAYOUT_COUNT += 1
-    qureg.state = fn(qureg.state)
+    with dispatch_annotation("quest_tpu.pergate.relayout"):
+        qureg.state = fn(qureg.state)
     qureg.layout = new_perm
     return new_perm
 
@@ -295,7 +298,8 @@ def sharded_unitary(qureg, u_packed, targets, ctrl_mask, flip_mask) -> None:
     if len(targets) == 1 and phys_t[0] >= lt:
         cmask, fmask = _phys_masks(perm, ctrl_mask, flip_mask)
         fn = _cross_1q_fn(mesh, n, s, phys_t[0], cmask, fmask)
-        qureg.state = fn(qureg.state, u_packed)
+        with dispatch_annotation("quest_tpu.pergate.gate:xshard"):
+            qureg.state = fn(qureg.state, u_packed)
         return
     if any(p >= lt for p in phys_t):
         if overlap_enabled():
@@ -314,14 +318,17 @@ def sharded_unitary(qureg, u_packed, targets, ctrl_mask, flip_mask) -> None:
                     fmask)
                 global RELAYOUT_COUNT
                 RELAYOUT_COUNT += 1
-                qureg.state = fn(qureg.state, u_packed)
+                with dispatch_annotation(
+                        "quest_tpu.pergate.gate:overlap"):
+                    qureg.state = fn(qureg.state, u_packed)
                 qureg.layout = new_perm
                 return
         perm = localise_targets(qureg, tuple(targets))
         phys_t = tuple(int(perm[t]) for t in targets)
     cmask, fmask = _phys_masks(perm, ctrl_mask, flip_mask)
     fn = _gate_fn(mesh, n, s, phys_t, cmask, fmask)
-    qureg.state = fn(qureg.state, u_packed)
+    with dispatch_annotation("quest_tpu.pergate.gate:local"):
+        qureg.state = fn(qureg.state, u_packed)
 
 
 def sharded_diag(qureg, tensor_np, qs_desc) -> None:

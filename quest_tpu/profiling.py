@@ -181,6 +181,9 @@ def _measure_tier(mesh, pairs, probe_bytes, trials) -> Optional[tuple]:
         if beta <= 0.0 or not np.isfinite(alpha) or not np.isfinite(beta):
             return None
         return (max(alpha, 0.0), beta)
+    # quest: allow-broad-except(calibration boundary: a failed or
+    # degenerate microbench fit must fall back to the default model,
+    # never break compile)
     except Exception:
         return None
 
@@ -421,6 +424,8 @@ def _measure_tier_model_locked(env, key, num_qubits, layers):
             drift[t.name] = max(refined, drift[t.name] / 10.0) \
                 if refined < drift[t.name] else refined
         model = TierErrorModel(drift_per_gate=drift, source="measured")
+    # quest: allow-broad-except(calibration boundary: tier-model
+    # measurement failure falls back to the conservative default)
     except Exception:
         model = DEFAULT_TIER_MODEL
     _TIER_MODEL_CACHE[key] = model

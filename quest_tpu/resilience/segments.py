@@ -105,6 +105,9 @@ def checkpointed_run(circuit, qureg, params: Optional[dict] = None, *,
                         qureg.state, is_density=qureg.is_density_matrix,
                         num_qubits=nq, config=health,
                         where=f"segment {k}")
+            # quest: allow-broad-except(classified barrier: classify()
+            # re-raises FATAL; everything else restores the last good
+            # snapshot and re-executes the segment)
             except Exception as e:
                 if classify(e) == FATAL or restarts >= max_restarts:
                     raise
@@ -183,6 +186,8 @@ def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,
                 if str(f["digest"]) == digest and int(f["batch"]) == B:
                     done = int(f["done"])
                     n_saved = int(f["segments"])
+        # quest: allow-broad-except(torn-archive boundary: a corrupt
+        # progress file must mean "start clean", never a crash)
         except Exception:
             # torn/truncated archive (crash mid-write before the atomic
             # rename landed, or pre-atomic leftovers): a corrupt
@@ -208,6 +213,9 @@ def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,
                         f"non-finite planes in sweep rows "
                         f"{[int(done + r) for r in bad]}", kind="nan",
                         rows=tuple(int(done + r) for r in bad))
+            # quest: allow-broad-except(classified barrier: classify()
+            # re-raises FATAL; transient faults re-execute the segment
+            # from the on-disk progress file)
             except Exception as e:
                 if classify(e) == FATAL or restarts >= max_restarts:
                     raise

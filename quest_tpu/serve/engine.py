@@ -1017,7 +1017,11 @@ class SimulationService:
         try:
             results, bad_rows, viol_rows, t_dispatch, padded = \
                 self._dispatch_batch(batch)
-        except Exception as e:  # noqa: BLE001 — classified fault barrier
+        # quest: allow-broad-except(THE classified fault barrier:
+        # classify() routes FATAL/TRANSIENT/POISON/PRECISION to typed
+        # recovery -- narrowing here would strand unknown runtime
+        # faults with no recovery path at all)
+        except Exception as e:
             self._heartbeat = time.monotonic()
             kind = classify(e)
             self._event("fault", program=pkey, kind=kind,
@@ -1129,6 +1133,9 @@ class SimulationService:
         try:
             out = self._dispatch_batch_inner(batch, cc, tier, B, padded,
                                              pm, kind)
+        # quest: allow-broad-except(close-spans-and-reraise: open
+        # dispatch spans must be closed on ANY interruption -- the
+        # exception always propagates to the classified barrier)
         except BaseException as e:
             for req in traced:
                 if req.dspan is not None:
@@ -1139,8 +1146,8 @@ class SimulationService:
         if traced:
             try:
                 mode = cc.dispatch_stats().batch_sharding_mode
-            except Exception:
-                mode = ""
+            except (AttributeError, KeyError, RuntimeError):
+                mode = ""    # stats shape drift: the span just loses it
             extra = {}
             if kind == KIND_TRAJECTORY:
                 info = getattr(cc, "last_traj_stats", None) or {}

@@ -162,6 +162,8 @@ class ServiceMetrics:
         if self.queue_depth_fn is not None:
             try:
                 depth = int(self.queue_depth_fn())
+            # quest: allow-broad-except(exporter boundary: a failing
+            # depth callback reads 0 rather than failing the snapshot)
             except Exception:
                 depth = 0
         return {

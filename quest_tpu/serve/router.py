@@ -487,10 +487,16 @@ class ServiceRouter:
             except DeadlineExceeded as e:
                 self._resolve(work, exc=e)
                 return
+            # quest: allow-broad-except(classified barrier: FATAL
+            # resolves the work with the caller's original error,
+            # everything else is a replica problem to route around)
             except Exception as e:
-                # anything else from a replica's submit() is a replica
-                # problem, not the caller's: route around it (the
-                # supervisor will judge the replica on its next poll)
+                if classify(e) == FATAL:
+                    # caller error (bad params/observables): no replica
+                    # can serve it — burning the exclusion set would
+                    # end in a misleading AllReplicasUnavailable
+                    self._resolve(work, exc=e)
+                    return
                 self._event("replica_submit_error", replica=h.index,
                             error=type(e).__name__)
                 exclude = set(exclude) | {h.index}
@@ -518,6 +524,9 @@ class ServiceRouter:
         # the work forever — resolve with the error instead
         try:
             self._handle_replica_done(work, h, fut)
+        # quest: allow-broad-except(callback barrier: an escaped
+        # exception would kill the replica dispatcher thread and strand
+        # the work; ANY failure must resolve the future instead)
         except Exception as e:
             self._resolve(work, exc=e)
 
@@ -674,6 +683,8 @@ class ServiceRouter:
                                        - spec.reference).max() \
                                 > sp.probe_tol:
                             return False
+        # quest: allow-broad-except(oracle-grade probe: ANY failure --
+        # timeout, typed fault, wrong shape -- means not ready)
         except Exception:
             return False
         return True
@@ -723,8 +734,8 @@ class ServiceRouter:
         try:
             if svc._thread.is_alive():
                 svc.close(drain=False, timeout=1.0)
-        except Exception:
-            pass
+        except (ServeError, RuntimeError, OSError):
+            pass    # best-effort: the replica is being quarantined
         self._reroute_from(h)
 
     def _reroute_from(self, h: _Replica) -> None:
@@ -766,6 +777,9 @@ class ServiceRouter:
             # hedge service for the router's whole lifetime
             try:
                 self._supervise_once()
+            # quest: allow-broad-except(thread barrier: the supervisor
+            # must outlive any single bad poll or quarantine/restart/
+            # hedge service silently ends for the router's lifetime)
             except Exception as e:
                 self.metrics.incr("supervisor_errors")
                 self._event("supervisor_error", error=type(e).__name__)
@@ -865,8 +879,8 @@ class ServiceRouter:
         t0 = time.perf_counter()
         try:
             h.service.close(drain=graceful, timeout=2.0)
-        except Exception:
-            pass
+        except (ServeError, RuntimeError, OSError):
+            pass    # the old service is being replaced regardless
         svc = self._new_service(h.env, index=h.index)
         with self._lock:
             specs = list(self._warm_specs)
@@ -876,6 +890,9 @@ class ServiceRouter:
                          observables=spec.observables, shots=spec.shots)
             warm_s = time.perf_counter() - t0
             ok = self._probe(svc)
+        # quest: allow-broad-except(restart barrier: ANY warm/probe
+        # failure means the replica is not readmitted -- the typed
+        # outcome is the quarantined state, not an exception)
         except Exception:
             warm_s = time.perf_counter() - t0
             ok = False
@@ -899,15 +916,15 @@ class ServiceRouter:
             # probe_failed in the incident timeline
             try:
                 svc.close(drain=False, timeout=1.0)
-            except Exception:
-                pass
+            except (ServeError, RuntimeError, OSError):
+                pass    # best-effort teardown of the failed candidate
             return {"ok": False, "warm_s": warm_s,
                     "ready_s": time.perf_counter() - t0}
         self.metrics.incr("probe_failures")
         try:
             svc.close(drain=False, timeout=1.0)
-        except Exception:
-            pass
+        except (ServeError, RuntimeError, OSError):
+            pass    # best-effort teardown of the failed candidate
         with self._lock:
             if not self._closed:
                 h.state = "quarantined"
@@ -1015,8 +1032,8 @@ class ServiceRouter:
                 t.join(timeout)
             try:
                 h.service.close(drain=drain, timeout=timeout)
-            except Exception:
-                pass
+            except (ServeError, RuntimeError, OSError):
+                pass    # closing: nothing left to fail over to
 
     def __enter__(self) -> "ServiceRouter":
         return self

@@ -103,6 +103,8 @@ def _hash_callable(h, fn, names) -> bool:
                     _hash_array(h, m)
             else:
                 _hash_array(h, out)
+    # quest: allow-broad-except(digest boundary: an unhashable exotic
+    # gate payload means "uncacheable", never a caller-visible error)
     except Exception:
         return False
     return True
@@ -147,7 +149,7 @@ def env_fingerprint(env) -> str:
     try:
         dev = jax.devices()[0]
         kind = getattr(dev, "device_kind", dev.platform)
-    except Exception:
+    except (AttributeError, IndexError, RuntimeError):
         kind = "unknown"
     return "|".join([
         jax.__version__, jax.default_backend(), str(kind),
@@ -194,8 +196,8 @@ class WarmCache:
                               os.path.join(self.root, "xla"))
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", 0.5)
-        except Exception:
-            pass                               # best-effort layering
+        except (AttributeError, KeyError, ValueError):
+            pass    # older jax without the knob: best-effort layering
 
     # -- accounting --------------------------------------------------------
 
@@ -229,6 +231,9 @@ class WarmCache:
             with open(path, "rb") as f:
                 payload = pickle.load(f)
             return deserialize_and_load(*payload)
+        # quest: allow-broad-except(torn-artifact boundary: a corrupt
+        # file or incompatible runtime must read as a MISS, never an
+        # error -- the recompile overwrites the slot)
         except Exception:
             # torn file, incompatible runtime, missing support: treat
             # as absent (the recompile will overwrite the slot)
@@ -240,6 +245,9 @@ class WarmCache:
             from jax.experimental.serialize_executable import serialize
             payload = serialize(compiled)
             blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        # quest: allow-broad-except(backend boundary: executable
+        # serialization support varies by backend/jax version; any
+        # failure means "don't persist", never a serving error)
         except Exception:
             self._incr("errors")
             return False
@@ -290,6 +298,9 @@ class WarmCache:
             _, _, lowered = cc.lower_batched(kind, batch, hamiltonian,
                                              tier=tier)
             compiled = lowered.compile()
+        # quest: allow-broad-except(warm boundary: a form that cannot
+        # lower/compile AOT just skips persistent warming -- the live
+        # jit path still serves it)
         except Exception:
             self._incr("skipped")
             return "skip"

@@ -170,7 +170,10 @@ class MetricsServer:
                     else:
                         self.send_error(404)
                         return
-                except Exception as e:             # never kill the server
+                # quest: allow-broad-except(exporter boundary: one
+                # sick provider answers 500; it must never kill the
+                # metrics server)
+                except Exception as e:
                     self.send_error(500, str(e))
                     return
                 self.send_response(200)

@@ -96,6 +96,9 @@ class Gauge:
         if self.fn is not None:
             try:
                 return float(self.fn())
+            # quest: allow-broad-except(exporter boundary: a failing
+            # gauge callback reads 0 -- the exporter must never take
+            # the service down)
             except Exception:
                 return 0.0
         with self._lock:
@@ -226,6 +229,9 @@ class _Provider:
             return None
         try:
             return fn()
+        # quest: allow-broad-except(exporter boundary: a failing
+        # provider is skipped -- one sick source must not hide the
+        # fleet)
         except Exception:
             return None
 

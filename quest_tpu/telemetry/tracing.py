@@ -333,5 +333,8 @@ def dispatch_annotation(name: str):
     try:
         import jax
         return jax.profiler.TraceAnnotation(name)
+    # quest: allow-broad-except(telemetry boundary: a missing/broken
+    # profiler API degrades to a null context -- telemetry must never
+    # be the import that breaks a backend)
     except Exception:
         return contextlib.nullcontext()

@@ -13,5 +13,7 @@ TPU chip at its precision's tolerance.
 from .golden import (
     GATE_SPECS, generate_files, run_file, GoldenFailure,
 )
+from .lockcheck import LockOrderViolation
 
-__all__ = ["GATE_SPECS", "generate_files", "run_file", "GoldenFailure"]
+__all__ = ["GATE_SPECS", "generate_files", "run_file", "GoldenFailure",
+           "LockOrderViolation"]

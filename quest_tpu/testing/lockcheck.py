@@ -1,0 +1,431 @@
+"""Runtime lock-order validation for the quest_tpu thread soup.
+
+19 locks across 11 modules guard the dispatcher/supervisor/watchdog
+threads, and nothing enforced a consistent acquisition order — an
+inversion (thread 1 takes A then B, thread 2 takes B then A) deadlocks
+a replica only under production interleavings. This module turns the
+invariant into a *deterministic test failure*:
+
+- under ``QUEST_TPU_LOCKCHECK=1`` (tier-1 conftest enables it),
+  :func:`install` wraps ``threading.Lock`` / ``threading.RLock`` /
+  ``threading.Condition`` so every lock **created from quest_tpu
+  code** is a tracked proxy tagged with its creation site
+  (``module:line`` — one graph node per site, shared by every instance,
+  so replica 0 and replica 1 teach the same ordering rules);
+- each thread keeps its held-set; every acquisition of B while holding
+  A records the edge ``A -> B`` in a process-global acquisition-order
+  graph (with the acquire site of first observation);
+- an acquisition that closes a cycle raises a typed
+  :class:`LockOrderViolation` naming BOTH lock sites and both acquire
+  sites — the would-be deadlock, surfaced on the first run that
+  exercises either order, not the unlucky one that interleaves them;
+- every violation is also recorded process-globally
+  (:func:`violations`), so a violation swallowed by a recovery path's
+  broad handler still fails the suite (the conftest asserts the list
+  is empty at session end).
+
+Reentrant acquisition of the same lock (RLock, the Condition idiom,
+and the shared-instance Counter-family lock in ``serve/metrics.py``)
+never adds edges. Overhead is a dict update per acquisition — noise
+against an engine dispatch.
+"""
+
+from __future__ import annotations
+
+import contextlib as _contextlib
+import os
+import threading
+
+__all__ = ["LockOrderViolation", "install", "uninstall", "installed",
+           "suspended",
+           "tracked_lock", "graph", "violations", "clear",
+           "assert_clean", "find_cycle"]
+
+
+class LockOrderViolation(RuntimeError):
+    """Two lock sites were acquired in both orders: a latent deadlock.
+
+    ``site_a`` / ``site_b`` name the lock CREATION sites
+    (``module.py:line``); the message carries the acquire sites of both
+    directions."""
+
+    def __init__(self, msg: str, site_a: str = "", site_b: str = ""):
+        super().__init__(msg)
+        self.site_a = site_a
+        self.site_b = site_b
+
+
+# ALL mutable state is anchored on the threading module itself, so the
+# conftest (which loads this file standalone, BEFORE any quest_tpu
+# import can create untracked locks) and the package import
+# (quest_tpu.testing.lockcheck) share one graph, one violation list,
+# one held-set — whichever copy of the module touches them.
+_STATE = getattr(threading, "_quest_tpu_lockcheck", None)
+if _STATE is None:
+    _STATE = {
+        "state_lock": threading.Lock(),   # guards graph + violations
+        "edges": {},                      # site -> {site: acquire_site}
+        "violations": [],
+        "installed": False,
+        "real": {},                       # saved threading factories
+        "tls": threading.local(),
+    }
+    threading._quest_tpu_lockcheck = _STATE
+
+# the exception CLASS is anchored too: the conftest's standalone load
+# and the package import must raise/catch the SAME type, or a
+# `pytest.raises(quest_tpu.testing.LockOrderViolation)` around a real
+# inversion (raised by the other copy's factory) would not catch
+LockOrderViolation = _STATE.setdefault("exc_class", LockOrderViolation)
+
+_state_lock = _STATE["state_lock"]
+_edges: dict = _STATE["edges"]
+_violations: list = _STATE["violations"]
+_real: dict = _STATE["real"]
+_tls = _STATE["tls"]
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SELF = os.path.abspath(__file__)
+
+
+def _held() -> list:
+    """This thread's held stack (innermost last)."""
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _caller_site(depth_limit: int = 12):
+    """The first stack frame inside quest_tpu (excluding this module):
+    the lock's creation/acquire site. None when the creation is not
+    quest_tpu code (those locks stay untracked raw locks)."""
+    import sys
+    frame = sys._getframe(2)
+    for _ in range(depth_limit):
+        if frame is None:
+            return None
+        fn = frame.f_code.co_filename
+        af = os.path.abspath(fn)
+        if af != _SELF and af.startswith(_PKG_DIR + os.sep) \
+                and "threading" not in os.path.basename(fn):
+            rel = os.path.relpath(af, os.path.dirname(_PKG_DIR))
+            return f"{rel.replace(os.sep, '/')}:{frame.f_lineno}"
+        frame = frame.f_back
+    return None
+
+
+def _reach(src: str, dst: str) -> bool:
+    """DFS reachability in the order graph (caller holds _state_lock)."""
+    seen = set()
+    stack = [src]
+    while stack:
+        n = stack.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(_edges.get(n, ()))
+    return False
+
+
+def _path(src: str, dst: str) -> list:
+    """One path src -> dst (caller holds _state_lock; assumes one
+    exists)."""
+    seen = {src: None}
+    stack = [src]
+    while stack:
+        n = stack.pop()
+        if n == dst:
+            out = [n]
+            while seen[n] is not None:
+                n = seen[n]
+                out.append(n)
+            return list(reversed(out))
+        for m in _edges.get(n, {}):
+            if m not in seen:
+                seen[m] = n
+                stack.append(m)
+    return [src, dst]
+
+
+class _HeldEntry:
+    __slots__ = ("site", "proxy", "count")
+
+    def __init__(self, site, proxy):
+        self.site = site
+        self.proxy = proxy
+        self.count = 1
+
+
+class _TrackedLock:
+    """Order-tracking proxy around a real lock primitive.
+
+    Forwards everything it does not intercept (``_is_owned``,
+    ``_release_save``... — the Condition protocol) to the wrapped lock,
+    so it composes with ``threading.Condition`` built on either side.
+    All hold bookkeeping is PER-THREAD (a Condition ``wait`` releases
+    the raw lock underneath while other threads acquire through the
+    proxy — a shared owner field would corrupt; per-thread held entries
+    stay consistent at the wait's entry and exit).
+    """
+
+    __slots__ = ("_lock", "site")
+
+    def __init__(self, raw, site: str):
+        self._lock = raw
+        self.site = site
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _note_acquired(self):
+        held = _held()
+        for e in held:
+            if e.proxy is self:
+                e.count += 1     # reentrant (RLock): no new edges
+                return
+        if held:
+            # the acquire-site stack walk is LAZY: only a first-time
+            # edge (or a violation) pays it — the steady state costs a
+            # dict probe, keeping the checker invisible next to the
+            # serving path's tracing overhead budget
+            acq = None
+            with _state_lock:
+                for e in held:
+                    site = e.site
+                    if site == self.site:
+                        # same creation site: distinct instances of one
+                        # class's lock held together (instance
+                        # hierarchies order themselves)
+                        continue
+                    fwd = _edges.setdefault(site, {})
+                    if self.site in fwd:
+                        continue
+                    if acq is None:
+                        acq = _caller_site() or "<non-quest_tpu frame>"
+                    if _reach(self.site, site):
+                        cyc = _path(self.site, site)
+                        first = _edges.get(cyc[0], {}).get(cyc[1], "?")
+                        msg = (
+                            f"lock-order inversion: acquiring "
+                            f"{self.site} (at {acq}) while holding "
+                            f"{site}, but the reverse order "
+                            f"{' -> '.join(cyc)} was already recorded "
+                            f"(first at {first}) — these locks "
+                            f"deadlock under the wrong interleaving")
+                        v = LockOrderViolation(msg, site_a=site,
+                                               site_b=self.site)
+                        _violations.append(v)
+                        raise v
+                    fwd[self.site] = acq
+        held.append(_HeldEntry(self.site, self))
+
+    def _note_released(self):
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            e = held[i]
+            if e.proxy is self:
+                e.count -= 1
+                if e.count <= 0:
+                    del held[i]
+                return
+
+    # -- lock protocol -----------------------------------------------------
+
+    def acquire(self, *a, **k):
+        got = self._lock.acquire(*a, **k)
+        if got:
+            try:
+                self._note_acquired()
+            except LockOrderViolation:
+                # leave the lock the way a failed acquire leaves it:
+                # unheld — the raiser must not wedge everyone else
+                self._lock.release()
+                raise
+        return got
+
+    def release(self):
+        self._note_released()
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __getattr__(self, name):
+        # Condition protocol (_is_owned/_acquire_restore/_release_save)
+        # and anything else forwards to the raw lock. A Condition wait
+        # releases/reacquires the RAW lock underneath — the held-set
+        # deliberately keeps the lock "held" across the wait, which is
+        # consistent at entry and exit of the wait.
+        return getattr(self._lock, name)
+
+
+def _factory(kind: str):
+    real = _real[kind]
+
+    def make(*args, **kwargs):
+        raw = real(*args, **kwargs)
+        site = _caller_site()
+        if site is None:
+            return raw           # not quest_tpu code: leave untouched
+        return _TrackedLock(raw, f"{site}")
+
+    make.__name__ = f"lockcheck_{kind}"
+    return make
+
+
+def install() -> None:
+    """Wrap the ``threading`` lock factories (idempotent). Only locks
+    created from quest_tpu modules AFTER this call are tracked."""
+    if _STATE["installed"]:
+        return
+    _real["Lock"] = threading.Lock
+    _real["RLock"] = threading.RLock
+    _STATE["installed"] = True
+    threading.Lock = _factory("Lock")
+    threading.RLock = _factory("RLock")
+    # threading.Condition(None) builds its RLock via threading.RLock —
+    # already routed through the patched factory; no separate wrap.
+
+
+def uninstall() -> None:
+    """Restore the real factories (tracked locks already handed out
+    keep tracking — they are still valid locks)."""
+    if not _STATE["installed"]:
+        return
+    threading.Lock = _real.pop("Lock")
+    threading.RLock = _real.pop("RLock")
+    _STATE["installed"] = False
+
+
+def installed() -> bool:
+    return bool(_STATE["installed"])
+
+
+@_contextlib.contextmanager
+def suspended():
+    """Temporarily restore the raw ``threading`` factories: locks
+    CREATED inside the block are untracked. For perf-measurement
+    scopes (bench.py's tracing-overhead rows) whose contract is the
+    production runtime's cost — the validator is a test-tier
+    instrument, and a benchmark must not measure it. Locks created
+    before the block keep tracking; no-op when not installed."""
+    was = bool(_STATE["installed"])
+    if was:
+        uninstall()
+    try:
+        yield
+    finally:
+        if was:
+            install()
+
+
+def enabled_by_env() -> bool:
+    """The conftest knob: ``QUEST_TPU_LOCKCHECK=1`` (default OFF
+    outside the test tiers)."""
+    return os.environ.get("QUEST_TPU_LOCKCHECK", "0") \
+        not in ("0", "", "off")
+
+
+def tracked_lock(site: str, rlock: bool = False) -> _TrackedLock:
+    """A tracked lock with an EXPLICIT site label — the test hook
+    (tests are outside quest_tpu, so the creation-site filter would
+    skip their locks)."""
+    real = _real.get("RLock" if rlock else "Lock")
+    if real is None:
+        real = threading.RLock if rlock else threading.Lock
+    return _TrackedLock(real(), site)
+
+
+# -- inspection -------------------------------------------------------------
+
+def graph() -> dict:
+    """A copy of the acquisition-order graph:
+    ``{site: {site: first_acquire_site}}``."""
+    with _state_lock:
+        return {a: dict(b) for a, b in _edges.items()}
+
+
+def find_cycle():
+    """A cycle in the current graph (``[site, ..., site]``), or None.
+    The edge-insertion check should make this impossible — this is the
+    session-end double-entry bookkeeping."""
+    with _state_lock:
+        edges = {a: list(b) for a, b in _edges.items()}
+    color: dict = {}
+    stack: list = []
+
+    def dfs(n):
+        color[n] = 1
+        stack.append(n)
+        for m in edges.get(n, ()):
+            if color.get(m, 0) == 1:
+                return stack[stack.index(m):] + [m]
+            if color.get(m, 0) == 0:
+                hit = dfs(m)
+                if hit:
+                    return hit
+        stack.pop()
+        color[n] = 2
+        return None
+
+    for n in sorted(edges):
+        if color.get(n, 0) == 0:
+            hit = dfs(n)
+            if hit:
+                return hit
+    return None
+
+
+def violations() -> list:
+    """Every :class:`LockOrderViolation` raised so far — including ones
+    swallowed by broad exception handlers downstream (the conftest
+    asserts this is empty at session end)."""
+    with _state_lock:
+        return list(_violations)
+
+
+def clear(site_prefix: str = "") -> None:
+    """Drop recorded violations and graph nodes whose site starts with
+    ``site_prefix`` (everything when empty) — the cleanup hook for
+    tests that PROVE a deliberate inversion raises."""
+    with _state_lock:
+        if not site_prefix:
+            _violations.clear()
+            _edges.clear()
+            return
+        _violations[:] = [
+            v for v in _violations
+            if not (v.site_a.startswith(site_prefix)
+                    or v.site_b.startswith(site_prefix))]
+        for a in list(_edges):
+            if a.startswith(site_prefix):
+                del _edges[a]
+                continue
+            for b in list(_edges[a]):
+                if b.startswith(site_prefix):
+                    del _edges[a][b]
+
+
+def assert_clean() -> None:
+    """Raise if any violation was recorded or the graph holds a cycle
+    (the tier-1 session-end gate)."""
+    vs = violations()
+    if vs:
+        raise AssertionError(
+            f"{len(vs)} LockOrderViolation(s) were raised during the "
+            f"run (possibly swallowed downstream): "
+            + "; ".join(str(v) for v in vs[:3]))
+    cyc = find_cycle()
+    if cyc is not None:
+        raise AssertionError(
+            f"lock acquisition graph holds a cycle: "
+            f"{' -> '.join(cyc)}")

@@ -13,6 +13,26 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Runtime lock-order validation (quest_tpu/testing/lockcheck.py): ON by
+# default in the test tiers (QUEST_TPU_LOCKCHECK=0 opts out). The module
+# is loaded STANDALONE by file path — importing quest_tpu.testing here
+# would run the package __init__ and create its module-level locks
+# (e.g. the global MetricsRegistry) before install() could track them.
+# State is process-global (anchored on the threading module), so the
+# copy tests import through the package shares this one's graph.
+os.environ.setdefault("QUEST_TPU_LOCKCHECK", "1")
+_lockcheck = None
+if os.environ["QUEST_TPU_LOCKCHECK"] not in ("0", "", "off"):
+    import importlib.util as _ilu
+
+    _lc_spec = _ilu.spec_from_file_location(
+        "quest_tpu_lockcheck_boot",
+        os.path.join(os.path.dirname(__file__), os.pardir, "quest_tpu",
+                     "testing", "lockcheck.py"))
+    _lockcheck = _ilu.module_from_spec(_lc_spec)
+    _lc_spec.loader.exec_module(_lockcheck)
+    _lockcheck.install()
+
 import jax  # noqa: E402
 
 # The image's sitecustomize force-registers the TPU plugin; an in-process
@@ -46,6 +66,17 @@ def pytest_collection_modifyitems(config, items):
             mod = mod[:-3]
         if mod in FAST_MODULES:
             it.add_marker(pytest.mark.fast)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockcheck_gate():
+    """Session-end gate for the runtime lock-order validator: zero
+    :class:`LockOrderViolation` recorded (even ones swallowed by broad
+    recovery handlers downstream) and an acyclic acquisition graph.
+    A violation here is a latent deadlock — fix the nesting order."""
+    yield
+    if _lockcheck is not None:
+        _lockcheck.assert_clean()
 
 
 @pytest.fixture

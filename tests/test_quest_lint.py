@@ -1,0 +1,482 @@
+"""quest-lint: per-rule positive/negative fixtures, the ratchet
+round-trip, the mirror lock, and the repo self-check (the merge
+acceptance criterion as a regression test)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.quest_lint import engine, mirror, rules  # noqa: E402
+
+
+def make_file(tmp_path, rel, source):
+    """A SourceFile whose REL path (what rules scope on) is chosen
+    independently of where the bytes live."""
+    p = tmp_path / rel.replace("/", "__")
+    p.write_text(textwrap.dedent(source))
+    return engine.SourceFile(str(p), rel)
+
+
+def codes(violations):
+    return [v.rule for v in violations]
+
+
+# -- QL001 ------------------------------------------------------------------
+
+class TestQL001HostSync:
+    SNIPPET = """
+        import numpy as np
+        def dispatch(x, arr):
+            a = float(x)
+            b = arr.item()
+            c = np.asarray(arr)
+            arr.block_until_ready()
+            return a, b, c
+    """
+
+    def test_flags_all_four_sync_forms_in_hot_path(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/serve/hot.py", self.SNIPPET)
+        vs = rules.rule_ql001_host_sync([f], ROOT)
+        assert codes(vs) == ["QL001"] * 4
+        assert {v.line for v in vs} == {4, 5, 6, 7}
+
+    def test_cold_path_files_are_out_of_scope(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/validation.py", self.SNIPPET)
+        assert rules.rule_ql001_host_sync([f], ROOT) == []
+
+    def test_doubledouble_is_exempt_by_construction(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/ops/doubledouble.py",
+                      self.SNIPPET)
+        assert rules.rule_ql001_host_sync([f], ROOT) == []
+
+    def test_float_of_literal_is_not_a_sync(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/serve/hot.py",
+                      "x = float(1.5)\n")
+        assert rules.rule_ql001_host_sync([f], ROOT) == []
+
+    def test_suppression_comment_clears_it(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/serve/hot.py", """
+            def dispatch(arr):
+                # quest: allow-host-sync(result materialization)
+                return arr.item()
+        """)
+        vs = [v for v in rules.rule_ql001_host_sync([f], ROOT)
+              if not f.suppressed(v.rule, v.line)]
+        assert vs == []
+
+
+# -- QL002 ------------------------------------------------------------------
+
+class TestQL002CacheKeys:
+    def test_key_missing_tier_flags(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/circuits2.py", """
+            class C:
+                def get(self, mode):
+                    key = (mode, str(self.env.dtype))
+                    fn = self._batched_cache.get(key)
+                    self._batched_cache[key] = fn
+        """)
+        vs = rules.rule_ql002_cache_keys([f], ROOT)
+        assert codes(vs) == ["QL002"]
+        assert "tier" in vs[0].message
+
+    def test_complete_key_passes(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/circuits2.py", """
+            class C:
+                def get(self, mode, tier):
+                    key = ("sweep", mode, self._dt_token(),
+                           self._tier_token(tier))
+                    self._batched_cache[key] = 1
+        """)
+        assert rules.rule_ql002_cache_keys([f], ROOT) == []
+
+    def test_cached_helper_call_sites_are_insertion_sites(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/engine2.py", """
+            class T:
+                def fn(self, mode):
+                    return self._cached(("x",), lambda: 1)
+        """)
+        vs = rules.rule_ql002_cache_keys([f], ROOT)
+        assert codes(vs) == ["QL002"]
+
+    def test_tier_exempt_file_needs_no_tier(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/ops/trajectories.py", """
+            class T:
+                def fn(self, mode):
+                    return self._cached(
+                        ("tsweep", mode, self._dt_token()), lambda: 1)
+        """)
+        assert rules.rule_ql002_cache_keys([f], ROOT) == []
+
+
+# -- QL003 ------------------------------------------------------------------
+
+class TestQL003UntypedExcept:
+    def test_flags_bare_and_broad(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/x.py", """
+            try:
+                pass
+            except Exception:
+                pass
+            try:
+                pass
+            except:
+                pass
+            try:
+                pass
+            except (ValueError, RuntimeError):
+                pass
+        """)
+        vs = rules.rule_ql003_untyped_except([f], ROOT)
+        assert codes(vs) == ["QL003", "QL003"]
+
+    def test_annotated_catch_all_is_suppressed(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/x.py", """
+            try:
+                pass
+            # quest: allow-broad-except(boundary: any failure means
+            # fall back to the default)
+            except Exception:
+                pass
+        """)
+        vs = [v for v in rules.rule_ql003_untyped_except([f], ROOT)
+              if not f.suppressed(v.rule, v.line)]
+        assert vs == []
+
+    def test_empty_reason_is_a_grammar_error_not_a_suppression(
+            self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/x.py", """
+            try:
+                pass
+            except Exception:  # quest: allow-broad-except()
+                pass
+        """)
+        assert codes(f.suppress_errors) == ["QL000"]
+        vs = [v for v in rules.rule_ql003_untyped_except([f], ROOT)
+              if not f.suppressed(v.rule, v.line)]
+        assert codes(vs) == ["QL003"]
+
+    def test_unknown_slug_is_a_grammar_error(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/x.py",
+                      "# quest: allow-everything(sure)\n")
+        assert codes(f.suppress_errors) == ["QL000"]
+
+
+# -- QL004 ------------------------------------------------------------------
+
+FAKE_FAULTS = """
+    SITES = (
+        "circuits.run",
+        "serve.execute",
+    )
+"""
+
+
+class TestQL004DispatchBoundaries:
+    def test_fire_without_annotation_flags(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS)
+        eng = make_file(tmp_path, "quest_tpu/serve/engine.py", """
+            from ..resilience import faults as _faults
+            def _dispatch(batch):
+                poison = _faults.fire("serve.execute")
+                return run(batch)
+            def _run2():
+                _faults.fire("circuits.run")
+        """)
+        # note: _run2 keeps "circuits.run" referenced so only the
+        # missing-annotation check fires, twice (both functions)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, eng], ROOT)
+        assert codes(vs) == ["QL004", "QL004"]
+        assert all("annotation" in v.message for v in vs)
+
+    def test_fire_with_annotation_passes(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS)
+        eng = make_file(tmp_path, "quest_tpu/serve/engine.py", """
+            def _dispatch(batch):
+                poison = _faults.fire("serve.execute")
+                with dispatch_annotation("quest_tpu.serve.dispatch"):
+                    return run(batch)
+            def _other():
+                _maybe_inject(q, "circuits.run")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        assert rules.rule_ql004_dispatch_boundaries(
+            [faults, eng], ROOT) == []
+
+    def test_deleted_hook_site_is_a_coverage_loss(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS)
+        eng = make_file(tmp_path, "quest_tpu/serve/engine.py", """
+            def _dispatch(batch):
+                poison = _faults.fire("serve.execute")
+                with dispatch_annotation("d"):
+                    return run(batch)
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, eng], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "circuits.run" in vs[0].message
+
+
+# -- QL005 ------------------------------------------------------------------
+
+class TestQL005TraceHeader:
+    GOOD = """
+        import argparse
+        import _trace_io
+        def main():
+            p = argparse.ArgumentParser()
+            _trace_io.add_output_argument(p)
+            args = p.parse_args()
+            _trace_io.emit({}, "demo", args.out)
+    """
+
+    def test_complete_dumper_passes(self, tmp_path):
+        f = make_file(tmp_path, "tools/demo_trace.py", self.GOOD)
+        assert rules.rule_ql005_trace_header([f], ROOT) == []
+
+    def test_missing_emit_flags(self, tmp_path):
+        f = make_file(tmp_path, "tools/demo_trace.py", """
+            import json
+            def main():
+                print(json.dumps({}))
+        """)
+        vs = rules.rule_ql005_trace_header([f], ROOT)
+        assert codes(vs) == ["QL005"]
+        assert "import _trace_io" in vs[0].message
+
+    def test_non_trace_tools_are_out_of_scope(self, tmp_path):
+        f = make_file(tmp_path, "tools/probe.py", "print('hi')\n")
+        assert rules.rule_ql005_trace_header([f], ROOT) == []
+
+
+# -- QL006 ------------------------------------------------------------------
+
+class TestQL006LockOrder:
+    def test_opposite_nesting_is_a_cycle(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/serve/locks.py", """
+            import threading
+            class A:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+                def one(self):
+                    with self._la:
+                        with self._lb:
+                            pass
+                def two(self):
+                    with self._lb:
+                        with self._la:
+                            pass
+        """)
+        vs = rules.rule_ql006_lock_order([f], ROOT)
+        assert any("cycle" in v.message for v in vs)
+        msg = next(v.message for v in vs if "cycle" in v.message)
+        assert "_la" in msg and "_lb" in msg
+
+    def test_one_hop_call_expansion_finds_the_cycle(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/serve/locks.py", """
+            import threading
+            class A:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+                def takes_b(self):
+                    with self._lb:
+                        pass
+                def one(self):
+                    with self._la:
+                        self.takes_b()
+                def two(self):
+                    with self._lb:
+                        with self._la:
+                            pass
+        """)
+        vs = rules.rule_ql006_lock_order([f], ROOT)
+        assert any("cycle" in v.message for v in vs)
+
+    def test_blocking_call_under_lock_flags(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/telemetry/reg.py", """
+            import threading
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def bad(self, fut):
+                    with self._lock:
+                        return fut.result()
+        """)
+        vs = rules.rule_ql006_lock_order([f], ROOT)
+        assert codes(vs) == ["QL006"]
+        assert "Future.result" in vs[0].message
+
+    def test_condition_self_wait_is_legitimate(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/serve/eng2.py", """
+            import threading
+            class S:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                def loop(self):
+                    with self._cond:
+                        self._cond.wait(timeout=0.1)
+        """)
+        assert rules.rule_ql006_lock_order([f], ROOT) == []
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/serve/locks.py", """
+            import threading
+            class A:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+                def one(self):
+                    with self._la:
+                        with self._lb:
+                            pass
+                def two(self):
+                    with self._la:
+                        with self._lb:
+                            pass
+        """)
+        assert rules.rule_ql006_lock_order([f], ROOT) == []
+
+
+# -- QL007 ------------------------------------------------------------------
+
+class TestQL007Mirror:
+    GROUPS = {
+        "demo": (
+            ("side.py", "py", "table"),
+            ("side.cc", "cc", (r"^int table", r"^\}")),
+        ),
+    }
+
+    def _write_pair(self, root, py_body, cc_body):
+        (root / "side.py").write_text(py_body)
+        (root / "side.cc").write_text(cc_body)
+
+    def test_locked_pair_passes_and_drift_fails(self, tmp_path):
+        root = tmp_path
+        self._write_pair(root, "table = [1, 2, 3]\n",
+                         "int table() {\n  return 1;\n}\n")
+        lock = str(tmp_path / "lock.json")
+        digests, missing = mirror.current_digests(str(root), self.GROUPS)
+        assert not missing
+        with open(lock, "w") as fh:
+            json.dump({"groups": digests}, fh)
+        assert mirror.check_mirror(str(root), lock, self.GROUPS) == []
+        # one-sided change: the python table moves, the cc twin does not
+        self._write_pair(root, "table = [1, 2, 4]\n",
+                         "int table() {\n  return 1;\n}\n")
+        vs = mirror.check_mirror(str(root), lock, self.GROUPS)
+        assert codes(vs) == ["QL007"]
+        assert "side.py" in vs[0].message and "side.cc" in vs[0].message
+
+    def test_comment_and_whitespace_churn_is_not_drift(self, tmp_path):
+        root = tmp_path
+        self._write_pair(root, "table = [1, 2, 3]\n",
+                         "int table() {\n  return 1;\n}\n")
+        lock = str(tmp_path / "lock.json")
+        digests, _ = mirror.current_digests(str(root), self.GROUPS)
+        with open(lock, "w") as fh:
+            json.dump({"groups": digests}, fh)
+        self._write_pair(
+            root, "table = [1,   2, 3]  # reformat only\n",
+            "int table() {\n  // a comment\n  return   1;\n}\n")
+        assert mirror.check_mirror(str(root), lock, self.GROUPS) == []
+
+    def test_missing_extract_reports(self, tmp_path):
+        root = tmp_path
+        self._write_pair(root, "other = 1\n", "int nope;\n")
+        vs = mirror.check_mirror(str(root), str(tmp_path / "nolock"),
+                                 self.GROUPS)
+        assert all(v.rule == "QL007" for v in vs)
+        assert vs  # missing extracts + missing lock
+
+
+# -- ratchet ----------------------------------------------------------------
+
+class TestRatchet:
+    def _violations(self, n, rule="QL001",
+                    path="quest_tpu/serve/hot.py"):
+        return [engine.Violation(rule, path, i + 1, "msg")
+                for i in range(n)]
+
+    def test_round_trip(self, tmp_path):
+        base_path = str(tmp_path / "baseline.json")
+        vs = self._violations(3)
+        # 1. no baseline: everything is new
+        new, stale, always = engine.diff_baseline(vs, {})
+        assert len(new) == 3 and not stale and not always
+        # 2. accept: clean
+        engine.save_baseline(vs, base_path)
+        baseline = engine.load_baseline(base_path)
+        new, stale, always = engine.diff_baseline(vs, baseline)
+        assert not new and not stale and not always
+        # 3. a NEW violation in the same file fails
+        new, stale, _ = engine.diff_baseline(self._violations(4),
+                                             baseline)
+        assert len(new) == 4 and not stale
+        # 4. fixing one makes the baseline STALE (bar must tighten)
+        new, stale, _ = engine.diff_baseline(self._violations(2),
+                                             baseline)
+        assert not new
+        assert stale == [("QL001", "quest_tpu/serve/hot.py", 3, 2)]
+        # 5. fixing the whole file is stale too
+        new, stale, _ = engine.diff_baseline([], baseline)
+        assert not new
+        assert stale == [("QL001", "quest_tpu/serve/hot.py", 3, 0)]
+
+    def test_ql000_is_never_baselineable(self, tmp_path):
+        vs = [engine.Violation("QL000", "quest_tpu/x.py", 1, "bad")]
+        assert engine.counts_of(vs) == {}
+        _, _, always = engine.diff_baseline(vs, {})
+        assert len(always) == 1
+
+
+# -- the repo itself --------------------------------------------------------
+
+class TestRepoSelfCheck:
+    @pytest.fixture(scope="class")
+    def repo_result(self):
+        files = engine.discover(ROOT)
+        violations = engine.run_rules(files, ROOT)
+        return files, violations
+
+    def test_repo_is_clean_against_its_baseline(self, repo_result):
+        """The merge acceptance criterion, as a regression: quest-lint
+        exits 0 — every count matches the ratchet, the mirror lock is
+        current, no grammar errors."""
+        _files, violations = repo_result
+        new, stale, always = engine.diff_baseline(
+            violations, engine.load_baseline())
+        assert not always, [v.render() for v in always]
+        assert not new, [v.render() for v in new]
+        assert not stale, stale
+
+    def test_static_lock_graph_is_cycle_free(self, repo_result):
+        files, _ = repo_result
+        edges, blocking = rules.build_lock_graph(files)
+        assert rules.find_cycles(edges) == []
+        assert blocking == []
+
+    def test_every_faults_site_is_covered(self, repo_result):
+        files, violations = repo_result
+        assert not [v for v in violations
+                    if v.rule == "QL004"], "dispatch boundaries drifted"
+
+    def test_cli_exits_zero(self):
+        import subprocess
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.quest_lint"], cwd=ROOT,
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "clean" in proc.stdout

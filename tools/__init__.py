@@ -1,0 +1,5 @@
+# Makes ``tools`` importable so ``python -m tools.quest_lint`` (and the
+# ``quest-lint`` console entry point) resolve from the repo root. The
+# standalone scripts in this directory (``tools/comm_trace.py`` & co.)
+# keep running as plain ``python tools/<name>.py`` — they import their
+# shared helper by file-relative path, not through this package.

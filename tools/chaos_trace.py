@@ -130,6 +130,8 @@ def build_trace(args) -> dict:
             try:
                 outcomes.append(("ok", float(f.result(
                     timeout=args.timeout_s + 30))))
+            # quest: allow-broad-except(replay boundary: the dump
+            # RECORDS every failure class -- that is the tool's job)
             except Exception as e:  # typed failure — record its class
                 outcomes.append((type(e).__name__, None))
         stats = svc.dispatch_stats()

@@ -29,6 +29,8 @@ import numpy as np, jax, jax.numpy as jnp
 try:
     jax.config.update("jax_compilation_cache_dir", %r)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# quest: allow-broad-except(probe child: cache knobs are best-effort
+# on whatever jax version the probe runs against)
 except Exception:
     pass
 nq = int(sys.argv[1])

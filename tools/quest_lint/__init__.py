@@ -1,0 +1,42 @@
+"""quest-lint: repo-invariant static analysis for quest_tpu.
+
+The stack enforces its correctness story by convention — tiers must key
+every executable cache, every dispatch boundary must carry a fault hook
+and a trace annotation, hot paths must avoid host syncs, 19 locks across
+11 modules must keep a consistent acquisition order. This package turns
+those conventions into *checked* named rules (QuEST itself dedicates a
+whole layer to machine-checked preconditions — ``QuEST_validation.c``,
+arXiv:1802.08032; quest-lint is that layer for THIS repo's invariants):
+
+========  ============================================================
+ rule      invariant
+========  ============================================================
+ QL001     no host sync (``float()`` / ``.item()`` / ``np.asarray()``
+           / ``.block_until_ready()``) on a dispatch hot path
+ QL002     every executable-cache insertion keys on tier + dtype +
+           form (the PR-8 invariant)
+ QL003     no bare ``except Exception`` outside the annotated
+           allowlist
+ QL004     every dispatch boundary fires a ``resilience.faults`` hook
+           AND carries a trace annotation; no ``faults.SITES`` entry
+           loses its ``fire()`` call
+ QL005     every ``tools/*_trace.py`` emits the ``quest_tpu.trace/1``
+           header through ``tools/_trace_io.py``
+ QL006     the static lock-acquisition graph is a DAG, and no blocking
+           call runs under a registry/metrics lock
+ QL007     the planner constant tables mirrored between
+           ``parallel/layout.py`` / ``profiling.py`` and
+           ``native/src/scheduler.cc`` move together (mirror lock)
+========  ============================================================
+
+Pre-existing debt lives in a checked-in per-rule/per-file ratchet
+baseline (``baseline.json``): the linter exits nonzero only on NEW
+violations or a STALE baseline entry, so the bar can only tighten.
+Suppression grammar: ``# quest: allow-<slug>(reason)`` on the violating
+line or the line above (see ``docs/dev.md``).
+
+Run ``python -m tools.quest_lint`` (or the ``quest-lint`` entry point);
+``--update-baseline`` re-ratchets, ``--update-mirror`` re-locks QL007.
+"""
+
+__version__ = "1.0"

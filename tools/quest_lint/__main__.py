@@ -1,0 +1,104 @@
+"""CLI driver: ``python -m tools.quest_lint`` / ``quest-lint``.
+
+Exit codes: 0 = clean (every count matches the ratchet baseline and the
+mirror lock), 1 = new violations / stale baseline / mirror drift,
+2 = usage error. ``--update-baseline`` re-ratchets the per-rule/per-file
+counts; ``--update-mirror`` re-locks the QL007 digests (both print what
+changed — commit the JSON next to the code change it blesses).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import __version__
+from .engine import (BASELINE_PATH, REPO_ROOT, diff_baseline, discover,
+                     load_baseline, run_rules, save_baseline)
+from .mirror import LOCK_PATH, save_lock
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="quest-lint",
+        description="repo-invariant static analysis for quest_tpu "
+                    "(rules QL001-QL007; see docs/dev.md)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="scan roots relative to the repo root "
+                             "(default: [tool.quest_lint] paths in "
+                             "pyproject.toml)")
+    parser.add_argument("--root", default=REPO_ROOT,
+                        help="repo root (default: auto-detected)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="accept the current violation counts as "
+                             "the new ratchet baseline")
+    parser.add_argument("--update-mirror", action="store_true",
+                        help="re-lock the QL007 native-mirror digests")
+    parser.add_argument("--list", action="store_true", dest="list_all",
+                        help="print every violation including "
+                             "baselined ones (audit view)")
+    parser.add_argument("--version", action="version",
+                        version=f"quest-lint {__version__}")
+    args = parser.parse_args(argv)
+
+    # quest-lint analyzes SOURCE (including native/src/*.cc for the
+    # QL007 mirror), so it only makes sense against a repo checkout —
+    # from a plain site-packages install the mirror sources don't
+    # exist and every QL007 group would read as spuriously drifted
+    if not os.path.isfile(os.path.join(args.root, "pyproject.toml")) \
+            or not os.path.isdir(os.path.join(args.root, "native")):
+        parser.error(
+            f"--root {args.root!r} is not a repository checkout "
+            f"(pyproject.toml / native/ not found). quest-lint "
+            f"analyzes source; run it from the repo root (or an "
+            f"editable install) or pass --root <checkout>.")
+
+    if args.update_mirror:
+        save_lock(args.root, LOCK_PATH)
+        print(f"mirror lock updated: {LOCK_PATH}")
+        if not args.update_baseline:
+            return 0
+
+    files = discover(args.root, args.paths or None)
+    violations = run_rules(files, args.root)
+
+    if args.update_baseline:
+        rules = save_baseline(violations, BASELINE_PATH)
+        total = sum(sum(f.values()) for f in rules.values())
+        print(f"baseline updated: {BASELINE_PATH} "
+              f"({total} accepted violations across "
+              f"{len(rules)} rules)")
+        grammar = [v for v in violations if v.rule == "QL000"]
+        for v in grammar:
+            print(f"  UNBASELINEABLE {v.render()}")
+        return 1 if grammar else 0
+
+    if args.list_all:
+        for v in violations:
+            print(v.render())
+        print(f"{len(violations)} total (before baseline)")
+
+    new, stale, always = diff_baseline(violations, load_baseline())
+    for v in always:
+        print(v.render())
+    if new:
+        print(f"{len(new)} violation(s) above the ratchet baseline:")
+        for v in new:
+            print(f"  {v.render()}")
+    if stale:
+        print(f"{len(stale)} STALE baseline entr(ies) — the bar "
+              f"tightened; run --update-baseline to commit it:")
+        for rule, path, b, n in stale:
+            print(f"  {rule} {path}: baseline {b} > current {n}")
+    if new or stale or always:
+        return 1
+    n_rules = len({v.rule for v in violations})
+    print(f"quest-lint: clean "
+          f"({len(violations)} baselined violation(s) across "
+          f"{n_rules} rule(s); {len(files)} files scanned)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,296 @@
+"""The quest-lint driver: file discovery, suppressions, ratchet baseline.
+
+The engine is deliberately stdlib-only (``ast`` + ``json`` + ``re``) so
+CI can run it without jax or a device — the rules analyze SOURCE, never
+import the package under analysis.
+
+Three layers:
+
+- :class:`SourceFile` — one parsed file: text, AST (None for non-Python
+  inputs like ``scheduler.cc``), and the suppression table parsed from
+  ``# quest: allow-<slug>(reason)`` comments;
+- :func:`run_rules` — applies every registered rule and drops
+  violations suppressed on their line (or the line above; a suppression
+  with an EMPTY reason suppresses nothing and is itself reported);
+- the **ratchet** (:func:`diff_baseline`) — per-rule/per-file violation
+  counts against ``baseline.json``: more than baselined fails with the
+  new sites, fewer fails as STALE (run ``--update-baseline`` to commit
+  the tightened bar), equal passes. The bar can only move down.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Optional
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(os.path.dirname(HERE))
+BASELINE_PATH = os.path.join(HERE, "baseline.json")
+
+# Scanned roots, relative to the repo root. bench.py is deliberately out
+# of scope: it is a measurement harness whose host syncs and broad
+# excepts are the point, not debt. ``[tool.quest_lint] paths`` in
+# pyproject.toml overrides this (parsed by :func:`configured_paths`).
+DEFAULT_PATHS = ("quest_tpu", "tools")
+
+# suppression-comment grammar: "# quest: allow-<slug>(reason)" — the
+# slug names the rule (long form or bare code), the reason is REQUIRED
+# (an empty reason is a lint error, not a suppression). The reason may
+# continue across following comment lines; the suppression covers the
+# comment block and the first code line after it (or its own line when
+# written inline).
+SUPPRESS_START_RE = re.compile(
+    r"#\s*quest:\s*allow-([a-z0-9-]+)\s*\((.*)$")
+_COMMENT_LINE_RE = re.compile(r"^\s*#\s?(.*)$")
+
+SLUG_TO_RULE = {
+    "host-sync": "QL001",
+    "cache-key": "QL002",
+    "broad-except": "QL003",
+    "dispatch-boundary": "QL004",
+    "trace-header": "QL005",
+    "lock-order": "QL006",
+    "mirror": "QL007",
+}
+for _code in list(SLUG_TO_RULE.values()):
+    SLUG_TO_RULE[_code.lower()] = _code
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str        # repo-relative, posix separators
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+class SourceFile:
+    """One file under analysis (AST parsed lazily for ``.py``)."""
+
+    def __init__(self, abspath: str, rel: str):
+        self.abspath = abspath
+        self.rel = rel.replace(os.sep, "/")
+        with open(abspath, "r", encoding="utf-8") as fh:
+            self.text = fh.read()
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        if abspath.endswith(".py"):
+            try:
+                self.tree = ast.parse(self.text, filename=self.rel)
+            except SyntaxError as e:    # reported as a violation
+                self.parse_error = f"syntax error: {e.msg}"
+        # line -> set of rule codes suppressed there; bad suppressions
+        # (unknown slug / empty reason) are violations in their own
+        # right — a suppression that silently does nothing is worse
+        # than none
+        self.suppress: dict = {}
+        self.suppress_errors: list = []
+        self._parse_suppressions()
+
+    def _parse_suppressions(self) -> None:
+        n = len(self.lines)
+        i = 0
+        while i < n:
+            m = SUPPRESS_START_RE.search(self.lines[i])
+            if m is None:
+                i += 1
+                continue
+            slug = m.group(1)
+            start = i
+            # collect the reason across continuation comment lines
+            # until the BALANCED closing paren (reasons may themselves
+            # contain parens — "classify() routes ...")
+            def _consume(text, depth):
+                part = []
+                for ch in text:
+                    if ch == "(":
+                        depth += 1
+                    elif ch == ")":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    part.append(ch)
+                return "".join(part), depth
+
+            piece, depth = _consume(m.group(2), 1)
+            reason_parts = [piece]
+            closed = depth == 0
+            j = i
+            while not closed and j + 1 < n:
+                j += 1
+                cm = _COMMENT_LINE_RE.match(self.lines[j])
+                if cm is None:
+                    break            # reason block ended unclosed
+                piece, depth = _consume(cm.group(1), depth)
+                reason_parts.append(piece)
+                closed = depth == 0
+            reason = " ".join(p.strip() for p in reason_parts).strip()
+            rule = SLUG_TO_RULE.get(slug)
+            if rule is None:
+                self.suppress_errors.append(Violation(
+                    "QL000", self.rel, start + 1,
+                    f"unknown suppression slug 'allow-{slug}' "
+                    f"(known: {sorted(set(SLUG_TO_RULE))})"))
+            elif not closed or not reason:
+                self.suppress_errors.append(Violation(
+                    "QL000", self.rel, start + 1,
+                    f"suppression 'allow-{slug}' needs a "
+                    f"(non-empty reason): "
+                    f"# quest: allow-{slug}(why this is safe)"))
+            else:
+                # the block's own lines plus the first line after it
+                # (inline comments cover their own line)
+                for ln in range(start + 1, j + 2):
+                    self.suppress.setdefault(ln, set()).add(rule)
+                if j + 2 <= n:
+                    self.suppress.setdefault(j + 2, set()).add(rule)
+            i = j + 1
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A suppression counts on any line of its comment block, the
+        first code line after the block, or (inline form) its own
+        line."""
+        return rule in self.suppress.get(line, ())
+
+
+def configured_paths(root: str) -> tuple:
+    """Scan roots from ``[tool.quest_lint] paths`` in pyproject.toml
+    (minimal single-line list parser — the interpreter floor is 3.10,
+    pre-``tomllib``), falling back to :data:`DEFAULT_PATHS`."""
+    pyproject = os.path.join(root, "pyproject.toml")
+    try:
+        with open(pyproject, "r", encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return DEFAULT_PATHS
+    section = re.search(r"(?ms)^\[tool\.quest_lint\]$(.*?)(?=^\[|\Z)",
+                        text)
+    if section is None:
+        return DEFAULT_PATHS
+    m = re.search(r"(?m)^paths\s*=\s*\[(.*?)\]", section.group(1))
+    if m is None:
+        return DEFAULT_PATHS
+    paths = re.findall(r"\"([^\"]+)\"|'([^']+)'", m.group(1))
+    out = tuple(a or b for a, b in paths)
+    return out or DEFAULT_PATHS
+
+
+def discover(root: str, paths=None) -> list:
+    """Collect the :class:`SourceFile` set: every ``.py`` under the
+    scan roots (skipping caches), plus the native mirror sources QL007
+    reads (``native/src/*.cc``)."""
+    out = []
+    for rel in (paths or configured_paths(root)):
+        top = os.path.join(root, rel)
+        if os.path.isfile(top):
+            out.append(SourceFile(top, os.path.relpath(top, root)))
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    p = os.path.join(dirpath, fn)
+                    out.append(SourceFile(p, os.path.relpath(p, root)))
+    return out
+
+
+def run_rules(files: list, root: str = REPO_ROOT) -> list:
+    """Apply every registered rule; returns unsuppressed violations
+    (plus QL000 suppression-grammar errors and parse failures)."""
+    from . import rules as _rules
+    violations: list = []
+    by_rel = {f.rel: f for f in files}
+    for f in files:
+        violations.extend(f.suppress_errors)
+        if f.parse_error is not None:
+            violations.append(Violation("QL000", f.rel, 1, f.parse_error))
+    for rule_fn in _rules.ALL_RULES:
+        for v in rule_fn(files, root):
+            f = by_rel.get(v.path)
+            if f is not None and f.suppressed(v.rule, v.line):
+                continue
+            violations.append(v)
+    violations.sort(key=lambda v: (v.rule, v.path, v.line))
+    return violations
+
+
+# -- ratchet baseline -------------------------------------------------------
+
+def counts_of(violations: list) -> dict:
+    """``{rule: {path: count}}`` — the ratchet unit. QL000 (grammar /
+    parse errors) is never baselinable: it always fails."""
+    out: dict = {}
+    for v in violations:
+        if v.rule == "QL000":
+            continue
+        out.setdefault(v.rule, {})
+        out[v.rule][v.path] = out[v.rule].get(v.path, 0) + 1
+    return out
+
+
+def load_baseline(path: str = BASELINE_PATH) -> dict:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except OSError:
+        return {}
+    return doc.get("rules", {})
+
+
+def save_baseline(violations: list, path: str = BASELINE_PATH) -> dict:
+    rules = {r: dict(sorted(files.items()))
+             for r, files in sorted(counts_of(violations).items())}
+    doc = {
+        "comment": "quest-lint ratchet: per-rule/per-file counts of "
+                   "ACCEPTED pre-existing violations. The linter fails "
+                   "on any count above these, and on any entry above "
+                   "the current count (stale). Regenerate with: "
+                   "python -m tools.quest_lint --update-baseline",
+        "version": 1,
+        "rules": rules,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return rules
+
+
+def diff_baseline(violations: list, baseline: dict) -> tuple:
+    """``(new, stale, always_fail)``:
+
+    - ``new`` — violations in files whose count exceeds the baselined
+      count (the whole file's violation list is shown so the offender
+      is findable without a line-level baseline format);
+    - ``stale`` — ``(rule, path, baselined, current)`` entries where
+      the baseline promises MORE debt than exists (including files that
+      disappeared): the bar tightened, commit it;
+    - ``always_fail`` — QL000 grammar/parse errors (never baselinable).
+    """
+    current = counts_of(violations)
+    new: list = []
+    stale: list = []
+    for rule, files in current.items():
+        base_files = baseline.get(rule, {})
+        for path, n in files.items():
+            b = int(base_files.get(path, 0))
+            if n > b:
+                new.extend(v for v in violations
+                           if v.rule == rule and v.path == path)
+            elif n < b:
+                stale.append((rule, path, b, n))
+    for rule, base_files in baseline.items():
+        cur_files = current.get(rule, {})
+        for path, b in base_files.items():
+            if path not in cur_files and int(b) > 0:
+                stale.append((rule, path, int(b), 0))
+    always = [v for v in violations if v.rule == "QL000"]
+    return new, sorted(stale), always

@@ -1,0 +1,236 @@
+"""QL007: native-mirror drift guard.
+
+The layout planner's pricing rules live twice — once in Python
+(``quest_tpu/parallel/layout.py`` + the cost model in
+``quest_tpu/profiling.py``) and once in the native scheduler
+(``native/src/scheduler.cc``), which must produce bit-identical plans
+(``tests/test_native_sched.py`` checks behavior, but only for the cases
+it enumerates). mpiQulacs-style hand-mirrored comm schedules are
+exactly the drift hazard (PAPERS.md: arXiv 2203.16044): one side gets a
+constant tweak, the twin silently keeps the old table, and plans
+diverge only on inputs the parity tests never generate.
+
+This guard makes the mirror *lockstep by construction*: named extracts
+(functions / constant tables) are cut from both sides, normalized
+(comments and whitespace dropped), hashed, and compared against the
+checked-in ``mirror_lock.json``. ANY drift — either side — fails QL007
+until the author re-locks with ``python -m tools.quest_lint
+--update-mirror``, which is the attestation that the twin was reviewed.
+A one-sided change therefore cannot merge unnoticed: it either fails
+lint or carries an explicit re-lock in the same diff.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+
+from .engine import Violation
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+LOCK_PATH = os.path.join(HERE, "mirror_lock.json")
+
+# Each group names ONE mirrored surface; its members must re-lock
+# together. Python extracts address ``file::qualname`` (ast-resolved);
+# C++ extracts address ``file::re:<start>..<end>`` line spans.
+MIRROR_GROUPS = {
+    "swap-absorption": (
+        ("quest_tpu/parallel/layout.py", "py", "_SWAP_MAT"),
+        ("quest_tpu/parallel/layout.py", "py", "is_swap_op"),
+        ("native/src/scheduler.cc", "cc",
+         (r"^bool is_swap\(", r"^\}")),
+    ),
+    "plan-item-kinds": (
+        ("quest_tpu/native/__init__.py", "py", "KIND_U"),
+        ("native/src/scheduler.cc", "cc",
+         (r"^constexpr int KIND_U = ",
+          r"^constexpr int KIND_DIAG_PARAM = ")),
+        ("native/src/scheduler.cc", "cc",
+         (r"^constexpr int ITEM_OP = ",
+          r"^constexpr int ITEM_XSHARD = ")),
+    ),
+    "comm-cost-model": (
+        ("quest_tpu/profiling.py", "py", "CommCostModel.tier"),
+        ("quest_tpu/profiling.py", "py", "CommCostModel.all_to_all_bytes"),
+        ("quest_tpu/profiling.py", "py", "CommCostModel.ppermute_bytes"),
+        ("quest_tpu/profiling.py", "py", "DEFAULT_COMM_MODEL"),
+        ("native/src/scheduler.cc", "cc",
+         (r"^void tier_of\(", r"^\}")),
+        ("native/src/scheduler.cc", "cc",
+         (r"^double a2a_seconds\(", r"^\}")),
+        ("native/src/scheduler.cc", "cc",
+         (r"^double ppermute_seconds\(", r"^\}")),
+    ),
+    "relayout-pricing": (
+        ("quest_tpu/parallel/layout.py", "py", "relayout_comm_tiered"),
+        ("native/src/scheduler.cc", "cc",
+         (r"^double relayout_seconds\(", r"^\}")),
+    ),
+}
+
+
+def _normalize(lines) -> str:
+    """Whitespace- and comment-insensitive canonical form: formatting
+    churn must never read as drift."""
+    out = []
+    for ln in lines:
+        ln = re.sub(r"//.*$", "", ln)
+        ln = re.sub(r"(?<!['\"])#.*$", "", ln)
+        ln = re.sub(r"\s+", " ", ln).strip()
+        if ln:
+            out.append(ln)
+    return "\n".join(out)
+
+
+def _py_segment(text: str, qualname: str):
+    """Source lines of a module-level function/class-method/assignment
+    named ``qualname`` (``Class.method`` or plain name)."""
+    tree = ast.parse(text)
+    parts = qualname.split(".")
+
+    def find(body, name):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and node.name == name:
+                return node
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == name:
+                        return node
+                    if isinstance(tgt, ast.Tuple) and any(
+                            isinstance(e, ast.Name) and e.id == name
+                            for e in tgt.elts):
+                        return node
+        return None
+
+    node, body = None, tree.body
+    for part in parts:
+        node = find(body, part)
+        if node is None:
+            return None
+        body = getattr(node, "body", [])
+    lines = text.splitlines()
+    # include decorators; end_lineno covers the whole statement
+    start = min([node.lineno] + [d.lineno for d in getattr(
+        node, "decorator_list", [])])
+    return lines[start - 1:node.end_lineno]
+
+
+def _cc_segment(text: str, start_re: str, end_re: str):
+    """Inclusive line span from the first ``start_re`` match to the
+    first subsequent ``end_re`` match."""
+    lines = text.splitlines()
+    start = None
+    for i, ln in enumerate(lines):
+        if start is None:
+            if re.search(start_re, ln):
+                start = i
+        elif re.search(end_re, ln):
+            return lines[start:i + 1]
+    return None
+
+
+def _member_key(spec) -> str:
+    path, kind, sel = spec
+    if kind == "py":
+        return f"{path}::{sel}"
+    return f"{path}::re:{sel[0]}"
+
+
+def current_digests(root: str, groups=None) -> tuple:
+    """``({group: {member_key: digest}}, [missing member messages])``"""
+    groups = groups if groups is not None else MIRROR_GROUPS
+    out: dict = {}
+    missing: list = []
+    cache: dict = {}
+    for gname, members in groups.items():
+        out[gname] = {}
+        for spec in members:
+            path, kind, sel = spec
+            abspath = os.path.join(root, path)
+            if path not in cache:
+                try:
+                    with open(abspath, "r", encoding="utf-8") as fh:
+                        cache[path] = fh.read()
+                except OSError:
+                    cache[path] = None
+            text = cache[path]
+            key = _member_key(spec)
+            if text is None:
+                missing.append((gname, key, f"{path} is unreadable"))
+                continue
+            seg = _py_segment(text, sel) if kind == "py" else \
+                _cc_segment(text, sel[0], sel[1])
+            if seg is None:
+                missing.append((gname, key,
+                                f"extract {key} not found — the "
+                                f"mirrored definition moved or was "
+                                f"renamed"))
+                continue
+            digest = hashlib.sha256(
+                _normalize(seg).encode()).hexdigest()[:16]
+            out[gname][key] = digest
+    return out, missing
+
+
+def load_lock(path: str = LOCK_PATH) -> dict:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return json.load(fh).get("groups", {})
+    except OSError:
+        return {}
+
+
+def save_lock(root: str, path: str = LOCK_PATH) -> dict:
+    digests, _missing = current_digests(root)
+    doc = {
+        "comment": "QL007 mirror lock: digests of the planner surfaces "
+                   "mirrored between the Python layout/cost model and "
+                   "native/src/scheduler.cc. Any drift on either side "
+                   "fails lint until re-locked (python -m "
+                   "tools.quest_lint --update-mirror) — re-locking "
+                   "attests that the twin side was reviewed.",
+        "version": 1,
+        "groups": {g: dict(sorted(m.items()))
+                   for g, m in sorted(digests.items())},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return digests
+
+
+def check_mirror(root: str, lock_path: str = LOCK_PATH,
+                 groups=None) -> list:
+    digests, missing = current_digests(root, groups)
+    locked = load_lock(lock_path)
+    out = []
+    for gname, key, msg in missing:
+        out.append(Violation("QL007", "tools/quest_lint/mirror.py", 1,
+                             f"native-mirror: [{gname}] {msg}"))
+    if not locked:
+        out.append(Violation(
+            "QL007", "tools/quest_lint/mirror_lock.json", 1,
+            "native-mirror: mirror_lock.json is missing or empty — "
+            "run python -m tools.quest_lint --update-mirror and "
+            "commit it"))
+        return out
+    for gname, members in digests.items():
+        lock_members = locked.get(gname, {})
+        drifted = sorted(k for k, d in members.items()
+                         if lock_members.get(k) != d)
+        stale = sorted(k for k in lock_members if k not in members)
+        if drifted or stale:
+            twins = sorted(set(members) - set(drifted))
+            out.append(Violation(
+                "QL007", drifted[0].split("::")[0] if drifted
+                else "tools/quest_lint/mirror_lock.json", 1,
+                f"native-mirror: mirrored surface [{gname}] drifted in "
+                f"{', '.join(drifted + stale)}; this table is "
+                f"hand-mirrored — update the twin side(s) "
+                f"({', '.join(twins) or 'none'}) to match, then "
+                f"re-lock with --update-mirror"))
+    return out

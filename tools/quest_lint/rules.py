@@ -1,0 +1,737 @@
+"""The quest-lint rule set (QL001–QL006; QL007 lives in ``mirror.py``).
+
+Every rule is ``fn(files, root) -> [Violation]`` over parsed
+:class:`~tools.quest_lint.engine.SourceFile` objects. Rules are
+deliberately *syntactic over-approximations*: a flagged site is "this
+needs a human decision", and the decision is recorded either as a fix,
+a ``# quest: allow-*`` suppression with a reason, or a ratchet baseline
+entry — never silently. The runtime half of QL006 (the precise,
+instance-level lock-order validator) is
+:mod:`quest_tpu.testing.lockcheck`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .engine import Violation
+
+# -- shared AST helpers -----------------------------------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target (``a.b.c`` for attribute chains,
+    ``''`` for computed targets)."""
+    parts = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+def tokens_in(node: ast.AST) -> set:
+    """Every identifier and string-constant token under ``node`` —
+    the evidence set the cache-key rule checks."""
+    out: set = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+def functions_of(tree: ast.AST):
+    """Yield ``(classname_or_None, funcdef)`` for every function, each
+    exactly once (methods carry their class name)."""
+    methods = set()
+    pairs = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    methods.add(id(sub))
+                    pairs.append((node.name, sub))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and id(node) not in methods:
+            pairs.append((None, node))
+    return pairs
+
+
+def enclosing_function_map(tree: ast.AST) -> dict:
+    """``id(node) -> funcdef`` for every node, innermost function."""
+    out: dict = {}
+
+    def visit(node, fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = fn
+            visit(child, fn)
+
+    visit(tree, None)
+    return out
+
+
+# -- QL001: host sync on a hot path ----------------------------------------
+
+HOT_PATH_PREFIXES = ("quest_tpu/serve/", "quest_tpu/ops/")
+HOT_PATH_FILES = ("quest_tpu/circuits.py", "quest_tpu/parallel/pergate.py")
+# ops/doubledouble.py is exempt by construction: its float()/np.asarray
+# calls are host-scalar double-double constant splitting that runs at
+# trace time (a float() on a tracer would throw inside jit), never a
+# device sync
+QL001_EXEMPT = ("quest_tpu/ops/doubledouble.py",)
+
+_SYNC_ATTRS = ("item", "block_until_ready")
+
+
+def rule_ql001_host_sync(files, root):
+    """``float()`` / ``.item()`` / ``np.asarray()`` /
+    ``.block_until_ready()`` inside the dispatch hot paths force a
+    device->host sync (``host_syncs_avoided`` is the headline metric
+    since PR 3). Deliberate syncs carry
+    ``# quest: allow-host-sync(reason)``; accepted history lives in the
+    ratchet baseline."""
+    out = []
+    for f in files:
+        if f.tree is None:
+            continue
+        hot = f.rel.startswith(HOT_PATH_PREFIXES) \
+            or f.rel in HOT_PATH_FILES
+        if not hot or f.rel in QL001_EXEMPT:
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            what = None
+            if name == "float" and node.args and not isinstance(
+                    node.args[0], ast.Constant):
+                what = "float(...)"
+            elif name in ("np.asarray", "numpy.asarray"):
+                what = "np.asarray(...)"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_ATTRS:
+                what = f".{node.func.attr}()"
+            if what is not None:
+                out.append(Violation(
+                    "QL001", f.rel, node.lineno,
+                    f"host-sync-in-hot-path: {what} blocks on device "
+                    f"results inside a dispatch path; keep the value "
+                    f"device-resident or annotate with "
+                    f"# quest: allow-host-sync(reason)"))
+    return out
+
+
+# -- QL002: executable-cache key completeness ------------------------------
+
+# Evidence vocabularies. A key expression must exhibit one token from
+# each required family; substring match on identifier/string tokens.
+_DTYPE_EVIDENCE = ("dtype", "dt_token")
+_TIER_EVIDENCE = ("tier",)
+_FORM_EVIDENCE = ("mode", "form", "kind", "broadcast", "donate", "shape")
+
+# engines that deliberately run at the environment precision (the tier
+# ladder is REJECTED at their submit boundary), so their cache keys
+# carry no tier token by design
+QL002_TIER_EXEMPT = (
+    "quest_tpu/ops/trajectories.py",
+    "quest_tpu/parallel/sampling.py",
+)
+
+
+def _resolve_key_expr(fn: ast.AST, use: ast.AST, expr: ast.AST):
+    """A key passed as a bare Name resolves to its latest assignment
+    textually above the use inside the same function (the
+    ``key = (...)`` idiom); anything else analyzes as-is."""
+    if not isinstance(expr, ast.Name):
+        return expr
+    best = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and node.lineno <= use.lineno:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == expr.id:
+                    if best is None or node.lineno > best.lineno:
+                        best = node
+    return best.value if best is not None else expr
+
+
+def rule_ql002_cache_keys(files, root):
+    """Every executable-cache insertion (``<x>_cache[key] = ...`` or the
+    ``self._cached(key, builder)`` idiom) must key on tier + dtype +
+    form — the PR-8 invariant: a FAST-tier executable must never serve
+    a SINGLE-tier dispatch, an f32 program never an f64 one, and two
+    forms (sweep vs energy, broadcast vs donated) never collide."""
+    out = []
+    for f in files:
+        if f.tree is None or not f.rel.startswith("quest_tpu/"):
+            continue
+        fmap = enclosing_function_map(f.tree)
+        sites = []   # (node, key_expr)
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) and isinstance(
+                            tgt.value, ast.Attribute) \
+                            and "cache" in tgt.value.attr.lower():
+                        sites.append((node, tgt.slice))
+            elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) \
+                    and node.func.attr == "_cached" and node.args:
+                sites.append((node, node.args[0]))
+        for node, key in sites:
+            fn = fmap.get(id(node))
+            if fn is not None:
+                key = _resolve_key_expr(fn, node, key)
+            toks = tokens_in(key)
+            if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str):
+                toks.add(key.value)
+            missing = []
+            if not any(any(ev in t.lower() for ev in _DTYPE_EVIDENCE)
+                       for t in toks):
+                missing.append("dtype")
+            if f.rel not in QL002_TIER_EXEMPT and not any(
+                    any(ev in t.lower() for ev in _TIER_EVIDENCE)
+                    for t in toks):
+                missing.append("tier")
+            has_str = any(isinstance(n, ast.Constant)
+                          and isinstance(n.value, str)
+                          for n in ast.walk(key)) if isinstance(
+                key, ast.AST) else False
+            if not has_str and not any(
+                    any(ev in t.lower() for ev in _FORM_EVIDENCE)
+                    for t in toks):
+                missing.append("form")
+            if missing:
+                out.append(Violation(
+                    "QL002", f.rel, node.lineno,
+                    f"cache-key-completeness: executable-cache key "
+                    f"carries no {'/'.join(missing)} component — a "
+                    f"stale program could serve a mismatched dispatch; "
+                    f"add the component(s) or annotate with "
+                    f"# quest: allow-cache-key(reason)"))
+    return out
+
+
+# -- QL003: untyped except --------------------------------------------------
+
+def rule_ql003_untyped_except(files, root):
+    """Bare ``except Exception`` (or ``except:``) outside the annotated
+    allowlist. PR 5 showed why these are dangerous in recovery paths:
+    a blind handler retries fatal caller errors and swallows typed
+    recovery signals. Convert to the typed tuples the
+    ``resilience.recovery`` classifier names, or annotate an
+    intentional boundary with ``# quest: allow-broad-except(reason)``."""
+    out = []
+    for f in files:
+        if f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name)
+                and node.type.id in ("Exception", "BaseException"))
+            if broad:
+                what = "bare except:" if node.type is None else \
+                    f"except {node.type.id}"
+                out.append(Violation(
+                    "QL003", f.rel, node.lineno,
+                    f"untyped-except: {what} — classify with the typed "
+                    f"tuples from resilience.recovery (FATAL vs "
+                    f"TRANSIENT is load-bearing in recovery paths) or "
+                    f"annotate # quest: allow-broad-except(reason)"))
+    return out
+
+
+# -- QL004: dispatch-boundary coverage -------------------------------------
+
+QL004_FILES = ("quest_tpu/serve/engine.py", "quest_tpu/circuits.py",
+               "quest_tpu/parallel/pergate.py")
+FAULTS_PATH = "quest_tpu/resilience/faults.py"
+_ANNOTATION_NAMES = ("dispatch_annotation", "TraceAnnotation")
+
+
+def _faults_sites(files):
+    """The ``SITES`` tuple parsed from faults.py (source of truth for
+    boundary coverage)."""
+    for f in files:
+        if f.rel == FAULTS_PATH and f.tree is not None:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name) \
+                                and tgt.id == "SITES":
+                            try:
+                                return (tuple(ast.literal_eval(
+                                    node.value)), node.lineno)
+                            except (ValueError, TypeError):
+                                return ((), node.lineno)
+    return ((), 1)
+
+
+def rule_ql004_dispatch_boundaries(files, root):
+    """Two checks on the dispatch boundaries:
+
+    1. every function containing a fault-hook call anchored at a
+       ``faults.SITES`` string (``_faults.fire("circuits.sweep")``,
+       ``_maybe_inject(q, "pergate.gate")``) must ALSO establish a
+       trace annotation (``dispatch_annotation`` /
+       ``jax.profiler.TraceAnnotation``) so device profiles line up
+       with host dispatch spans (the PR-9 contract);
+    2. every non-router ``SITES`` entry must still appear as a string
+       literal outside faults.py — deleting a ``fire()`` hook (or the
+       site string) is a lint failure, not a silent coverage loss.
+    """
+    sites, sites_line = _faults_sites(files)
+    dispatch_sites = tuple(s for s in sites
+                           if not s.startswith("router."))
+    out = []
+    seen: set = set()
+    for f in files:
+        if f.tree is None:
+            continue
+        track_literals = f.rel != FAULTS_PATH
+        if track_literals:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value in sites:
+                    seen.add(node.value)
+        if f.rel not in QL004_FILES:
+            continue
+        for _cls, fn in functions_of(f.tree):
+            anchored = None
+            has_ann = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf in _ANNOTATION_NAMES:
+                    has_ann = True
+                if (leaf == "fire" or "inject" in leaf) and any(
+                        isinstance(a, ast.Constant)
+                        and a.value in dispatch_sites
+                        for a in node.args):
+                    anchored = node
+            if anchored is not None and not has_ann:
+                out.append(Violation(
+                    "QL004", f.rel, anchored.lineno,
+                    f"dispatch-boundary-coverage: "
+                    f"{fn.name}() fires a fault hook but establishes "
+                    f"no trace annotation "
+                    f"(dispatch_annotation/TraceAnnotation) — device "
+                    f"profiles cannot be aligned with this dispatch; "
+                    f"wrap the executable call or annotate "
+                    f"# quest: allow-dispatch-boundary(reason)"))
+    for site in dispatch_sites:
+        if site not in seen:
+            out.append(Violation(
+                "QL004", FAULTS_PATH, sites_line,
+                f"dispatch-boundary-coverage: faults.SITES entry "
+                f"{site!r} has no fire()/injection call site left in "
+                f"the scanned tree — the boundary lost its hook"))
+    return out
+
+
+# -- QL005: trace schema header --------------------------------------------
+
+def rule_ql005_trace_header(files, root):
+    """Every ``tools/*_trace.py`` dumper must route its output through
+    ``tools/_trace_io.py`` — importing it, registering the shared
+    ``--out`` flag, and emitting via ``_trace_io.emit`` so the
+    ``quest_tpu.trace/1`` header is on every dump (generalizes the
+    source-level completeness test in ``tests/test_trace_io.py``)."""
+    out = []
+    for f in files:
+        if not (f.rel.startswith("tools/")
+                and f.rel.endswith("_trace.py")) or f.rel.endswith(
+                "/_trace_io.py") or f.tree is None:
+            continue
+        imports = False
+        emits = False
+        adds_flag = False
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                imports = imports or any(
+                    a.name == "_trace_io" for a in node.names)
+            elif isinstance(node, ast.ImportFrom):
+                imports = imports or node.module == "_trace_io"
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if name.endswith("_trace_io.emit"):
+                    emits = True
+                if name.endswith("_trace_io.add_output_argument"):
+                    adds_flag = True
+        missing = [what for ok, what in (
+            (imports, "import _trace_io"),
+            (adds_flag, "_trace_io.add_output_argument(parser)"),
+            (emits, "_trace_io.emit(doc, kind, out)"),
+        ) if not ok]
+        if missing:
+            out.append(Violation(
+                "QL005", f.rel, 1,
+                f"trace-schema-header: trace dumper is missing "
+                f"{'; '.join(missing)} — every tools/*_trace.py must "
+                f"emit the quest_tpu.trace/1 header through "
+                f"tools/_trace_io.py"))
+    return out
+
+
+# -- QL006: static lock order ----------------------------------------------
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore", "Event")
+# Event is tracked only for the blocking-wait check, never as a node in
+# the order graph (events are not mutual-exclusion locks)
+_ORDER_FACTORIES = ("Lock", "RLock", "Condition", "Semaphore",
+                    "BoundedSemaphore")
+_BLOCKING_ATTRS = ("result", "wait")
+_DISPATCH_LEAVES = ("sweep", "expectation_sweep", "sample_sweep",
+                    "expectation_batch", "trajectory_sweep", "submit")
+
+
+def _is_lock_factory(node: ast.AST):
+    """``threading.Lock()``-shaped call -> factory name (or None)."""
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _LOCK_FACTORIES and (
+                name.startswith("threading.")
+                or name.startswith("_threading.") or name == leaf):
+            return leaf
+    return None
+
+
+class _LockIndex:
+    """All lock definitions across the scan set.
+
+    A node is ``<file>:<Class>.<attr>`` (instance locks — one node per
+    *creation site*, shared by every instance, which is what makes a
+    cross-instance acquisition order meaningful) or ``<file>:<name>``
+    (module-level locks).
+    """
+
+    def __init__(self, files):
+        self.by_class: dict = {}   # (rel, cls, attr) -> (node, line, kind)
+        self.by_attr: dict = {}    # attr -> [node ids]
+        self.module_level: dict = {}   # (rel, name) -> (node, line, kind)
+        for f in files:
+            if f.tree is None or not f.rel.startswith("quest_tpu/"):
+                continue
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Assign) and len(
+                        node.targets) == 1:
+                    kind = _is_lock_factory(node.value)
+                    if kind is None:
+                        continue
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Attribute) and isinstance(
+                            tgt.value, ast.Name) \
+                            and tgt.value.id == "self":
+                        cls = self._owning_class(f.tree, node)
+                        if cls is None:
+                            continue
+                        nid = f"{f.rel}:{cls}.{tgt.attr}"
+                        self.by_class[(f.rel, cls, tgt.attr)] = (
+                            nid, node.lineno, kind)
+                        self.by_attr.setdefault(tgt.attr, []).append(
+                            (nid, kind))
+                    elif isinstance(tgt, ast.Name):
+                        nid = f"{f.rel}:{tgt.id}"
+                        self.module_level[(f.rel, tgt.id)] = (
+                            nid, node.lineno, kind)
+
+    @staticmethod
+    def _owning_class(tree, node):
+        for cls in ast.walk(tree):
+            if isinstance(cls, ast.ClassDef):
+                for sub in ast.walk(cls):
+                    if sub is node:
+                        return cls.name
+        return None
+
+    def resolve(self, f, cls, expr):
+        """``(node_id, kind)`` for a with-item / receiver expression, or
+        None when it cannot be resolved unambiguously (conservative:
+        unresolved locks add no edges — the runtime lockcheck is the
+        precise instrument)."""
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name):
+            if expr.value.id == "self" and cls is not None:
+                hit = self.by_class.get((f.rel, cls, expr.attr))
+                if hit is not None:
+                    return hit[0], hit[2]
+            cands = self.by_attr.get(expr.attr, [])
+            if len(cands) == 1:
+                return cands[0]
+            return None
+        if isinstance(expr, ast.Name):
+            hit = self.module_level.get((f.rel, expr.id))
+            if hit is not None:
+                return hit[0], hit[2]
+        return None
+
+
+def _method_top_locks(files, index):
+    """One-hop call expansion support: which lock nodes does each
+    function acquire anywhere in its body? Keyed three ways (same-class
+    method, same-module function, globally-unique method name)."""
+    by_qual: dict = {}
+    by_name: dict = {}
+    for f in files:
+        if f.tree is None or not f.rel.startswith("quest_tpu/"):
+            continue
+        for cls, fn in functions_of(f.tree):
+            acquired = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        hit = index.resolve(f, cls, item.context_expr)
+                        if hit is not None and hit[1] in \
+                                _ORDER_FACTORIES:
+                            acquired.add(hit[0])
+            by_qual[(f.rel, cls, fn.name)] = acquired
+            by_name.setdefault(fn.name, []).append(
+                ((f.rel, cls), acquired))
+    return by_qual, by_name
+
+
+def _attr_types(files):
+    """Light instance-attribute type inference for the one-hop call
+    expansion: ``self.X = ClassName(...)`` inside a scanned class binds
+    attr X to ClassName (when that class name is unique in the scan
+    set), so ``self.X.m()`` resolves to the right method's lock set.
+    Returns ``({(rel, cls, attr): (rel2, cls2)}, {classname: [(rel,
+    cls)]})``."""
+    class_homes: dict = {}
+    for f in files:
+        if f.tree is None or not f.rel.startswith("quest_tpu/"):
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ClassDef):
+                class_homes.setdefault(node.name, []).append(
+                    (f.rel, node.name))
+    types: dict = {}
+    for f in files:
+        if f.tree is None or not f.rel.startswith("quest_tpu/"):
+            continue
+        for cls, fn in functions_of(f.tree):
+            if cls is None:
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                tgt = node.targets[0]
+                if not (isinstance(tgt, ast.Attribute) and isinstance(
+                        tgt.value, ast.Name) and tgt.value.id == "self"):
+                    continue
+                leaf = call_name(node.value).rsplit(".", 1)[-1]
+                homes = class_homes.get(leaf, [])
+                if len(homes) == 1:
+                    types[(f.rel, cls, tgt.attr)] = homes[0]
+    return types, class_homes
+
+
+def _is_metrics_lock(node_id: str) -> bool:
+    rel, _, qual = node_id.partition(":")
+    return "metrics" in rel or any(
+        t in qual for t in ("Registry", "Metrics", "Counter", "Gauge",
+                            "Histogram"))
+
+
+def build_lock_graph(files):
+    """The static lock-acquisition graph + blocking-call findings:
+    ``(edges, blocking)`` where ``edges`` is ``{node: {node: (rel,
+    line, why)}}`` built from ``with <lock>`` nesting plus a ONE-HOP
+    call expansion (a call made under lock A to a function that
+    acquires lock B adds A->B), and ``blocking`` lists
+    :class:`Violation` for blocking calls made while holding a lock —
+    ``Future.result``, ``.wait()`` on anything but the held condition,
+    ``thread.join``, ``time.sleep``, and engine dispatch entry points
+    (``sweep``/``submit``/...): the
+    holding-a-registry-lock-across-a-dispatch hazard.
+
+    Instance-ambiguous references resolve to nothing (no edge) rather
+    than guessing; the runtime validator
+    (:mod:`quest_tpu.testing.lockcheck`) covers what static analysis
+    cannot see.
+    """
+    index = _LockIndex(files)
+    by_qual, by_name = _method_top_locks(files, index)
+    attr_types, _homes = _attr_types(files)
+    edges: dict = {}      # node -> {node: (rel, line, why)}
+    out = []
+
+    def add_edge(a, b, rel, line, why):
+        if a == b:
+            return
+        edges.setdefault(a, {})
+        if b not in edges[a]:
+            edges[a][b] = (rel, line, why)
+
+    def callee_locks(f, cls, node):
+        """Locks acquired by the target of a call node (one hop):
+        ``self.m()`` -> same-class method; ``self.X.m()`` -> the method
+        of X's inferred type; bare ``f()`` -> same-module function;
+        otherwise a globally-unique method name. Ambiguity resolves to
+        nothing (no edge) — conservative by design."""
+        name = call_name(node)
+        if not name:
+            return set()
+        leaf = name.rsplit(".", 1)[-1]
+        parts = name.split(".")
+        if parts[0] == "self" and cls is not None:
+            if len(parts) == 2:
+                hit = by_qual.get((f.rel, cls, leaf))
+                if hit is not None:
+                    return hit
+            elif len(parts) == 3:
+                home = attr_types.get((f.rel, cls, parts[1]))
+                if home is not None:
+                    hit = by_qual.get((home[0], home[1], leaf))
+                    if hit is not None:
+                        return hit
+        if "." not in name:
+            hit = by_qual.get((f.rel, None, leaf))
+            if hit is not None:
+                return hit
+            return set()
+        cands = by_name.get(leaf, [])
+        if len(cands) == 1:
+            return cands[0][1]
+        return set()
+
+    def walk(f, cls, fn, node, held):
+        """Dispatch on the node ITSELF (a with-statement in a with-body
+        must push onto the held stack, not be skipped as a mere
+        parent)."""
+        if isinstance(node, ast.With):
+            pushed = list(held)
+            for item in node.items:
+                hit = index.resolve(f, cls, item.context_expr)
+                if hit is not None and hit[1] in _ORDER_FACTORIES:
+                    for h, _ in pushed:
+                        add_edge(h, hit[0], f.rel, node.lineno,
+                                 f"with-nesting in {fn.name}()")
+                    pushed.append((hit[0], item.context_expr))
+            for sub in node.body:
+                walk(f, cls, fn, sub, pushed)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            # nested def: its body runs later, under an unknown held-set
+            for child in ast.iter_child_nodes(node):
+                walk(f, cls, fn, child, [])
+            return
+        if isinstance(node, ast.Call) and held:
+            self_check_call(f, cls, fn, node, held)
+        for child in ast.iter_child_nodes(node):
+            walk(f, cls, fn, child, held)
+
+    def self_check_call(f, cls, fn, node, held):
+        name = call_name(node)
+        leaf = name.rsplit(".", 1)[-1]
+        for locked in callee_locks(f, cls, node):
+            for h, _ in held:
+                add_edge(h, locked, f.rel, node.lineno,
+                         f"call to {name}() in {fn.name}()")
+        blocking = None
+        if name == "time.sleep":
+            blocking = "time.sleep()"
+        elif leaf == "result" and isinstance(node.func, ast.Attribute):
+            blocking = "Future.result()"
+        elif leaf == "join" and isinstance(node.func, ast.Attribute) \
+                and "thread" in ast.dump(node.func.value).lower():
+            blocking = "Thread.join()"
+        elif leaf == "wait" and isinstance(node.func, ast.Attribute):
+            recv = index.resolve(f, cls, node.func.value)
+            if recv is None or all(recv[0] != h for h, _ in held):
+                blocking = f"{name}()"
+        elif leaf in _DISPATCH_LEAVES and isinstance(
+                node.func, ast.Attribute):
+            blocking = f"engine dispatch {name}()"
+        if blocking is not None:
+            holder = held[-1][0]
+            out.append(Violation(
+                "QL006", f.rel, node.lineno,
+                f"lock-order: blocking call {blocking} while holding "
+                f"{holder} — a stalled callee wedges every thread "
+                f"contending on that lock; move the call outside the "
+                f"critical section or annotate "
+                f"# quest: allow-lock-order(reason)"))
+
+    for f in files:
+        if f.tree is None or not f.rel.startswith("quest_tpu/"):
+            continue
+        for cls, fn in functions_of(f.tree):
+            walk(f, cls, fn, fn, [])
+    return edges, out
+
+
+def find_cycles(edges: dict) -> list:
+    """Every acquisition cycle in the graph, as ``(path, rel, line,
+    why)`` anchored at the edge that closes it."""
+    cycles = []
+    color: dict = {}
+    stack: list = []
+
+    def dfs(n):
+        color[n] = 1
+        stack.append(n)
+        for m, (rel, line, why) in sorted(edges.get(n, {}).items()):
+            if color.get(m, 0) == 1:
+                cycles.append((stack[stack.index(m):] + [m],
+                               rel, line, why))
+            elif color.get(m, 0) == 0:
+                dfs(m)
+        stack.pop()
+        color[n] = 2
+
+    for n in sorted(edges):
+        if color.get(n, 0) == 0:
+            dfs(n)
+    return cycles
+
+
+def rule_ql006_lock_order(files, root):
+    """Static lock-order discipline: the acquisition graph
+    (:func:`build_lock_graph`) must be a DAG, and no blocking call may
+    run inside a critical section. Cycles name both lock sites."""
+    edges, out = build_lock_graph(files)
+    for cyc, rel, line, why in find_cycles(edges):
+        out.append(Violation(
+            "QL006", rel, line,
+            f"lock-order: acquisition cycle {' -> '.join(cyc)} "
+            f"(edge added by {why}) — two threads taking these locks "
+            f"in opposite order deadlock; fix the nesting order"))
+    return out
+
+
+def rule_ql007_mirror(files, root):
+    from .mirror import check_mirror
+    return check_mirror(root)
+
+
+ALL_RULES = (
+    rule_ql001_host_sync,
+    rule_ql002_cache_keys,
+    rule_ql003_untyped_except,
+    rule_ql004_dispatch_boundaries,
+    rule_ql005_trace_header,
+    rule_ql006_lock_order,
+    rule_ql007_mirror,
+)
