@@ -61,7 +61,8 @@ from .serve import (SimulationService, CoalescePolicy, ServeError,
 from .resilience import (FaultInjector, FaultSpec, HealthConfig,
                          NumericalFault, ResiliencePolicy,
                          SupervisorPolicy)
-from .telemetry import (Tracer, TraceContext, metrics_registry,
+from .telemetry import (DispatchProfiler, PerfLedger, Tracer,
+                        TraceContext, metrics_registry, profiler,
                         prometheus_text, start_http_exporter)
 from .api import *  # noqa: F401,F403  (the QuEST-compatible surface)
 from .api import __all__ as _api_all
@@ -90,6 +91,7 @@ __all__ = (
         "ResiliencePolicy", "SupervisorPolicy",
         "Tracer", "TraceContext", "metrics_registry",
         "prometheus_text", "start_http_exporter",
+        "DispatchProfiler", "PerfLedger", "profiler",
     ]
     + list(_api_all)
 )

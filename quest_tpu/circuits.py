@@ -54,6 +54,7 @@ from .qureg import Qureg
 from .resilience import faults as _faults
 from .resilience import health as _health
 from .telemetry.tracing import dispatch_annotation
+from .telemetry import profile as _profile
 from .types import PauliOpType
 
 __all__ = ["Circuit", "CompiledCircuit", "Param"]
@@ -1910,6 +1911,66 @@ class CompiledCircuit:
     is_density = False   # set by Circuit.compile(density=True)
     error_budget = None  # set by Circuit.compile(error_budget=...)
     _aot = None          # set by precompile()
+    _digest_cached = None   # lazy program_digest (content-addressed)
+    _plan_comm_s = None     # lazy modeled plan comm seconds (profiler)
+
+    @property
+    def program_digest(self) -> str:
+        """Stable content digest of the recorded program (the
+        :func:`~quest_tpu.serve.warmcache.circuit_digest` address) —
+        what the dispatch profiler and the persistent perf ledger key
+        on, so measurements survive process restarts and object
+        identity churn. Falls back to a process-local id token when an
+        op resists content addressing."""
+        if self._digest_cached is None:
+            from .serve.warmcache import circuit_digest
+            d = circuit_digest(self.circuit, self.is_density)
+            self._digest_cached = d or f"id-{id(self):x}"
+        return self._digest_cached
+
+    def _bytes_per_pass(self, batch: int = 1, terms: int = 0) -> float:
+        """The planner-known device traffic of ONE dispatch of this
+        program: every planned kernel/relayout streams the split re/im
+        planes once (read + write, the memory-bound model bench.py's
+        offline rooflines use), times the batch rows, plus one gather
+        pass per Pauli term for energy dispatches. The dispatch
+        profiler divides this by measured wall-to-ready seconds for a
+        live achieved-bytes/s and roofline_frac per key."""
+        itemsize = np.dtype(self.env.precision.real_dtype).itemsize
+        state_bytes = 4.0 * itemsize * (1 << self.num_qubits)
+        passes = max(self.plan.num_dispatches, 1) + max(int(terms), 0)
+        return passes * max(int(batch), 1) * state_bytes
+
+    def _plan_comm_seconds(self) -> float:
+        """Modeled collective seconds of one execution of this plan
+        (0.0 unsharded) — the ``comm_plan`` drift model's modeled side,
+        cached after the first call."""
+        if not self.plan.shard_bits:
+            return 0.0
+        if self._plan_comm_s is None:
+            from .parallel.layout import plan_comm_stats
+            from .profiling import DEFAULT_COMM_MODEL
+            model = self._cost_model or DEFAULT_COMM_MODEL
+            self._plan_comm_s = plan_comm_stats(
+                self.plan, self._chunk_bytes, model,
+                host_bits=self._host_bits)["seconds"] + 0.0
+        return self._plan_comm_s
+
+    def _drift_models(self, mode: str, rows: int, pol: dict) -> dict:
+        """The drift-monitor model dict for one batched dispatch — ONE
+        definition for the library sweep paths and the serving
+        dispatcher. Models exist only where the dispatch actually pays
+        collectives: amp mode runs every planned relayout per batch row
+        (``comm_plan``) at the crossover price the sharding policy
+        modeled (``batch_amp_comm``)."""
+        models: dict = {}
+        if mode == "amp":
+            cps = self._plan_comm_seconds()
+            if cps > 0.0:
+                models["comm_plan"] = cps * rows
+            if pol.get("amp_comm_seconds", 0.0) > 0.0:
+                models["batch_amp_comm"] = pol["amp_comm_seconds"]
+        return models
 
     def precompile(self) -> "CompiledCircuit":
         """Ahead-of-time compile (lower + compile), no execution.
@@ -1949,12 +2010,27 @@ class CompiledCircuit:
         state = qureg.state
         fn = self._aot if (self._aot is not None
                            and self._aot_accepts(state)) else self._jitted
+        # QL004 trio: the profile span opens BEFORE the fault hook so
+        # injected stalls land inside the measured wall-to-ready time
+        sp = _profile.profile_dispatch("circuits.run")
         poison = _faults.fire("circuits.run")
         # QL004: every dispatch boundary carries a fault hook AND a
         # profiler annotation (device profiles align with host spans)
         with dispatch_annotation(
                 f"quest_tpu.circuits.run:{self.num_qubits}q"):
             qureg.state = fn(state, self._param_vec(params))
+        if sp is not None:
+            models = {}
+            cps = self._plan_comm_seconds()
+            if cps > 0.0:
+                models["comm_plan"] = cps
+            sp.done(qureg.state, program=self.program_digest,
+                    kind="run", bucket=1,
+                    tier=self._tier_token(self.tier),
+                    dtype=str(np.dtype(self.env.precision.real_dtype)),
+                    sharding="amp" if self.plan.shard_bits else "none",
+                    bytes_per_pass=self._bytes_per_pass(),
+                    models=models)
         qureg.state = _faults.poison_output(poison, qureg.state)
         qureg.state = self._health_tick(
             qureg.state, is_density=qureg.is_density_matrix,
@@ -2684,10 +2760,12 @@ class CompiledCircuit:
         tier compiles and caches its OWN executable."""
         tier = self._effective_tier(tier)
         pm = self._validated_param_matrix(param_matrix)
+        sp = _profile.profile_dispatch("circuits.sweep")
         poison = _faults.fire("circuits.sweep")
         n = self.num_qubits
         B = pm.shape[0]
-        mode = self._batch_policy(B)["mode"]
+        pol = self._batch_policy(B)
+        mode = pol["mode"]
         pm_run, B = self._padded_params(pm, mode)
         pm_run = self._place_batch(pm_run, mode)
         # ONE annotation label for both dispatch branches (profiler
@@ -2743,6 +2821,16 @@ class CompiledCircuit:
                 out = self._batched_fn(False, True, mode,
                                        tier)(planes, pm_run)
         self._record_batch_stats(B, mode, B - 1)
+        if sp is not None:
+            sp.done(out, program=self.program_digest, kind="sweep",
+                    bucket=pm_run.shape[0],
+                    tier=self._tier_token(tier),
+                    dtype=str(np.dtype(self.env.precision.real_dtype)),
+                    sharding=mode,
+                    bytes_per_pass=self._bytes_per_pass(
+                        pm_run.shape[0]),
+                    models=self._drift_models(mode, pm_run.shape[0],
+                                              pol))
         out = out[:B] if out.shape[0] != B else out
         out = _faults.poison_output(poison, out)
         return self._health_tick(
@@ -2772,6 +2860,7 @@ class CompiledCircuit:
         n = self.num_qubits
 
         pm = self._validated_param_matrix(param_matrix)
+        sp = _profile.profile_dispatch("circuits.expectation_sweep")
         poison = _faults.fire("circuits.expectation_sweep")
         if poison == "precision":
             # energies carry no unit-norm invariant for any monitor to
@@ -2780,7 +2869,8 @@ class CompiledCircuit:
             # the screens catch (same rule as the serving boundary)
             poison = "nan"
         B = pm.shape[0]
-        mode = self._batch_policy(B)["mode"]
+        pol = self._batch_policy(B)
+        mode = pol["mode"]
         pm_run, B = self._padded_params(pm, mode)
         pm_run = self._place_batch(pm_run, mode)
 
@@ -2819,6 +2909,16 @@ class CompiledCircuit:
         # reference: one per term per point) — the engine's whole sweep
         # is one (B,) transfer
         self._record_batch_stats(B, mode, B * max(T, 1) - 1)
+        if sp is not None:
+            sp.done(out, program=self.program_digest, kind="energy",
+                    bucket=pm_run.shape[0],
+                    tier=self._tier_token(tier),
+                    dtype=str(np.dtype(self.env.precision.real_dtype)),
+                    sharding=mode,
+                    bytes_per_pass=self._bytes_per_pass(
+                        pm_run.shape[0], terms=T),
+                    models=self._drift_models(mode, pm_run.shape[0],
+                                              pol))
         out = out[:B] if out.shape[0] != B else out
         return _faults.poison_output(poison, out)
 

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 
 from ..core.apply import apply_unitary, apply_diagonal
 from ..core.packing import pack, unpack
+from ..telemetry import profile as _profile
 from . import reductions as red
 
 __all__ = ["TrajectoryProgram", "DensityMaterialisationError",
@@ -111,6 +112,18 @@ class TrajectoryProgram:
 
     tier = None          # trajectory dispatches run at the env precision
     is_density = False   # the point: pure states at statevector cost
+    _digest_cached = None   # lazy program_digest (content-addressed)
+
+    @property
+    def program_digest(self) -> str:
+        """Stable content digest of the recorded circuit (the perf
+        ledger / dispatch-profiler key, shared with the deterministic
+        compile path's :attr:`CompiledCircuit.program_digest`)."""
+        if self._digest_cached is None:
+            from ..serve.warmcache import circuit_digest
+            d = circuit_digest(self.circuit, False)
+            self._digest_cached = d or f"id-{id(self):x}"
+        return self._digest_cached
 
     def __init__(self, circuit, env):
         self.env = env
@@ -682,6 +695,9 @@ class TrajectoryProgram:
         fn = self._wave_fn(mode)
         args_const = (jnp.asarray(xm), jnp.asarray(ym), jnp.asarray(zm),
                       jnp.asarray(cf, dtype=rdt))
+        # the whole wave loop is one profiled dispatch: trajectory
+        # waves get the same live roofline number every other mode has
+        sp = _profile.profile_dispatch("trajectories.wave")
         run = 0
         waves_run = 0
         early = False
@@ -728,6 +744,15 @@ class TrajectoryProgram:
         }
         with self._stats_lock:
             self._last_traj_stats = dict(info)
+        if sp is not None:
+            itemsize = np.dtype(self.env.precision.real_dtype).itemsize
+            state_bytes = 4.0 * itemsize * (1 << self.num_qubits)
+            sp.done(snap, program=self.program_digest,
+                    kind="trajectory", bucket=int(bucket), tier="env",
+                    dtype=str(np.dtype(self.env.precision.real_dtype)),
+                    sharding=mode,
+                    bytes_per_pass=max(len(self._ops), 1)
+                    * B * run * state_bytes)
         # the engine-off path pays one device->host sync per trajectory
         # per row; the wave loop pays one per wave
         self._record_batch_stats(B * run, mode, B * run - waves_run)

@@ -38,6 +38,7 @@ from ..core.packing import pack, unpack
 from ..env import AMP_AXIS
 from ..resilience import faults as _faults
 from ..telemetry.tracing import dispatch_annotation
+from ..telemetry import profile as _profile
 from .exchange import (plan_exchange, run_exchange, apply_op_local,
                        apply_1q_cross_shard, overlap_eligible,
                        run_exchange_overlapped)
@@ -207,11 +208,16 @@ def canonicalise(qureg) -> None:
     s = _shard_bits(qureg)
     fn = _relayout_fn(qureg.env.mesh, n, s,
                       tuple(int(p) for p in lay), tuple(range(n)))
+    sp = _profile.profile_dispatch("pergate.relayout")
     _maybe_inject(qureg, "pergate.relayout")
     global RELAYOUT_COUNT
     RELAYOUT_COUNT += 1
     with dispatch_annotation("quest_tpu.pergate.relayout"):
         qureg.state = fn(qureg.state)
+    if sp is not None:
+        sp.done(qureg.state, program="pergate", kind="relayout",
+                bucket=1, dtype=str(qureg.state.dtype), sharding="amp",
+                bytes_per_pass=2.0 * qureg.state.nbytes)
     qureg.layout = None
 
 
@@ -265,11 +271,16 @@ def localise_targets(qureg, targets) -> np.ndarray:
     fn = _relayout_fn(qureg.env.mesh, n, s,
                       tuple(int(p) for p in perm),
                       tuple(int(p) for p in new_perm))
+    sp = _profile.profile_dispatch("pergate.relayout")
     _maybe_inject(qureg, "pergate.relayout")
     global RELAYOUT_COUNT
     RELAYOUT_COUNT += 1
     with dispatch_annotation("quest_tpu.pergate.relayout"):
         qureg.state = fn(qureg.state)
+    if sp is not None:
+        sp.done(qureg.state, program="pergate", kind="relayout",
+                bucket=1, dtype=str(qureg.state.dtype), sharding="amp",
+                bytes_per_pass=2.0 * qureg.state.nbytes)
     qureg.layout = new_perm
     return new_perm
 
@@ -288,6 +299,7 @@ def sharded_unitary(qureg, u_packed, targets, ctrl_mask, flip_mask) -> None:
     gate: local positions -> local kernel; one sharded 1q target ->
     role-split pair exchange; multi-qubit sharded -> batched swap-to-local
     relayout then local kernel. Controls never move."""
+    sp = _profile.profile_dispatch("pergate.gate")
     _maybe_inject(qureg, "pergate.gate")
     n = qureg.num_qubits_in_state_vec
     s = _shard_bits(qureg)
@@ -295,11 +307,20 @@ def sharded_unitary(qureg, u_packed, targets, ctrl_mask, flip_mask) -> None:
     mesh = qureg.env.mesh
     perm = _perm(qureg)
     phys_t = tuple(int(perm[t]) for t in targets)
+
+    def _done(form: str) -> None:
+        if sp is not None:
+            sp.done(qureg.state, program="pergate", kind="gate",
+                    bucket=1, dtype=str(qureg.state.dtype),
+                    sharding=form,
+                    bytes_per_pass=2.0 * qureg.state.nbytes)
+
     if len(targets) == 1 and phys_t[0] >= lt:
         cmask, fmask = _phys_masks(perm, ctrl_mask, flip_mask)
         fn = _cross_1q_fn(mesh, n, s, phys_t[0], cmask, fmask)
         with dispatch_annotation("quest_tpu.pergate.gate:xshard"):
             qureg.state = fn(qureg.state, u_packed)
+        _done("xshard")
         return
     if any(p >= lt for p in phys_t):
         if overlap_enabled():
@@ -321,6 +342,7 @@ def sharded_unitary(qureg, u_packed, targets, ctrl_mask, flip_mask) -> None:
                 with dispatch_annotation(
                         "quest_tpu.pergate.gate:overlap"):
                     qureg.state = fn(qureg.state, u_packed)
+                _done("overlap")
                 qureg.layout = new_perm
                 return
         perm = localise_targets(qureg, tuple(targets))
@@ -329,6 +351,7 @@ def sharded_unitary(qureg, u_packed, targets, ctrl_mask, flip_mask) -> None:
     fn = _gate_fn(mesh, n, s, phys_t, cmask, fmask)
     with dispatch_annotation("quest_tpu.pergate.gate:local"):
         qureg.state = fn(qureg.state, u_packed)
+    _done("local")
 
 
 def sharded_diag(qureg, tensor_np, qs_desc) -> None:

@@ -26,7 +26,8 @@ import jax
 
 __all__ = ["trace", "GateStats", "DispatchStats", "probe_gate",
            "CommCostModel", "DEFAULT_COMM_MODEL", "comm_model",
-           "measure_comm_model", "TierErrorModel", "DEFAULT_TIER_MODEL",
+           "measure_comm_model", "invalidate_comm_model",
+           "TierErrorModel", "DEFAULT_TIER_MODEL",
            "tier_error_model", "measure_tier_model", "modeled_tier_error",
            "engine_tiers", "choose_tier", "tier_runtime_tol"]
 
@@ -270,6 +271,18 @@ def measure_comm_model(mesh, probe_bytes=(1 << 14, 1 << 19),
             inter_beta_s_per_byte=inter[1] if inter is not None else None)
     _COMM_MODEL_CACHE[mkey] = model
     return model
+
+
+def invalidate_comm_model() -> int:
+    """Drop every cached :func:`measure_comm_model` fit so the next
+    plan recalibrates — the drift monitor's opt-in recalibration hook
+    (:func:`quest_tpu.telemetry.profile.enable_recalibration`): when
+    measured collective time departs the modeled cost by more than the
+    drift threshold, the cached fit is the stale thing to throw away.
+    Returns the number of cache entries dropped."""
+    n = len(_COMM_MODEL_CACHE)
+    _COMM_MODEL_CACHE.clear()
+    return n
 
 
 def comm_model(env=None, measure: Optional[bool] = None) -> CommCostModel:

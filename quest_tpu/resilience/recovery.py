@@ -146,6 +146,13 @@ class SupervisorPolicy:
     probe_tol: float = 1e-9
     max_restart_attempts: int = 5
     restart_backoff_s: float = 0.05
+    # the router's per-replica service-time EMA decay: each completed
+    # hop blends as (1 - ema_decay) * measured + ema_decay * ema. 0.8
+    # (the old hardcoded blend) weights ~the last 5 requests; raise it
+    # for steadier placement under bursty latency, lower it to track
+    # regime changes faster. The ledger warm-start seeds the EMA's
+    # initial value; this knob sets how fast live traffic overrides it.
+    ema_decay: float = 0.8
 
     def __post_init__(self):
         if self.poll_s <= 0:
@@ -154,6 +161,9 @@ class SupervisorPolicy:
             raise ValueError("probe_batch must be >= 1")
         if self.max_restart_attempts < 1:
             raise ValueError("max_restart_attempts must be >= 1")
+        if not (0.0 <= self.ema_decay < 1.0):
+            raise ValueError("ema_decay must be in [0, 1) — 1.0 would "
+                             "never admit a measurement")
 
     def restart_delay(self, attempt: int) -> float:
         """Backoff before restart ``attempt`` (1-based)."""

@@ -68,6 +68,7 @@ from ..resilience.health import NumericalFault
 from ..resilience.recovery import (FATAL, POISON, PRECISION, TRANSIENT,
                                    CircuitBreaker, ResiliencePolicy,
                                    classify)
+from ..telemetry import profile as _profile
 from ..telemetry.events import make_event, read_timeline
 from ..telemetry.metrics import metrics_registry
 from ..telemetry.tracing import Tracer, dispatch_annotation
@@ -214,6 +215,16 @@ class SimulationService:
         ``False`` to force it off. With a cache, :meth:`warm` LOADS
         serialized executables instead of recompiling (hit/miss
         counters land in the metrics registry).
+    perf_ledger : PerfLedger | False | None
+        The persistent perf ledger (:class:`quest_tpu.telemetry.ledger.
+        PerfLedger`). Default None resolves ``QUEST_TPU_PERF_LEDGER_DIR``
+        (disabled when unset); ``False`` forces it off. With a ledger,
+        :meth:`close` records each served program's measured request
+        latency and observed batch buckets, :meth:`warm` defaults its
+        bucket choices to the buckets prior runs actually hit, and a
+        :class:`~quest_tpu.serve.router.ServiceRouter` built over the
+        same ledger warm-starts its placement EMA from the recorded
+        means instead of cold-starting at zero.
     """
 
     def __init__(self, env, *, max_queue: int = 1024, max_batch: int = 64,
@@ -222,6 +233,7 @@ class SimulationService:
                  max_circuits: int = 32,
                  resilience: Optional[ResiliencePolicy] = None,
                  record_events: int = 256, warm_cache=None,
+                 perf_ledger=None,
                  trace_sample_rate: float = 0.0,
                  tracer: Optional[Tracer] = None,
                  name: Optional[str] = None):
@@ -256,6 +268,14 @@ class SimulationService:
             from .warmcache import WarmCache
             warm_cache = WarmCache.from_env()
         self.warm_cache = warm_cache or None
+        if perf_ledger is None:
+            from ..telemetry.ledger import PerfLedger
+            perf_ledger = PerfLedger.from_env()
+        self.perf_ledger = perf_ledger or None
+        # per-program measured latency, flushed to the perf ledger on
+        # close: digest -> [completed, total_request_s, {bucket: n}]
+        # (dispatcher-thread writes; close() reads after the join)
+        self._lat_by_program: dict = {}
         self._inflight = 0           # requests inside an engine dispatch
         # replica-fault simulation hooks (router chaos: a SIGKILLed
         # process / a wedged dispatcher that stops heartbeating)
@@ -286,7 +306,7 @@ class SimulationService:
         self.tracer = tracer if tracer is not None else Tracer(
             sample_rate=trace_sample_rate, name=self.name)
         self._registry_token = metrics_registry().register(
-            self.name, self.dispatch_stats, kind="service", owner=self)
+            self.name, self._registry_stats, kind="service", owner=self)
         self._heartbeat = time.monotonic()
         self._stall_flagged = False
         self._watchdog_stop = threading.Event()
@@ -591,8 +611,20 @@ class SimulationService:
             self._last_cc = compiled
             return compiled
         tier = compiled._effective_tier(tier)
-        sizes = tuple(batch_sizes) if batch_sizes is not None \
-            else (self.policy.max_batch,)
+        if batch_sizes is not None:
+            sizes = tuple(batch_sizes)
+        else:
+            # default bucket choice: the buckets this program's traffic
+            # ACTUALLY hit in prior runs (the persistent perf ledger),
+            # falling back to the policy's max_batch bucket cold
+            sizes = ()
+            if self.perf_ledger is not None:
+                recorded = self.perf_ledger.warm_buckets(
+                    getattr(compiled, "program_digest", "") or "")
+                sizes = tuple(b for b in recorded
+                              if 1 <= b <= 2 * self.policy.max_batch)
+            if not sizes:
+                sizes = (self.policy.max_batch,)
         mult = self._device_multiple(compiled)
         ham = None
         if observables is not None:
@@ -724,9 +756,27 @@ class SimulationService:
             res["fault_injection"] = inj.snapshot()
         out = {**base, "service": self.metrics.snapshot(),
                "resilience": res,
-               "telemetry": self.tracer.stats()}
+               "telemetry": self.tracer.stats(),
+               # the model-vs-measured layer: per-key device-time
+               # percentiles + roofline_frac and the drift gauges (the
+               # profiler is process-global; tools/obs_console.py's
+               # profiler panel reads this section)
+               "profile": _profile.profiler().snapshot()}
         if self.warm_cache is not None:
             out["warm_cache"] = self.warm_cache.stats()
+        if self.perf_ledger is not None:
+            out["perf_ledger"] = self.perf_ledger.stats()
+        return out
+
+    def _registry_stats(self) -> dict:
+        """The document the metrics registry scrapes: everything in
+        :meth:`dispatch_stats` EXCEPT the process-global profiler
+        section — that one is registered once under its own
+        ``dispatch_profiler`` provider, and re-exporting it per
+        service/replica would multiply every profiler gauge by the
+        provider count in one ``prometheus_text()`` scrape."""
+        out = self.dispatch_stats()
+        out.pop("profile", None)
         return out
 
     def close(self, drain: bool = True, timeout: Optional[float] = 30.0
@@ -746,6 +796,31 @@ class SimulationService:
             self._thread.join(timeout)
         self._watchdog_stop.set()
         metrics_registry().unregister(self._registry_token)
+        self._flush_perf_ledger()
+
+    def _flush_perf_ledger(self) -> None:
+        """Record this service's measured per-program accounting into
+        the persistent perf ledger (idempotent: the accumulators are
+        cleared after a successful flush, so a double close never
+        double-counts). Best-effort: the ledger can make the next
+        restart smarter, never make this shutdown fail."""
+        if self.perf_ledger is None or not self._lat_by_program:
+            return
+        # RuntimeError included: a dispatcher that outlived a timed-out
+        # join can mutate the dict mid-iteration — a lost flush window,
+        # never a failed shutdown
+        try:
+            for digest, ent in list(self._lat_by_program.items()):
+                if ent[0]:
+                    self.perf_ledger.record_program(
+                        digest, requests=ent[0], total_request_s=ent[1],
+                        buckets=ent[2], tiers=ent[3])
+            self._lat_by_program.clear()
+            prof = _profile.profiler()
+            if prof.sample_rate > 0.0:
+                prof.flush_to_ledger(self.perf_ledger)
+        except (OSError, ValueError, TypeError, KeyError, RuntimeError):
+            pass    # best-effort persistence; the shutdown proceeds
 
     def __enter__(self) -> "SimulationService":
         return self
@@ -1170,6 +1245,10 @@ class SimulationService:
         t_dispatch = time.monotonic()
         if tier is not None and tier.name == "fast":
             self.metrics.incr("fast_tier_dispatches")
+        # QL004 trio (fault hook + trace annotation + profiler): the
+        # profile span opens BEFORE the fault hook so injected stalls
+        # land inside the measured wall-to-ready time
+        sp = _profile.profile_dispatch("serve.execute")
         poison = _faults.fire("serve.execute")
         guard = self.resilience.guard_outputs
         viol = ()
@@ -1256,6 +1335,34 @@ class SimulationService:
             with self._cond:
                 obs = self._tier_observed.setdefault(tier.name, 0.0)
                 self._tier_observed[tier.name] = max(obs, m)
+            if m > 0.0:
+                # the tier error model's drift feed: modeled per-run
+                # bound vs the fidelity monitor's observed norm drift
+                from ..profiling import modeled_tier_error
+                _profile.record_model(
+                    "tier_error",
+                    modeled_tier_error(tier, max(cc.circuit.depth, 1)),
+                    m)
+        if sp is not None:
+            mode = "none"
+            bpp = 0.0
+            models: dict = {}
+            try:
+                pol = cc._batch_policy(padded)
+                mode = pol["mode"]
+                bpp = cc._bytes_per_pass(
+                    padded, terms=len(batch[0].observables[0])
+                    if kind == KIND_EXPECTATION else 0)
+                models = cc._drift_models(mode, padded, pol)
+            except (AttributeError, TypeError, KeyError):
+                pass    # trajectory programs price their own sharding
+            sp.done(results, program=getattr(cc, "program_digest", ""),
+                    kind=kind, bucket=padded,
+                    tier=tier.name if tier is not None else "env",
+                    dtype=str(np.dtype(
+                        cc.env.precision.real_dtype)),
+                    sharding=mode, replica=self.name,
+                    bytes_per_pass=bpp, models=models)
         return (results, {int(r) for r in bad}, {int(r) for r in viol},
                 t_dispatch, padded)
 
@@ -1377,6 +1484,23 @@ class SimulationService:
             self.metrics.incr("completed")
             self.metrics.record_latency(done_t - req.submit_t,
                                         t_dispatch - req.submit_t)
+        if self.perf_ledger is not None:
+            # per-program measured latency + bucket mix, flushed to the
+            # persistent perf ledger on close (the router's EMA
+            # warm-start and warm()'s bucket seed in the NEXT process)
+            digest = getattr(cc, "program_digest", "")
+            if digest:
+                ent = self._lat_by_program.setdefault(
+                    digest, [0, 0.0, {}, {}])
+                for i, req in enumerate(batch):
+                    if i in bad_rows or i in viol_rows:
+                        continue
+                    ent[0] += 1
+                    ent[1] += done_t - req.submit_t
+                ent[2][padded] = ent[2].get(padded, 0) + 1
+                tname = batch[0].tier.name if batch[0].tier is not None \
+                    else "env"
+                ent[3][tname] = ent[3].get(tname, 0) + 1
         for i, (req, res) in enumerate(zip(batch, results)):
             if i in bad_rows:
                 err = NumericalFault(

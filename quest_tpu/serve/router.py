@@ -60,6 +60,7 @@ from ..ops.trajectories import TrajectoryProgram
 from ..resilience import faults as _faults
 from ..resilience.recovery import (FATAL, POISON, TRANSIENT,
                                    SupervisorPolicy, classify)
+from ..telemetry import profile as _profile
 from ..telemetry.events import make_event, read_timeline
 from ..telemetry.metrics import metrics_registry
 from ..telemetry.tracing import Tracer
@@ -224,6 +225,16 @@ class ServiceRouter:
         One persistent warm-start cache SHARED by all replicas (same
         programs, same artifacts — replica 1's stores are replica 2's
         loads). None resolves ``QUEST_TPU_WARM_CACHE_DIR``.
+    perf_ledger : PerfLedger | False | None
+        One persistent perf ledger (:class:`quest_tpu.telemetry.ledger.
+        PerfLedger`) SHARED by all replicas. None resolves
+        ``QUEST_TPU_PERF_LEDGER_DIR``; ``False`` forces it off. With a
+        ledger carrying prior-run records, every replica's service-time
+        EMA warm-starts at the recorded mean request latency — the
+        FIRST request is placed with a measured ``est_wait``, not the
+        cold-start zero — and each replica service flushes its measured
+        per-program accounting back on close. The EMA's live decay is
+        ``SupervisorPolicy.ema_decay``.
     trace_sample_rate : float
         Fraction of router submissions that record a request-scoped
         trace (:mod:`quest_tpu.telemetry.tracing`). The router CREATES
@@ -248,7 +259,8 @@ class ServiceRouter:
                  supervisor: Optional[SupervisorPolicy] = None,
                  max_failovers: Optional[int] = None,
                  hedge_after_s: Optional[float] = None,
-                 warm_cache=None, record_events: int = 1024,
+                 warm_cache=None, perf_ledger=None,
+                 record_events: int = 1024,
                  trace_sample_rate: float = 0.0,
                  tracer: Optional[Tracer] = None,
                  name: Optional[str] = None,
@@ -262,6 +274,10 @@ class ServiceRouter:
             from .warmcache import WarmCache
             warm_cache = WarmCache.from_env()
         self.warm_cache = warm_cache or None
+        if perf_ledger is None:
+            from ..telemetry.ledger import PerfLedger
+            perf_ledger = PerfLedger.from_env()
+        self.perf_ledger = perf_ledger or None
         self.supervisor = supervisor if supervisor is not None \
             else SupervisorPolicy()
         self._service_kwargs = dict(service_kwargs)
@@ -281,7 +297,7 @@ class ServiceRouter:
         self.tracer = tracer if tracer is not None else Tracer(
             sample_rate=trace_sample_rate, name=self.name)
         self._registry_token = metrics_registry().register(
-            self.name, self.dispatch_stats, kind="router", owner=self)
+            self.name, self._registry_stats, kind="router", owner=self)
         self._lock = threading.RLock()
         self._closed = False
         self._warm_specs: list = []
@@ -290,6 +306,15 @@ class ServiceRouter:
         self._replicas = [
             _Replica(i, env, self._new_service(env, index=i))
             for i, env in enumerate(envs)]
+        if self.perf_ledger is not None:
+            # EMA warm-start: a prior run's measured mean request
+            # latency seeds every replica, so the very first placement
+            # prices est_wait with a measurement instead of zero (live
+            # traffic then blends it out at SupervisorPolicy.ema_decay)
+            seed_s = self.perf_ledger.mean_request_s()
+            if seed_s > 0.0:
+                for h in self._replicas:
+                    h.ema_request_s = seed_s
         self._stop = threading.Event()
         self._supervisor = threading.Thread(
             target=self._supervise_loop, daemon=True,
@@ -306,6 +331,8 @@ class ServiceRouter:
         prefix = f"{self.name}-replica{index}" if index is not None \
             else f"{self.name}-replica"
         return SimulationService(env, warm_cache=self.warm_cache or False,
+                                 perf_ledger=getattr(
+                                     self, "perf_ledger", None) or False,
                                  name=metrics_registry().unique_name(
                                      prefix),
                                  **self._service_kwargs)
@@ -553,8 +580,9 @@ class ServiceRouter:
             exc = fut.exception()
         if exc is None:
             dur = time.monotonic() - entry[2]
+            d = self.supervisor.ema_decay
             h.ema_request_s = dur if h.ema_request_s == 0.0 \
-                else 0.2 * dur + 0.8 * h.ema_request_s
+                else (1.0 - d) * dur + d * h.ema_request_s
             if was_hedge:
                 self.metrics.incr("hedge_wins")
             self._resolve(work, result=fut.result())
@@ -998,12 +1026,24 @@ class ServiceRouter:
                        "outstanding": outstanding},
             "replicas": per,
             "telemetry": self.tracer.stats(),
+            "profile": _profile.profiler().snapshot(),
         }
         if self.warm_cache is not None:
             out["warm_cache"] = self.warm_cache.stats()
+        if self.perf_ledger is not None:
+            out["perf_ledger"] = self.perf_ledger.stats()
         inj = _faults.active()
         if inj is not None:
             out["fault_injection"] = inj.snapshot()
+        return out
+
+    def _registry_stats(self) -> dict:
+        """Registry-scraped document: :meth:`dispatch_stats` minus the
+        process-global profiler section (exported once under its own
+        ``dispatch_profiler`` provider — the engine-side rationale,
+        :meth:`SimulationService._registry_stats`)."""
+        out = self.dispatch_stats()
+        out.pop("profile", None)
         return out
 
     def close(self, drain: bool = True,

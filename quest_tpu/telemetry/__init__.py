@@ -38,6 +38,9 @@ from .export import (METRICS_SCHEMA, MetricsServer, json_snapshot,
                      validate_prometheus_text, write_snapshot)
 from .tracing import (TRACE_SCHEMA, Span, TraceContext, Tracer,
                       dispatch_annotation)
+from .profile import (DEFAULT_PROFILE_RATE, DispatchProfiler,
+                      DriftMonitor, profile_dispatch, profiler)
+from .ledger import PERF_LEDGER_ENV, PERF_SCHEMA, PerfLedger
 
 __all__ = [
     "TRACE_SCHEMA", "Span", "TraceContext", "Tracer",
@@ -48,4 +51,7 @@ __all__ = [
     "prometheus_text", "start_http_exporter",
     "validate_prometheus_text", "write_snapshot",
     "EVENT_SCHEMA", "make_event", "read_timeline",
+    "DEFAULT_PROFILE_RATE", "DispatchProfiler", "DriftMonitor",
+    "profile_dispatch", "profiler",
+    "PERF_LEDGER_ENV", "PERF_SCHEMA", "PerfLedger",
 ]

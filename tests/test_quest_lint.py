@@ -186,26 +186,31 @@ class TestQL004DispatchBoundaries:
         eng = make_file(tmp_path, "quest_tpu/serve/engine.py", """
             from ..resilience import faults as _faults
             def _dispatch(batch):
+                sp = profile_dispatch("serve.execute")
                 poison = _faults.fire("serve.execute")
                 return run(batch)
             def _run2():
+                sp = profile_dispatch("circuits.run")
                 _faults.fire("circuits.run")
         """)
-        # note: _run2 keeps "circuits.run" referenced so only the
+        # note: _run2 keeps "circuits.run" referenced, and both
+        # functions carry the profiler hook — so only the
         # missing-annotation check fires, twice (both functions)
         vs = rules.rule_ql004_dispatch_boundaries([faults, eng], ROOT)
         assert codes(vs) == ["QL004", "QL004"]
         assert all("annotation" in v.message for v in vs)
 
-    def test_fire_with_annotation_passes(self, tmp_path):
+    def test_fire_with_annotation_and_profiler_passes(self, tmp_path):
         faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
                            FAKE_FAULTS)
         eng = make_file(tmp_path, "quest_tpu/serve/engine.py", """
             def _dispatch(batch):
+                sp = _profile.profile_dispatch("serve.execute")
                 poison = _faults.fire("serve.execute")
                 with dispatch_annotation("quest_tpu.serve.dispatch"):
                     return run(batch)
             def _other():
+                sp = profile_dispatch("circuits.run")
                 _maybe_inject(q, "circuits.run")
                 with dispatch_annotation("x"):
                     pass
@@ -213,11 +218,49 @@ class TestQL004DispatchBoundaries:
         assert rules.rule_ql004_dispatch_boundaries(
             [faults, eng], ROOT) == []
 
+    def test_fire_without_profiler_hook_flags(self, tmp_path):
+        # the ISSUE-13 extension: annotation alone is no longer enough —
+        # profiler + fault hook + trace annotation travel together
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS)
+        eng = make_file(tmp_path, "quest_tpu/serve/engine.py", """
+            def _dispatch(batch):
+                poison = _faults.fire("serve.execute")
+                with dispatch_annotation("quest_tpu.serve.dispatch"):
+                    return run(batch)
+            def _keeps_site_alive():
+                sp = profile_dispatch("circuits.run")
+                _maybe_inject(q, "circuits.run")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, eng], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "profile_dispatch" in vs[0].message
+
+    def test_new_dispatch_site_under_ops_tree_in_scope(self, tmp_path):
+        # a NEW file under ops/ (not one of the legacy QL004_FILES)
+        # gets the full-trio requirement from day one
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS)
+        new = make_file(tmp_path, "quest_tpu/ops/newengine.py", """
+            def dispatch_wave(batch):
+                poison = _faults.fire("serve.execute")
+                return run(batch)
+            def _keeps_site_alive():
+                x = "circuits.run"
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, new], ROOT)
+        assert codes(vs) == ["QL004", "QL004"]
+        msgs = " ".join(v.message for v in vs)
+        assert "annotation" in msgs and "profile_dispatch" in msgs
+
     def test_deleted_hook_site_is_a_coverage_loss(self, tmp_path):
         faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
                            FAKE_FAULTS)
         eng = make_file(tmp_path, "quest_tpu/serve/engine.py", """
             def _dispatch(batch):
+                sp = profile_dispatch("serve.execute")
                 poison = _faults.fire("serve.execute")
                 with dispatch_annotation("d"):
                     return run(batch)

@@ -157,6 +157,54 @@ def _replica_table(replicas: list, indent: str = "  ") -> list:
     return lines
 
 
+def _profile_lines(prof: dict, indent: str = "  ") -> list:
+    """The profiler panel: per-program device-time percentiles +
+    roofline_frac per key, then the drift-monitor gauges (a
+    ``dispatch_stats()["profile"]`` section — plain dict, stdlib-only
+    rendering)."""
+    lines = [indent + _kv((
+        ("rate", prof.get("sample_rate", 0.0)),
+        ("sampled", f"{prof.get('dispatches_sampled', 0)}"
+                    f"/{prof.get('dispatches_seen', 0)}"),
+        ("roofline_model", prof.get("roofline_model")),
+    ))]
+    keys = prof.get("keys", {}) or {}
+    if keys:
+        lines.append(
+            f"{indent}{'site':<22} {'program':<10} {'kind':<10} "
+            f"{'bkt':>4} {'tier':<6} {'shard':<6} {'n':>5} "
+            f"{'p50':>8} {'p99':>8} {'roofline':>8}")
+        ranked = sorted(keys.values(),
+                        key=lambda k: -float(k.get("count", 0)))
+        for k in ranked[:12]:
+            lines.append(
+                f"{indent}{str(k.get('site', '?'))[:22]:<22} "
+                f"{str(k.get('program', ''))[:10]:<10} "
+                f"{str(k.get('kind', ''))[:10]:<10} "
+                f"{k.get('bucket', 0):>4} "
+                f"{str(k.get('tier', '')):<6} "
+                f"{str(k.get('sharding', ''))[:6]:<6} "
+                f"{k.get('count', 0):>5} "
+                f"{_fmt_s(k.get('p50_s')):>8} "
+                f"{_fmt_s(k.get('p99_s')):>8} "
+                f"{k.get('roofline_frac', 0.0):>8.4f}")
+        if len(ranked) > 12:
+            lines.append(f"{indent}... {len(ranked) - 12} more key(s)")
+    drift = (prof.get("drift", {}) or {}).get("models", {}) or {}
+    if drift:
+        parts = []
+        for name, st in sorted(drift.items()):
+            tag = f"{name}={st.get('drift_ratio', 1.0):.3g}x"
+            ev = st.get("drift_events", 0)
+            if ev:
+                tag += f"({ev} drift events)"
+            if not st.get("baseline_locked", True):
+                tag += "[baselining]"
+            parts.append(tag)
+        lines.append(indent + "drift: " + "  ".join(parts))
+    return lines
+
+
 def _event_lines(events: list, limit: int, indent: str = "  ") -> list:
     lines = []
     for ev in list(events)[-limit:]:
@@ -208,6 +256,10 @@ def render(stats: dict, events: list = None, title: str = "engine",
         lines.extend(_tier_lines(stats, svc))
         lines.append("RESILIENCE")
         lines.extend(_breaker_lines(stats))
+    prof = stats.get("profile")
+    if prof:
+        lines.append("PROFILER")
+        lines.extend(_profile_lines(prof))
     wc = stats.get("warm_cache")
     if wc:
         lines.append("WARM CACHE")
@@ -248,6 +300,8 @@ def _demo_service():
     import numpy as np
     import quest_tpu as qt
     from quest_tpu.serve import SimulationService
+    from quest_tpu.telemetry import profile as _profile
+    _profile.configure(sample_rate=1.0, reset=True)
     env = qt.createQuESTEnv(num_devices=1, seed=[11])
     c = qt.Circuit(2)
     c.ry(0, c.parameter("a"))

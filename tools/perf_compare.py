@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Diff two performance snapshots and gate regressions.
+
+The bench trajectory was never persisted: each ``bench.py`` run printed
+JSON rows and exited, so "did this PR make serving slower" had no
+machine answer. This tool closes that gap. It reads two snapshots —
+each either
+
+- a perf-ledger directory (``bench.py --ledger DIR`` /
+  ``$QUEST_BENCH_LEDGER_DIR``; rows live in ``DIR/bench.jsonl`` with
+  the ``quest_tpu.perf/1`` schema),
+- a ``BENCH_*.json`` file (the driver's JSON-lines relay), or
+- any ``.jsonl``/``.json`` file of bench result rows
+
+— matches rows by their ``metric`` name, and exits nonzero when any
+compared metric regressed by more than ``--threshold`` percent
+(default 20). Units decide direction: ``s`` (and other pure-time
+units) regress UP, throughput units (``*/sec``) regress DOWN. Rows
+with value 0.0 (error/skip/heartbeat sentinels) and ``repeat: true``
+headline re-emissions are ignored. ``--metric SUBSTR`` (repeatable)
+restricts the comparison to named metrics.
+
+Pure stdlib — runs in CI without jax (wired as a smoke step in
+``.github/workflows/ci.yml``).
+
+Usage::
+
+    python tools/perf_compare.py BENCH_old.json BENCH_new.json
+    python tools/perf_compare.py ledger_main/ ledger_pr/ --threshold 10
+    python tools/perf_compare.py old.json new.json --metric requests/sec
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# units where a LOWER value is better (everything else is throughput-
+# shaped: higher is better)
+_LOWER_BETTER_UNITS = ("s", "seconds", "ms", "us")
+
+
+def load_rows(path: str) -> list:
+    """Bench result rows from a ledger dir, a JSON-lines file, or a
+    JSON list/dict file."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "bench.jsonl")
+    rows = []
+    with open(path) as fh:
+        text = fh.read()
+    text = text.strip()
+    if not text:
+        return rows
+    if text.startswith("["):
+        try:
+            doc = json.loads(text)
+            return [r for r in doc if isinstance(r, dict)]
+        except ValueError:
+            pass
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            row = json.loads(raw)
+        except ValueError:
+            continue              # torn/noise line: skip, never crash
+        if isinstance(row, dict):
+            rows.append(row)
+    # a ledger dir accumulates every `bench.py --ledger` run: keep only
+    # the LATEST run's rows (bench_run is parent-stamped per
+    # invocation), or an older faster row would mask a fresh regression
+    # through the best-of-duplicates pick below
+    runs = {str(r["bench_run"]) for r in rows if r.get("bench_run")}
+    if runs:
+        latest = max(runs)
+        rows = [r for r in rows
+                if str(r.get("bench_run", latest)) == latest]
+    return rows
+
+
+def index_metrics(rows: list) -> dict:
+    """``{metric: (value, unit)}`` over the real result rows (value >
+    0, not a ``repeat`` re-emission). A metric emitted twice keeps its
+    BEST value — re-runs in one stream are retries, and scheduler noise
+    only ever adds time."""
+    out: dict = {}
+    for row in rows:
+        try:
+            metric = str(row["metric"])
+            value = float(row.get("value", 0.0))
+        except (KeyError, TypeError, ValueError):
+            continue
+        if value <= 0.0 or row.get("repeat"):
+            continue
+        unit = str(row.get("unit", ""))
+        prev = out.get(metric)
+        if prev is None:
+            out[metric] = (value, unit)
+        else:
+            lower = prev[1] in _LOWER_BETTER_UNITS
+            better = value < prev[0] if lower else value > prev[0]
+            if better:
+                out[metric] = (value, unit)
+    return out
+
+
+def compare(old: dict, new: dict, threshold_pct: float,
+            metric_filters=()) -> dict:
+    """``{"compared": [...], "regressions": [...], "only_old": [...],
+    "only_new": [...]}`` — one entry per common metric with the signed
+    percent change (positive = improved)."""
+    common = sorted(set(old) & set(new))
+    if metric_filters:
+        common = [m for m in common
+                  if any(f.lower() in m.lower() for f in metric_filters)]
+    compared = []
+    regressions = []
+    for metric in common:
+        ov, unit = old[metric]
+        nv, _ = new[metric]
+        lower = unit in _LOWER_BETTER_UNITS
+        # signed improvement: positive is better in BOTH directions
+        change_pct = ((ov - nv) / ov if lower else (nv - ov) / ov) * 100.0
+        entry = {"metric": metric, "unit": unit, "old": ov, "new": nv,
+                 "change_pct": round(change_pct, 2),
+                 "lower_is_better": lower,
+                 "regressed": change_pct < -threshold_pct}
+        compared.append(entry)
+        if entry["regressed"]:
+            regressions.append(entry)
+    return {"compared": compared, "regressions": regressions,
+            "only_old": sorted(set(old) - set(new)),
+            "only_new": sorted(set(new) - set(old)),
+            "threshold_pct": threshold_pct}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline snapshot: ledger dir, "
+                                "BENCH_*.json, or .jsonl of rows")
+    ap.add_argument("new", help="candidate snapshot (same forms)")
+    ap.add_argument("--threshold", type=float, default=20.0,
+                    metavar="PCT",
+                    help="regression gate: fail when any compared "
+                         "metric is worse by more than PCT percent "
+                         "(default 20)")
+    ap.add_argument("--metric", action="append", default=[],
+                    metavar="SUBSTR",
+                    help="compare only metrics whose name contains "
+                         "SUBSTR (repeatable; default: all common)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full comparison as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        old = index_metrics(load_rows(args.old))
+        new = index_metrics(load_rows(args.new))
+    except OSError as e:
+        print(f"perf_compare: cannot read snapshot: {e}",
+              file=sys.stderr)
+        return 2
+    result = compare(old, new, args.threshold, args.metric)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        for e in result["compared"]:
+            flag = "REGRESSED" if e["regressed"] else "ok"
+            print(f"{flag:>9}  {e['change_pct']:+7.1f}%  "
+                  f"{e['old']:.4g} -> {e['new']:.4g} {e['unit']}  "
+                  f"{e['metric']}")
+        if result["only_old"]:
+            print(f"only in old ({len(result['only_old'])}): "
+                  + "; ".join(result["only_old"][:5]))
+        if result["only_new"]:
+            print(f"only in new ({len(result['only_new'])}): "
+                  + "; ".join(result["only_new"][:5]))
+    if not result["compared"]:
+        print("perf_compare: no common metrics to compare",
+              file=sys.stderr)
+        return 2
+    if result["regressions"]:
+        print(f"perf_compare: {len(result['regressions'])} metric(s) "
+              f"regressed past {args.threshold:g}%", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -258,8 +258,13 @@ def rule_ql003_untyped_except(files, root):
 
 QL004_FILES = ("quest_tpu/serve/engine.py", "quest_tpu/circuits.py",
                "quest_tpu/parallel/pergate.py")
+# ANY file under these trees is in scope for the boundary checks — a
+# NEW dispatch site added under serve/ or ops/ must carry the full trio
+# (fault hook + trace annotation + profiler hook) from day one
+QL004_TREE_PREFIXES = ("quest_tpu/serve/", "quest_tpu/ops/")
 FAULTS_PATH = "quest_tpu/resilience/faults.py"
 _ANNOTATION_NAMES = ("dispatch_annotation", "TraceAnnotation")
+_PROFILE_NAMES = ("profile_dispatch",)
 
 
 def _faults_sites(files):
@@ -281,7 +286,8 @@ def _faults_sites(files):
 
 
 def rule_ql004_dispatch_boundaries(files, root):
-    """Two checks on the dispatch boundaries:
+    """Three checks on the dispatch boundaries — the fault hook, the
+    trace annotation, and the profiler hook TRAVEL TOGETHER:
 
     1. every function containing a fault-hook call anchored at a
        ``faults.SITES`` string (``_faults.fire("circuits.sweep")``,
@@ -289,7 +295,13 @@ def rule_ql004_dispatch_boundaries(files, root):
        trace annotation (``dispatch_annotation`` /
        ``jax.profiler.TraceAnnotation``) so device profiles line up
        with host dispatch spans (the PR-9 contract);
-    2. every non-router ``SITES`` entry must still appear as a string
+    2. the same function must pass through the dispatch-profiler hook
+       (``profile_dispatch``, :mod:`quest_tpu.telemetry.profile`) so
+       the model-vs-measured layer sees every boundary the fault/trace
+       hooks see — a new dispatch site added under ``serve/`` or
+       ``ops/`` (the whole trees are in scope, not just the files that
+       exist today) cannot silently skip profiling;
+    3. every non-router ``SITES`` entry must still appear as a string
        literal outside faults.py — deleting a ``fire()`` hook (or the
        site string) is a lint failure, not a silent coverage loss.
     """
@@ -308,11 +320,13 @@ def rule_ql004_dispatch_boundaries(files, root):
                         and isinstance(node.value, str) \
                         and node.value in sites:
                     seen.add(node.value)
-        if f.rel not in QL004_FILES:
+        if f.rel not in QL004_FILES \
+                and not f.rel.startswith(QL004_TREE_PREFIXES):
             continue
         for _cls, fn in functions_of(f.tree):
             anchored = None
             has_ann = False
+            has_prof = False
             for node in ast.walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
@@ -320,6 +334,8 @@ def rule_ql004_dispatch_boundaries(files, root):
                 leaf = name.rsplit(".", 1)[-1]
                 if leaf in _ANNOTATION_NAMES:
                     has_ann = True
+                if leaf in _PROFILE_NAMES:
+                    has_prof = True
                 if (leaf == "fire" or "inject" in leaf) and any(
                         isinstance(a, ast.Constant)
                         and a.value in dispatch_sites
@@ -334,6 +350,18 @@ def rule_ql004_dispatch_boundaries(files, root):
                     f"(dispatch_annotation/TraceAnnotation) — device "
                     f"profiles cannot be aligned with this dispatch; "
                     f"wrap the executable call or annotate "
+                    f"# quest: allow-dispatch-boundary(reason)"))
+            if anchored is not None and not has_prof:
+                out.append(Violation(
+                    "QL004", f.rel, anchored.lineno,
+                    f"dispatch-boundary-coverage: "
+                    f"{fn.name}() fires a fault hook but never passes "
+                    f"through the profiler hook (profile_dispatch) — "
+                    f"the dispatch is invisible to the "
+                    f"model-vs-measured profiling layer "
+                    f"(quest_tpu/telemetry/profile.py); profiler + "
+                    f"fault hook + trace annotation travel together, "
+                    f"or annotate "
                     f"# quest: allow-dispatch-boundary(reason)"))
     for site in dispatch_sites:
         if site not in seen:
