@@ -1577,6 +1577,257 @@ def bench_precision_tiers(qt, env, platform: str) -> dict:
     }
 
 
+def _profiler_doc(site: str, tier=None) -> dict:
+    """The PR-12 dispatch profiler's per-key document for ``site`` (and
+    optionally ``tier``) from the CURRENT snapshot — the live
+    roofline_frac / achieved-GB/s attribution the mxu rows carry."""
+    from quest_tpu.telemetry import profile as _tprof
+    snap = _tprof.profiler().snapshot()
+    for doc in snap["keys"].values():
+        if doc["site"] == site and (tier is None or doc["tier"] == tier):
+            return doc
+    return {}
+
+
+def _roofline_fields(doc: dict) -> dict:
+    return {
+        "roofline_frac": round(float(doc.get("roofline_frac", 0.0)), 4),
+        "achieved_gb_per_s": round(
+            float(doc.get("achieved_bytes_per_s", 0.0)) / 1e9, 3),
+    }
+
+
+def bench_mxu_saturation(qt, env, platform: str) -> list:
+    """MXU saturation off/on rows (ISSUE 14), each pair the SAME
+    workload with one kernel-coverage gap closed:
+
+    1. **MXU-shaped fusion**: a row-qubit-heavy FAST-tier sweep with the
+       lane/VPU kernels (``QUEST_TPU_MXU_SHAPE=0``) vs the MXU-tile
+       contractions (``=1`` — dense row-bit groups packed with the
+       128-lane axis onto the systolic array);
+    2. **Pallas trajectory waves**: the noisy-ensemble wave loop on the
+       plain-XLA per-op path vs the fused layer + fused Kraus-draw
+       kernels;
+    3. **batched QUAD-dd**: the highest-precision rung as a per-point
+       compile_dd loop (the pre-ISSUE-14 reality: dd fell off the fast
+       path entirely) vs ONE batched engine executable
+       (``sweep(tier='quad')``).
+
+    Every on-row carries the live ``roofline_frac`` + achieved-GB/s of
+    its dispatch key from the PR-12 profiler (sample rate 1.0 for the
+    measured pass), plus a parity figure — never-worse selection means
+    zero tolerated accuracy loss. On CPU the Pallas pairs run
+    interpret-mode (delivery-testing the contract, not the speed);
+    accel platforms compile the real kernels."""
+    import jax
+    from quest_tpu.circuits import Circuit
+    from quest_tpu.telemetry import profile as _tprof
+    accel = _is_accel(platform)
+    pallas_mode = None if accel else "interpret"
+    nq = int(os.environ.get("QUEST_BENCH_MXU_QUBITS",
+                            "14" if accel else "10"))
+    batch = int(os.environ.get("QUEST_BENCH_MXU_BATCH", "8"))
+    ntraj = int(os.environ.get("QUEST_BENCH_MXU_TRAJ", "64"))
+    traj_nq = int(os.environ.get("QUEST_BENCH_MXU_TRAJ_QUBITS", "8"))
+    dd_nq = int(os.environ.get("QUEST_BENCH_MXU_DD_QUBITS", "8"))
+    dd_batch = int(os.environ.get("QUEST_BENCH_MXU_DD_BATCH", "4"))
+    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 3)
+    rng = np.random.default_rng(2026)
+    rows = []
+    prof = _tprof.profiler()
+    old_rate = prof.sample_rate
+    old_shape = os.environ.get("QUEST_TPU_MXU_SHAPE")
+
+    def _restore_shape():
+        if old_shape is None:
+            os.environ.pop("QUEST_TPU_MXU_SHAPE", None)
+        else:
+            os.environ["QUEST_TPU_MXU_SHAPE"] = old_shape
+
+    def _timed(fn):
+        fn()                                   # compile + warm
+        best = None
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return out, best
+
+    _tprof.configure(sample_rate=1.0, reset=True)
+    try:
+        # -- 1: MXU-shaped fused contractions vs the lane/VPU kernels --
+        c = Circuit(nq)
+        for q in range(nq):
+            c.ry(q, c.parameter(f"y{q}"))
+        for q in range(7, nq):
+            c.gate(np.linalg.qr(
+                rng.normal(size=(2, 2))
+                + 1j * rng.normal(size=(2, 2)))[0], (q,))
+        for q in range(nq):
+            c.t(q)
+        pm = rng.uniform(0.0, 2.0 * np.pi, size=(batch, nq))
+        os.environ["QUEST_TPU_MXU_SHAPE"] = "0"
+        cc_off = c.compile(env, pallas=pallas_mode, tier="fast")
+        os.environ["QUEST_TPU_MXU_SHAPE"] = "1"
+        cc_on = c.compile(env, pallas=pallas_mode, tier="fast")
+        _restore_shape()
+        out_off, dt_off = _timed(lambda: cc_off.sweep(pm))
+        doc_off = _profiler_doc("circuits.sweep", "fast")
+        _tprof.configure(sample_rate=1.0, reset=True)
+        out_on, dt_on = _timed(lambda: cc_on.sweep(pm))
+        doc_on = _profiler_doc("circuits.sweep", "fast")
+        mxu_stages = sum(
+            1 for op in cc_on._ops
+            if getattr(op, "kind", None) == "layer"
+            for st in op.stages if st[0] == "rowmxu")
+        dev = float(np.max(np.abs(np.asarray(out_on)
+                                  - np.asarray(out_off))))
+        label = (f"row-heavy sweep {nq}q batch={batch}, FAST tier, "
+                 f"single {platform} chip")
+        rows.append({
+            "metric": f"mxu fusion off (lane/VPU row kernels), {label}",
+            "value": round(batch / dt_off, 2), "unit": "points/sec",
+            **_roofline_fields(doc_off),
+        })
+        rows.append({
+            "metric": f"mxu fusion on (MXU-shaped fused contractions), "
+                      f"{label}",
+            "value": round(batch / dt_on, 2), "unit": "points/sec",
+            "speedup_vs_off": round(dt_off / max(dt_on, 1e-12), 3),
+            "rowmxu_stages": mxu_stages,
+            "max_amp_deviation": dev,
+            **_roofline_fields(doc_on),
+        })
+
+        # -- 2: Pallas trajectory waves vs the plain-XLA wave loop -----
+        tc = Circuit(traj_nq)
+        for q in range(traj_nq):
+            tc.ry(q, float(rng.uniform(0.2, 2.8)))
+        tc.damp(2, 0.2)
+        for q in range(traj_nq - 1):
+            tc.cnot(q, q + 1)
+        tc.dephase(4, 0.15)
+        for q in range(traj_nq):
+            tc.ry(q, float(rng.uniform(0.2, 2.8)))
+        terms = [[(q, 3)] for q in range(traj_nq)]
+        coeffs = list(rng.normal(size=traj_nq))
+        key = jax.random.PRNGKey(7)
+        tp_off = tc.compile_trajectories(env, pallas=False)
+        tp_on = tc.compile_trajectories(env, pallas=pallas_mode)
+        _tprof.configure(sample_rate=1.0, reset=True)
+        (m_off, e_off), dt_toff = _timed(lambda: tp_off.expectation(
+            terms, coeffs, num_trajectories=ntraj, key=key))
+        doc_toff = _profiler_doc("trajectories.wave")
+        _tprof.configure(sample_rate=1.0, reset=True)
+        (m_on, e_on), dt_ton = _timed(lambda: tp_on.expectation(
+            terms, coeffs, num_trajectories=ntraj, key=key))
+        doc_ton = _profiler_doc("trajectories.wave")
+        fused = sum(1 for it in (tp_on._pallas_items or ())
+                    if it[0] in ("layer", "kraus_fused"))
+        tlabel = (f"noisy ensemble {traj_nq}q T={ntraj}, "
+                  f"single {platform} chip")
+        rows.append({
+            "metric": f"trajectory waves pallas-off (plain-XLA per-op "
+                      f"loop), {tlabel}",
+            "value": round(ntraj / dt_toff, 2),
+            "unit": "trajectories/sec",
+            **_roofline_fields(doc_toff),
+        })
+        rows.append({
+            "metric": f"trajectory waves pallas-on (fused layer + fused "
+                      f"Kraus-draw kernels), {tlabel}",
+            "value": round(ntraj / dt_ton, 2),
+            "unit": "trajectories/sec",
+            "speedup_vs_off": round(dt_toff / max(dt_ton, 1e-12), 3),
+            "fused_items": fused,
+            "mean_deviation_sigma": round(
+                abs(m_on - m_off) / max(e_on + e_off, 1e-12), 3),
+            **_roofline_fields(doc_ton),
+        })
+
+        # -- 3: batched QUAD-dd engine vs the per-point dd loop --------
+        x64_was = bool(jax.config.jax_enable_x64)
+        if not x64_was:
+            jax.config.update("jax_enable_x64", True)
+        try:
+            env_dd = qt.createQuESTEnv(num_devices=1,
+                                       precision=qt.DOUBLE, seed=[7])
+            dc = Circuit(dd_nq)
+            for q in range(dd_nq):
+                dc.ry(q, dc.parameter(f"y{q}"))
+            for q in range(dd_nq - 1):
+                dc.cnot(q, q + 1)
+            cc_dd = dc.compile(env_dd, pallas=False)
+            pm_dd = rng.uniform(0.0, 2.0 * np.pi, size=(dd_batch, dd_nq))
+            from quest_tpu.ops.doubledouble import dd_unpack
+
+            # the pre-ISSUE-14 reality: the quad rung had NO batched
+            # executable, so a sweep was one compile_dd + run per point
+            # (compile cost included — that IS the fast path it fell
+            # off). One timed pass: per-point compiles dominate and
+            # repeat identically.
+            t0 = time.perf_counter()
+            seq = []
+            for b in range(dd_batch):
+                bc = Circuit(dd_nq)
+                for q in range(dd_nq):
+                    bc.ry(q, float(pm_dd[b, q]))
+                for q in range(dd_nq - 1):
+                    bc.cnot(q, q + 1)
+                ddp = bc.compile_dd(env_dd, dtype=np.float32)
+                planes = ddp.run(ddp.init_zero())
+                jax.block_until_ready(planes)
+                seq.append(dd_unpack(np.asarray(planes)))
+            dt_soff = time.perf_counter() - t0
+
+            _tprof.configure(sample_rate=1.0, reset=True)
+            out_dd, dt_son = _timed(
+                lambda: cc_dd.sweep(pm_dd, tier="quad"))
+            doc_dd = _profiler_doc("circuits.sweep", "quad")
+            out_np = np.asarray(out_dd)
+            dev_dd = max(
+                float(np.max(np.abs(
+                    (out_np[b, 0] + 1j * out_np[b, 1]) - seq[b])))
+                for b in range(dd_batch))
+            dlabel = (f"QUAD-dd sweep {dd_nq}q batch={dd_batch}, "
+                      f"single {platform} chip")
+            rows.append({
+                "metric": f"dd sweep batched-engine-off (per-point "
+                          f"compile_dd loop), {dlabel}",
+                "value": round(dd_batch / dt_soff, 2),
+                "unit": "points/sec",
+                "host_syncs": dd_batch,
+            })
+            rows.append({
+                "metric": f"dd sweep batched-engine-on (one quad-tier "
+                          f"executable), {dlabel}",
+                "value": round(dd_batch / dt_son, 2),
+                "unit": "points/sec",
+                "speedup_vs_off": round(dt_soff / max(dt_son, 1e-12), 3),
+                "max_amp_deviation": dev_dd,
+                "host_syncs": 1,
+                **_roofline_fields(doc_dd),
+            })
+        finally:
+            if not x64_was:
+                jax.config.update("jax_enable_x64", False)
+    finally:
+        _restore_shape()
+        _tprof.configure(sample_rate=old_rate, reset=True)
+    return rows
+
+
+def bench_mxu_saturation_config(qt, env, platform: str) -> dict:
+    """Config-list adapter: emit every mxu off/on row, return the
+    headline (dd engine-on) row."""
+    rows = bench_mxu_saturation(qt, env, platform)
+    for row in rows[:-1]:
+        emit(row)
+    return rows[-1]
+
+
 def bench_serving(qt, env, platform: str) -> list:
     """Serving runtime vs the one-at-a-time client, SAME request trace:
     a mixed stream of expectation and shot requests against one
@@ -2643,6 +2894,8 @@ def main() -> None:
         ("sweep", 45, lambda: bench_ensemble_sweep_config(qt, env,
                                                           platform)),
         ("tiers", 45, lambda: bench_precision_tiers(qt, env, platform)),
+        ("mxu", 45, lambda: bench_mxu_saturation_config(qt, env,
+                                                        platform)),
         ("serve", 45, lambda: bench_serving_config(qt, env, platform)),
         ("telemetry", 45, lambda: bench_serving_telemetry_config(
             qt, env, platform)),
@@ -2676,7 +2929,14 @@ def main() -> None:
     if not accel:
         configs.append(("native_density", 30,
                         lambda: bench_native_density()))
+    # QUEST_BENCH_ONLY=name[,name...]: restrict to the named configs —
+    # CI gates one tiny config (mxu) through the ledger + perf_compare
+    # without paying the whole suite
+    only = {s.strip() for s in os.environ.get(
+        "QUEST_BENCH_ONLY", "").split(",") if s.strip()}
     for name, min_time_s, fn in configs:
+        if only and name not in only:
+            continue
         if not accel:
             min_time_s /= 4  # CPU compiles are fast (and cache-warmed)
         if _remaining() < min_time_s:

@@ -822,9 +822,13 @@ class Circuit:
                     "density=True and run on a density register")
             circ = self
         if tier is None and error_budget is not None:
-            from .profiling import choose_tier
+            from .profiling import choose_tier, engine_tiers
+            # compile-time tiers pin run()/apply() too, which have no
+            # dd form — quad stays a per-DISPATCH rung (sweep/submit
+            # budgets may still select it; see engine_tiers)
+            ladder = [t for t in engine_tiers(env) if t.name != "quad"]
             tier = choose_tier(float(error_budget), max(len(circ.ops), 1),
-                               env)
+                               env, tiers=ladder)
         cc = CompiledCircuit(circ, env, donate=donate, fuse=fuse,
                              lookahead=lookahead, pallas=pallas,
                              supergate_k=supergate_k, fusion=fusion,
@@ -868,11 +872,20 @@ class Circuit:
         from .native.statevec import NativeProgram
         return NativeProgram(circ, threads=threads)
 
-    def compile_trajectories(self, env: QuESTEnv):
+    def compile_trajectories(self, env: QuESTEnv, pallas=None):
         """Lower to a quantum-trajectory program: channels applied
         stochastically to a STATEVECTOR (Monte-Carlo wavefunction), so a
         noisy n-qubit circuit costs 2^n amplitudes per trajectory
         instead of the density path's 2^(2n) (``ops/trajectories.py``).
+
+        ``pallas`` controls the wave loop's fused-kernel path (same
+        semantics as :meth:`compile`: None = auto on TPU backends,
+        "interpret" = interpreted kernels for tests, False = off):
+        static gate runs apply through the batch-gridded Pallas layer
+        kernel and eligible static channels through the fused
+        Kraus-draw kernel — active in the unsharded dispatch mode
+        (docs/tpu.md "MXU saturation"). The fused-kernel draw stream
+        differs bitwise (not statistically) from the XLA path's.
 
         The trajectory axis is the batched engine's batch axis:
         ``trajectory_sweep(T)`` runs T draws through one keyed
@@ -885,7 +898,7 @@ class Circuit:
         served via ``SimulationService.submit(..., trajectories=,
         sampling_budget=)``. docs/tpu.md "Trajectory execution"."""
         from .ops.trajectories import TrajectoryProgram
-        return TrajectoryProgram(self, env)
+        return TrajectoryProgram(self, env, pallas=pallas)
 
     def compile_dd(self, env: QuESTEnv, dtype=None):
         """Compile to the double-double amplitude path: each amplitude
@@ -1013,6 +1026,29 @@ def _group_supergates(ops: list, max_k: int = 4,
     return out
 
 
+def _mxu_policy(enabled: bool, fast: bool):
+    """The layer collector's MXU-shaping policy: None (off) or a dict
+    with the memoized per-gate crossover ``decide(row_bits,
+    gate_qubits)`` and the row-bit ``cap`` — one decision table shared
+    by ``_layer_eligible`` (the supergate fence) and
+    ``_LayerAccum.try_add`` (the stage emitter), so the fence and the
+    collector can never disagree about which gates the MXU tile
+    claims."""
+    if not enabled:
+        return None
+    from .parallel.layout import MXU_ROW_CAP, choose_mxu_contraction
+    memo: dict = {}
+
+    def decide(row_bits: int, gate_qubits: int) -> bool:
+        k = (row_bits, gate_qubits)
+        if k not in memo:
+            memo[k] = choose_mxu_contraction(row_bits, gate_qubits,
+                                             fast)["use_mxu"]
+        return memo[k]
+
+    return {"decide": decide, "cap": MXU_ROW_CAP}
+
+
 class _LayerAccum:
     """Stage accumulator for one Pallas layer run (ops at PHYSICAL
     coordinates of a ``num_local``-qubit state view).
@@ -1021,13 +1057,21 @@ class _LayerAccum:
     compatible adjacent stages) and returns True, or rejects it untouched.
     Masks handed to the kernel use its coordinate split: lane masks over
     the 128-lane index, row masks over the row index (bit p = qubit p+7).
+
+    ``mxu`` (a :func:`_mxu_policy` dict) turns on MXU-shaped
+    contractions: a dense uncontrolled gate whose row-bit targets fit
+    the tile cap becomes (or folds into) a ``rowmxu`` stage — one
+    ``(2^j * 128)``-dim systolic-array contraction — when the modeled
+    flops-vs-bytes crossover says the MXU wins; otherwise the existing
+    lane/row stages keep it (never-worse by construction).
     """
 
     LANE_MASK = (1 << 7) - 1   # == (1 << pk.LANE_QUBITS) - 1
 
-    def __init__(self, num_local: int, hi: int):
+    def __init__(self, num_local: int, hi: int, mxu=None):
         self.num_local = num_local
         self.hi = hi
+        self.mxu = mxu
         self.stages: list = []
         self.members = 0
         self.src_items: list = []
@@ -1044,8 +1088,33 @@ class _LayerAccum:
             if st[0] in ("row", "rowk") and st[3] == 0:
                 i -= 1               # lane-blind row stage: commutes
                 continue
+            if st[0] == "rowmxu" and self.mxu is not None:
+                # fold the lane matrix into the open MXU tile (free:
+                # kron-embed over the tile's row bits, matrix product).
+                # Valid past the skipped lane-blind row stages — a pure
+                # lane operator commutes with them.
+                big = np.kron(np.eye(1 << len(st[1])), m)
+                self.stages[i] = ("rowmxu", st[1], big @ st[2])
+                return
             break
         self.stages.append(("lane", m))
+
+    def _append_rowmxu(self, bits: tuple, phys_targets, mat) -> None:
+        from .ops import pallas_kernels as pk
+        prev = self.stages[-1] if self.stages else None
+        if prev is not None and prev[0] == "rowmxu":
+            union = tuple(sorted(set(bits) | set(prev[1])))
+            if len(union) <= self.mxu["cap"]:
+                # merge by union: same flops at the cap (2^(j1+j2) =
+                # 2^j1 * 2^j2 column work either way), one stage fewer
+                pm = prev[2] if union == prev[1] \
+                    else pk.mxu_expand(prev[2], prev[1], union)
+                m = pk.mxu_group_matrix(mat, phys_targets, union)
+                self.stages[-1] = ("rowmxu", union, m @ pm)
+                return
+        self.stages.append(
+            ("rowmxu", bits, pk.mxu_group_matrix(mat, phys_targets,
+                                                 bits)))
 
     def _append_row(self, q: int, u: np.ndarray, lane_mask: int,
                     lane_want: int, row_mask: int, row_want: int) -> None:
@@ -1077,6 +1146,22 @@ class _LayerAccum:
             want = cmask & ~fmask
             lane_cm, lane_want = cmask & self.LANE_MASK, want & self.LANE_MASK
             row_cm, row_want = cmask >> 7, want >> 7
+            row_t = [t for t in phys_targets if t >= pk.LANE_QUBITS]
+            if (self.mxu is not None and cmask == 0 and row_t
+                    and len(row_t) <= self.mxu["cap"]
+                    and all(t <= self.hi for t in row_t)):
+                # MXU-shaped contraction: fold into an open tile for
+                # free, else open one when the modeled crossover says
+                # the systolic array beats the VPU row path
+                bits = tuple(sorted(t - pk.LANE_QUBITS for t in row_t))
+                prev = self.stages[-1] if self.stages else None
+                fold = (prev is not None and prev[0] == "rowmxu"
+                        and set(bits) <= set(prev[1]))
+                if fold or self.mxu["decide"](len(bits),
+                                              len(phys_targets)):
+                    self._append_rowmxu(bits, phys_targets, op.mat)
+                    self.members += 1
+                    return True
             if all(t < pk.LANE_QUBITS for t in phys_targets):
                 m = pk.embed_lane_matrix(op.mat, phys_targets, lane_cm,
                                          fmask & self.LANE_MASK)
@@ -1139,7 +1224,7 @@ class _LayerAccum:
 
 def _collect_layers_plan(items: list, ops: list, num_local: int,
                          block_rows: Optional[int] = None,
-                         min_members: int = 2):
+                         min_members: int = 2, mxu=None):
     """Post-plan peephole: fuse runs of consecutive op items whose PHYSICAL
     footprint fits the Pallas layer kernel into LayerOps.
 
@@ -1157,7 +1242,7 @@ def _collect_layers_plan(items: list, ops: list, num_local: int,
     hi = pk.max_mid_qubit(min(block_rows, max(total_rows, 1)))
     ops = list(ops)
     out: list = []
-    acc = _LayerAccum(num_local, hi)
+    acc = _LayerAccum(num_local, hi, mxu)
 
     def flush():
         nonlocal acc
@@ -1166,7 +1251,7 @@ def _collect_layers_plan(items: list, ops: list, num_local: int,
             out.append(("op", len(ops) - 1, (), 0, 0, None))
         else:
             out.extend(acc.src_items)
-        acc = _LayerAccum(num_local, hi)
+        acc = _LayerAccum(num_local, hi, mxu)
 
     for item in items:
         if item[0] != "op":
@@ -1185,28 +1270,37 @@ def _collect_layers_plan(items: list, ops: list, num_local: int,
     return out, ops
 
 
-def _layer_eligible(op, num_local: int, hi: int) -> bool:
+def _layer_eligible(op, num_local: int, hi: int, mxu=None) -> bool:
     """Mask/target-only mirror of ``_LayerAccum.try_add``'s accept set —
     no operand construction, so it is cheap enough to run per op during
-    supergate grouping."""
+    supergate grouping. ``mxu`` (the :func:`_mxu_policy` dict) extends
+    the accept set with the MXU-tile gates the accumulator would claim."""
     from .ops import pallas_kernels as pk
     if getattr(op, "kind", None) not in ("u", "diag") or not op.is_static:
         return False
     if op.kind == "u":
         if op.ctrl_mask >> num_local:
             return False
-        return (all(t < pk.LANE_QUBITS for t in op.targets)
+        if (all(t < pk.LANE_QUBITS for t in op.targets)
                 or (len(op.targets) == 1
                     and pk.LANE_QUBITS <= op.targets[0] <= hi)
                 or (2 <= len(op.targets) <= 3
                     and all(pk.LANE_QUBITS <= t <= hi
-                            for t in op.targets)))
+                            for t in op.targets))):
+            return True
+        if mxu is None or op.ctrl_mask:
+            return False
+        row_t = [t for t in op.targets if t >= pk.LANE_QUBITS]
+        return (bool(row_t) and len(row_t) <= mxu["cap"]
+                and all(t <= hi for t in row_t)
+                and mxu["decide"](len(row_t), len(op.targets)))
     if any(p >= num_local for p in op.targets):
         return False
     return sum(p >= pk.LANE_QUBITS for p in op.targets) <= 3
 
 
-def _layer_barrier(ops: Sequence, num_qubits: int, shard_bits: int):
+def _layer_barrier(ops: Sequence, num_qubits: int, shard_bits: int,
+                   mxu=None):
     """Fence set (by op identity) for the supergate pass: ops the layer
     peephole can fuse more cheaply. Only RUNS of >=2 adjacent eligible
     ops are fenced — an isolated eligible gate can never form a layer
@@ -1216,7 +1310,7 @@ def _layer_barrier(ops: Sequence, num_qubits: int, shard_bits: int):
     num_local = num_qubits - shard_bits
     total_rows = (1 << num_local) // 128
     hi = pk.max_mid_qubit(min(pk.DEFAULT_BLOCK_ROWS, max(total_rows, 1)))
-    elig = [_layer_eligible(op, num_local, hi) for op in ops]
+    elig = [_layer_eligible(op, num_local, hi, mxu) for op in ops]
     fence = set()
     for i, op in enumerate(ops):
         if elig[i] and ((i > 0 and elig[i - 1])
@@ -1227,13 +1321,14 @@ def _layer_barrier(ops: Sequence, num_qubits: int, shard_bits: int):
 
 def _collect_layers(ops: list, num_qubits: int,
                     block_rows: Optional[int] = None,
-                    min_members: int = 2) -> list:
+                    min_members: int = 2, mxu=None) -> list:
     """Ops-level view of the layer peephole (identity placement): merge
     runs of eligible static gates into Pallas LayerOps."""
     from .parallel import plan_layout
     plan = plan_layout(ops, num_qubits, 0)
     items, new_ops = _collect_layers_plan(plan.items, ops, num_qubits,
-                                          block_rows, min_members)
+                                          block_rows, min_members,
+                                          mxu=mxu)
     return [new_ops[item[1]] for item in items]
 
 
@@ -1466,6 +1561,16 @@ class CompiledCircuit:
             interpret or jax.default_backend() in ("tpu", "axon"))
         self._pallas_interpret = interpret
         use_layers = enabled and (n - shard_bits) >= 7
+        # MXU-shaping policy (ROADMAP item 4): dense fused groups with
+        # row-bit targets become (2^j * 128)-tile systolic-array
+        # contractions when the modeled flops-vs-bytes crossover says
+        # the MXU beats the VPU row path (parallel/layout.
+        # choose_mxu_contraction; QUEST_TPU_MXU_SHAPE forces either
+        # way). Decided with the COMPILE-TIME tier's matmul mode — a
+        # per-dispatch tier override reuses these stages at its own
+        # precision, which is numerically identical, just priced off
+        # this tier's model.
+        mxu_policy = _mxu_policy(use_layers, self._pallas_fast)
 
         # communication-aware planner: on by default wherever there is a
         # mesh to communicate over; ``comm_planner=False`` pins the
@@ -1530,7 +1635,8 @@ class CompiledCircuit:
             fstats = None
             k_fuse = resolve_fusion_k(fusion, n - shard_bits)
             if k_fuse >= 2:
-                barrier = _fence(_layer_barrier(recorded, n, shard_bits)
+                barrier = _fence(_layer_barrier(recorded, n, shard_bits,
+                                                mxu_policy)
                                  if use_layers else None, comm)
                 recorded, fstats = fuse_ops(
                     recorded, max_k=k_fuse,
@@ -1558,7 +1664,8 @@ class CompiledCircuit:
                     before = len(ops)
                     ops = _group_supergates(
                         ops, k_eff, fold_diags=(shard_bits == 0),
-                        barrier=_fence(_layer_barrier(ops, n, shard_bits)
+                        barrier=_fence(_layer_barrier(ops, n, shard_bits,
+                                                      mxu_policy)
                                        if use_layers else None, comm))
                     replan = len(ops) != before
             if replan:
@@ -1598,7 +1705,8 @@ class CompiledCircuit:
         if use_layers:
             from .parallel.layout import LayoutPlan
             items, ops = _collect_layers_plan(self.plan.items, ops,
-                                              n - shard_bits)
+                                              n - shard_bits,
+                                              mxu=mxu_policy)
             # prune the table to executed ops (fused members are
             # superseded by their LayerOp) so _ops remains the program
             ref = sorted({it[1] for it in items
@@ -1809,23 +1917,43 @@ class CompiledCircuit:
         # which ticks actually pay a check
         self._health_counter = 0
 
-    def _resolve_tier(self, tier):
-        """Validate a tier request for engine execution (None passes
-        through). QUAD rides the DDProgram path, not the engine; the
-        DOUBLE tier's f64 planes need x64 (without it JAX silently
-        downcasts — the QUAD64 env guard, one ladder down) AND an f64
-        STORAGE env — results leave the engine as env-dtype planes, so
-        on an f32 env a DOUBLE execution would round straight back to
-        f32 on exit and quietly deliver SINGLE-tier accuracy."""
+    def _resolve_tier(self, tier, dispatch: bool = False):
+        """Validate a tier request (None passes through); ``dispatch``
+        marks a per-dispatch request (sweep/expectation_sweep/serving)
+        as opposed to the compile-time tier. QUAD executes on
+        double-double planes THROUGH the batched engine
+        (``_dd_batched_runner``) as a per-dispatch tier; it needs x64
+        AND an f64-storage env because results leave the engine as
+        env-dtype planes — on an f32 env the ~2^-49-significand dd
+        values would round straight back to f32 on exit and the tier
+        would quietly deliver SINGLE accuracy. The DOUBLE tier's f64
+        planes need the same pair of guards (without x64 JAX silently
+        downcasts — the QUAD64 env guard, one ladder down)."""
         if tier is None:
             return None
         from .config import tier_by_name
         tier = tier_by_name(tier)
         if tier.name == "quad":
-            raise ValueError(
-                "the QUAD tier holds double-double planes; compile with "
-                "Circuit.compile_dd (static circuits) — the batched "
-                "engine ladder tops out at DOUBLE")
+            if not dispatch:
+                raise ValueError(
+                    "the QUAD tier is a per-DISPATCH rung: pass "
+                    "tier='quad' to sweep/expectation_sweep/"
+                    "sample_sweep (or submit()) — a compile-time quad "
+                    "tier would pin run()/apply() to the XLA "
+                    "executable, which has no dd form; for static "
+                    "circuits Circuit.compile_dd is the whole-program "
+                    "dd path")
+            if not jax.config.jax_enable_x64 or \
+                    np.dtype(self.env.precision.real_dtype) != \
+                    np.dtype(np.float64):
+                raise ValueError(
+                    "the QUAD tier's double-double planes recombine to "
+                    "env-dtype planes at the engine boundary: it needs "
+                    "jax_enable_x64 AND an f64-storage environment "
+                    "(precision=DOUBLE) so the ~48-bit significand "
+                    "survives the exit; on this env use "
+                    "Circuit.compile_dd (static circuits) instead")
+            return tier
         if tier.real_dtype == jnp.dtype("float64"):
             if not jax.config.jax_enable_x64:
                 raise ValueError(
@@ -1849,7 +1977,7 @@ class CompiledCircuit:
         precision)."""
         if tier is None:
             return self.tier
-        return self._resolve_tier(tier)
+        return self._resolve_tier(tier, dispatch=True)
 
     @staticmethod
     def _tier_exec_mode(tier) -> tuple:
@@ -1871,7 +1999,12 @@ class CompiledCircuit:
 
     @staticmethod
     def _tier_dtypes(tier, env) -> tuple:
-        """(real, complex) EXECUTION dtypes for one dispatch."""
+        """(real, complex) EXECUTION dtypes for one dispatch. QUAD is
+        special: its PLANES are f32 dd pairs but its engine boundary is
+        complex128 — casting the entry states to complex64 would
+        destroy the precision the dd split is about to preserve."""
+        if tier is not None and tier.name == "quad":
+            return np.dtype(np.float64), jnp.complex128
         rdt = np.dtype(tier.real_dtype) if tier is not None \
             else np.dtype(env.precision.real_dtype)
         cdt = jnp.complex64 if rdt == np.dtype(np.float32) \
@@ -2421,6 +2554,8 @@ class CompiledCircuit:
         the call in shard_map, where the kernel sees only the per-device
         sub-batch). ``tier`` (already effective) sets the dispatch's
         matmul precision and Pallas fast mode."""
+        if tier is not None and tier.name == "quad":
+            return self._dd_batched_runner()
         src = self._xla_only() if (mode == "amp"
                                    and self.env.mesh is not None) else self
         prec, fast = self._tier_exec_mode(tier)
@@ -2428,6 +2563,72 @@ class CompiledCircuit:
         def run(states, pm):
             return src._run_plan_batched(states, pm, gate_prec=prec,
                                          pallas_fast=fast)
+
+        return run
+
+    def _dd_batched_runner(self):
+        """The QUAD rung's plan executor: each batch row walks the
+        (layer-free) plan on DOUBLE-DOUBLE planes — every dense group
+        through :func:`~quest_tpu.ops.doubledouble.dd_apply_kq_traced`
+        (bound Param matrices dd-split traceably, so parameterised
+        sweeps ride the dd path the standalone ``DDProgram`` rejects),
+        diagonals through the dd factor kernel, relayouts as per-plane
+        transposes — then recombines to complex128 at the boundary.
+        Closes ROADMAP item 4's "dd sweeps fall off the fast path": one
+        keyed executable per (form, mode, dtype, tier='quad') through
+        the same ``_BoundedExecutableCache``, so the coalescer and the
+        serving tier ladder admit the highest-precision rung like any
+        other."""
+        from .ops import doubledouble as dd
+        # the dd walk needs the layer-free twin (Pallas stages have no
+        # dd form), same rule as the amp-mode runner
+        src = self._xla_only() if any(
+            getattr(op, "kind", None) == "layer" for op in self._ops) \
+            else self
+        ops = src._ops
+        plan_items = src.plan.items
+        n = self.num_qubits
+        names = self.param_names
+
+        def make_step(item):
+            if item[0] == "relayout":
+                _, before, after = item
+                return lambda planes, vec: dd.dd_relayout(
+                    planes, n, before, after)
+            _, i, phys_targets, cmask, fmask, axis_order = item
+            op = ops[i]
+            if op.kind == "u":
+                def step_u(planes, vec, _op=op, _pt=phys_targets,
+                           _cm=cmask, _fm=fmask):
+                    params = {nm: vec[j] for j, nm in enumerate(names)}
+                    u = _op.mat_fn(params) if _op.mat_fn is not None \
+                        else _op.mat
+                    return dd.dd_apply_kq_traced(planes, n, u, _pt,
+                                                 _cm, _fm)
+                return step_u
+
+            def step_d(planes, vec, _op=op, _pt=phys_targets,
+                       _ao=axis_order):
+                params = {nm: vec[j] for j, nm in enumerate(names)}
+                d = _op.diag_fn(params) if _op.diag_fn is not None \
+                    else _op.diag
+                d = jnp.transpose(jnp.asarray(d), _ao)
+                return dd.dd_apply_diag_traced(planes, n, d, _pt)
+            return step_d
+
+        steps = [make_step(item) for item in plan_items]
+
+        def run(states, pm):
+            planes_b = jax.vmap(dd.dd_split_traceable)(states)
+            for step in steps:
+                planes_b = jax.vmap(step)(planes_b, pm)
+                # stop XLA's simplifier from folding the error-free
+                # transformations ACROSS op boundaries (the DDProgram
+                # barrier rule — measured 1.4e-6 instead of 4e-13 on
+                # QFT-6 without it); outside the vmap: the primitive
+                # has no batching rule
+                planes_b = jax.lax.optimization_barrier(planes_b)
+            return jax.vmap(dd.dd_join_traceable)(planes_b)
 
         return run
 

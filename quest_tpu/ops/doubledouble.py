@@ -41,7 +41,9 @@ import numpy as np
 from .reductions import sum_pair, _split, _two_sum
 
 __all__ = ["dd_pack", "dd_unpack", "dd_apply_1q", "dd_apply_perm_1q",
-           "dd_apply_diag", "dd_total_prob", "DDProgram"]
+           "dd_apply_diag", "dd_total_prob", "DDProgram",
+           "dd_split_traceable", "dd_join_traceable",
+           "dd_apply_kq_traced", "dd_apply_diag_traced", "dd_relayout"]
 
 
 def _quick_two_sum(a, b):
@@ -91,6 +93,74 @@ def dd_pack(z: np.ndarray, dtype=np.float32) -> jnp.ndarray:
 def dd_unpack(planes) -> np.ndarray:
     p = np.asarray(planes, dtype=np.float64)
     return (p[0] + p[1]) + 1j * (p[2] + p[3])
+
+
+def dd_split_traceable(z, dtype=jnp.float32):
+    """Traceable dd split: a complex128 jnp array (a TRACER — the
+    batched QUAD engine's per-row entry, or a bound parameterised
+    matrix) -> (4, ...) dd planes. The hi/lo split is error-free by the
+    same argument as the host split: ``hi = fl32(x)`` and ``lo = x -
+    hi`` is exact in f64."""
+    re = jnp.real(z)
+    im = jnp.imag(z)
+    rh = re.astype(dtype)
+    ih = im.astype(dtype)
+    return jnp.stack([rh, (re - rh.astype(re.dtype)).astype(dtype),
+                      ih, (im - ih.astype(im.dtype)).astype(dtype)])
+
+
+def dd_join_traceable(planes):
+    """(4, ...) dd planes -> complex128 (traceable; each dd value
+    rounds to its nearest f64 — the engine-boundary exit of the QUAD
+    tier, which is why that tier needs an f64-storage env)."""
+    rh, rl, ih, il = (planes[i].astype(jnp.float64) for i in range(4))
+    return jax.lax.complex(rh + rl, ih + il)
+
+
+def dd_relayout(planes, num_qubits: int, perm_before,
+                perm_after) -> jnp.ndarray:
+    """The layout planner's relayout on dd planes: one per-plane
+    transpose of the ``(2,)*n`` view (the
+    :func:`quest_tpu.parallel.layout.apply_relayout` choreography with
+    a leading plane axis)."""
+    n = num_qubits
+    src = np.empty(n, dtype=np.int64)
+    for l in range(n):
+        src[n - 1 - int(perm_after[l])] = n - 1 - int(perm_before[l])
+    out = planes.reshape((4,) + (2,) * n).transpose(
+        (0,) + tuple(int(a) + 1 for a in src))
+    return out.reshape(4, -1)
+
+
+def dd_apply_kq_traced(planes, num_qubits: int, u, targets,
+                       ctrl_mask: int = 0, flip_mask: int = 0):
+    """Trace-time dense k-qubit (controlled) gate on dd planes: ``u``
+    is a complex matrix in user bit order — a host constant OR a traced
+    matrix (a bound Param gate), dd-split traceably. The batched QUAD
+    engine's gate kernel."""
+    from ..core.apply import permutation_to_sorted_desc
+    targets = tuple(int(t) for t in targets)
+    perm = permutation_to_sorted_desc(targets)
+    if not np.array_equal(perm, np.arange(1 << len(targets))):
+        u = u[perm][:, perm]
+    desc = tuple(sorted(targets, reverse=True))
+    u_dd = dd_split_traceable(u, jnp.dtype(planes.dtype))
+    out = _dd_apply_kq_body(planes, u_dd, num_qubits, desc)
+    if ctrl_mask:
+        cond = _index_bits_cond(planes.shape[1], int(ctrl_mask),
+                                int(ctrl_mask) ^ int(flip_mask))
+        out = jnp.where(cond[None, :], out, planes)
+    return out
+
+
+def dd_apply_diag_traced(planes, num_qubits: int, factors,
+                         targets_desc):
+    """Trace-time diagonal factor on dd planes (framework axis order,
+    qubits sorted descending); ``factors`` may be a traced tensor."""
+    f_dd = dd_split_traceable(jnp.reshape(factors, (-1,)),
+                              jnp.dtype(planes.dtype))
+    return _dd_diag_traced(planes, f_dd, num_qubits,
+                           tuple(int(q) for q in targets_desc))
 
 
 # --- kernels ---------------------------------------------------------------

@@ -47,7 +47,9 @@ DEFAULT_BLOCK_ROWS = 1024
 
 __all__ = ["LANE_QUBITS", "DEFAULT_BLOCK_ROWS", "LayerOp",
            "embed_lane_matrix", "lane_diag_matrix", "lane_diag_vector",
-           "max_mid_qubit", "apply_layer", "apply_layer_batched"]
+           "max_mid_qubit", "apply_layer", "apply_layer_batched",
+           "mxu_group_matrix", "apply_mxu_tile",
+           "fused_kraus_apply_batched"]
 
 
 def embed_lane_matrix(u: np.ndarray, targets: Sequence[int],
@@ -107,6 +109,50 @@ def max_mid_qubit(block_rows: int) -> int:
     return LANE_QUBITS + int(np.log2(block_rows)) - 1
 
 
+def mxu_group_matrix(u: np.ndarray, targets: Sequence[int],
+                     row_bits_asc: Sequence[int]) -> np.ndarray:
+    """Embed a dense (uncontrolled) gate into the MXU-tile contraction
+    operator over ``(lane qubits 0..6) + (row bits + 7)``: a
+    ``(2^j * 128, 2^j * 128)`` matrix whose index bit ``l < 7`` is lane
+    bit ``l`` and bit ``7 + m`` is row bit ``row_bits_asc[m]`` — exactly
+    the flat ``b * 128 + lane`` axis the ``rowmxu`` kernel stage
+    contracts after regrouping. ``targets`` are the gate's physical
+    qubit positions (lane and row positions mixed freely)."""
+    from ..core import matrices as mats
+    sup = tuple(range(LANE_QUBITS)) + tuple(
+        int(b) + LANE_QUBITS for b in row_bits_asc)
+    # quest: allow-host-sync(compile-time operand prep: u is a host
+    # matrix, never a device array)
+    return mats.embed_in_support(np.asarray(u, np.complex128), targets,
+                                 sup)
+
+
+def mxu_expand(m: np.ndarray, prev_bits: Sequence[int],
+               union_bits: Sequence[int]) -> np.ndarray:
+    """Expand an MXU-tile operator over ``(lanes + prev_bits)`` to the
+    superset support ``(lanes + union_bits)`` (identity on the new row
+    bits) — vectorized, so merging adjacent ``rowmxu`` stages with
+    different row-bit sets stays cheap at compile time."""
+    prev_bits = tuple(int(b) for b in prev_bits)
+    union_bits = tuple(int(b) for b in union_bits)
+    dim_u = (1 << len(union_bits)) * (1 << LANE_QUBITS)
+    idx = np.arange(dim_u)
+    a_p = idx & ((1 << LANE_QUBITS) - 1)
+    a_e = np.zeros_like(idx)
+    e = 0
+    for mpos, b in enumerate(union_bits):
+        bit = (idx >> (LANE_QUBITS + mpos)) & 1
+        if b in prev_bits:
+            a_p = a_p | (bit << (LANE_QUBITS + prev_bits.index(b)))
+        else:
+            a_e = a_e | (bit << e)
+            e += 1
+    # quest: allow-host-sync(compile-time operand prep: m is the host
+    # tile matrix, never a device array)
+    return np.asarray(m)[a_p[:, None], a_p[None, :]] \
+        * (a_e[:, None] == a_e[None, :])
+
+
 class LayerOp:
     """A fused layer: an ordered list of stages applied in one HBM pass.
 
@@ -125,6 +171,16 @@ class LayerOp:
       factor: ``table`` is complex ``(2^k, 128)``; the factor row is
       selected by the bits of the global row index at ``row_bits``
       (ascending positions, in row-bit coordinates).
+    - ``("rowmxu", row_bits, M)`` — MXU-shaped fused contraction: the
+      ``j`` row bits (ascending, row-bit coordinates) pack with the
+      128-lane axis into one ``(2^j * 128)``-dim contraction and ``M``
+      is the complex operator over that combined axis (bit ``l < 7`` =
+      lane bit ``l``, bit ``7 + m`` = ``row_bits[m]``; see
+      :func:`mxu_group_matrix`). One systolic-array matmul serves the
+      whole fused dense group — the FAST bf16 tier rides the MXU here
+      instead of the VPU row path. Uncontrolled groups only; selection
+      is the modeled crossover
+      :func:`quest_tpu.parallel.layout.choose_mxu_contraction`.
 
     Quacks enough like circuits._Op for the executors (kind/targets/
     masks/is_static).
@@ -149,7 +205,10 @@ class LayerOp:
                     support |= set(range(min(LANE_QUBITS, num_qubits)))
                 elif st[0] == "row":
                     support.add(st[1])
-                elif st[0] == "rowk":
+                elif st[0] in ("rowk", "rowmxu"):
+                    if st[0] == "rowmxu":
+                        support |= set(range(min(LANE_QUBITS,
+                                                 num_qubits)))
                     support |= {b + LANE_QUBITS for b in st[1]}
                 else:
                     support |= {b + LANE_QUBITS for b in st[2]}
@@ -175,9 +234,69 @@ def _global_row(base, shape, axis):
     return base + jax.lax.broadcasted_iota(jnp.int32, shape, axis)
 
 
+def _mxu_matmuls(re, im, mre_t, mim_t, acc, fast: bool):
+    """The shared complex contraction ``(v_re + i v_im) @ (M_re + i
+    M_im)^T`` as 4 real MXU matmuls — HIGHEST-precision f32 passes, or
+    the FAST tier's bf16-split compensated form (state splits error-free
+    into a bf16 hi plane + f32 residual, residual partials combine
+    first; same trade as the lane stage, see the comment there)."""
+    acc_dt = acc
+    if fast:
+        lp = jax.lax.Precision.DEFAULT
+
+        def _fdot(v, m):
+            hi = v.astype(jnp.bfloat16).astype(acc_dt)
+            lo = (v - hi).astype(acc_dt)
+            return (jnp.dot(hi, m, preferred_element_type=acc_dt,
+                            precision=lp),
+                    jnp.dot(lo, m, preferred_element_type=acc_dt,
+                            precision=lp))
+
+        rr_h, rr_l = _fdot(re, mre_t)
+        ii_h, ii_l = _fdot(im, mim_t)
+        ri_h, ri_l = _fdot(re, mim_t)
+        ir_h, ir_l = _fdot(im, mre_t)
+        return ((rr_h - ii_h) + (rr_l - ii_l),
+                (ri_h + ir_h) + (ri_l + ir_l))
+    hp = jax.lax.Precision.HIGHEST
+    new_re = (jnp.dot(re, mre_t, preferred_element_type=acc_dt,
+                      precision=hp)
+              - jnp.dot(im, mim_t, preferred_element_type=acc_dt,
+                        precision=hp))
+    new_im = (jnp.dot(re, mim_t, preferred_element_type=acc_dt,
+                      precision=hp)
+              + jnp.dot(im, mre_t, preferred_element_type=acc_dt,
+                        precision=hp))
+    return new_re, new_im
+
+
+def _row_regroup_plan(rows: int, bits: tuple):
+    """Static reshape/transpose plan bringing the row ``bits`` adjacent:
+    ``(dims, perm, inv_perm, groups, dim)`` such that reshaping to
+    ``dims + (128,)``, transposing by ``perm + (last,)`` and flattening
+    yields ``(groups, dim, 128)`` with combined-axis bit ``m`` = row bit
+    ``bits[m]`` (the ``rowk`` choreography, factored for reuse)."""
+    k = len(bits)
+    dim = 1 << k
+    rlog = int(np.log2(rows))
+    dims = []
+    prev = rlog
+    for b in reversed(bits):
+        dims += [1 << (prev - b - 1), 2]
+        prev = b
+    dims.append(1 << prev)
+    two_axes = [2 * i + 1 for i in range(k)]       # bits[k-1]..bits[0]
+    other_axes = [a for a in range(len(dims)) if a not in two_axes]
+    perm = other_axes + two_axes
+    inv = [0] * len(dims)
+    for pos, a in enumerate(perm):
+        inv[a] = pos
+    return tuple(dims), tuple(perm), tuple(inv), rows // dim, dim
+
+
 def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, tre_ref, tim_ref,
-                  ore_ref, oim_ref, *, stages, block_rows,
-                  batched: bool = False, fast: bool = False):
+                  xre_ref, xim_ref, ore_ref, oim_ref, *, stages,
+                  block_rows, batched: bool = False, fast: bool = False):
     from jax.experimental import pallas as pl
 
     # batched form: the grid grows a LEADING batch dimension and state
@@ -368,6 +487,33 @@ def _layer_kernel(re_ref, im_ref, mre_ref, mim_ref, tre_ref, tim_ref,
                        for g in range(dim)]
             re = ungroup(jnp.stack(nre, axis=1))
             im = ungroup(jnp.stack(nim, axis=1))
+        elif tag == "rowmxu":
+            # MXU-shaped fused contraction: the j row target bits pack
+            # with the 128-lane axis into one (2^j * 128)-dim axis and
+            # the whole fused group is a single systolic-array matmul
+            # over it — (groups, 2^j*128) x (2^j*128, 2^j*128) — where
+            # the row/rowk stages pay 2^k VPU MACs per amplitude
+            # (ROADMAP item 4: the FAST bf16 tier rides the MXU).
+            _, bits, xi, xdim = st
+            dims, perm, inv, groups, gdim = _row_regroup_plan(rows, bits)
+            flat = gdim * 128
+
+            def mx_regroup(x):
+                x = x.reshape(*dims, 128)
+                x = jnp.transpose(x, perm + (len(dims),))
+                return x.reshape(groups, flat)
+
+            def mx_ungroup(x):
+                x = x.reshape(*[dims[a] for a in perm], 128)
+                x = jnp.transpose(x, inv + (len(dims),))
+                return x.reshape(rows, 128)
+
+            mre_t = xre_ref[xi, :xdim, :xdim].T
+            mim_t = xim_ref[xi, :xdim, :xdim].T
+            new_re, new_im = _mxu_matmuls(mx_regroup(re), mx_regroup(im),
+                                          mre_t, mim_t, acc, fast)
+            re = mx_ungroup(new_re.astype(re.dtype))
+            im = mx_ungroup(new_im.astype(im.dtype))
         else:  # rowdiag
             _, toff, bits = st
             g = _global_row(base, (rows, 1), 0)
@@ -398,7 +544,10 @@ def layer_kernel_plan(layer: LayerOp, num_qubits: int,
     :func:`apply_layer` and the VMEM-budget tests (which need the EXACT
     per-chip stage chains the collector emits, without tracing).
 
-    Returns ``(kstages, mats, tables, block_rows, total_rows)``.
+    Returns ``(kstages, mats, tables, xmats, block_rows, total_rows)``
+    — ``xmats`` are the MXU-tile contraction operators of the layer's
+    ``rowmxu`` stages (variable dim; stacked zero-padded by the
+    operand prep).
     """
     total_rows = (1 << num_qubits) // 128
     if total_rows < 1:
@@ -409,6 +558,7 @@ def layer_kernel_plan(layer: LayerOp, num_qubits: int,
     # static stage plan + stacked matrix/table operands
     mats: list[np.ndarray] = []
     tables: list[np.ndarray] = []
+    xmats: list[np.ndarray] = []
     kstages: list[tuple] = []
     for st in layer.stages:
         if st[0] in ("lane", "clane"):
@@ -444,16 +594,31 @@ def layer_kernel_plan(layer: LayerOp, num_qubits: int,
                 tuple((float(z.real), float(z.imag)) for z in u.reshape(-1)),
                 int(lane_mask), int(lane_want),
                 int(row_mask), int(row_want)))
+        elif st[0] == "rowmxu":
+            _, bits, m = st
+            bits = tuple(int(b) for b in bits)
+            if bits and bits[-1] + LANE_QUBITS > hi:
+                raise ValueError(
+                    f"rowmxu bit {bits[-1]} outside block row range")
+            # quest: allow-host-sync(static stage plan: host matrix)
+            m = np.asarray(m)
+            dim = (1 << len(bits)) * (1 << LANE_QUBITS)
+            if m.shape != (dim, dim):
+                raise ValueError(
+                    f"rowmxu matrix shape {m.shape} != {(dim, dim)}")
+            kstages.append(("rowmxu", bits, len(xmats), dim))
+            xmats.append(np.ascontiguousarray(m))
         else:
             _, table, bits = st
             kstages.append(("rowdiag", len(tables), tuple(int(b)
                                                           for b in bits)))
             tables.extend(np.asarray(table))
-    return kstages, mats, tables, block_rows, total_rows
+    return kstages, mats, tables, xmats, block_rows, total_rows
 
 
 def choose_block_rows(kstages, mstack, tstack, block_rows: int,
-                      itemsize: int, vmem_limit: int) -> tuple[int, int]:
+                      itemsize: int, vmem_limit: int,
+                      xstack=None) -> tuple[int, int]:
     """Shrink ``block_rows`` until the Mosaic working-set estimate fits
     ``vmem_limit`` (halving trades grid steps for VMEM), respecting the
     pairing floor: a row stage pairing rows at ``stride`` needs its whole
@@ -465,13 +630,14 @@ def choose_block_rows(kstages, mstack, tstack, block_rows: int,
     """
     min_block = max([2 * st[1] for st in kstages if st[0] == "row"]
                     + [2 << st[1][-1] for st in kstages
-                       if st[0] == "rowk" and st[1]],
+                       if st[0] in ("rowk", "rowmxu") and st[1]],
                     default=8)
-    est = _vmem_estimate(block_rows, kstages, mstack, tstack, itemsize)
+    est = _vmem_estimate(block_rows, kstages, mstack, tstack, itemsize,
+                         xstack)
     while block_rows > max(8, min_block) and est > vmem_limit:
         block_rows //= 2
         est = _vmem_estimate(block_rows, kstages, mstack, tstack,
-                             itemsize)
+                             itemsize, xstack)
     return block_rows, est
 
 
@@ -489,23 +655,34 @@ def _layer_operands(layer: LayerOp, num_qubits: int, block_rows: int,
     chip's real VMEM and, if the estimate still exceeds it, halve the
     block until it fits (choose_block_rows).
     """
-    kstages, mats, tables, block_rows, total_rows = layer_kernel_plan(
-        layer, num_qubits, block_rows)
+    kstages, mats, tables, xmats, block_rows, total_rows = \
+        layer_kernel_plan(layer, num_qubits, block_rows)
     mstack = (np.stack(mats) if mats
               else np.zeros((1, 128, 128), np.complex128))
     tstack = (np.stack(tables) if tables
               else np.zeros((1, 128), np.complex128))
+    if xmats:
+        # the MXU-tile operators may mix dims (one per row-bit count);
+        # stack zero-padded to the max — the kernel slices [:dim, :dim]
+        xdim = max(m.shape[0] for m in xmats)
+        xstack = np.zeros((len(xmats), xdim, xdim), np.complex128)
+        for i, m in enumerate(xmats):
+            xstack[i, :m.shape[0], :m.shape[1]] = m
+    else:
+        xstack = np.zeros((1, 8, 8), np.complex128)
     mre = jnp.asarray(mstack.real, rdtype)
     mim = jnp.asarray(mstack.imag, rdtype)
     tre = jnp.asarray(tstack.real, rdtype)
     tim = jnp.asarray(tstack.imag, rdtype)
+    xre = jnp.asarray(xstack.real, rdtype)
+    xim = jnp.asarray(xstack.imag, rdtype)
     itemsize = np.dtype(rdtype).itemsize
     vmem_limit = int(os.environ.get("QUEST_PALLAS_VMEM_LIMIT",
                                     100 * 1024 * 1024))
     block_rows, _ = choose_block_rows(kstages, mstack, tstack, block_rows,
-                                      itemsize, vmem_limit)
-    return (kstages, mstack, tstack, mre, mim, tre, tim, block_rows,
-            total_rows, vmem_limit)
+                                      itemsize, vmem_limit, xstack)
+    return (kstages, mstack, tstack, xstack, mre, mim, tre, tim, xre,
+            xim, block_rows, total_rows, vmem_limit)
 
 
 def _compiler_kwargs(interpret: bool, vmem_limit: int) -> dict:
@@ -531,9 +708,9 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
     from jax.experimental import pallas as pl
 
     rdtype = jnp.float32 if state.dtype == jnp.complex64 else jnp.float64
-    (kstages, mstack, tstack, mre, mim, tre, tim, block_rows,
-     total_rows, vmem_limit) = _layer_operands(layer, num_qubits,
-                                               block_rows, rdtype)
+    (kstages, mstack, tstack, xstack, mre, mim, tre, tim, xre, xim,
+     block_rows, total_rows, vmem_limit) = _layer_operands(
+        layer, num_qubits, block_rows, rdtype)
     re = jnp.real(state).astype(rdtype).reshape(total_rows, 128)
     im = jnp.imag(state).astype(rdtype).reshape(total_rows, 128)
     kernel = functools.partial(_layer_kernel, stages=tuple(kstages),
@@ -541,17 +718,18 @@ def apply_layer(state: jnp.ndarray, num_qubits: int, layer: LayerOp,
     state_spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
     mat_spec = pl.BlockSpec(mstack.shape, lambda i: (0, 0, 0))
     tab_spec = pl.BlockSpec(tstack.shape, lambda i: (0, 0))
+    xmat_spec = pl.BlockSpec(xstack.shape, lambda i: (0, 0, 0))
     with jax.named_scope(f"pallas_layer_{layer.members}gates"):
         out_re, out_im = pl.pallas_call(
             kernel,
             grid=(total_rows // block_rows,),
             in_specs=[state_spec, state_spec, mat_spec, mat_spec,
-                      tab_spec, tab_spec],
+                      tab_spec, tab_spec, xmat_spec, xmat_spec],
             out_specs=[state_spec, state_spec],
             out_shape=[jax.ShapeDtypeStruct((total_rows, 128), rdtype)] * 2,
             interpret=interpret,
             **_compiler_kwargs(interpret, vmem_limit),
-        )(re, im, mre, mim, tre, tim)
+        )(re, im, mre, mim, tre, tim, xre, xim)
     return jax.lax.complex(out_re, out_im).reshape(-1).astype(state.dtype)
 
 
@@ -573,9 +751,9 @@ def apply_layer_batched(states: jnp.ndarray, num_qubits: int, layer: LayerOp,
 
     batch = states.shape[0]
     rdtype = jnp.float32 if states.dtype == jnp.complex64 else jnp.float64
-    (kstages, mstack, tstack, mre, mim, tre, tim, block_rows,
-     total_rows, vmem_limit) = _layer_operands(layer, num_qubits,
-                                               block_rows, rdtype)
+    (kstages, mstack, tstack, xstack, mre, mim, tre, tim, xre, xim,
+     block_rows, total_rows, vmem_limit) = _layer_operands(
+        layer, num_qubits, block_rows, rdtype)
     re = jnp.real(states).astype(rdtype).reshape(batch, total_rows, 128)
     im = jnp.imag(states).astype(rdtype).reshape(batch, total_rows, 128)
     kernel = functools.partial(_layer_kernel, stages=tuple(kstages),
@@ -584,32 +762,246 @@ def apply_layer_batched(states: jnp.ndarray, num_qubits: int, layer: LayerOp,
     state_spec = pl.BlockSpec((1, block_rows, 128), lambda b, i: (b, i, 0))
     mat_spec = pl.BlockSpec(mstack.shape, lambda b, i: (0, 0, 0))
     tab_spec = pl.BlockSpec(tstack.shape, lambda b, i: (0, 0))
+    xmat_spec = pl.BlockSpec(xstack.shape, lambda b, i: (0, 0, 0))
     with jax.named_scope(
             f"pallas_layer_b{batch}_{layer.members}gates"):
         out_re, out_im = pl.pallas_call(
             kernel,
             grid=(batch, total_rows // block_rows),
             in_specs=[state_spec, state_spec, mat_spec, mat_spec,
-                      tab_spec, tab_spec],
+                      tab_spec, tab_spec, xmat_spec, xmat_spec],
             out_specs=[state_spec, state_spec],
             out_shape=[jax.ShapeDtypeStruct((batch, total_rows, 128),
                                             rdtype)] * 2,
             interpret=interpret,
             **_compiler_kwargs(interpret, vmem_limit),
-        )(re, im, mre, mim, tre, tim)
+        )(re, im, mre, mim, tre, tim, xre, xim)
     return jax.lax.complex(out_re, out_im).reshape(batch, -1).astype(
         states.dtype)
 
 
+class _ExecCache:
+    """Tiny thread-safe keyed executable cache for the standalone
+    kernel entries below — the same ``_cached(key, builder)`` idiom
+    (and the same LRU bound class) as the engine caches, so quest-lint
+    QL002 checks these insertions' key completeness too."""
+
+    def __init__(self, maxsize: int = 16):
+        import threading
+        self._lock = threading.Lock()
+        self._maxsize = maxsize
+        self._c = None
+
+    def _cached(self, key, builder):
+        from ..circuits import _BoundedExecutableCache
+        with self._lock:
+            if self._c is None:
+                self._c = _BoundedExecutableCache(self._maxsize)
+            fn = self._c.get(key)
+        if fn is not None:
+            return fn
+        fn = builder()
+        with self._lock:
+            self._c[key] = fn
+        return fn
+
+
+_MXU_EXEC = _ExecCache(int(os.environ.get("QUEST_TPU_MXU_TILE_CACHE",
+                                          "16")))
+
+
+def apply_mxu_tile(state: jnp.ndarray, num_qubits: int, u: np.ndarray,
+                   targets: Sequence[int], fast: bool = False,
+                   interpret: bool = False,
+                   block_rows: int = DEFAULT_BLOCK_ROWS) -> jnp.ndarray:
+    """Apply ONE dense uncontrolled gate as an MXU-shaped contraction:
+    the gate (static host matrix, any mix of lane and row targets within
+    the block range) embeds over ``(lane qubits + its row bits)`` into a
+    ``(2^j * 128)``-tile operator and runs as systolic-array matmuls in
+    one HBM pass — the standalone form of the ``rowmxu`` layer stage
+    (bench off/on rows and parity tests drive it directly; compiled
+    programs get the same shape through the layer collector).
+
+    The jitted executable is cached per ``(geometry, dtype, tier mode)``
+    — the MATRIX is an argument, so one executable serves every gate of
+    the same shape."""
+    from jax.experimental import pallas as pl
+
+    n = int(num_qubits)
+    targets = tuple(int(t) for t in targets)
+    bits = tuple(sorted(t - LANE_QUBITS for t in targets
+                        if t >= LANE_QUBITS))
+    total_rows = (1 << n) // 128
+    if total_rows < 1:
+        raise ValueError("MXU tiles need at least 7 qubits")
+    block_rows = min(block_rows, total_rows)
+    if bits and bits[-1] + LANE_QUBITS > max_mid_qubit(block_rows):
+        raise ValueError(
+            f"row target {bits[-1] + LANE_QUBITS} outside the "
+            f"{block_rows}-row block range")
+    m = mxu_group_matrix(u, targets, bits)
+    dim = m.shape[0]
+    rdtype = jnp.float32 if state.dtype == jnp.complex64 else jnp.float64
+    dt_token = str(np.dtype(rdtype))
+    tier_tok = "fast" if fast else "highest"
+    vmem_limit = int(os.environ.get("QUEST_PALLAS_VMEM_LIMIT",
+                                    100 * 1024 * 1024))
+
+    def build():
+        kernel = functools.partial(
+            _layer_kernel, stages=(("rowmxu", bits, 0, dim),),
+            block_rows=block_rows, fast=fast)
+        state_spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
+        dummy_spec = pl.BlockSpec((1, 1, 1), lambda i: (0, 0, 0))
+        tab_spec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+        xmat_spec = pl.BlockSpec((1, dim, dim), lambda i: (0, 0, 0))
+
+        def fn(re, im, xre, xim):
+            z = jnp.zeros((1, 1, 1), rdtype)
+            zt = jnp.zeros((1, 1), rdtype)
+            return pl.pallas_call(
+                kernel,
+                grid=(total_rows // block_rows,),
+                in_specs=[state_spec, state_spec, dummy_spec, dummy_spec,
+                          tab_spec, tab_spec, xmat_spec, xmat_spec],
+                out_specs=[state_spec, state_spec],
+                out_shape=[jax.ShapeDtypeStruct((total_rows, 128),
+                                                rdtype)] * 2,
+                interpret=interpret,
+                **_compiler_kwargs(interpret, vmem_limit),
+            )(re, im, z, z, zt, zt, xre, xim)
+
+        return jax.jit(fn)
+
+    call = _MXU_EXEC._cached(
+        ("mxu_tile", n, bits, block_rows, dt_token, tier_tok,
+         bool(interpret)), build)
+    re = jnp.real(state).astype(rdtype).reshape(total_rows, 128)
+    im = jnp.imag(state).astype(rdtype).reshape(total_rows, 128)
+    xre = jnp.asarray(m.real, rdtype)[None]
+    xim = jnp.asarray(m.imag, rdtype)[None]
+    with jax.named_scope(f"pallas_mxu_tile_{dim}"):
+        out_re, out_im = call(re, im, xre, xim)
+    return jax.lax.complex(out_re, out_im).reshape(-1).astype(state.dtype)
+
+
+def _kraus_kernel(re_ref, im_ref, kre_ref, kim_ref, p_ref, u_ref,
+                  ore_ref, oim_ref, *, num_ops, block_rows):
+    """Fused per-trajectory Kraus draw + apply + renormalise: ONE kernel
+    replaces the XLA chain categorical-draw -> stacked-operator gather
+    -> apply -> rsqrt renorm. The draw is inverse-CDF over the channel
+    probabilities against the trajectory's uniform (scalar unrolled —
+    K is the Kraus count, 2-4 for every physical channel), the selected
+    lane-embedded operator is blended by exact one-hot weights, the
+    renormalisation ``1/sqrt(p_j)`` folds into the operator, and the
+    state streams through VMEM exactly once."""
+    re = re_ref[0]
+    im = im_ref[0]
+    acc = re.dtype
+    total = p_ref[0, 0]
+    for k in range(1, num_ops):
+        total = total + p_ref[0, k]
+    # cap the threshold STRICTLY below the total: fl(u * total) can
+    # round up to `total` at u -> 1, where every prefix would count and
+    # the clamp would select branch K-1 even at p_{K-1} == 0 — a
+    # zero-probability draw the XLA categorical never makes, rsqrt'd
+    # into a garbage trajectory. With uu < total the selected branch
+    # (the first prefix sum exceeding uu) always carries positive
+    # probability; prefixes that EQUAL uu are counted as used up, so a
+    # leading zero-probability branch is skipped at u == 0 too.
+    uu = jnp.minimum(u_ref[0, 0] * total,
+                     total - total * jnp.finfo(acc).eps)
+    cum = p_ref[0, 0] * 0.0
+    cnt = jnp.int32(0)
+    for k in range(num_ops):
+        cum = cum + p_ref[0, k]
+        cnt = cnt + (cum <= uu).astype(jnp.int32)
+    jidx = jnp.minimum(cnt, num_ops - 1)
+    psel = p_ref[0, 0] * 0.0
+    for k in range(num_ops):
+        psel = psel + (jidx == k).astype(acc) * p_ref[0, k]
+    scale = jax.lax.rsqrt(jnp.maximum(psel, jnp.finfo(acc).tiny))
+    mre = (jidx == 0).astype(acc) * kre_ref[0]
+    mim = (jidx == 0).astype(acc) * kim_ref[0]
+    for k in range(1, num_ops):
+        w = (jidx == k).astype(acc)
+        mre = mre + w * kre_ref[k]
+        mim = mim + w * kim_ref[k]
+    mre = mre * scale
+    mim = mim * scale
+    new_re, new_im = _mxu_matmuls(re, im, mre.T, mim.T, acc, False)
+    ore_ref[0] = new_re.astype(re.dtype)
+    oim_ref[0] = new_im.astype(im.dtype)
+
+
+def fused_kraus_apply_batched(states: jnp.ndarray, num_qubits: int,
+                              kstack: np.ndarray, probs: jnp.ndarray,
+                              u01: jnp.ndarray,
+                              block_rows: int = DEFAULT_BLOCK_ROWS,
+                              interpret: bool = False) -> jnp.ndarray:
+    """Draw + apply one Kraus channel for a whole trajectory batch in
+    ONE ``pallas_call``: ``states`` is the ``(T, 2^n)`` complex batch,
+    ``kstack`` the ``(K, 128, 128)`` LANE-EMBEDDED operator stack (all
+    channel targets below qubit 7 — :func:`embed_lane_matrix` per
+    operator), ``probs`` the ``(T, K)`` physical channel probabilities
+    (one reduced-density pass, computed upstream), and ``u01`` the
+    ``(T,)`` per-trajectory uniforms driving the inverse-CDF draw.
+    Grid ``(T, row_blocks)``; traceable — call under jit."""
+    from jax.experimental import pallas as pl
+
+    T = states.shape[0]
+    n = int(num_qubits)
+    K = int(kstack.shape[0])
+    total_rows = (1 << n) // 128
+    if total_rows < 1:
+        raise ValueError("the fused Kraus kernel needs at least 7 qubits")
+    block_rows = min(block_rows, total_rows)
+    rdtype = jnp.float32 if states.dtype == jnp.complex64 \
+        else jnp.float64
+    vmem_limit = int(os.environ.get("QUEST_PALLAS_VMEM_LIMIT",
+                                    100 * 1024 * 1024))
+    re = jnp.real(states).astype(rdtype).reshape(T, total_rows, 128)
+    im = jnp.imag(states).astype(rdtype).reshape(T, total_rows, 128)
+    kre = jnp.asarray(np.ascontiguousarray(kstack.real), rdtype)
+    kim = jnp.asarray(np.ascontiguousarray(kstack.imag), rdtype)
+    p2 = jnp.asarray(probs, rdtype).reshape(T, K)
+    u2 = jnp.asarray(u01, rdtype).reshape(T, 1)
+    kernel = functools.partial(_kraus_kernel, num_ops=K,
+                               block_rows=block_rows)
+    state_spec = pl.BlockSpec((1, block_rows, 128), lambda t, i: (t, i, 0))
+    k_spec = pl.BlockSpec((K, 128, 128), lambda t, i: (0, 0, 0))
+    p_spec = pl.BlockSpec((1, K), lambda t, i: (t, 0))
+    u_spec = pl.BlockSpec((1, 1), lambda t, i: (t, 0))
+    with jax.named_scope(f"pallas_kraus_t{T}_k{K}"):
+        out_re, out_im = pl.pallas_call(
+            kernel,
+            grid=(T, total_rows // block_rows),
+            in_specs=[state_spec, state_spec, k_spec, k_spec, p_spec,
+                      u_spec],
+            out_specs=[state_spec, state_spec],
+            out_shape=[jax.ShapeDtypeStruct((T, total_rows, 128),
+                                            rdtype)] * 2,
+            interpret=interpret,
+            **_compiler_kwargs(interpret, vmem_limit),
+        )(re, im, kre, kim, p2, u2)
+    return jax.lax.complex(out_re, out_im).reshape(T, -1).astype(
+        states.dtype)
+
+
 def _vmem_estimate(block_rows: int, kstages, mstack, tstack,
-                   itemsize: int) -> int:
+                   itemsize: int, xstack=None) -> int:
     """Conservative Mosaic working-set model for one grid step: in + out
     plane pairs with double-buffering (x2), ~2 extra live plane pairs per
     stage (a rowk stage keeps its 2^k group slices live, so it weighs
-    2^(k-1) plain stages), plus the stacked operand buffers."""
+    2^(k-1) plain stages; a rowmxu stage keeps its regrouped planes —
+    one full pair — live next to the contraction), plus the stacked
+    operand buffers (the MXU-tile stack included)."""
     plane_pair = 2 * block_rows * 128 * itemsize
-    weight = sum((1 << len(st[1])) // 2 if st[0] == "rowk" else 1
+    weight = sum((1 << len(st[1])) // 2 if st[0] == "rowk"
+                 else 2 if st[0] == "rowmxu" else 1
                  for st in kstages)
+    xbytes = 2 * int(np.prod(xstack.shape)) * itemsize \
+        if xstack is not None else 0
     return (4 * plane_pair + 2 * weight * plane_pair
             + 2 * int(np.prod(mstack.shape)) * itemsize
-            + 2 * int(np.prod(tstack.shape)) * itemsize)
+            + 2 * int(np.prod(tstack.shape)) * itemsize + xbytes)

@@ -125,7 +125,7 @@ class TrajectoryProgram:
             self._digest_cached = d or f"id-{id(self):x}"
         return self._digest_cached
 
-    def __init__(self, circuit, env):
+    def __init__(self, circuit, env, pallas=None):
         self.env = env
         self.circuit = circuit
         self.num_qubits = circuit.num_qubits
@@ -197,67 +197,190 @@ class TrajectoryProgram:
             self._host_bits = min(topo.host_bits, shard_bits) if topo \
                 else 0
 
+        # Pallas layer path for the WAVE LOOP (ROADMAP item 4: "the
+        # trajectory amp-mode wave loop has no Pallas layer path"):
+        # static gate runs between channels fuse into LayerOps applied
+        # by the batch-gridded layer kernel (one HBM pass per run,
+        # whole wave at once), and an eligible static channel (all
+        # targets on lane qubits) runs the FUSED per-trajectory Kraus
+        # draw + apply + renorm kernel instead of the plain-XLA
+        # categorical-draw -> stacked-operator-gather chain. Same knob
+        # semantics as Circuit.compile (None = auto on TPU backends,
+        # "interpret" for tests, False off); active only in the
+        # unsharded ("none") dispatch mode — mesh modes keep the XLA
+        # twin (GSPMD has no pallas_call partitioning rule), so the
+        # cache key carries the path token. NOTE the fused kernel draws
+        # by inverse-CDF from the key stream's uniform rather than the
+        # XLA path's Gumbel categorical: statistically identical,
+        # bitwise different — the pallas-on path is its own draw
+        # stream.
+        if pallas is None:
+            pallas = os.environ.get("QUEST_TPU_PALLAS", "auto")
+        interpret = pallas == "interpret"
+        self._pallas_interpret = interpret
+        enabled = pallas not in (False, "0", "off") and (
+            interpret or jax.default_backend() in ("tpu", "axon")) \
+            and self.num_qubits >= 7
+        self._pallas_items = self._build_pallas_items() if enabled \
+            else None
+
+    def _build_pallas_items(self):
+        """The layered item stream for the batched Pallas walker:
+        ``("layer", LayerOp)`` for fused static runs, ``("kraus_fused",
+        targets, (stack, estack, lane-embedded stack), idx)`` for
+        channels the fused draw+apply kernel covers, the plain op
+        tuples otherwise. Channel order (and so key fold-in indices)
+        matches ``self._ops``."""
+        from ..circuits import _collect_layers
+        from . import pallas_kernels as pk
+        n = self.num_qubits
+        layered = _collect_layers(list(self.circuit._fused_ops()), n)
+        kraus_tuples = [t for t in self._ops
+                        if t[0] in ("kraus", "kraus_fn")]
+        items = []
+        ki = 0
+        for op in layered:
+            kind = getattr(op, "kind", None)
+            if kind == "layer":
+                items.append(("layer", op))
+            elif kind == "kraus":
+                t = kraus_tuples[ki]
+                ki += 1
+                if t[0] == "kraus" and all(
+                        q < pk.LANE_QUBITS for q in t[1]):
+                    stack, estack = t[2]
+                    kemb = np.stack([pk.embed_lane_matrix(k, t[1])
+                                     for k in stack])
+                    items.append(("kraus_fused", t[1],
+                                  (stack, estack, kemb), t[3]))
+                else:
+                    items.append(t)
+            elif kind == "u":
+                data = op.mat_fn if op.mat_fn is not None else op.mat
+                items.append(("u_fn" if op.mat_fn is not None else "u",
+                              op.targets, data,
+                              (op.ctrl_mask, op.flip_mask)))
+            else:
+                data = op.diag_fn if op.diag_fn is not None else op.diag
+                items.append(
+                    ("diag_fn" if op.diag_fn is not None else "diag",
+                     op.targets, data, None))
+        return items
+
     # -- the per-trajectory program ----------------------------------------
 
-    def _apply_core(self, state_f, key, param_vec=None):
+    def _channel_probs(self, psi, targets, estack):
+        """``p_j = <psi| E_j |psi> = tr(E_j rho_T)``: ONE state pass
+        builds the 2^t x 2^t reduced density of the targets, then every
+        probability is a tiny trace. HIGHEST: these feed the
+        renormalisation, so the TPU bf16 matmul default would drift
+        every trajectory's norm (same reason as core/apply.py)."""
+        n = self.num_qubits
+        k = len(targets)
+        axes_front = [n - 1 - targets[j] for j in reversed(range(k))]
+        rest = [ax for ax in range(n) if ax not in axes_front]
+        a = jnp.transpose(psi.reshape((2,) * n),
+                          axes_front + rest).reshape(1 << k, -1)
+        rho_t = jnp.matmul(a, a.conj().T,
+                           precision=jax.lax.Precision.HIGHEST)
+        return jnp.real(jnp.einsum(
+            "kab,ba->k", estack, rho_t,
+            precision=jax.lax.Precision.HIGHEST))
+
+    def _op_step(self, psi, key, params, op):
+        """One op of the per-trajectory program on an UNPACKED complex
+        state (shared by the single-trajectory jit and the batched XLA
+        fallback's vmapped walker)."""
         n = self.num_qubits
         cdtype = self.env.precision.complex_dtype
+        kind, targets, data, extra = op
+        if kind in ("u", "u_fn"):
+            cmask, fmask = extra
+            u = data(params) if kind == "u_fn" else data
+            return apply_unitary(psi, n, jnp.asarray(u, cdtype),
+                                 targets, cmask, fmask)
+        if kind in ("diag", "diag_fn"):
+            d = data(params) if kind == "diag_fn" else data
+            return apply_diagonal(psi, n, targets,
+                                  jnp.asarray(d, cdtype))
+        if kind == "kraus_fn":
+            kstack = jnp.stack(
+                [jnp.asarray(m).astype(cdtype)
+                 for m in data(params)])
+            estack = jnp.einsum(
+                "kba,kbc->kac", jnp.conj(kstack), kstack,
+                precision=jax.lax.Precision.HIGHEST)
+        else:
+            kstack = jnp.asarray(data[0], cdtype)
+            estack = jnp.asarray(data[1], cdtype)
+        sub = jax.random.fold_in(key, extra)
+        probs = self._channel_probs(psi, targets, estack)
+        # categorical draw over the physical channel probs
+        # (log space; zero-prob branches get ~-inf)
+        logp = jnp.log(jnp.maximum(
+            probs, jnp.finfo(probs.dtype).tiny))
+        j = jax.random.categorical(sub, logp)
+        psi = apply_unitary(psi, n, kstack[j], targets)
+        return psi * jax.lax.rsqrt(
+            jnp.maximum(probs[j],
+                        jnp.finfo(probs.dtype).tiny)).astype(psi.dtype)
+
+    def _apply_core(self, state_f, key, param_vec=None):
         if param_vec is None:
             params = {}
         else:
             params = {nm: param_vec[i]
                       for i, nm in enumerate(self.param_names)}
         psi = unpack(state_f)
-        for kind, targets, data, extra in self._ops:
-            if kind in ("u", "u_fn"):
-                cmask, fmask = extra
-                u = data(params) if kind == "u_fn" else data
-                psi = apply_unitary(psi, n, jnp.asarray(u, cdtype),
-                                    targets, cmask, fmask)
-            elif kind in ("diag", "diag_fn"):
-                d = data(params) if kind == "diag_fn" else data
-                psi = apply_diagonal(psi, n, targets,
-                                     jnp.asarray(d, cdtype))
-            else:
-                if kind == "kraus_fn":
-                    kstack = jnp.stack(
-                        [jnp.asarray(m).astype(cdtype)
-                         for m in data(params)])
-                    estack = jnp.einsum(
-                        "kba,kbc->kac", jnp.conj(kstack), kstack,
-                        precision=jax.lax.Precision.HIGHEST)
-                else:
-                    kstack = jnp.asarray(data[0], cdtype)
-                    estack = jnp.asarray(data[1], cdtype)
-                sub = jax.random.fold_in(key, extra)
-                # p_j = <psi| E_j |psi> = tr(E_j rho_T): ONE state pass
-                # builds the 2^t x 2^t reduced density of the targets,
-                # then every probability is a tiny trace
-                k = len(targets)
-                axes_front = [n - 1 - targets[j]
-                              for j in reversed(range(k))]
-                rest = [ax for ax in range(n) if ax not in axes_front]
-                a = jnp.transpose(psi.reshape((2,) * n),
-                                  axes_front + rest).reshape(1 << k, -1)
-                # HIGHEST: these feed the renormalisation, so the TPU
-                # bf16 matmul default would drift every trajectory's
-                # norm (same reason as core/apply.py)
-                rho_t = jnp.matmul(a, a.conj().T,
-                                   precision=jax.lax.Precision.HIGHEST)
-                probs = jnp.real(jnp.einsum(
-                    "kab,ba->k", estack, rho_t,
-                    precision=jax.lax.Precision.HIGHEST))
-                # categorical draw over the physical channel probs
-                # (log space; zero-prob branches get ~-inf)
-                logp = jnp.log(jnp.maximum(
-                    probs, jnp.finfo(probs.dtype).tiny))
-                j = jax.random.categorical(sub, logp)
-                psi = apply_unitary(psi, n, kstack[j], targets)
-                psi = psi * jax.lax.rsqrt(
-                    jnp.maximum(probs[j],
-                                jnp.finfo(probs.dtype).tiny)
-                ).astype(psi.dtype)
+        for op in self._ops:
+            if op[0] == "kraus_fused":
+                op = ("kraus", op[1], op[2][:2], op[3])
+            psi = self._op_step(psi, key, params, op)
         return pack(psi)
+
+    def _apply_batch(self, state_f, keys, flat_pv):
+        """The PALLAS wave-loop walker: the whole trajectory batch
+        advances item by item — fused static runs through the
+        batch-gridded layer kernel (:func:`quest_tpu.ops.
+        pallas_kernels.apply_layer_batched`, one HBM pass per run for
+        the WHOLE wave), eligible channels through the fused
+        draw+apply+renorm Kraus kernel, everything else through the
+        vmapped XLA step. Returns the ``(T, 2^n)`` complex batch."""
+        from . import pallas_kernels as pk
+        n = self.num_qubits
+        T = keys.shape[0]
+        psi0 = unpack(state_f)
+        states = jnp.broadcast_to(psi0, (T,) + psi0.shape)
+        interp = self._pallas_interpret
+        for op in self._pallas_items:
+            kind = op[0]
+            if kind == "layer":
+                states = pk.apply_layer_batched(states, n, op[1],
+                                                interpret=interp)
+                continue
+            if kind == "kraus_fused":
+                _, targets, (stack, estack, kemb), idx = op
+                cdtype = self.env.precision.complex_dtype
+                es = jnp.asarray(estack, cdtype)
+                probs = jax.vmap(
+                    lambda s: self._channel_probs(s, targets, es))(
+                    states)
+                subs = jax.vmap(
+                    lambda k: jax.random.fold_in(k, idx))(keys)
+                u01 = jax.vmap(
+                    lambda k: jax.random.uniform(
+                        k, dtype=probs.dtype))(subs)
+                states = pk.fused_kraus_apply_batched(
+                    states, n, kemb, probs, u01, interpret=interp)
+                continue
+
+            def step(s, k, vec, _op=op):
+                params = {nm: vec[i]
+                          for i, nm in enumerate(self.param_names)}
+                return self._op_step(s, k, params, _op)
+
+            states = jax.vmap(step)(states, keys, flat_pv)
+        return states
 
     # -- parameters / operands ---------------------------------------------
 
@@ -451,25 +574,47 @@ class TrajectoryProgram:
         with self._stats_lock:
             # quest: allow-cache-key(the key is built at the _cached()
             # call sites, which the QL002 rule checks individually --
-            # trajectory keys carry form+mode+dtype; the tier ladder is
-            # rejected at the trajectory submit boundary, so no tier)
+            # trajectory keys carry form+mode+dtype+kernel-path (the
+            # pallas/xla token: the two paths trace different programs);
+            # the tier ladder is rejected at the trajectory submit
+            # boundary, so no tier)
             self._cache[key] = fn
         return fn
 
+    def _use_pallas(self, mode: str) -> bool:
+        """The Pallas layer path runs in the unsharded mode only: mesh
+        modes dispatch under GSPMD, which has no ``pallas_call``
+        partitioning rule (it would replicate the wave exactly where a
+        mesh mode was chosen for memory)."""
+        return self._pallas_items is not None and mode == "none"
+
+    def _path_token(self, mode: str) -> str:
+        return "pallas" if self._use_pallas(mode) else "xla"
+
     def _sweep_fn(self, mode: str):
         """The trajectory-sweep executable for one sharding mode:
-        vmapped draws over the key axis, output pinned to the policy's
-        layout."""
+        vmapped draws over the key axis (or the batched Pallas walker
+        in the unsharded mode), output pinned to the policy's layout."""
         constrain = self._out_constraint(mode)
+        use_p = self._use_pallas(mode)
 
         def build():
-            def fn(state_f, keys, pv):
-                out = jax.vmap(
-                    lambda k: self._apply_core(state_f, k, pv))(keys)
-                return constrain(out)
+            if use_p:
+                def fn(state_f, keys, pv):
+                    flat_pv = jnp.broadcast_to(
+                        pv, (keys.shape[0],) + pv.shape)
+                    z = self._apply_batch(state_f, keys, flat_pv)
+                    out = jnp.stack([jnp.real(z), jnp.imag(z)], axis=1)
+                    return constrain(out)
+            else:
+                def fn(state_f, keys, pv):
+                    out = jax.vmap(
+                        lambda k: self._apply_core(state_f, k, pv))(keys)
+                    return constrain(out)
             return jax.jit(fn)
 
-        return self._cached(("tsweep", mode, self._dt_token()), build)
+        return self._cached(("tsweep", mode, self._dt_token(),
+                             self._path_token(mode)), build)
 
     def _wave_fn(self, mode: str):
         """One convergence-loop wave for the ``(B, W)`` request-batch
@@ -480,6 +625,7 @@ class TrajectoryProgram:
         ``(3, B)`` carry is the only device->host transfer the stop
         decision needs."""
         constrain = self._out_constraint(mode)
+        use_p = self._use_pallas(mode)
         rdt = jnp.float64 if np.dtype(
             self.env.precision.real_dtype) == np.float64 else jnp.float32
 
@@ -488,11 +634,14 @@ class TrajectoryProgram:
                 B = pm.shape[0]
                 W = flat_keys.shape[0] // B
                 flat_pv = jnp.repeat(pm, W, axis=0)
-                planes = jax.vmap(
-                    lambda k, pv_: self._apply_core(state_f, k, pv_))(
-                    flat_keys, flat_pv)
-                planes = constrain(planes)
-                z = jax.lax.complex(planes[:, 0], planes[:, 1])
+                if use_p:
+                    z = self._apply_batch(state_f, flat_keys, flat_pv)
+                else:
+                    planes = jax.vmap(
+                        lambda k, pv_: self._apply_core(
+                            state_f, k, pv_))(flat_keys, flat_pv)
+                    planes = constrain(planes)
+                    z = jax.lax.complex(planes[:, 0], planes[:, 1])
                 vals = jax.vmap(lambda s: red.pauli_sum_total_sv(
                     s, xm, ym, zm, cf))(z)
                 vals = vals.reshape(B, W).astype(rdt)
@@ -501,7 +650,8 @@ class TrajectoryProgram:
                     (carry[0], carry[1], carry[2]), (n_w, m_w, s_w))
                 return jnp.stack([n, m, s])
             return jax.jit(fn, donate_argnums=(8,))
-        return self._cached(("twave", mode, self._dt_token()), build)
+        return self._cached(("twave", mode, self._dt_token(),
+                             self._path_token(mode)), build)
 
     # -- execution ---------------------------------------------------------
 
@@ -549,7 +699,14 @@ class TrajectoryProgram:
         the key array, not the placement, decides every draw — and
         non-divisible counts pad-and-mask with a one-time warning.
         ``shard_trajectories`` overrides the policy (True forces
-        trajectory-parallel, False forces unsharded)."""
+        trajectory-parallel, False forces unsharded).
+
+        One caveat: with the Pallas wave path on (``pallas=`` at
+        compile), the UNSHARDED mode's fused Kraus kernel draws by
+        inverse-CDF where the XLA twin draws categorically — the two
+        KERNEL paths are separate (statistically identical) draw
+        streams, so cross-mode bit-identity holds within a kernel path,
+        not across the pallas/xla boundary."""
         T = int(num_trajectories)
         if T < 1:
             raise ValueError("num_trajectories must be >= 1")

@@ -93,7 +93,8 @@ import jax.numpy as jnp
 
 __all__ = ["LayoutPlan", "plan_layout", "apply_relayout", "is_swap_op",
            "plan_comm_stats", "relayout_comm", "relayout_comm_tiered",
-           "choose_batch_sharding", "traj_cross_shard_ops"]
+           "choose_batch_sharding", "traj_cross_shard_ops",
+           "choose_mxu_contraction", "MXU_ROW_CAP"]
 
 _SWAP_MAT = np.array([[1, 0, 0, 0], [0, 0, 1, 0],
                       [0, 1, 0, 0], [0, 0, 0, 1]], dtype=np.complex128)
@@ -740,6 +741,95 @@ def choose_batch_sharding(num_qubits: int, batch: int, num_devices: int,
                 "per_device_bytes": batch_mode_bytes}
     return {"mode": "amp", "amp_comm_seconds": amp_comm,
             "per_device_bytes": 2.0 * state_bytes / num_devices}
+
+
+# ---------------------------------------------------------------------------
+# MXU-shaping crossover (the fused-contraction kernel selection rule)
+# ---------------------------------------------------------------------------
+
+# Nominal per-chip compute-rate models for the MXU-shaping decision
+# (flops/s; overridable via QUEST_TPU_MXU_FLOPS / QUEST_TPU_VPU_FLOPS).
+# The systolic array runs dense (128, 128) matmuls at ~2e13 f32-
+# accumulate flops/s on a v5e-class chip and ~5x that with bf16 inputs
+# (the FAST tier's Precision.DEFAULT mode); the VPU's 8x128 elementwise
+# lanes sustain ~4e11. Decisions depend only on the RATIOS between
+# these and the HBM roofline, so the defaults are safe order-of-
+# magnitude models wherever no measurement exists — the same contract
+# as DEFAULT_COMM_MODEL.
+MXU_FLOPS_F32 = 2.0e13
+MXU_FLOPS_BF16 = 1.0e14
+VPU_FLOPS = 4.0e11
+
+# Row-bit budget for one MXU-shaped contraction: j row bits pack with
+# the 128-lane axis into a (2^j * 128)-dim contraction, so the operand
+# matrix is (2^j * 128)^2 — 2 MB of split f32 planes at the cap of 2,
+# comfortably inside the scoped-VMEM budget next to the state block.
+MXU_ROW_CAP = 2
+
+
+def _env_flops(name: str, default: float) -> float:
+    import os
+    raw = os.environ.get(name, "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return default
+
+
+def choose_mxu_contraction(num_row_bits: int, gate_qubits: int,
+                           fast: bool = False, itemsize: int = 4,
+                           peak_bytes_per_s: Optional[float] = None
+                           ) -> dict:
+    """The modeled flops-vs-bytes crossover for ONE dense gate inside a
+    fused Pallas layer: MXU-shaped (its ``num_row_bits`` row-bit targets
+    packed with the 128-lane axis into a ``(2^j * 128)``-dim contraction
+    riding the systolic array) versus the existing lane/VPU row path
+    (2x2 row pairing / unrolled ``2^k`` MACs per amplitude).
+
+    Both forms stream the state through VMEM exactly once, so the bytes
+    side of the roofline is identical; the decision is the compute side:
+
+    - MXU: ``8 * 2^j * 128`` real flops per amplitude (4 real matmuls,
+      2 flops per MAC) at the MXU rate — the bf16-input rate when
+      ``fast`` (the FAST tier's ``Precision.DEFAULT`` mode, where the
+      pass is typically memory-bound again), the f32 rate otherwise;
+    - VPU: ``8 * 2^gate_qubits`` real flops per amplitude at the VPU
+      rate (the ``row``/``rowk`` stage cost).
+
+    Each side's modeled stage time is ``max(flop_time, memory_time)``
+    and the MXU shape is selected only when it is **no slower** — the
+    never-worse-by-construction rule: when the 128x padding waste loses
+    (a lone 1q row gate at full f32 precision), the existing lane/VPU
+    kernel keeps the stage. ``QUEST_TPU_MXU_SHAPE=1/0`` forces the
+    decision either way (tests, benches); unset means the model
+    decides.
+
+    Returns ``{"use_mxu", "mxu_seconds", "alt_seconds", "mem_seconds",
+    "source"}`` with per-amplitude modeled seconds.
+    """
+    import os
+    if peak_bytes_per_s is None:
+        from ..telemetry.profile import platform_peak_bytes_per_s
+        peak_bytes_per_s = platform_peak_bytes_per_s()[1]
+    # one pass over split re/im planes: read + write, 4 * itemsize/amp
+    mem_s = 4.0 * itemsize / max(peak_bytes_per_s, 1.0)
+    mxu_rate = _env_flops("QUEST_TPU_MXU_FLOPS",
+                          MXU_FLOPS_BF16 if fast else MXU_FLOPS_F32)
+    vpu_rate = _env_flops("QUEST_TPU_VPU_FLOPS", VPU_FLOPS)
+    dim = (1 << max(int(num_row_bits), 0)) * 128
+    mxu_s = max(8.0 * dim / mxu_rate, mem_s)
+    alt_s = max(8.0 * (1 << max(int(gate_qubits), 0)) / vpu_rate, mem_s)
+    forced = os.environ.get("QUEST_TPU_MXU_SHAPE", "").strip()
+    if forced in ("1", "on"):
+        use, source = True, "forced"
+    elif forced in ("0", "off"):
+        use, source = False, "forced"
+    else:
+        use, source = mxu_s <= alt_s, "modeled"
+    return {"use_mxu": use, "mxu_seconds": mxu_s, "alt_seconds": alt_s,
+            "mem_seconds": mem_s, "source": source}
 
 
 def traj_cross_shard_ops(op_supports, num_qubits: int,

@@ -348,6 +348,13 @@ class TierErrorModel:
     drift_per_gate: dict
     floor: float = 1e-15
     source: str = "default"      # "default" | "measured"
+    # silicon-calibrated per-tier execution cost (seconds per gate pass
+    # of the calibration workload, measured on the LIVE backend — the
+    # MXU pass count each tier actually pays, including the compensated
+    # tiers' extra reduction traffic). Empty = unmeasured; the CPU
+    # proxy never fills it.
+    cost_per_gate: dict = dataclasses.field(default_factory=dict)
+    cost_source: str = "none"    # "none" | "silicon"
 
     def error(self, tier, num_gates: int) -> float:
         from .config import tier_by_name
@@ -355,6 +362,18 @@ class TierErrorModel:
         per_gate = self.drift_per_gate.get(tier.name,
                                            tier.drift_per_gate)
         return max(per_gate * max(int(num_gates), 1), self.floor)
+
+    def cost_ratio(self, tier) -> float:
+        """Measured cost of one gate pass at ``tier`` relative to the
+        FAST rung (1.0 when uncalibrated) — the reduction trade priced
+        by measured silicon instead of a CPU proxy."""
+        from .config import tier_by_name
+        tier = tier_by_name(tier)
+        base = self.cost_per_gate.get("fast")
+        mine = self.cost_per_gate.get(tier.name)
+        if not base or not mine:
+            return 1.0
+        return mine / base
 
 
 def _default_tier_model() -> TierErrorModel:
@@ -384,28 +403,70 @@ def _tier_model_pinned() -> bool:
     return os.environ.get("QUEST_TPU_TIER_MODEL", "") == "default"
 
 
-def measure_tier_model(env, num_qubits: int = 8,
-                       layers: int = 4) -> TierErrorModel:
+def _tier_silicon_auto() -> bool:
+    """Silicon cost calibration defaults ON for accelerator backends
+    (real MXUs whose pass counts a CPU proxy cannot price) and OFF on
+    hosts; ``QUEST_TPU_TIER_SILICON=1/0`` overrides."""
+    import os
+    import jax as jax_
+    flag = os.environ.get("QUEST_TPU_TIER_SILICON")
+    if flag is not None:
+        return flag not in ("0", "", "off")
+    return jax_.default_backend() in ("tpu", "axon")
+
+
+def _mesh_fingerprint(env) -> tuple:
+    """The env's device fingerprint — backend, device kind, device
+    count — the :func:`measure_comm_model` cache-key discipline, so a
+    model measured on one mesh shape is never served to another."""
+    import jax as jax_
+    try:
+        dev = jax_.devices()[0]
+        kind = getattr(dev, "device_kind", "")
+    except (RuntimeError, IndexError):
+        kind = ""
+    return (jax_.default_backend(), kind,
+            int(getattr(env, "num_devices", 1)))
+
+
+def measure_tier_model(env, num_qubits: int = 8, layers: int = 4,
+                       silicon: Optional[bool] = None) -> TierErrorModel:
     """Refine the per-tier drift constants with a tiny fixed-workload
     microbenchmark: a seeded brickwork circuit runs at each
     engine-executable tier and its state is compared against the most
     accurate tier available; the measured max|Δ|/gate refines each
     tier's constant (never below the measurement; never below the
-    model floor). Cached per backend fingerprint — including failures,
-    which pin the seeds — so the bench runs at most once per process."""
+    model floor).
+
+    ``silicon`` (default: auto — on for accelerator backends, off on
+    hosts; ``QUEST_TPU_TIER_SILICON`` overrides) additionally TIMES
+    each tier's executable on the live backend — device-synced
+    best-of-trials seconds per gate pass — so the reduction trade
+    (compensated pair-path tiers pay real extra passes, the FAST rung's
+    bf16 matmuls pay fewer MXU passes than HIGHEST's six-pass form) is
+    priced by measured silicon rather than a CPU proxy; the figures
+    land in :attr:`TierErrorModel.cost_per_gate` /
+    :meth:`~TierErrorModel.cost_ratio`.
+
+    Cached per mesh fingerprint (backend, device kind, device count,
+    storage dtype, silicon flag — the :func:`measure_comm_model`
+    discipline), failures included (they pin the seeds), so the bench
+    runs at most once per process per fingerprint."""
     import numpy as np_
-    import jax as jax_
     if _tier_model_pinned():
         return DEFAULT_TIER_MODEL
-    key = (jax_.default_backend(),
-           str(np_.dtype(env.precision.real_dtype)))
+    if silicon is None:
+        silicon = _tier_silicon_auto()
+    key = _mesh_fingerprint(env) + (
+        str(np_.dtype(env.precision.real_dtype)), bool(silicon))
     with _TIER_MODEL_LOCK:
         if key in _TIER_MODEL_CACHE:
             return _TIER_MODEL_CACHE[key]
-        return _measure_tier_model_locked(env, key, num_qubits, layers)
+        return _measure_tier_model_locked(env, key, num_qubits, layers,
+                                          silicon)
 
 
-def _measure_tier_model_locked(env, key, num_qubits, layers):
+def _measure_tier_model_locked(env, key, num_qubits, layers, silicon):
     import numpy as np_
     try:
         from .circuits import Circuit
@@ -436,7 +497,25 @@ def _measure_tier_model_locked(env, key, num_qubits, layers):
             refined = max(4.0 * meas / n_gates, DEFAULT_TIER_MODEL.floor)
             drift[t.name] = max(refined, drift[t.name] / 10.0) \
                 if refined < drift[t.name] else refined
-        model = TierErrorModel(drift_per_gate=drift, source="measured")
+        cost: dict = {}
+        if silicon:
+            import jax as jax_
+            trials = 3
+            for t in tiers:
+                # warmed above (the drift sweep compiled each tier);
+                # time device-synced best-of-trials on the LIVE backend
+                best = None
+                for _ in range(trials):
+                    t0 = time.perf_counter()
+                    out = cc.sweep(pm, tier=t)
+                    jax_.block_until_ready(out)
+                    dt = time.perf_counter() - t0
+                    best = dt if best is None else min(best, dt)
+                cost[t.name] = best / max(n_gates, 1)
+        model = TierErrorModel(
+            drift_per_gate=drift, source="measured",
+            cost_per_gate=cost,
+            cost_source="silicon" if cost else "none")
     # quest: allow-broad-except(calibration boundary: tier-model
     # measurement failure falls back to the conservative default)
     except Exception:
@@ -477,21 +556,25 @@ def modeled_tier_error(tier, num_gates: int, model: Optional[
 
 def engine_tiers(env) -> tuple:
     """The ladder rungs the BATCHED ENGINE can execute on this env, in
-    rank order. FAST and SINGLE always run (f32 planes); DOUBLE needs
-    x64 (without it JAX would silently downcast the f64 planes — the
-    same guard as the QUAD64 env check) AND an f64 STORAGE precision —
-    results leave the engine as env-dtype planes, so on an f32 env a
-    DOUBLE-tier execution would round straight back to f32 on exit and
-    silently violate the budget that selected it; QUAD rides the
-    separate DDProgram path (static circuits only) and is never
-    engine-selected."""
+    rank order. FAST and SINGLE always run (f32 planes); DOUBLE and
+    QUAD need x64 (without it JAX would silently downcast the f64
+    planes — the same guard as the QUAD64 env check) AND an f64 STORAGE
+    precision — results leave the engine as env-dtype planes, so on an
+    f32 env a DOUBLE execution would round straight back to f32 on exit
+    (and QUAD's ~48-bit dd significand would too) and silently violate
+    the budget that selected the tier. QUAD executes through the
+    engine's double-double runner (``CompiledCircuit.
+    _dd_batched_runner``) as a per-dispatch tier, so the serving
+    ladder's escalation tops out at the genuinely highest rung instead
+    of silently excluding it."""
     import jax as jax_
     import numpy as np_
-    from .config import DOUBLE_TIER, FAST_TIER, SINGLE_TIER
+    from .config import DOUBLE_TIER, FAST_TIER, QUAD_TIER, SINGLE_TIER
     tiers = [FAST_TIER, SINGLE_TIER]
     if jax_.config.jax_enable_x64 and env is not None and \
             np_.dtype(env.precision.real_dtype) == np_.dtype(np_.float64):
         tiers.append(DOUBLE_TIER)
+        tiers.append(QUAD_TIER)
     return tuple(tiers)
 
 

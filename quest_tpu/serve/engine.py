@@ -503,7 +503,10 @@ class SimulationService:
         else:
             kind, ham, obs_key = KIND_STATE, None, ()
         if tier is not None:
-            req_tier = compiled._resolve_tier(tier)
+            # per-request = per-dispatch: the QUAD rung is admitted here
+            # (dd engine runner), where a compile-time quad would be
+            # rejected
+            req_tier = compiled._resolve_tier(tier, dispatch=True)
         elif error_budget is not None:
             from ..profiling import choose_tier
             req_tier = choose_tier(

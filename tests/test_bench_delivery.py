@@ -177,6 +177,67 @@ def test_trajectory_rows_required():
     assert "bench_trajectories" in src
 
 
+def test_mxu_saturation_rows_required():
+    """The bench must deliver the ISSUE-14 MXU saturation off/on pairs:
+    MXU-shaped fusion vs the lane/VPU kernels, Pallas trajectory waves
+    vs the plain-XLA loop, and the batched QUAD-dd engine vs the
+    per-point compile_dd loop — each on-row carrying the PR-12
+    profiler's roofline attribution. Run tiny so the delivery contract
+    is tested, not the measurement (interpret-mode Pallas on CPU)."""
+    env_overrides = {
+        "QUEST_BENCH_MXU_QUBITS": "8",
+        "QUEST_BENCH_MXU_BATCH": "3",
+        "QUEST_BENCH_MXU_TRAJ": "16",
+        "QUEST_BENCH_MXU_TRAJ_QUBITS": "7",
+        "QUEST_BENCH_MXU_DD_QUBITS": "5",
+        "QUEST_BENCH_MXU_DD_BATCH": "2",
+        "QUEST_BENCH_TRIALS": "1",
+    }
+    old = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        import quest_tpu as qt
+        env = qt.createQuESTEnv(num_devices=1, seed=[2026])
+        rows = bench.bench_mxu_saturation(qt, env, "cpu")
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    assert len(rows) == 6
+    fus_off, fus_on, traj_off, traj_on, dd_off, dd_on = rows
+    for row in rows:
+        assert row["value"] > 0.0
+    assert "mxu fusion off" in fus_off["metric"]
+    assert "MXU-shaped fused contractions" in fus_on["metric"]
+    assert fus_on["rowmxu_stages"] >= 1
+    # never-worse selection: zero tolerated accuracy loss beyond the
+    # FAST tier's own modeled drift
+    from quest_tpu import FAST_TIER
+    assert fus_on["max_amp_deviation"] <= \
+        FAST_TIER.drift_per_gate * 64
+    assert "pallas-off" in traj_off["metric"]
+    assert "fused Kraus-draw" in traj_on["metric"]
+    assert traj_on["fused_items"] >= 1
+    assert traj_on["mean_deviation_sigma"] <= 5.0
+    assert "per-point compile_dd loop" in dd_off["metric"]
+    assert "quad-tier executable" in dd_on["metric"]
+    assert dd_on["max_amp_deviation"] <= 1e-10
+    assert dd_on["host_syncs"] == 1
+    # every row carries units the perf ledger can gate on; the on-rows
+    # carry the PR-12 roofline attribution
+    for row in (fus_on, traj_on, dd_on):
+        assert "roofline_frac" in row and "achieved_gb_per_s" in row
+        assert row["unit"].endswith("/sec")
+        assert row["speedup_vs_off"] > 0.0
+    # the headline adapter emits every row and is registered as a
+    # budget-gated config in main()
+    import inspect
+    src = inspect.getsource(bench.bench_mxu_saturation_config)
+    assert "bench_mxu_saturation" in src
+    src_main = inspect.getsource(bench.main)
+    assert "bench_mxu_saturation_config" in src_main
+
+
 def test_serving_rows_required():
     """The bench must deliver the ISSUE-4 serving rows: service-off and
     service-on requests/sec for the same mixed request trace, with the

@@ -1,0 +1,360 @@
+"""MXU saturation (ISSUE 14): interpret-mode parity for the MXU-tile
+contraction kernel and the fused Kraus-draw kernel, the layer
+collector's crossover-gated rowmxu stages, the batched QUAD-dd engine
+vs the sequential dd path, and the measure_tier_model silicon
+calibration cache (the measure_comm_model discipline).
+
+In the CI fast tier (conftest FAST_MODULES): everything here runs
+interpret-mode Pallas at small registers — seconds, no device.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+from quest_tpu.core.apply import apply_unitary
+from quest_tpu.ops import pallas_kernels as pk
+
+
+def rand_u(rng, k):
+    d = 1 << k
+    return np.linalg.qr(rng.normal(size=(d, d))
+                        + 1j * rng.normal(size=(d, d)))[0]
+
+
+def rand_state(rng, n):
+    z = rng.normal(size=1 << n) + 1j * rng.normal(size=1 << n)
+    return z / np.linalg.norm(z)
+
+
+class TestMxuTileKernel:
+    """The standalone MXU-tile contraction vs the XLA oracle, <=1e-12
+    (interpret mode runs the identical stage code path as silicon)."""
+
+    @pytest.mark.parametrize("targets", [(3,), (8,), (3, 8), (7, 8),
+                                         (2, 5, 7)])
+    def test_tile_parity_vs_oracle(self, rng, targets):
+        n = 9
+        z = rand_state(rng, n)
+        u = rand_u(rng, len(targets))
+        got = np.asarray(pk.apply_mxu_tile(jnp.asarray(z), n, u, targets,
+                                           interpret=True))
+        ref = np.asarray(apply_unitary(jnp.asarray(z), n, jnp.asarray(u),
+                                       targets, 0, 0))
+        assert float(np.abs(got - ref).max()) <= 1e-12
+
+    def test_tile_executable_cache_is_keyed(self, rng):
+        n = 9
+        z = jnp.asarray(rand_state(rng, n))
+        pk.apply_mxu_tile(z, n, rand_u(rng, 1), (8,), interpret=True)
+        pk.apply_mxu_tile(z, n, rand_u(rng, 1), (8,), interpret=True)
+        keys = list(pk._MXU_EXEC._c)
+        hits = [k for k in keys if k[0] == "mxu_tile" and k[1] == n]
+        assert hits, keys
+        # the matrix is an ARGUMENT: two gates of one geometry share
+        # one executable; dtype and tier mode are key components
+        assert len([k for k in hits if k[2] == (1,)]) == 1
+        assert all("float" in k[4] for k in hits)
+        assert all(k[5] in ("fast", "highest") for k in hits)
+
+    def test_row_target_outside_block_raises(self, rng):
+        n = 9
+        z = jnp.asarray(rand_state(rng, n))
+        with pytest.raises(ValueError, match="block"):
+            pk.apply_mxu_tile(z, n, rand_u(rng, 1), (8,), interpret=True,
+                              block_rows=2)
+
+    def test_fast_mode_within_modeled_drift(self, rng):
+        from quest_tpu import FAST_TIER
+        n = 9
+        z = rand_state(rng, n).astype(np.complex64)
+        u = rand_u(rng, 2)
+        ref = np.asarray(pk.apply_mxu_tile(jnp.asarray(z), n, u, (3, 8),
+                                           interpret=True))
+        fast = np.asarray(pk.apply_mxu_tile(jnp.asarray(z), n, u, (3, 8),
+                                            interpret=True, fast=True))
+        assert float(np.abs(fast - ref).max()) <= FAST_TIER.drift_per_gate
+
+
+class TestRowMxuLayerStages:
+    """The layer collector's MXU shaping: crossover-gated stage
+    selection, union merging, lane folding, and compiled-program
+    parity."""
+
+    def _mixed_circuit(self, rng, n=10):
+        c = Circuit(n)
+        for q in range(n):
+            c.ry(q, float(rng.uniform(0, 2 * np.pi)))
+        for q in range(7, n):
+            c.gate(rand_u(rng, 1), (q,))
+        c.gate(rand_u(rng, 2), (3, 8))
+        for q in range(n):
+            c.t(q)
+        return c
+
+    def test_forced_on_emits_rowmxu_and_parity(self, rng, env,
+                                               monkeypatch):
+        monkeypatch.setenv("QUEST_TPU_MXU_SHAPE", "1")
+        c = self._mixed_circuit(rng)
+        cc_ref = c.compile(env, pallas=False)
+        cc_mxu = c.compile(env, pallas="interpret")
+        stages = [st[0] for op in cc_mxu._ops
+                  if getattr(op, "kind", None) == "layer"
+                  for st in op.stages]
+        assert "rowmxu" in stages
+        pm = np.zeros((1, 0))
+        a = np.asarray(cc_ref.sweep(pm))
+        b = np.asarray(cc_mxu.sweep(pm))
+        assert float(np.abs(a - b).max()) <= 1e-12
+
+    def test_forced_off_keeps_lane_row_kernels(self, rng, env,
+                                               monkeypatch):
+        """Never-worse fallback: with the crossover forced off, the
+        existing lane/row stages keep every gate (and parity holds)."""
+        monkeypatch.setenv("QUEST_TPU_MXU_SHAPE", "0")
+        c = self._mixed_circuit(rng)
+        cc = c.compile(env, pallas="interpret")
+        stages = [st[0] for op in cc._ops
+                  if getattr(op, "kind", None) == "layer"
+                  for st in op.stages]
+        assert "rowmxu" not in stages
+        a = np.asarray(c.compile(env, pallas=False).sweep(np.zeros((1, 0))))
+        b = np.asarray(cc.sweep(np.zeros((1, 0))))
+        assert float(np.abs(a - b).max()) <= 1e-12
+
+    def test_union_merge_and_lane_fold(self, rng, env, monkeypatch):
+        """Adjacent tiles with different row bits merge by union (same
+        flops, one stage fewer) and a following lane gate folds in for
+        free."""
+        monkeypatch.setenv("QUEST_TPU_MXU_SHAPE", "1")
+        n = 10
+        c = Circuit(n)
+        c.gate(rand_u(rng, 1), (7,))
+        c.gate(rand_u(rng, 1), (8,))
+        c.gate(rand_u(rng, 2), (2, 4))     # lane gate folds into the tile
+        cc = c.compile(env, pallas="interpret", fusion=False,
+                       supergate_k=0)
+        layers = [op for op in cc._ops
+                  if getattr(op, "kind", None) == "layer"]
+        assert len(layers) == 1
+        assert [st[0] for st in layers[0].stages] == ["rowmxu"]
+        assert layers[0].stages[0][1] == (0, 1)
+        a = np.asarray(c.compile(env, pallas=False).sweep(np.zeros((1, 0))))
+        b = np.asarray(cc.sweep(np.zeros((1, 0))))
+        assert float(np.abs(a - b).max()) <= 1e-12
+
+    def test_batched_engine_keeps_rowmxu(self, rng, env, monkeypatch):
+        monkeypatch.setenv("QUEST_TPU_MXU_SHAPE", "1")
+        c = Circuit(9)
+        for q in range(9):
+            c.ry(q, c.parameter(f"y{q}"))
+        c.gate(rand_u(rng, 1), (8,))
+        c.gate(rand_u(rng, 1), (7,))
+        cc = c.compile(env, pallas="interpret")
+        pm = rng.uniform(0, 2 * np.pi, size=(3, 9))
+        ref = np.asarray(c.compile(env, pallas=False).sweep(pm))
+        got = np.asarray(cc.sweep(pm))
+        assert float(np.abs(got - ref).max()) <= 1e-12
+
+    def test_crossover_model_shape(self):
+        """The modeled crossover: never-worse (<=), memory floor
+        respected, forced decisions labeled."""
+        from quest_tpu.parallel.layout import choose_mxu_contraction
+        d = choose_mxu_contraction(1, 1, fast=False)
+        assert d["mxu_seconds"] >= d["mem_seconds"]
+        assert d["alt_seconds"] >= d["mem_seconds"]
+        assert d["use_mxu"] == (d["mxu_seconds"] <= d["alt_seconds"]) \
+            or d["source"] == "forced"
+        # the FAST (bf16-input) rate can only move the decision TOWARD
+        # the MXU
+        df = choose_mxu_contraction(1, 1, fast=True)
+        assert df["mxu_seconds"] <= d["mxu_seconds"]
+
+
+class TestFusedKrausKernel:
+    """The fused draw+apply+renorm kernel: exact renormalisation, and
+    the pallas-path trajectory ensemble agrees with the density oracle
+    within 5 stderr."""
+
+    def _noisy_circuit(self, rng, n=8):
+        c = Circuit(n)
+        for q in range(n):
+            c.ry(q, float(rng.uniform(0.2, 2.8)))
+        c.damp(2, 0.2)
+        for q in range(n - 1):
+            c.cnot(q, q + 1)
+        c.dephase(4, 0.15)
+        for q in range(n):
+            c.ry(q, float(rng.uniform(0.2, 2.8)))
+        return c
+
+    def test_kernel_select_and_renorm_exact(self, rng):
+        n = 8
+        z = rand_state(rng, n)
+        p_damp = 0.3
+        k0 = np.array([[1, 0], [0, np.sqrt(1 - p_damp)]], dtype=complex)
+        k1 = np.array([[0, np.sqrt(p_damp)], [0, 0]], dtype=complex)
+        kemb = np.stack([pk.embed_lane_matrix(k0, (2,)),
+                         pk.embed_lane_matrix(k1, (2,))])
+        T = 4
+        states = jnp.stack([jnp.asarray(z)] * T)
+        probs = jnp.asarray(rng.uniform(0.2, 0.8, size=(T, 2)))
+        u01 = jnp.asarray([0.0, 0.49, 0.51, 0.999])
+        out = np.asarray(pk.fused_kraus_apply_batched(
+            states, n, kemb, probs, u01, interpret=True))
+        pnp = np.asarray(probs)
+        for t in range(T):
+            cum = np.cumsum(pnp[t])
+            uu = float(u01[t]) * pnp[t].sum()
+            j = min(int((cum <= uu).sum()), 1)
+            ksel = [k0, k1][j] / np.sqrt(pnp[t][j])
+            ref = np.asarray(apply_unitary(jnp.asarray(z), n,
+                                           jnp.asarray(ksel), (2,), 0, 0))
+            assert float(np.abs(out[t] - ref).max()) <= 1e-12
+
+    def test_pallas_trajectories_vs_density_oracle(self, rng, env):
+        c = self._noisy_circuit(rng)
+        tp = c.compile_trajectories(env, pallas="interpret")
+        kinds = [i[0] for i in tp._pallas_items]
+        assert "layer" in kinds and "kraus_fused" in kinds
+        n = c.num_qubits
+        terms = [[(q, 3)] for q in range(n)] + [[(0, 1), (1, 1)]]
+        coeffs = list(rng.normal(size=len(terms)))
+        mean, err = tp.expectation(terms, coeffs, num_trajectories=384,
+                                   key=jax.random.PRNGKey(0))
+        cc_d = c.compile(env, density=True, pallas=False)
+        oracle = float(np.asarray(cc_d.expectation_sweep(
+            np.zeros((1, 0)), (terms, coeffs)))[0])
+        assert abs(mean - oracle) <= 5.0 * max(err, 1e-12), \
+            (mean, err, oracle)
+
+    def test_pallas_sweep_norms_and_cache_keys(self, rng, env):
+        c = self._noisy_circuit(rng)
+        tp = c.compile_trajectories(env, pallas="interpret")
+        out = np.asarray(tp.trajectory_sweep(6,
+                                             key=jax.random.PRNGKey(3)))
+        norms = np.linalg.norm(out[:, 0] + 1j * out[:, 1], axis=1)
+        assert np.allclose(norms, 1.0, atol=1e-10)
+        # the kernel path is a cache-key dimension: pallas and xla
+        # programs never collide
+        assert all(k[-1] == "pallas" for k in tp._cache)
+        tp_x = c.compile_trajectories(env, pallas=False)
+        assert tp_x._pallas_items is None
+        tp_x.trajectory_sweep(6, key=jax.random.PRNGKey(3))
+        assert all(k[-1] == "xla" for k in tp_x._cache)
+
+    def test_row_target_channel_falls_back_to_xla_step(self, rng, env):
+        """A channel on a row qubit (>= 7) has no lane embedding — it
+        rides the vmapped XLA step inside the pallas stream."""
+        c = Circuit(8)
+        for q in range(8):
+            c.ry(q, float(rng.uniform(0.2, 2.8)))
+        c.damp(7, 0.2)
+        tp = c.compile_trajectories(env, pallas="interpret")
+        kinds = [i[0] for i in tp._pallas_items]
+        assert "kraus" in kinds and "kraus_fused" not in kinds
+        out = np.asarray(tp.trajectory_sweep(4,
+                                             key=jax.random.PRNGKey(1)))
+        norms = np.linalg.norm(out[:, 0] + 1j * out[:, 1], axis=1)
+        assert np.allclose(norms, 1.0, atol=1e-10)
+
+
+class TestBatchedDDEngine:
+    """The QUAD rung through the batched engine: parity vs the
+    sequential DDProgram path, parameterised sweeps, and energy."""
+
+    def test_static_sweep_matches_ddprogram(self, env):
+        from quest_tpu.ops.doubledouble import dd_unpack
+        n = 6
+        c = Circuit(n)
+        for q in range(n):
+            c.h(q)
+        for q in range(n - 1):
+            c.cnot(q, q + 1)
+        c.rz(0, 0.4)
+        c.ry(2, 1.1)
+        cc = c.compile(env, pallas=False)
+        out = np.asarray(cc.sweep(np.zeros((2, 0)), tier="quad"))
+        ddp = c.compile_dd(env, dtype=np.float32)
+        seq = dd_unpack(np.asarray(ddp.run(ddp.init_zero())))
+        got = out[0, 0] + 1j * out[0, 1]
+        assert float(np.abs(got - seq).max()) <= 1e-10
+        assert out.dtype == np.float64    # callers keep env planes
+
+    def test_param_sweep_and_energy_parity(self, env, rng):
+        n = 6
+        c = Circuit(n)
+        for q in range(n):
+            c.ry(q, c.parameter(f"y{q}"))
+        for q in range(n - 1):
+            c.cnot(q, q + 1)
+        cc = c.compile(env, pallas=False)
+        pm = rng.uniform(0, 2 * np.pi, size=(3, n))
+        qd = np.asarray(cc.sweep(pm, tier="quad"))
+        db = np.asarray(cc.sweep(pm, tier="double"))
+        assert float(np.abs(qd - db).max()) <= 1e-12
+        ham = ([[(0, 3)], [(1, 1)]], [0.5, -0.25])
+        eq = np.asarray(cc.expectation_sweep(pm, ham, tier="quad"))
+        ed = np.asarray(cc.expectation_sweep(pm, ham, tier="double"))
+        assert float(np.abs(eq - ed).max()) <= 1e-12
+        toks = {k[-1] for k in cc._batched_cache}
+        assert "quad" in toks     # its OWN keyed executable
+
+    def test_quad_serving_submit(self, env, rng):
+        from quest_tpu.serve import SimulationService
+        c = Circuit(4)
+        for q in range(4):
+            c.ry(q, c.parameter(f"y{q}"))
+        cc = c.compile(env, pallas=False)
+        pm = rng.uniform(0, 2 * np.pi, size=(2, 4))
+        ref = np.asarray(cc.sweep(pm))
+        with SimulationService(env, max_batch=2, max_wait_s=1e-3) as svc:
+            futs = [svc.submit(cc, dict(zip(c.param_names, pm[b])),
+                               tier="quad") for b in range(2)]
+            res = [np.asarray(f.result(timeout=120)) for f in futs]
+        for b in range(2):
+            assert float(np.abs(res[b] - ref[b]).max()) <= 1e-12
+
+
+class TestTierModelSiliconCalibration:
+    """measure_tier_model's real-silicon mode: per-mesh-fingerprint
+    caching (the measure_comm_model discipline), cost figures, and the
+    deterministic pin."""
+
+    def test_pinned_env_skips_measurement(self, env, monkeypatch):
+        from quest_tpu import profiling as prof
+        monkeypatch.setenv("QUEST_TPU_TIER_MODEL", "default")
+        m = prof.measure_tier_model(env, silicon=True)
+        assert m is prof.DEFAULT_TIER_MODEL
+        assert m.cost_source == "none"
+
+    def test_silicon_mode_measures_and_caches(self, env, monkeypatch):
+        from quest_tpu import profiling as prof
+        monkeypatch.delenv("QUEST_TPU_TIER_MODEL", raising=False)
+        prof._TIER_MODEL_CACHE.clear()
+        try:
+            m1 = prof.measure_tier_model(env, num_qubits=4, layers=1,
+                                         silicon=True)
+            assert m1.cost_source == "silicon"
+            for t in prof.engine_tiers(env):
+                assert m1.cost_per_gate.get(t.name, 0.0) > 0.0
+                assert m1.cost_ratio(t) > 0.0
+            # cached per fingerprint: the second call returns the SAME
+            # object without re-benching
+            m2 = prof.measure_tier_model(env, silicon=True)
+            assert m2 is m1
+            # the silicon flag is a cache dimension — the CPU-proxy
+            # form does not serve the silicon request (and vice versa)
+            m3 = prof.measure_tier_model(env, num_qubits=4, layers=1,
+                                         silicon=False)
+            assert m3 is not m1
+            assert m3.cost_source == "none"
+        finally:
+            prof._TIER_MODEL_CACHE.clear()
+
+    def test_uncalibrated_cost_ratio_is_one(self):
+        from quest_tpu.profiling import DEFAULT_TIER_MODEL
+        assert DEFAULT_TIER_MODEL.cost_ratio("single") == 1.0

@@ -76,14 +76,17 @@ class TestShardedVmemBudget:
     @classmethod
     def _plan_and_estimate(cls, layer, num_local, budget=None):
         from quest_tpu.ops import pallas_kernels as pk
-        kstages, mats, tables, block_rows, _ = pk.layer_kernel_plan(
-            layer, num_local)
+        kstages, mats, tables, xmats, block_rows, _ = \
+            pk.layer_kernel_plan(layer, num_local)
         mstack = (np.stack(mats) if mats
                   else np.zeros((1, 128, 128), np.complex128))
         tstack = (np.stack(tables) if tables
                   else np.zeros((1, 128), np.complex128))
+        xstack = (np.stack(xmats) if xmats
+                  else np.zeros((1, 8, 8), np.complex128))
         return pk.choose_block_rows(kstages, mstack, tstack, block_rows,
-                                    cls.F32, budget or cls.OOM_BUDGET)
+                                    cls.F32, budget or cls.OOM_BUDGET,
+                                    xstack)
 
     def test_unsharded_22q_layer_exceeds_default_budget(self):
         """Documents the failure mode the estimator exists for: at least
@@ -95,8 +98,8 @@ class TestShardedVmemBudget:
         assert layers
         raw = []
         for layer in layers:
-            kstages, mats, tables, block_rows, _ = pk.layer_kernel_plan(
-                layer, 22)
+            kstages, mats, tables, _xmats, block_rows, _ = \
+                pk.layer_kernel_plan(layer, 22)
             mstack = (np.stack(mats) if mats
                       else np.zeros((1, 128, 128), np.complex128))
             tstack = (np.stack(tables) if tables

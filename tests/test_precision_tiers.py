@@ -58,10 +58,25 @@ class TestTierSelection:
         assert tier_runtime_tol(DOUBLE_TIER, 1) == pytest.approx(1e-6)
         assert tier_runtime_tol(FAST_TIER, 10_000) == pytest.approx(2e-2)
 
-    def test_quad_tier_rejected_by_engine(self, env):
+    def test_quad_tier_gates(self, env):
+        """QUAD is a per-DISPATCH rung: a compile-time quad tier is
+        rejected (run()/apply() have no dd form — the message names the
+        constraint and the compile_dd alternative), an f32 env rejects
+        the dispatch form too (dd planes would round back to f32 on
+        exit), and on an x64 f64 env the dispatch form executes through
+        the batched dd runner."""
         c = Circuit(3).h(0)
         with pytest.raises(ValueError, match="compile_dd"):
             c.compile(env, tier=QUAD_TIER)
+        env32 = qt.createQuESTEnv(num_devices=1, precision=qt.SINGLE,
+                                  seed=[2])
+        cc32 = c.compile(env32, pallas=False)
+        with pytest.raises(ValueError, match="f64-storage"):
+            cc32.sweep(np.zeros((1, 0)), tier=QUAD_TIER)
+        cc = c.compile(env, pallas=False)
+        out = np.asarray(cc.sweep(np.zeros((1, 0)), tier=QUAD_TIER))
+        assert out.shape == (1, 2, 8)
+        assert ("quad" in {k[-1] for k in cc._batched_cache})
 
     def test_compile_error_budget_selects_and_reports(self, env):
         c = Circuit(4)
@@ -247,9 +262,35 @@ class TestEscalation:
         for b in range(4):      # zero violations survive to callers
             assert float(np.max(np.abs(res[b] - ref[b]))) <= tol
 
+    def test_double_escalates_to_quad(self, env, rng):
+        """The dd rung is re-admitted to the serving ladder (ISSUE 14):
+        a violating DOUBLE dispatch escalates to QUAD — which used to be
+        silently excluded — and the caller gets correct planes."""
+        from quest_tpu.resilience import FaultInjector, FaultSpec, inject
+        from quest_tpu.serve import SimulationService
+        c = Circuit(3)
+        for q in range(3):
+            c.ry(q, c.parameter(f"y{q}"))
+        cc = c.compile(env, pallas=False)
+        pm = rng.uniform(0, 2 * np.pi, size=(1, 3))
+        ref = np.asarray(cc.sweep(pm))
+        inj = FaultInjector([FaultSpec(kind="precision",
+                                       site="serve.execute",
+                                       at_calls=(0,))], seed=3)
+        with inject(inj):
+            with SimulationService(env, max_batch=2,
+                                   max_wait_s=1e-3) as svc:
+                fut = svc.submit(cc, dict(zip(c.param_names, pm[0])),
+                                 tier=DOUBLE_TIER)
+                res = np.asarray(fut.result(timeout=120))
+                stats = svc.dispatch_stats()["service"]
+        assert stats["tier_violations"] >= 1
+        assert stats["tier_escalations"] >= 1
+        assert float(np.max(np.abs(res - ref[0]))) <= 1e-6
+
     def test_escalation_bounded_at_ladder_top(self, env, rng):
-        """At the top engine rung a violation fails TYPED (kind
-        'precision'), it does not loop."""
+        """At the top engine rung — now QUAD — a violation fails TYPED
+        (kind 'precision'), it does not loop."""
         from quest_tpu.resilience import FaultInjector, FaultSpec, inject
         from quest_tpu.resilience.health import NumericalFault
         from quest_tpu.serve import SimulationService
@@ -265,7 +306,7 @@ class TestEscalation:
             with SimulationService(env, max_batch=2,
                                    max_wait_s=1e-3) as svc:
                 fut = svc.submit(cc, dict(zip(c.param_names, pm[0])),
-                                 tier=DOUBLE_TIER)
+                                 tier=QUAD_TIER)
                 with pytest.raises(NumericalFault) as ei:
                     fut.result(timeout=120)
                 stats = svc.dispatch_stats()["service"]

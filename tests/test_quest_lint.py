@@ -115,6 +115,86 @@ class TestQL002CacheKeys:
         """)
         assert rules.rule_ql002_cache_keys([f], ROOT) == []
 
+    # -- the ISSUE-14 kernel-cache key shapes ------------------------------
+
+    def test_mxu_tile_key_complete_passes(self, tmp_path):
+        """The standalone MXU-tile executable cache
+        (ops/pallas_kernels.apply_mxu_tile): geometry + dtype + tier
+        mode, matrix as an argument."""
+        f = make_file(tmp_path, "quest_tpu/ops/pallas_kernels2.py", """
+            def apply(n, bits, dt_token, fast, interpret):
+                tier_tok = "fast" if fast else "highest"
+                return _MXU_EXEC._cached(
+                    ("mxu_tile", n, bits, dt_token, tier_tok,
+                     bool(interpret)), lambda: 1)
+        """)
+        assert rules.rule_ql002_cache_keys([f], ROOT) == []
+
+    def test_mxu_tile_key_missing_tier_mode_flags(self, tmp_path):
+        """A tile executable keyed without the tier execution mode
+        would serve a FAST (bf16-split) kernel to a HIGHEST dispatch."""
+        f = make_file(tmp_path, "quest_tpu/ops/pallas_kernels2.py", """
+            def apply(n, bits, dt_token, interpret):
+                return _MXU_EXEC._cached(
+                    ("mxu_tile", n, bits, dt_token, bool(interpret)),
+                    lambda: 1)
+        """)
+        vs = rules.rule_ql002_cache_keys([f], ROOT)
+        assert codes(vs) == ["QL002"]
+        assert "tier" in vs[0].message
+
+    def test_trajectory_layer_key_carries_kernel_path(self, tmp_path):
+        """The trajectory wave executables key on the pallas/xla path
+        token next to form+mode+dtype (tier-exempt file): the two paths
+        trace different programs."""
+        f = make_file(tmp_path, "quest_tpu/ops/trajectories.py", """
+            class T:
+                def fn(self, mode):
+                    return self._cached(
+                        ("twave", mode, self._dt_token(),
+                         self._path_token(mode)), lambda: 1)
+        """)
+        assert rules.rule_ql002_cache_keys([f], ROOT) == []
+
+    def test_trajectory_layer_key_missing_dtype_flags(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/ops/trajectories.py", """
+            class T:
+                def fn(self, mode):
+                    return self._cached(
+                        ("twave", mode, self._path_token(mode)),
+                        lambda: 1)
+        """)
+        vs = rules.rule_ql002_cache_keys([f], ROOT)
+        assert codes(vs) == ["QL002"]
+        assert "dtype" in vs[0].message
+
+    def test_dd_batch_key_tier_token_passes(self, tmp_path):
+        """The QUAD-dd batched executable rides the engine cache with
+        tier token 'quad' — same key discipline as every other rung."""
+        f = make_file(tmp_path, "quest_tpu/circuits2.py", """
+            class C:
+                def fn(self, broadcast, donate, mode, tier):
+                    key = (broadcast, donate, mode,
+                           str(self.env.dtype),
+                           self._tier_token(tier))
+                    self._batched_cache[key] = 1
+        """)
+        assert rules.rule_ql002_cache_keys([f], ROOT) == []
+
+    def test_dd_batch_key_missing_tier_flags(self, tmp_path):
+        """A dd executable keyed without the tier would serve dd planes
+        to a DOUBLE dispatch (or vice versa)."""
+        f = make_file(tmp_path, "quest_tpu/circuits2.py", """
+            class C:
+                def fn(self, broadcast, donate, mode):
+                    key = (broadcast, donate, mode,
+                           str(self.env.dtype))
+                    self._batched_cache[key] = 1
+        """)
+        vs = rules.rule_ql002_cache_keys([f], ROOT)
+        assert codes(vs) == ["QL002"]
+        assert "tier" in vs[0].message
+
 
 # -- QL003 ------------------------------------------------------------------
 
