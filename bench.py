@@ -989,6 +989,19 @@ def bench_sharded_mesh(qt, platform: str) -> dict:
               "unit": "points/sec", "vs_baseline": 0.0,
               "errors": [f"{type(e).__name__}: {e}"]})
 
+    # gradient rows (ISSUE 15 acceptance mesh): parameter-shift client
+    # loop vs one-executable grad_sweep vs served/coalesced gradients —
+    # batch scaled down for the timeshared virtual mesh (the
+    # single-chip "grad" config grades the full acceptance shape)
+    try:
+        os.environ.setdefault("QUEST_BENCH_GRAD_BATCH", "8")
+        for row in bench_gradients(_qt, env, platform):
+            emit(row)
+    except Exception as e:
+        emit({"metric": "gradient sweep (bench error)", "value": 0.0,
+              "unit": "grads/sec", "vs_baseline": 0.0,
+              "errors": [f"{type(e).__name__}: {e}"]})
+
     # precision-tier row (ISSUE 8 acceptance mesh): the same ensemble
     # sweep at the FAST / SINGLE-compensated / QUAD rungs, with the
     # seeded precision-fault escalation pass
@@ -1416,6 +1429,173 @@ def bench_ensemble_sweep_config(qt, env, platform: str) -> dict:
     for row in rows[:-1]:
         emit(row)
     return rows[-1]
+
+
+def bench_gradients(qt, env, platform: str) -> list:
+    """One-executable gradient sweeps vs the client-side loop, SAME
+    workload (ISSUE 15): a hardware-efficient ansatz's (B, P) gradient
+    against a Pauli-sum objective. Three rows in grads/sec (gradient
+    COMPONENTS per second, B*P per full sweep):
+
+    - **parameter-shift client loop** — per point, 2P+1 single-row
+      ``expectation_sweep`` dispatches (the strongest client baseline:
+      it already rides the batched engine's executable cache; the
+      reference-style run+calcExpecPauliSum loop is strictly slower),
+      B*(2P+1) executables and transfers per sweep;
+    - **one-executable grad_sweep** — ``value_and_grad_sweep``: one
+      reverse pass, one (B, P+1) transfer, with the parity of its
+      gradients against the shift oracle in the row (exact for
+      rotation gates; the acceptance gate is <= 1e-9);
+    - **served/coalesced** — B independent ``gradient=True``
+      submissions through a SimulationService, coalesced into padded
+      buckets, with p50/p99 request latency.
+    """
+    import jax as _jax
+    num_qubits = int(os.environ.get("QUEST_BENCH_GRAD_QUBITS", "16"))
+    batch = int(os.environ.get("QUEST_BENCH_GRAD_BATCH", "16"))
+    num_terms = int(os.environ.get("QUEST_BENCH_GRAD_TERMS", "12"))
+    layers = int(os.environ.get("QUEST_BENCH_GRAD_LAYERS", "1"))
+    trials = max(1, int(os.environ.get("QUEST_BENCH_TRIALS", "10")) // 5)
+    # the parity grade (shift oracle vs reverse pass, <= 1e-9) needs
+    # f64 arithmetic — same convention as the dd rows: flip x64 on for
+    # this config and restore after
+    x64_was = bool(_jax.config.jax_enable_x64)
+    if not x64_was:
+        _jax.config.update("jax_enable_x64", True)
+        env = qt.createQuESTEnv(num_devices=env.num_devices,
+                                precision=qt.DOUBLE, seed=[2026])
+    try:
+        return _bench_gradients_body(qt, env, platform, num_qubits,
+                                     batch, num_terms, layers, trials)
+    finally:
+        if not x64_was:
+            _jax.config.update("jax_enable_x64", False)
+
+
+def _bench_gradients_body(qt, env, platform, num_qubits, batch,
+                          num_terms, layers, trials) -> list:
+    rng = np.random.default_rng(2026)
+    circ, n_gates, names = build_hea_circuit(num_qubits, layers)
+    P = len(names)
+    codes = rng.integers(0, 4, size=(num_terms, num_qubits))
+    coeffs = rng.normal(size=num_terms)
+    terms = [[(q_, int(codes[t, q_])) for q_ in range(num_qubits)]
+             for t in range(num_terms)]
+    ham = (terms, coeffs)
+    pm = rng.uniform(0.0, 2.0 * np.pi, size=(batch, P))
+    dev_desc = (f"single {platform} chip" if env.num_devices == 1
+                else f"{env.num_devices} {platform} devices")
+    label = (f"hardware-efficient-ansatz-{num_qubits}, batch={batch}, "
+             f"P={P}, {num_terms}-term Pauli sum, {dev_desc}")
+    cc = circ.compile(env, pallas="off")
+
+    # parameter-shift client loop: warmed on a probe row, then per
+    # point 2P+1 single-row energy dispatches (one value + two shifts
+    # per parameter), each >= one device->host transfer
+    np.asarray(cc.expectation_sweep(pm[:1], ham))
+    shift_dts = []
+    shift_grads = np.zeros((batch, P))
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for b in range(batch):
+            np.asarray(cc.expectation_sweep(pm[b:b + 1], ham))
+            for p_ in range(P):
+                for s, sgn in ((np.pi / 2, 1.0), (-np.pi / 2, -1.0)):
+                    row = pm[b:b + 1].copy()
+                    row[0, p_] += s
+                    shift_grads[b, p_] += sgn * 0.5 * float(
+                        np.asarray(cc.expectation_sweep(row, ham))[0])
+        shift_dts.append(time.perf_counter() - t0)
+        if len(shift_dts) < trials:
+            shift_grads[:] = 0.0
+    shift_rate = batch * P / min(shift_dts)
+
+    # one-executable gradient sweep (compile + warm, then timed)
+    vals, grads = cc.value_and_grad_sweep(pm, ham)
+    grads = np.asarray(grads)
+    dts = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        vals, grads = cc.value_and_grad_sweep(pm, ham)
+        grads = np.asarray(grads)
+        dts.append(time.perf_counter() - t0)
+    on_rate = batch * P / min(dts)
+    parity = float(np.max(np.abs(grads - shift_grads)))
+    stats = cc.dispatch_stats().as_dict()
+
+    # served: B independent gradient submissions, coalesced
+    svc = qt.createSimulationService(env, max_batch=batch,
+                                     max_wait_s=2e-3)
+    try:
+        svc.warm(cc, batch_sizes=[batch], observables=ham,
+                 gradient=True)
+        t0 = time.perf_counter()
+        futs = [svc.submit(cc, pm[b], observables=ham, gradient=True)
+                for b in range(batch)]
+        served = [f.result(timeout=300.0) for f in futs]
+        served_dt = time.perf_counter() - t0
+        served_rate = batch * P / served_dt
+        served_parity = float(max(
+            np.max(np.abs(np.asarray(g) - shift_grads[b]))
+            for b, (_v, g) in enumerate(served)))
+        snap = svc.dispatch_stats()["service"]
+        served_extra = {
+            "p50_latency_s": round(snap["p50_latency_s"], 6),
+            "p99_latency_s": round(snap["p99_latency_s"], 6),
+            "batch_occupancy": round(snap["batch_occupancy"], 2),
+            "gradient_dispatches": snap["gradient_dispatches"],
+        }
+    finally:
+        svc.close()
+
+    # roofline grads/sec: a reverse pass streams ~2x the forward's
+    # gate passes plus one xor-gather per term, and yields P gradient
+    # components per point
+    itemsize = np.dtype(env.precision.real_dtype).itemsize
+    baseline = _roofline_baseline(num_qubits, itemsize) \
+        / max(2 * n_gates + num_terms, 1) * P
+    shift_row = {
+        "metric": f"gradient sweep parameter-shift client loop "
+                  f"(2P+1 energy dispatches per point), {label}",
+        "value": round(shift_rate, 2),
+        "unit": "grads/sec",
+        "vs_baseline": round(shift_rate / baseline, 4),
+        "host_syncs": batch * (2 * P + 1),
+    }
+    on_row = {
+        "metric": f"gradient sweep one-executable "
+                  f"(value_and_grad_sweep reverse pass), {label}",
+        "value": round(on_rate, 2),
+        "unit": "grads/sec",
+        "vs_baseline": round(on_rate / baseline, 4),
+        "speedup_vs_shift": round(on_rate / max(shift_rate, 1e-9), 3),
+        "grad_parity": parity,
+        "host_syncs": 1,
+        "batch_size": stats["batch_size"],
+        "host_syncs_avoided": stats["host_syncs_avoided"],
+        "batch_sharding_mode": stats["batch_sharding_mode"],
+    }
+    served_row = {
+        "metric": f"gradient serving coalesced (B gradient=True "
+                  f"submissions -> padded buckets), {label}",
+        "value": round(served_rate, 2),
+        "unit": "grads/sec",
+        "vs_baseline": round(served_rate / baseline, 4),
+        "speedup_vs_shift": round(served_rate / max(shift_rate, 1e-9),
+                                  3),
+        "grad_parity": served_parity,
+        **served_extra,
+    }
+    return [shift_row, on_row, served_row]
+
+
+def bench_gradients_config(qt, env, platform: str) -> dict:
+    """Config-list adapter: emit every gradient row, return the
+    headline (one-executable) row."""
+    rows = bench_gradients(qt, env, platform)
+    emit(rows[0])
+    emit(rows[2])
+    return rows[1]
 
 
 def _bound_hea(num_qubits: int, layers: int, values: dict):
@@ -2893,6 +3073,7 @@ def main() -> None:
         ("paulisum", 45, lambda: bench_pauli_sum(qt, env, platform)),
         ("sweep", 45, lambda: bench_ensemble_sweep_config(qt, env,
                                                           platform)),
+        ("grad", 45, lambda: bench_gradients_config(qt, env, platform)),
         ("tiers", 45, lambda: bench_precision_tiers(qt, env, platform)),
         ("mxu", 45, lambda: bench_mxu_saturation_config(qt, env,
                                                         platform)),

@@ -57,7 +57,9 @@ from .qasm_import import ParsedQASM, parse_qasm, load_qasm_file
 from .serve import (SimulationService, CoalescePolicy, ServeError,
                     QueueFull, DeadlineExceeded, ServiceClosed,
                     CircuitBreakerOpen, ServiceRouter,
-                    AllReplicasUnavailable, WarmCache)
+                    AllReplicasUnavailable, WarmCache,
+                    VariationalProblem, OptimizationHandle,
+                    GradientDescent, Adam)
 from .resilience import (FaultInjector, FaultSpec, HealthConfig,
                          NumericalFault, ResiliencePolicy,
                          SupervisorPolicy)
@@ -87,6 +89,8 @@ __all__ = (
         "QueueFull", "DeadlineExceeded", "ServiceClosed",
         "CircuitBreakerOpen", "ServiceRouter", "AllReplicasUnavailable",
         "WarmCache",
+        "VariationalProblem", "OptimizationHandle", "GradientDescent",
+        "Adam",
         "FaultInjector", "FaultSpec", "HealthConfig", "NumericalFault",
         "ResiliencePolicy", "SupervisorPolicy",
         "Tracer", "TraceContext", "metrics_registry",

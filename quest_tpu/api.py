@@ -46,6 +46,7 @@ __all__ = [
     "reportQuESTEnv", "getEnvironmentString", "seedQuEST", "seedQuESTDefault",
     "createSimulationService",       # serving runtime (TPU-native addition)
     "createServiceRouter",           # replicated serving (TPU-native)
+    "createVariationalProblem",      # optimizer-in-the-loop (TPU-native)
     # registers
     "createQureg", "createDensityQureg", "createCloneQureg", "destroyQureg",
     "createComplexMatrixN", "destroyComplexMatrixN", "initComplexMatrixN",
@@ -610,6 +611,22 @@ def createServiceRouter(envs=None, **kwargs):
     with ``router.close()`` (or use it as a context manager)."""
     from .serve import ServiceRouter
     return ServiceRouter(envs, **kwargs)
+
+
+def createVariationalProblem(circuit, observables, x0, **kwargs):
+    """Name a variational workload for the optimizer-in-the-loop
+    serving API (:class:`quest_tpu.serve.optimize.VariationalProblem`;
+    TPU-native addition, no reference counterpart): ``circuit`` (a
+    recorded :class:`~quest_tpu.circuits.Circuit` with Param angles),
+    the ``(pauli_terms, coeffs)`` objective, and the starting point
+    ``x0`` (name->angle dict or ordered vector). Keyword arguments:
+    ``trajectories``/``sampling_budget`` (noisy objectives through the
+    differentiable trajectory wave loop) and ``tier``. Run it with
+    ``service.optimize(problem, ...)`` or ``router.optimize(...)`` —
+    each iterate is one coalesced ``kind="gradient"`` dispatch, and
+    the returned handle streams iterates as incremental results."""
+    from .serve import VariationalProblem
+    return VariationalProblem(circuit, observables, x0, **kwargs)
 
 
 def createSimulationService(env: QuESTEnv, **kwargs):

@@ -2522,16 +2522,19 @@ class CompiledCircuit:
             states = jax.vmap(seg_fn, in_axes=(0, 0))(states, pm)
         return states
 
-    def _batch_policy(self, batch: int) -> dict:
+    def _batch_policy(self, batch: int, mem_factor: float = 1.0) -> dict:
         """The mesh batch-sharding decision for a ``batch``-point
         ensemble (:func:`quest_tpu.parallel.layout.choose_batch_sharding`,
-        priced by the compile-time comm model)."""
+        priced by the compile-time comm model). ``mem_factor=2.0`` is
+        the gradient executables' pricing: reverse mode keeps primal
+        and cotangent planes live together, so the batch-parallel
+        memory wall arrives one doubling earlier."""
         from .parallel.layout import choose_batch_sharding
         return choose_batch_sharding(
             self.num_qubits, batch, self.env.num_devices,
             np.dtype(self.env.precision.real_dtype).itemsize,
             self.plan.num_relayouts, cost_model=self._cost_model,
-            host_bits=self._host_bits)
+            host_bits=self._host_bits, mem_factor=mem_factor)
 
     def _batch_constraint(self, mode: str):
         """Amplitude-axis sharding constraint for the in-engine
@@ -2790,35 +2793,21 @@ class CompiledCircuit:
             codes.reshape(-1), nq, coeffs)
         return nq, T, xm, ym, zm, coeffs
 
-    def _energy_fn(self, mode: str, tier=None):
-        """The batched-energy jit wrapper for one (sharding mode, tier)
-        (masks and coefficients are ARGUMENTS, so one executable serves
-        every Hamiltonian of the same bucketed term shape). Cached in
-        the keyed executable cache; also the lowering source for the
-        warm cache's ``energy`` artifacts. A compensated tier
-        (SINGLE/QUAD) routes each Pauli-term reduction through the
-        TwoSum/Veltkamp pair path (:mod:`quest_tpu.ops.reductions`) —
-        ~4x the per-term memory traffic, exact to the state's true sum;
-        the FAST tier keeps the naive reduce its budget already covers."""
+    def _energies_trace(self, constrain, run_batched, tier):
+        """The ONE batched-energy lowering shared by :meth:`_energy_fn`
+        and :meth:`_grad_fn` (its differentiated form): broadcast the
+        shared start state over the batch, run the plan, reduce the
+        Pauli sum per row. One definition, so a change to the energy
+        lowering (compensated reductions, density trace, constraint
+        placement) can never leave gradient energies diverging from
+        ``expectation_sweep`` energies. Returns a traceable
+        ``(z, pm, xm, ym, zm, cf) -> (B,)`` closure."""
         from .ops import reductions as red
-        key = ("energy", mode,
-               str(np.dtype(self.env.precision.real_dtype)),
-               self._tier_token(tier))
-        with self._stats_lock:
-            fn = self._batched_cache.get(key)
-        if fn is not None:
-            return fn
-        constrain = self._batch_constraint(mode)
-        run_batched = self._batched_runner(mode, tier)
         is_density = self.is_density
         nq = self.num_qubits // 2 if is_density else self.num_qubits
-        tier_cdt = self._tier_dtypes(tier, self.env)[1]
         comp = tier is not None and tier.compensated
 
-        def energy(state_f_, pm_, xm_, ym_, zm_, cf_):
-            z = unpack(state_f_)
-            if z.dtype != tier_cdt:
-                z = z.astype(tier_cdt)
+        def energies(z, pm_, xm_, ym_, zm_, cf_):
             states = jnp.broadcast_to(z, (pm_.shape[0],) + z.shape)
             states = constrain(states)
             states = run_batched(states, pm_)
@@ -2829,6 +2818,36 @@ class CompiledCircuit:
             return jax.vmap(lambda s: red.pauli_sum_total_sv(
                 s, xm_, ym_, zm_, cf_, compensated=comp))(states)
 
+        return energies
+
+    def _energy_fn(self, mode: str, tier=None):
+        """The batched-energy jit wrapper for one (sharding mode, tier)
+        (masks and coefficients are ARGUMENTS, so one executable serves
+        every Hamiltonian of the same bucketed term shape). Cached in
+        the keyed executable cache; also the lowering source for the
+        warm cache's ``energy`` artifacts. A compensated tier
+        (SINGLE/QUAD) routes each Pauli-term reduction through the
+        TwoSum/Veltkamp pair path (:mod:`quest_tpu.ops.reductions`) —
+        ~4x the per-term memory traffic, exact to the state's true sum;
+        the FAST tier keeps the naive reduce its budget already covers."""
+        key = ("energy", mode,
+               str(np.dtype(self.env.precision.real_dtype)),
+               self._tier_token(tier))
+        with self._stats_lock:
+            fn = self._batched_cache.get(key)
+        if fn is not None:
+            return fn
+        constrain = self._batch_constraint(mode)
+        energies = self._energies_trace(
+            constrain, self._batched_runner(mode, tier), tier)
+        tier_cdt = self._tier_dtypes(tier, self.env)[1]
+
+        def energy(state_f_, pm_, xm_, ym_, zm_, cf_):
+            z = unpack(state_f_)
+            if z.dtype != tier_cdt:
+                z = z.astype(tier_cdt)
+            return energies(z, pm_, xm_, ym_, zm_, cf_)
+
         from jax.sharding import PartitionSpec as P
         from .env import AMP_AXIS
         energy = self._wrap_batch_spmd(
@@ -2836,6 +2855,64 @@ class CompiledCircuit:
             in_specs=(P(), P(AMP_AXIS, None), P(), P(), P(), P()),
             out_specs=P(AMP_AXIS))
         fn = jax.jit(energy)
+        with self._stats_lock:
+            self._batched_cache[key] = fn
+        return fn
+
+    def _grad_fn(self, mode: str, tier=None):
+        """The batched value-and-grad executable for one (sharding
+        mode, tier): ``jax.value_and_grad`` through the SAME
+        ``_run_plan_batched`` trace ``expectation_sweep`` runs, so one
+        reverse pass replaces the whole parameter-shift loop
+        (PennyLane-Lightning's adjoint insight, arXiv:2508.13615,
+        recast through the batched engine). Rows are independent, so
+        the gradient of the SUMMED energies w.r.t. the ``(B, P)``
+        parameter matrix is exactly the per-row gradient block — no
+        per-row vjp loop, one backward walk for the whole batch. The
+        executable returns ONE ``(B, P + 1)`` array (column 0 the
+        energies, columns 1..P the gradients) so the whole gradient
+        sweep leaves the device as a single transfer. Always traces
+        the layer-free XLA twin (``jax.grad`` has no rule for a
+        compiled ``pallas_call``); density-compiled programs
+        differentiate through their lifted channels, including
+        Param-rate Kraus strengths."""
+        key = ("grad", mode,
+               str(np.dtype(self.env.precision.real_dtype)),
+               self._tier_token(tier))
+        with self._stats_lock:
+            fn = self._batched_cache.get(key)
+        if fn is not None:
+            return fn
+        constrain = self._batch_constraint(mode)
+        src = self._xla_only()
+        prec, _fast = self._tier_exec_mode(tier)
+        energies = self._energies_trace(
+            constrain,
+            lambda states, pmat: src._run_plan_batched(
+                states, pmat, gate_prec=prec),
+            tier)
+        tier_cdt = self._tier_dtypes(tier, self.env)[1]
+
+        def value_and_grad(state_f_, pm_, xm_, ym_, zm_, cf_):
+            z = unpack(state_f_)
+            if z.dtype != tier_cdt:
+                z = z.astype(tier_cdt)
+
+            def total(pmat):
+                e = energies(z, pmat, xm_, ym_, zm_, cf_)
+                return jnp.sum(e), e
+
+            (_, e), g = jax.value_and_grad(total, has_aux=True)(pm_)
+            return jnp.concatenate(
+                [e[:, None].astype(pm_.dtype), g], axis=1)
+
+        from jax.sharding import PartitionSpec as P
+        from .env import AMP_AXIS
+        value_and_grad = self._wrap_batch_spmd(
+            value_and_grad, mode,
+            in_specs=(P(), P(AMP_AXIS, None), P(), P(), P(), P()),
+            out_specs=P(AMP_AXIS, None))
+        fn = jax.jit(value_and_grad)
         with self._stats_lock:
             self._batched_cache[key] = fn
         return fn
@@ -3122,6 +3199,117 @@ class CompiledCircuit:
                                               pol))
         out = out[:B] if out.shape[0] != B else out
         return _faults.poison_output(poison, out)
+
+    def _grad_tier(self, tier):
+        """Tier resolution for GRADIENT dispatches: the ladder applies
+        (FAST/SINGLE/DOUBLE change only dtype and matmul precision, the
+        reverse pass differentiates through them unchanged), but the
+        QUAD rung's double-double walk is not a supported
+        differentiation path — its per-op ``optimization_barrier`` +
+        plane-splitting steps would need custom transpose rules; reject
+        typed instead of silently falling to a lower rung. (Residual
+        headroom: an SPSA fallback could serve quad gradients without
+        differentiating the dd walk — ROADMAP open items.)"""
+        tier = self._effective_tier(tier)
+        if tier is not None and tier.name == "quad":
+            raise ValueError(
+                "gradient sweeps cannot run at the QUAD tier: the "
+                "double-double engine walk is not differentiable "
+                "(no transpose rules for the dd split/barrier steps); "
+                "use tier='double' for the highest differentiable "
+                "rung, or estimate quad gradients by parameter shift "
+                "over expectation_sweep(tier='quad')")
+        return tier
+
+    def value_and_grad_sweep(self, param_matrix, hamiltonian,
+                             state_f=None, tier=None):
+        """``(B,)`` energies AND their ``(B, P)`` parameter gradients
+        from ONE executable and ONE ``(B, P+1)`` device->host transfer.
+
+        The variational fast path (ROADMAP item 1): where a client-side
+        parameter-shift loop pays ``2P + 1`` energy evaluations per
+        point — ``B * (2P + 1)`` executables and transfers for the
+        sweep — this is ``jax.value_and_grad`` THROUGH the
+        ``expectation_sweep`` trace, vmapped over the batch axis: one
+        reverse pass per batch, one executable, one transfer.
+        ``hamiltonian``/``state_f`` exactly as
+        :meth:`expectation_sweep`. Works on density-compiled circuits
+        (gradients of ``Tr(H rho)`` THROUGH the noise channels,
+        including Param-bound channel rates — noise-model fitting by
+        gradient at batch scale). ``tier`` as in :meth:`sweep`, except
+        QUAD (rejected typed — the dd walk has no transpose rules).
+
+        Returns ``(values, grads)``: ``(B,)`` and ``(B, P)`` arrays.
+        """
+        tier = self._grad_tier(tier)
+        nparams = len(self.param_names)
+        if nparams == 0:
+            raise ValueError(
+                "this circuit declares no parameters; there is nothing "
+                "to differentiate (record angles via "
+                "Circuit.parameter / Param placeholders)")
+        nq, T, xm, ym, zm, coeffs = self._pauli_operands(hamiltonian)
+        n = self.num_qubits
+        pm = self._validated_param_matrix(param_matrix)
+        sp = _profile.profile_dispatch("circuits.grad_sweep")
+        poison = _faults.fire("circuits.grad_sweep")
+        if poison == "precision":
+            # gradients carry no unit-norm invariant for a monitor to
+            # check — degrade the injected drift to the NaN form the
+            # row screens catch (same rule as expectation_sweep)
+            poison = "nan"
+        B = pm.shape[0]
+        # reverse mode holds primal + cotangent planes: the memory wall
+        # prices at 2x the forward sweep's working set
+        pol = self._batch_policy(B, mem_factor=2.0)
+        mode = pol["mode"]
+        pm_run, B = self._padded_params(pm, mode)
+        pm_run = self._place_batch(pm_run, mode)
+        fn = self._grad_fn(mode, tier)
+        if state_f is None:
+            state_f = jnp.zeros((2, 1 << n),
+                                dtype=self.env.precision.real_dtype
+                                ).at[0, 0].set(1.0)
+        elif getattr(state_f, "shape", None) != (2, 1 << n):
+            raise ValueError(
+                f"value_and_grad_sweep state_f must be shared "
+                f"(2, {1 << n}) planes; got "
+                f"{getattr(state_f, 'shape', None)}")
+        args = (state_f, pm_run, jnp.asarray(xm), jnp.asarray(ym),
+                jnp.asarray(zm),
+                jnp.asarray(coeffs, dtype=self.env.precision.real_dtype))
+        ann_name = (f"quest_tpu.circuits.grad_sweep:"
+                    f"b{pm_run.shape[0]}:t{T}:"
+                    f"{tier.name if tier is not None else 'env'}")
+        with dispatch_annotation(ann_name):
+            out = fn(*args)
+        # the parameter-shift client pays (2P+1) energy dispatches per
+        # row, each >= 1 transfer; the engine's whole (B, P) gradient
+        # sweep is one (B, P+1) block
+        self._record_batch_stats(B, mode, B * (2 * nparams + 1) - 1)
+        if sp is not None:
+            sp.done(out, program=self.program_digest, kind="gradient",
+                    bucket=pm_run.shape[0],
+                    tier=self._tier_token(tier),
+                    dtype=str(np.dtype(self.env.precision.real_dtype)),
+                    sharding=mode,
+                    # forward + reverse each stream every planned pass
+                    bytes_per_pass=2.0 * self._bytes_per_pass(
+                        pm_run.shape[0], terms=T),
+                    models=self._drift_models(mode, pm_run.shape[0],
+                                              pol))
+        out = out[:B] if out.shape[0] != B else out
+        out = _faults.poison_output(poison, out)
+        return out[:, 0], out[:, 1:]
+
+    def grad_sweep(self, param_matrix, hamiltonian, state_f=None,
+                   tier=None):
+        """The ``(B, P)`` gradient block alone (one executable, one
+        transfer — :meth:`value_and_grad_sweep` with the energies
+        dropped; the values are computed by the same reverse pass
+        either way, so there is no cheaper gradient-only form)."""
+        return self.value_and_grad_sweep(param_matrix, hamiltonian,
+                                         state_f=state_f, tier=tier)[1]
 
     def sample_sweep(self, param_matrix, num_shots: int, key=None,
                      tier=None):

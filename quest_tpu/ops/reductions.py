@@ -41,7 +41,7 @@ __all__ = ["sum_compensated", "sum_pair", "dot_pair", "vdot_pair",
            "pauli_sum_operands", "pauli_sum_expvals_sv",
            "pauli_sum_expvals_dm", "pauli_sum_total_sv",
            "pauli_sum_total_dm", "welford_wave", "welford_merge",
-           "welford_stderr"]
+           "welford_stderr", "score_surrogate"]
 
 
 def _two_sum(a, b):
@@ -294,8 +294,9 @@ def pauli_sum_total_dm(flat, num_qubits: int, xmask, ymask, zmask, coeffs,
 def welford_wave(vals, weights):
     """(count, mean, M2) of one wave of per-trajectory values under a
     0/1 ``weights`` mask (padded wave rows contribute nothing). ``vals``
-    may be ``(W,)`` or ``(B, W)`` (reduced over the last axis); weights
-    broadcast against it."""
+    may be ``(W,)``, ``(B, W)``, or ``(B, C, W)`` (the gradient wave
+    loop's per-component form: C = params + 1) — always reduced over
+    the last axis; weights broadcast against it."""
     w = jnp.broadcast_to(weights.astype(vals.dtype), vals.shape)
     n = jnp.sum(w, axis=-1)
     safe = jnp.maximum(n, 1.0)
@@ -316,6 +317,26 @@ def welford_merge(a, b):
     mean = ma + delta * nb / safe
     m2 = sa + sb + delta * delta * na * nb / safe
     return n, mean, m2
+
+
+def score_surrogate(value, logq):
+    """The differentiation surrogate for a stochastic-trajectory
+    estimator: ``value + stop_grad(value) * (logq - stop_grad(logq))``.
+
+    A trajectory's value ``v_j(theta)`` is drawn with a
+    parameter-dependent measure ``p_j(theta)`` (the Kraus draw
+    probabilities read the evolving state), so the pathwise derivative
+    alone — ``E[dv_j]`` — misses the measure term ``sum_j v_j dp_j``
+    and is a BIASED estimate of ``d/dtheta E[v]``. The surrogate's
+    primal is exactly ``value`` (the added term is identically zero),
+    while its gradient is the pathwise term PLUS the score-function
+    (REINFORCE) correction ``v_j * d log p_j`` — together the unbiased
+    total derivative, so the trajectory-gradient mean converges to the
+    density-path gradient at the usual O(1/sqrt(T)). ``logq`` is the
+    accumulated log-probability of every channel draw the trajectory
+    took (normalised per channel)."""
+    sg = lax.stop_gradient
+    return value + sg(value) * (logq - sg(logq))
 
 
 def welford_stderr(n, m2):

@@ -291,6 +291,19 @@ class TrajectoryProgram:
         """One op of the per-trajectory program on an UNPACKED complex
         state (shared by the single-trajectory jit and the batched XLA
         fallback's vmapped walker)."""
+        return self._op_step_lp(psi, None, key, params, op)[0]
+
+    def _op_step_lp(self, psi, logq, key, params, op):
+        """:meth:`_op_step` with draw log-probability accounting: when
+        ``logq`` is not None, every channel draw adds its NORMALISED
+        log-probability ``log(p_j / sum_k p_k)`` to the running total —
+        the measure term the gradient wave loop's score-function
+        surrogate (:func:`quest_tpu.ops.reductions.score_surrogate`)
+        needs for unbiased trajectory gradients. The drawn operator
+        index and the state update are BITWISE the value path's (the
+        categorical reads the same unnormalised log weights), so
+        gradient waves replay the exact draw stream of the value
+        waves under the same key."""
         n = self.num_qubits
         cdtype = self.env.precision.complex_dtype
         kind, targets, data, extra = op
@@ -298,11 +311,11 @@ class TrajectoryProgram:
             cmask, fmask = extra
             u = data(params) if kind == "u_fn" else data
             return apply_unitary(psi, n, jnp.asarray(u, cdtype),
-                                 targets, cmask, fmask)
+                                 targets, cmask, fmask), logq
         if kind in ("diag", "diag_fn"):
             d = data(params) if kind == "diag_fn" else data
             return apply_diagonal(psi, n, targets,
-                                  jnp.asarray(d, cdtype))
+                                  jnp.asarray(d, cdtype)), logq
         if kind == "kraus_fn":
             kstack = jnp.stack(
                 [jnp.asarray(m).astype(cdtype)
@@ -317,13 +330,15 @@ class TrajectoryProgram:
         probs = self._channel_probs(psi, targets, estack)
         # categorical draw over the physical channel probs
         # (log space; zero-prob branches get ~-inf)
-        logp = jnp.log(jnp.maximum(
-            probs, jnp.finfo(probs.dtype).tiny))
+        tiny = jnp.finfo(probs.dtype).tiny
+        logp = jnp.log(jnp.maximum(probs, tiny))
         j = jax.random.categorical(sub, logp)
+        if logq is not None:
+            logq = logq + logp[j] - jnp.log(
+                jnp.maximum(jnp.sum(probs), tiny))
         psi = apply_unitary(psi, n, kstack[j], targets)
         return psi * jax.lax.rsqrt(
-            jnp.maximum(probs[j],
-                        jnp.finfo(probs.dtype).tiny)).astype(psi.dtype)
+            jnp.maximum(probs[j], tiny)).astype(psi.dtype), logq
 
     def _apply_core(self, state_f, key, param_vec=None):
         if param_vec is None:
@@ -337,6 +352,19 @@ class TrajectoryProgram:
                 op = ("kraus", op[1], op[2][:2], op[3])
             psi = self._op_step(psi, key, params, op)
         return pack(psi)
+
+    def _apply_core_lp(self, state_f, key, param_vec):
+        """The gradient walker's form of :meth:`_apply_core`: returns
+        the UNPACKED final state plus the accumulated draw
+        log-probability (the score-surrogate's measure term). Same op
+        order, same key folds — the draw stream is the value path's."""
+        params = {nm: param_vec[i]
+                  for i, nm in enumerate(self.param_names)}
+        psi = unpack(state_f)
+        logq = jnp.zeros((), dtype=self.env.precision.real_dtype)
+        for op in self._ops:
+            psi, logq = self._op_step_lp(psi, logq, key, params, op)
+        return psi, logq
 
     def _apply_batch(self, state_f, keys, flat_pv):
         """The PALLAS wave-loop walker: the whole trajectory batch
@@ -459,13 +487,15 @@ class TrajectoryProgram:
             self._cost_model_cached = True
         return self._cost_model
 
-    def _policy(self, batch: int) -> dict:
+    def _policy(self, batch: int, mem_factor: float = 1.0) -> dict:
         """The priced sharding decision for a ``batch``-trajectory wave
         (:func:`quest_tpu.parallel.layout.choose_batch_sharding`):
         trajectory-parallel while the replicated working set fits,
         amplitude-sharded past the wall, with the amp fallback's
         per-trajectory collectives counted by
-        :func:`~quest_tpu.parallel.layout.traj_cross_shard_ops`."""
+        :func:`~quest_tpu.parallel.layout.traj_cross_shard_ops`.
+        ``mem_factor=2.0`` is the gradient wave loop's pricing
+        (primal + cotangent live together through the reverse walk)."""
         if self.env.mesh is None or self.env.num_devices < 2:
             return {"mode": "none"}
         from ..parallel.layout import (choose_batch_sharding,
@@ -477,13 +507,15 @@ class TrajectoryProgram:
         return choose_batch_sharding(
             self.num_qubits, batch, self.env.num_devices,
             np.dtype(self.env.precision.real_dtype).itemsize, est,
-            cost_model=self._comm_model(), host_bits=self._host_bits)
+            cost_model=self._comm_model(), host_bits=self._host_bits,
+            mem_factor=mem_factor)
 
     def _device_multiple(self) -> int:
         return self.env.num_devices if (
             self.env.mesh is not None and self.env.num_devices > 1) else 1
 
-    def _resolve_mode(self, batch: int, shard_trajectories) -> str:
+    def _resolve_mode(self, batch: int, shard_trajectories,
+                      mem_factor: float = 1.0) -> str:
         """``shard_trajectories``: None -> the priced policy; True ->
         force trajectory-parallel (mesh required); False -> force
         unsharded."""
@@ -494,7 +526,7 @@ class TrajectoryProgram:
             return "batch"
         if shard_trajectories is False:
             return "none"
-        return self._policy(batch)["mode"]
+        return self._policy(batch, mem_factor=mem_factor)["mode"]
 
     def _padded_keys(self, key, num: int, mode: str):
         """Split ``num`` per-trajectory keys and pad to the device
@@ -652,6 +684,58 @@ class TrajectoryProgram:
             return jax.jit(fn, donate_argnums=(8,))
         return self._cached(("twave", mode, self._dt_token(),
                              self._path_token(mode)), build)
+
+    def _grad_wave_fn(self, mode: str):
+        """One GRADIENT wave for the ``(B, W)`` form: every trajectory
+        is differentiated by ``jax.value_and_grad`` through the
+        score-function surrogate (:func:`quest_tpu.ops.reductions.
+        score_surrogate` — pathwise + measure term, so the wave mean
+        is an unbiased estimate of the density-path gradient), and the
+        per-trajectory ``(P + 1)``-component (value, grad...) rows fold
+        into a device-resident ``(3, B, P+1)`` Welford carry. ONE
+        executable per wave, the carry its only transfer — noisy-VQE
+        gradients ride the same early-stopping machinery as values.
+        Always the vmapped XLA walker (``jax.grad`` has no rule for a
+        compiled ``pallas_call``), so the kernel-path token is pinned
+        ``"xla"``."""
+        # the (B*W, P+1) value rows split on the trajectory axis in
+        # batch mode; in amp mode they are tiny per-trajectory scalars
+        # (the STATE carries the sharding) — no constraint
+        constrain = self._out_constraint(mode, ndim=2) \
+            if mode == "batch" else (lambda z: z)
+        rdt = jnp.float64 if np.dtype(
+            self.env.precision.real_dtype) == np.float64 else jnp.float32
+
+        def build():
+            def fn(state_f, flat_keys, pm, mask, xm, ym, zm, cf, carry):
+                B = pm.shape[0]
+                W = flat_keys.shape[0] // B
+                flat_pv = jnp.repeat(pm, W, axis=0)
+
+                def one(k, vec):
+                    def surrogate(v):
+                        psi, logq = self._apply_core_lp(state_f, k, v)
+                        val = red.pauli_sum_total_sv(psi, xm, ym, zm,
+                                                     cf)
+                        return red.score_surrogate(
+                            val, logq.astype(val.dtype)), val
+
+                    (_, val), g = jax.value_and_grad(
+                        surrogate, has_aux=True)(vec)
+                    return jnp.concatenate(
+                        [jnp.reshape(val, (1,)).astype(g.dtype), g])
+
+                vals = jax.vmap(one)(flat_keys, flat_pv)  # (B*W, P+1)
+                vals = constrain(vals)
+                C = vals.shape[1]
+                vals = vals.reshape(B, W, C).transpose(0, 2, 1)
+                n_w, m_w, s_w = red.welford_wave(vals.astype(rdt), mask)
+                n, m, s = red.welford_merge(
+                    (carry[0], carry[1], carry[2]), (n_w, m_w, s_w))
+                return jnp.stack([n, m, s])
+            return jax.jit(fn, donate_argnums=(8,))
+        return self._cached(("tgradwave", mode, self._dt_token(),
+                             "xla"), build)
 
     # -- execution ---------------------------------------------------------
 
@@ -827,12 +911,103 @@ class TrajectoryProgram:
             live_rows=live_rows)
         return means, errs, info
 
+    def expectation_grad(self, pauli_terms, coeffs, state_f=None,
+                         num_trajectories: int = None,
+                         key: Optional[jax.Array] = None, *,
+                         params=None,
+                         sampling_budget: Optional[float] = None,
+                         wave_size: Optional[int] = None,
+                         shard_trajectories: Optional[bool] = None):
+        """Monte-Carlo estimate of ``<H>`` AND its parameter gradient
+        under the noisy evolution — the noisy-VQE objective and its
+        derivative from ONE wave loop. Returns ``(value, grad,
+        stderr)``: the scalar energy, the ``(P,)`` gradient, and the
+        ``(P + 1,)`` standard errors (component 0 the value's).
+
+        Each trajectory differentiates through the stochastic trace
+        with the score-function correction
+        (:func:`quest_tpu.ops.reductions.score_surrogate`), so the
+        ensemble mean converges to the DENSITY-path gradient — channel
+        draws are parameter-dependent measures, and the pathwise
+        derivative alone would be biased. Early stopping
+        (``sampling_budget``) waits for EVERY component's standard
+        error to fit, and the stop decision is a pure function of the
+        seeded key stream — identical on every replay, sharing the
+        value loop's per-row streams."""
+        if num_trajectories is None or int(num_trajectories) < 2:
+            raise ValueError("expectation_grad needs >= 2 trajectories "
+                             "for a standard error")
+        if not self.param_names:
+            raise ValueError(
+                "this circuit declares no parameters; there is nothing "
+                "to differentiate (record angles via Circuit.parameter "
+                "/ Param placeholders)")
+        pm = jnp.reshape(self._param_vec(params),
+                         (1, len(self.param_names)))
+        _, terms, cfs = self._validated_pauli_terms(pauli_terms, coeffs)
+        if state_f is None:
+            state_f = self._default_state()
+        means, errs, _info = self._converge(
+            pm, terms, cfs, state_f, int(num_trajectories), key,
+            sampling_budget=sampling_budget, wave_size=wave_size,
+            shard_trajectories=shard_trajectories, grad=True)
+        # quest: allow-host-sync(result boundary: the convergence loop
+        # already synced its carry; means is a host array here)
+        return float(means[0, 0]), means[0, 1:], errs[0]
+
+    def expectation_grad_batch(self, param_matrix, hamiltonian,
+                               num_trajectories: int,
+                               key: Optional[jax.Array] = None, *,
+                               sampling_budget: Optional[float] = None,
+                               wave_size: Optional[int] = None,
+                               live_rows: Optional[int] = None,
+                               state_f=None):
+        """The ``(B, T)`` gradient form — one noisy-VQE ensemble per
+        parameter row, every row's value AND gradient advancing through
+        shared gradient waves of one executable (the serving runtime's
+        ``kind="gradient"`` dispatch for trajectory programs). Early
+        stopping waits for every live row's every component. Returns
+        ``(values, grads, stderrs, info)``: ``(B,)``, ``(B, P)``,
+        ``(B, P+1)`` arrays and the convergence accounting."""
+        if not self.param_names:
+            # BEFORE the shape check: the dedicated typed rejection
+            # must not be preempted by a confusing (batch, 0) message
+            raise ValueError(
+                "this circuit declares no parameters; there is nothing "
+                "to differentiate (record angles via Circuit.parameter "
+                "/ Param placeholders)")
+        pm = jnp.asarray(param_matrix,
+                         dtype=self.env.precision.real_dtype)
+        if pm.ndim != 2 or pm.shape[1] != len(self.param_names):
+            raise ValueError(
+                f"param_matrix must be (batch, {len(self.param_names)}); "
+                f"got {pm.shape}")
+        if int(num_trajectories) < 2:
+            raise ValueError("expectation_grad needs >= 2 trajectories "
+                             "for a standard error")
+        terms_in, coeffs_in = hamiltonian
+        _, terms, coeffs = self._validated_pauli_terms(terms_in,
+                                                       coeffs_in)
+        if state_f is None:
+            state_f = self._default_state()
+        means, errs, info = self._converge(
+            pm, terms, coeffs, state_f, int(num_trajectories), key,
+            sampling_budget=sampling_budget, wave_size=wave_size,
+            live_rows=live_rows, grad=True)
+        return means[:, 0], means[:, 1:], errs, info
+
     def _converge(self, pm, terms, coeffs, state_f, max_trajectories,
                   key, sampling_budget=None, wave_size=None,
-                  live_rows=None, shard_trajectories=None):
+                  live_rows=None, shard_trajectories=None,
+                  grad: bool = False):
         """The shared convergence loop. ``pm``: ``(B, P)``; per row the
         keys are an up-front ``split`` of one fold of the base key, so
-        wave boundaries never change any draw."""
+        wave boundaries never change any draw. ``grad=True`` runs the
+        GRADIENT wave executable instead: the carry grows a
+        ``P + 1``-component axis (value + every parameter gradient),
+        the stop decision requires EVERY component's standard error to
+        fit the budget, and the returned means/stderrs are
+        ``(B, P+1)``."""
         B = pm.shape[0]
         T = max_trajectories
         live = B if live_rows is None else max(1, min(int(live_rows), B))
@@ -841,15 +1016,18 @@ class TrajectoryProgram:
             key = self.env.next_key()
         W = int(wave_size) if wave_size else self._default_wave(T)
         waves, bucket = plan_waves(T, W, self._device_multiple())
-        mode = self._resolve_mode(B * bucket, shard_trajectories)
+        mode = self._resolve_mode(B * bucket, shard_trajectories,
+                                  mem_factor=2.0 if grad else 1.0)
         # per-row key streams: row b's trajectory t key is
         # split(fold_in(key, b), T)[t] — wave slicing never re-splits
         keys_rows = [jax.random.split(jax.random.fold_in(key, b), T)
                      for b in range(B)]
         rdt = np.float64 if np.dtype(
             self.env.precision.real_dtype) == np.float64 else np.float32
-        carry = jnp.zeros((3, B), dtype=rdt)
-        fn = self._wave_fn(mode)
+        carry = jnp.zeros(
+            (3, B, len(self.param_names) + 1) if grad else (3, B),
+            dtype=rdt)
+        fn = self._grad_wave_fn(mode) if grad else self._wave_fn(mode)
         args_const = (jnp.asarray(xm), jnp.asarray(ym), jnp.asarray(zm),
                       jnp.asarray(cf, dtype=rdt))
         # the whole wave loop is one profiled dispatch: trajectory
@@ -858,7 +1036,7 @@ class TrajectoryProgram:
         run = 0
         waves_run = 0
         early = False
-        stderr = np.full((B,), np.inf)
+        stderr = np.full(carry.shape[1:], np.inf)
         snap = None
         for start, live_w in waves:
             mask = np.zeros((bucket,), dtype=bool)
@@ -898,18 +1076,22 @@ class TrajectoryProgram:
             "max_stderr": float(np.max(stderr[:live])),
             "mode": mode,
             "num_terms": int(num_terms),
+            "kind": "gradient" if grad else "value",
         }
         with self._stats_lock:
             self._last_traj_stats = dict(info)
         if sp is not None:
             itemsize = np.dtype(self.env.precision.real_dtype).itemsize
             state_bytes = 4.0 * itemsize * (1 << self.num_qubits)
+            # the reverse walk streams every pass twice (primal +
+            # cotangent), so a gradient wave's traffic doubles
             sp.done(snap, program=self.program_digest,
-                    kind="trajectory", bucket=int(bucket), tier="env",
+                    kind="gradient" if grad else "trajectory",
+                    bucket=int(bucket), tier="env",
                     dtype=str(np.dtype(self.env.precision.real_dtype)),
                     sharding=mode,
-                    bytes_per_pass=max(len(self._ops), 1)
-                    * B * run * state_bytes)
+                    bytes_per_pass=(2.0 if grad else 1.0)
+                    * max(len(self._ops), 1) * B * run * state_bytes)
         # the engine-off path pays one device->host sync per trajectory
         # per row; the wave loop pays one per wave
         self._record_batch_stats(B * run, mode, B * run - waves_run)

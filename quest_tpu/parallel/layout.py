@@ -678,7 +678,8 @@ def choose_batch_sharding(num_qubits: int, batch: int, num_devices: int,
                           itemsize: int, num_relayouts: int,
                           cost_model=None,
                           mem_limit_bytes: Optional[int] = None,
-                          host_bits: int = 0) -> dict:
+                          host_bits: int = 0,
+                          mem_factor: float = 1.0) -> dict:
     """Pick the batched ensemble engine's sharding axis on a mesh.
 
     An ensemble of ``batch`` independent states can shard the BATCH axis
@@ -715,6 +716,15 @@ def choose_batch_sharding(num_qubits: int, batch: int, num_devices: int,
     ``num_relayouts`` estimate — trajectory programs have no
     LayoutPlan).
 
+    ``mem_factor`` scales the batch-parallel mode's per-device working
+    set for executables that hold more than the forward pass's two
+    plane sets: reverse-mode GRADIENT sweeps
+    (:meth:`~quest_tpu.circuits.CompiledCircuit.value_and_grad_sweep`)
+    keep the primal state and the cotangent live simultaneously
+    through the backward walk, so they price at ``mem_factor=2.0`` —
+    the crossover to amplitude sharding arrives one batch doubling
+    earlier than the forward sweep's, never later.
+
     Returns ``{"mode": "none"|"batch"|"amp", "amp_comm_seconds": float,
     "per_device_bytes": float}``.
     """
@@ -731,7 +741,8 @@ def choose_batch_sharding(num_qubits: int, batch: int, num_devices: int,
     state_bytes = 2.0 * itemsize * (1 << num_qubits)    # split re/im planes
     shard_bits = max(num_devices.bit_length() - 1, 1)
     per_dev_batch = -(-batch // num_devices)
-    batch_mode_bytes = per_dev_batch * 2.0 * state_bytes
+    batch_mode_bytes = per_dev_batch * 2.0 * state_bytes \
+        * max(float(mem_factor), 1.0)
     amp_comm = (batch * num_relayouts
                 * cost_model.all_to_all_seconds(state_bytes / num_devices,
                                                 shard_bits,

@@ -65,9 +65,11 @@ SITES = (
     "circuits.run",                # CompiledCircuit.run / apply dispatch
     "circuits.sweep",              # batched ensemble sweep dispatch
     "circuits.expectation_sweep",  # batched energy dispatch
+    "circuits.grad_sweep",         # batched value-and-grad dispatch
     "pergate.gate",                # imperative sharded gate dispatch
     "pergate.relayout",            # imperative relayout exchange
     "serve.execute",               # serving dispatcher batch execution
+    "serve.optimize",              # optimizer-in-the-loop iterate step
     "router.route",                # ServiceRouter placement decision
 )
 

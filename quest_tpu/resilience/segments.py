@@ -36,7 +36,8 @@ import numpy as np
 from .health import HealthConfig, check_planes, bad_plane_rows, NumericalFault
 from .recovery import classify, FATAL
 
-__all__ = ["split_circuit", "checkpointed_run", "checkpointed_sweep"]
+__all__ = ["split_circuit", "checkpointed_run", "checkpointed_sweep",
+           "opt_progress_save", "opt_progress_load"]
 
 
 def split_circuit(circuit, num_segments: int) -> list:
@@ -128,6 +129,61 @@ def checkpointed_run(circuit, qureg, params: Optional[dict] = None, *,
 def _pm_digest(pm: np.ndarray) -> str:
     return hashlib.sha256(
         np.ascontiguousarray(pm, dtype=np.float64).tobytes()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# optimizer-in-the-loop progress (serve/optimize.py; ISSUE 15)
+# ---------------------------------------------------------------------------
+#
+# The optimization handle checkpoints every completed iterate the same
+# way checkpointed_sweep checkpoints row segments: one atomic .npz
+# (checkpoint.atomic_savez — a crash mid-write leaves the previous
+# progress whole) guarded by a PROBLEM digest, so a resumed run
+# continues a killed optimization only when the circuit + observables +
+# optimizer configuration actually match. Mismatch or torn files mean
+# "start clean", never a crash and never the wrong problem's iterates.
+
+
+def opt_progress_save(path: str, *, digest: str, iteration: int,
+                      x: np.ndarray, value: float,
+                      opt_state: Optional[dict] = None) -> None:
+    """Atomically persist one completed optimizer iterate: the iterate
+    index, the parameter vector, its measured objective value, and the
+    optimizer's own state arrays (Adam moments etc., saved under
+    ``opt_<name>`` keys)."""
+    from .. import checkpoint as ckpt
+    arrays = {"digest": np.asarray(digest),
+              "iteration": np.asarray(int(iteration)),
+              "x": np.ascontiguousarray(x, dtype=np.float64),
+              "value": np.asarray(float(value))}
+    for k, v in (opt_state or {}).items():
+        arrays[f"opt_{k}"] = np.asarray(v)
+    ckpt.atomic_savez(path, **arrays)
+
+
+def opt_progress_load(path: str, digest: str) -> Optional[dict]:
+    """Read a saved optimizer iterate back, or None when the file is
+    missing, torn, or belongs to a different problem (digest
+    mismatch — silently resuming someone else's iterates would walk
+    the WRONG energy surface). Returns ``{"iteration", "x", "value",
+    "opt_state"}``."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as f:
+            if str(f["digest"]) != digest:
+                return None
+            out = {"iteration": int(f["iteration"]),
+                   "x": np.asarray(f["x"], dtype=np.float64),
+                   "value": float(f["value"]),
+                   "opt_state": {k[len("opt_"):]: np.asarray(f[k])
+                                 for k in f.files
+                                 if k.startswith("opt_")}}
+        return out
+    # quest: allow-broad-except(torn-archive boundary: a corrupt
+    # progress file must mean "start clean", never a crash)
+    except Exception:
+        return None
 
 
 def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,

@@ -20,6 +20,8 @@ from .coalesce import (CoalescePolicy, batch_bucket, coalesce_key,
 from .engine import (CircuitBreakerOpen, DeadlineExceeded, QueueFull,
                      ServeError, ServiceClosed, SimulationService)
 from .metrics import RouterMetrics, ServiceMetrics
+from .optimize import (Adam, GradientDescent, OptimizationHandle,
+                       VariationalProblem, resolve_optimizer)
 from .router import AllReplicasUnavailable, ServiceRouter, replica_envs
 from .warmcache import WARM_CACHE_ENV, WarmCache
 
@@ -30,4 +32,6 @@ __all__ = [
     "split_ready",
     "ServiceRouter", "AllReplicasUnavailable", "replica_envs",
     "RouterMetrics", "WarmCache", "WARM_CACHE_ENV",
+    "VariationalProblem", "OptimizationHandle", "GradientDescent",
+    "Adam", "resolve_optimizer",
 ]

@@ -36,8 +36,9 @@ from typing import Optional, Sequence
 from ..parallel.sampling import shot_bucket
 
 __all__ = ["KIND_STATE", "KIND_EXPECTATION", "KIND_SAMPLE",
-           "KIND_TRAJECTORY", "batch_bucket", "coalesce_key",
-           "CoalescePolicy", "split_ready", "plan_schedule"]
+           "KIND_TRAJECTORY", "KIND_GRADIENT", "batch_bucket",
+           "coalesce_key", "CoalescePolicy", "split_ready",
+           "plan_schedule"]
 
 KIND_STATE = "state"
 KIND_EXPECTATION = "expectation"
@@ -47,6 +48,14 @@ KIND_SAMPLE = "sample"
 # sampling_budget), so a group is homogeneous in its convergence
 # contract and executes as ONE (B, T) wave loop
 KIND_TRAJECTORY = "trajectory"
+# value-and-gradient requests (``submit(..., gradient=True)``): the
+# observable key carries the Pauli masks PLUS the program's parameter
+# count, so a group is homogeneous in its gradient width and executes
+# as ONE (B, P) reverse pass — one executable, one (B, P+1) transfer
+# (``CompiledCircuit.value_and_grad_sweep``); trajectory-program
+# gradients additionally carry the (max_T, budget) convergence
+# contract and run one gradient wave loop
+KIND_GRADIENT = "gradient"
 
 
 def batch_bucket(n: int, floor: int = 1) -> int:

@@ -72,9 +72,9 @@ from ..telemetry import profile as _profile
 from ..telemetry.events import make_event, read_timeline
 from ..telemetry.metrics import metrics_registry
 from ..telemetry.tracing import Tracer, dispatch_annotation
-from .coalesce import (KIND_EXPECTATION, KIND_SAMPLE, KIND_STATE,
-                       KIND_TRAJECTORY, CoalescePolicy, coalesce_key,
-                       split_ready)
+from .coalesce import (KIND_EXPECTATION, KIND_GRADIENT, KIND_SAMPLE,
+                       KIND_STATE, KIND_TRAJECTORY, CoalescePolicy,
+                       coalesce_key, split_ready)
 from .metrics import ServiceMetrics
 
 __all__ = ["ServeError", "QueueFull", "DeadlineExceeded", "ServiceClosed",
@@ -358,14 +358,16 @@ class SimulationService:
 
     def _param_vec(self, compiled: CompiledCircuit, params) -> np.ndarray:
         names = compiled.param_names
-        params = params or {}
-        if not isinstance(params, dict):
+        # vector forms FIRST: a numpy array has no truth value, so the
+        # `params or {}` default must only ever see dict/None
+        if params is not None and not isinstance(params, dict):
             vec = np.asarray(params, dtype=np.float64)
             if vec.shape != (len(names),):
                 raise ValueError(
                     f"parameter vector has shape {vec.shape}; expected "
                     f"({len(names)},) ordered like {list(names)}")
             return vec
+        params = params or {}
         missing = [nm for nm in names if nm not in params]
         if missing:
             raise ValueError(f"missing circuit parameters: {missing}")
@@ -378,6 +380,7 @@ class SimulationService:
                observables=None, shots: Optional[int] = None,
                trajectories: Optional[int] = None,
                sampling_budget: Optional[float] = None,
+               gradient: bool = False,
                deadline: Optional[float] = None,
                error_budget: Optional[float] = None,
                tier=None, _trace=None) -> Future:
@@ -419,6 +422,23 @@ class SimulationService:
         (typed NumericalFault), its batchmates complete. Trajectory
         requests run at the environment precision (no tier ladder).
 
+        ``gradient=True`` makes this a GRADIENT request
+        (``kind="gradient"``, ROADMAP item 1): the result is the
+        ``(value, grad)`` pair of the required ``observables=`` Pauli
+        sum — the ``(P,)`` gradient w.r.t. the circuit's declared
+        parameters, computed by ONE reverse pass through the batched
+        engine (:meth:`~quest_tpu.circuits.CompiledCircuit.
+        value_and_grad_sweep`), never a parameter-shift loop. Requests
+        sharing the program, observables, and tier coalesce into one
+        ``(B, P)`` gradient executable with a single ``(B, P+1)``
+        transfer. Combined with ``trajectories=T`` the request is a
+        NOISY gradient: the trajectory program's differentiable wave
+        loop returns ``(value, grad, stderr)`` with early stopping
+        against ``sampling_budget``. Non-differentiable submissions
+        reject typed at this boundary: ``shots=`` (samples have no
+        gradient), a circuit with no declared parameters, and the
+        QUAD tier (the dd walk has no transpose rules).
+
         ``error_budget`` states the max amplitude error this request
         may carry; the service picks the cheapest
         :class:`~quest_tpu.config.PrecisionTier` whose modeled error
@@ -437,6 +457,16 @@ class SimulationService:
                 "a request returns ONE result: pass observables= for an "
                 "energy or shots= for samples, not both (submit twice "
                 "to get both)")
+        if gradient:
+            if shots is not None:
+                raise ValueError(
+                    "gradient requests differentiate a Pauli-sum "
+                    "expectation; shot blocks have no gradient (drop "
+                    "shots= or gradient=)")
+            if observables is None:
+                raise ValueError(
+                    "gradient requests differentiate a Pauli-sum "
+                    "observable; pass observables=(terms, coeffs)")
         if trajectories is not None:
             if int(trajectories) < 2:
                 raise ValueError("trajectories must be >= 2 (a standard "
@@ -472,6 +502,12 @@ class SimulationService:
                 "pass the recorded noisy Circuit (the service compiles "
                 "and caches it) or a TrajectoryProgram, not a "
                 "CompiledCircuit")
+        if gradient and not compiled.param_names:
+            raise ValueError(
+                "gradient requests differentiate the circuit's "
+                "declared parameters; this circuit declares none "
+                "(record angles via Circuit.parameter / Param "
+                "placeholders)")
         vec = self._param_vec(compiled, params)
         now = time.monotonic()
         abs_deadline = now + self.request_timeout_s
@@ -482,13 +518,22 @@ class SimulationService:
                     f"deadline {deadline!r} s is already unmeetable")
             abs_deadline = min(abs_deadline, now + float(deadline))
         if trajectories is not None:
-            kind = KIND_TRAJECTORY
+            kind = KIND_GRADIENT if gradient else KIND_TRAJECTORY
             ham, obs_key = _canonical_observables(compiled, observables)
             # the convergence contract is a coalescing dimension: a
             # group must agree on (max_T, budget) to share a wave loop
             obs_key = obs_key + (int(trajectories),
                                  float(sampling_budget)
                                  if sampling_budget is not None else -1.0)
+            if gradient:
+                # the gradient width is a coalescing dimension too
+                obs_key = obs_key + (len(compiled.param_names),)
+        elif gradient:
+            kind = KIND_GRADIENT
+            ham, obs_key = _canonical_observables(compiled, observables)
+            # obs masks + the gradient width P: a group must agree on
+            # both to share one (B, P) reverse pass
+            obs_key = obs_key + (len(compiled.param_names),)
         elif shots is not None:
             if int(shots) < 1:
                 raise ValueError("shots must be >= 1")
@@ -505,13 +550,22 @@ class SimulationService:
         if tier is not None:
             # per-request = per-dispatch: the QUAD rung is admitted here
             # (dd engine runner), where a compile-time quad would be
-            # rejected
-            req_tier = compiled._resolve_tier(tier, dispatch=True)
+            # rejected. Gradient requests take the GRAD resolution —
+            # the quad rung rejects typed (the dd walk has no
+            # transpose rules)
+            req_tier = compiled._grad_tier(tier) if gradient \
+                else compiled._resolve_tier(tier, dispatch=True)
         elif error_budget is not None:
-            from ..profiling import choose_tier
+            from ..profiling import choose_tier, engine_tiers
+            ladder = None
+            if gradient:
+                # the budget selector must never hand a gradient
+                # request the non-differentiable quad rung
+                ladder = [t for t in engine_tiers(self.env)
+                          if t.name != "quad"]
             req_tier = choose_tier(
                 float(error_budget),
-                max(compiled.circuit.depth, 1), self.env)
+                max(compiled.circuit.depth, 1), self.env, tiers=ladder)
         else:
             req_tier = compiled.tier     # the compile-time tier, if any
         key = coalesce_key(compiled, kind, obs_key, int(shots or 0),
@@ -569,7 +623,8 @@ class SimulationService:
 
     def warm(self, circuit, batch_sizes: Optional[Sequence[int]] = None,
              observables=None, shots: Optional[int] = None,
-             tier=None, trajectories: Optional[int] = None):
+             tier=None, trajectories: Optional[int] = None,
+             gradient: bool = False):
         """Pre-compile the executables the given traffic will hit, so
         first requests pay dispatch latency, not compiles.
 
@@ -609,8 +664,16 @@ class SimulationService:
                 padded = self.policy.bucket_size(int(bs), 1)
                 pm = np.zeros((padded, len(compiled.param_names)),
                               dtype=np.float64)
-                compiled.expectation_batch(pm, ham, warm_t,
-                                           wave_size=warm_t)
+                if gradient:
+                    # the GRADIENT wave executable is its own cache
+                    # slot ("tgradwave"): warming the value wave would
+                    # leave the first served trajectory-gradient
+                    # request paying the reverse-pass compile
+                    compiled.expectation_grad_batch(pm, ham, warm_t,
+                                                    wave_size=warm_t)
+                else:
+                    compiled.expectation_batch(pm, ham, warm_t,
+                                               wave_size=warm_t)
             self._last_cc = compiled
             return compiled
         tier = compiled._effective_tier(tier)
@@ -632,9 +695,17 @@ class SimulationService:
         ham = None
         if observables is not None:
             ham, _ = _canonical_observables(compiled, observables)
+        if gradient and ham is None:
+            raise ValueError("warming gradient executables needs "
+                             "observables= (the reverse pass embeds "
+                             "the Pauli-sum reduction)")
         for bs in sizes:
+            # gradient requests coalesce at the plain power-of-two
+            # bucket (the P+1 transfer block, not the state planes,
+            # rides the request axis through a trajectory program);
+            # compiled-circuit gradients pad like energies
             padded = self.policy.bucket_size(int(bs), mult)
-            if self.warm_cache is not None:
+            if self.warm_cache is not None and not gradient:
                 kind = "energy" if observables is not None else "sweep"
                 status = self.warm_cache.warm_form(
                     compiled, kind, padded, hamiltonian=ham, tier=tier)
@@ -644,7 +715,14 @@ class SimulationService:
                     self.metrics.incr("warm_cache_misses")
             pm = np.zeros((padded, len(compiled.param_names)),
                           dtype=np.float64)
-            if observables is not None:
+            if gradient:
+                # one throwaway reverse pass compiles the (form, mode,
+                # dtype, tier)-keyed gradient executable
+                # quest: allow-host-sync(warm-up materialisation,
+                # deliberately synchronous before traffic opens)
+                np.asarray(compiled.value_and_grad_sweep(
+                    pm, ham, tier=tier)[1])
+            elif observables is not None:
                 np.asarray(compiled.expectation_sweep(pm, ham, tier=tier))
             elif shots is not None:
                 compiled.sample_sweep(pm, int(shots), tier=tier)
@@ -652,6 +730,46 @@ class SimulationService:
                 np.asarray(compiled.sweep(pm, tier=tier))
         self._last_cc = compiled
         return compiled
+
+    def optimize(self, problem, optimizer="adam", *,
+                 max_iters: int = 100, tol: float = 1e-6,
+                 learning_rate: Optional[float] = None,
+                 checkpoint_path: Optional[str] = None,
+                 resume: bool = True, max_restarts: int = 3):
+        """Run a variational optimization INSIDE the serving layer and
+        stream its iterates back (ROADMAP item 1's
+        optimizer-in-the-loop API).
+
+        ``problem`` is a :class:`~quest_tpu.serve.optimize.
+        VariationalProblem` (circuit + Pauli-sum objective + starting
+        point, optionally a trajectory/sampling-budget contract for
+        noisy objectives). Each iterate is ONE ``kind="gradient"``
+        submission — a coalesced value-and-grad dispatch through the
+        batched engine, so concurrent optimizations over the same
+        program share gradient executables and batch slots — followed
+        by a host-side ``optimizer`` step (``"adam"`` / ``"gd"`` or an
+        ``init``/``update`` object). The returned
+        :class:`~quest_tpu.serve.optimize.OptimizationHandle` yields
+        each ``{iteration, value, grad_norm, x, converged}`` from
+        :meth:`~quest_tpu.serve.optimize.OptimizationHandle.iterates`
+        as it lands and resolves the final summary via ``result()``.
+        Convergence is ``|value_k - value_{k-1}| <= tol``, bounded by
+        ``max_iters``.
+
+        ``checkpoint_path`` checkpoints every completed iterate
+        atomically (:func:`quest_tpu.resilience.segments.
+        opt_progress_save`); with ``resume=True`` a killed run
+        continues from its last good iterate — digest-guarded, so a
+        checkpoint from a different problem/optimizer configuration is
+        ignored rather than silently continued. Transient iterate
+        faults re-execute within ``max_restarts``; fatal caller errors
+        fail the handle with the original exception."""
+        from .optimize import run_optimization
+        return run_optimization(
+            self, problem, optimizer, max_iters=max_iters, tol=tol,
+            learning_rate=learning_rate,
+            checkpoint_path=checkpoint_path, resume=resume,
+            max_restarts=max_restarts)
 
     def pause(self) -> None:
         """Hold dispatching (requests keep queueing, deadlines keep
@@ -1183,11 +1301,13 @@ class SimulationService:
         tier = batch[0].tier
         B = len(batch)
         kind = batch[0].kind
-        # trajectory groups pad only to the power-of-two bucket — the
-        # device multiple lives on the (inner) trajectory axis, and a
-        # padded REQUEST row costs a whole throwaway ensemble
+        # trajectory groups (value AND gradient) pad only to the
+        # power-of-two bucket — the device multiple lives on the
+        # (inner) trajectory axis, and a padded REQUEST row costs a
+        # whole throwaway ensemble
         padded = self.policy.bucket_size(
-            B, 1 if kind == KIND_TRAJECTORY
+            B, 1 if (kind == KIND_TRAJECTORY
+                     or isinstance(cc, TrajectoryProgram))
             else self._device_multiple(cc))
         pm = np.zeros((padded, len(cc.param_names)), dtype=np.float64)
         for i, req in enumerate(batch):
@@ -1227,7 +1347,9 @@ class SimulationService:
             except (AttributeError, KeyError, RuntimeError):
                 mode = ""    # stats shape drift: the span just loses it
             extra = {}
-            if kind == KIND_TRAJECTORY:
+            if kind == KIND_TRAJECTORY or (
+                    kind == KIND_GRADIENT
+                    and isinstance(cc, TrajectoryProgram)):
                 info = getattr(cc, "last_traj_stats", None) or {}
                 extra = {"trajectories_run":
                          info.get("trajectories_run", 0),
@@ -1257,14 +1379,16 @@ class SimulationService:
         viol = ()
         norms = None
         if poison == "precision" and (tier is None
-                                      or kind == KIND_EXPECTATION):
+                                      or kind in (KIND_EXPECTATION,
+                                                  KIND_GRADIENT)):
             # a drifted result is UNDETECTABLE silent corruption
             # wherever the fidelity monitor cannot see it — energies
-            # carry no unit-norm invariant, and UNTIERED requests have
-            # no tier tolerance (and no escalation rung) to screen
-            # against. Degrade the injected fault to the NaN form the
-            # value/plane screens catch: the request still fails typed,
-            # never wrong — the one thing chaos runs must never produce.
+            # and gradients carry no unit-norm invariant, and UNTIERED
+            # requests have no tier tolerance (and no escalation rung)
+            # to screen against. Degrade the injected fault to the NaN
+            # form the value/plane screens catch: the request still
+            # fails typed, never wrong — the one thing chaos runs must
+            # never produce.
             poison = "nan"
         # the annotation name carries kind + bucket + tier, so a device
         # profile (profiling.trace -> Perfetto) shows which serving
@@ -1296,6 +1420,52 @@ class SimulationService:
             # the per-row screen quarantines that request typed while
             # its batchmates complete (per-row, never per-batch)
             bad = _health.bad_value_rows(means) if guard else ()
+        elif kind == KIND_GRADIENT and isinstance(cc,
+                                                  TrajectoryProgram):
+            # the differentiable wave loop: every row's value AND
+            # gradient advance through shared gradient waves with the
+            # same early-stopping contract as value requests
+            with ann:
+                vals, grads, errs, info = cc.expectation_grad_batch(
+                    pm, batch[0].observables, batch[0].trajectories,
+                    sampling_budget=batch[0].sampling_budget,
+                    live_rows=B)
+            # quest: allow-host-sync(result fan-out boundary: the wave
+            # loop already synced its convergence carry per wave)
+            vals, grads = np.asarray(vals), np.asarray(grads)
+            block = np.concatenate([vals[:, None], grads], axis=1)
+            block = _faults.poison_output(poison, block)[:B]
+            # quest: allow-host-sync(fan-out of already-host values)
+            results = [(float(block[i, 0]), np.array(block[i, 1:]),
+                        np.array(errs[i])) for i in range(B)]
+            self.metrics.incr("gradient_dispatches")
+            self.metrics.incr("trajectory_dispatches")
+            self.metrics.incr("trajectories_run",
+                              info["trajectories_run"])
+            self.metrics.incr("trajectories_saved",
+                              max(0, info["max_trajectories"]
+                                  - info["trajectories_run"]))
+            # a NaN value OR gradient component poisons only ITS row
+            bad = _health.bad_plane_rows(block) if guard else ()
+        elif kind == KIND_GRADIENT:
+            # ONE reverse pass through the batched engine: the whole
+            # group's values + gradients arrive as a single (B, P+1)
+            # block (CompiledCircuit.value_and_grad_sweep)
+            with ann:
+                vals, grads = cc.value_and_grad_sweep(
+                    pm, batch[0].observables, tier=tier)
+            # quest: allow-host-sync(result fan-out boundary: ONE
+            # (B, P+1) transfer resolves the whole coalesced group)
+            vals, grads = np.asarray(vals), np.asarray(grads)
+            block = np.concatenate([vals[:, None], grads], axis=1)
+            block = _faults.poison_output(poison, block)[:B]
+            # quest: allow-host-sync(fan-out of already-host values)
+            results = [(float(block[i, 0]), np.array(block[i, 1:]))
+                       for i in range(B)]
+            self.metrics.incr("gradient_dispatches")
+            bad = _health.bad_plane_rows(block) if guard else ()
+            # gradients carry no unit-norm invariant: only the NaN
+            # screen applies (same contract as energies)
         elif kind == KIND_EXPECTATION:
             with ann:
                 out = _faults.poison_output(poison, np.asarray(
@@ -1487,6 +1657,10 @@ class SimulationService:
             self.metrics.incr("completed")
             self.metrics.record_latency(done_t - req.submit_t,
                                         t_dispatch - req.submit_t)
+        if batch[0].kind == KIND_GRADIENT:
+            good = B - len(bad_rows) - len(viol_rows)
+            if good > 0:
+                self.metrics.incr("gradients_returned", good)
         if self.perf_ledger is not None:
             # per-program measured latency + bucket mix, flushed to the
             # persistent perf ledger on close (the router's EMA

@@ -64,6 +64,13 @@ _COUNTERS = (
     "trajectory_dispatches",  # coalesced trajectory wave loops executed
     "trajectories_run",       # stochastic draws those loops executed
     "trajectories_saved",     # draws early stopping skipped vs max_T
+    # gradient serving + optimizer-in-the-loop (ISSUE 15):
+    "gradient_dispatches",    # coalesced value-and-grad executables run
+    "gradients_returned",     # (value, grad) results fanned back
+    "optimizer_runs",         # optimize() handles started
+    "optimizer_iterations",   # optimizer steps executed (all handles)
+    "optimizer_converged",    # handles that met their tolerance
+    "optimizer_resumes",      # handles resumed from a checkpoint
 )
 
 
@@ -195,6 +202,13 @@ _ROUTER_COUNTERS = (
     "probe_failures",        # probes whose results failed the oracle check
     "failed_unroutable",     # requests failed: no healthy replica in budget
     "supervisor_errors",     # supervisor-loop iterations that raised
+    # optimizer-in-the-loop over the replicated front end (ISSUE 15):
+    # router.optimize() drives the same OptimizationHandle as the
+    # single service, so its accounting must not vanish at this level
+    "optimizer_runs",        # optimize() handles started on this router
+    "optimizer_iterations",  # optimizer steps executed (all handles)
+    "optimizer_converged",   # handles that met their tolerance
+    "optimizer_resumes",     # handles resumed from a checkpoint
 )
 
 

@@ -151,17 +151,19 @@ class _Work:
                  "deadline", "future", "failovers_left", "lock", "done",
                  "tried", "active", "last_route_t", "hedged",
                  "park_logged", "trace", "trajectories",
-                 "sampling_budget")
+                 "sampling_budget", "gradient", "tier")
 
     def __init__(self, circuit, params, observables, shots, submit_t,
                  deadline, failovers_left, trajectories=None,
-                 sampling_budget=None):
+                 sampling_budget=None, gradient=False, tier=None):
         self.circuit = circuit
         self.params = params
         self.observables = observables
         self.shots = shots
         self.trajectories = trajectories
         self.sampling_budget = sampling_budget
+        self.gradient = gradient
+        self.tier = tier
         self.submit_t = submit_t
         self.deadline = deadline        # ABSOLUTE (monotonic); immutable
         self.future: Future = Future()
@@ -412,12 +414,19 @@ class ServiceRouter:
                observables=None, shots: Optional[int] = None,
                trajectories: Optional[int] = None,
                sampling_budget: Optional[float] = None,
+               gradient: bool = False, tier=None,
                deadline: Optional[float] = None) -> Future:
         """Enqueue one request on the healthiest replica; returns a
         router-owned Future. Semantics match
         :meth:`SimulationService.submit` — including trajectory
         requests (``trajectories=`` / ``sampling_budget=``; each
-        replica lowers and caches its own trajectory program) — plus:
+        replica lowers and caches its own trajectory program) and
+        gradient requests (``gradient=True`` — kind="gradient"
+        value-and-grad dispatches, failover-safe like every other
+        kind: the recorded circuit re-routes and any replica's own
+        gradient executable serves it) and per-request precision
+        tiers (``tier=`` — resolved and tier-keyed by whichever
+        replica serves each hop) — plus:
         replica faults fail the request over to a healthy replica under
         its ORIGINAL absolute deadline, and a window with no ready
         replica parks the request for re-placement instead of dropping
@@ -434,7 +443,8 @@ class ServiceRouter:
             abs_deadline = min(abs_deadline, now + float(deadline))
         work = _Work(route, params, observables, shots, now, abs_deadline,
                      self.max_failovers, trajectories=trajectories,
-                     sampling_budget=sampling_budget)
+                     sampling_budget=sampling_budget, gradient=gradient,
+                     tier=tier)
         ctx = self.tracer.start(router=self.name)
         if ctx is not None:
             work.trace = ctx
@@ -503,6 +513,7 @@ class ServiceRouter:
                     observables=work.observables, shots=work.shots,
                     trajectories=work.trajectories,
                     sampling_budget=work.sampling_budget,
+                    gradient=work.gradient, tier=work.tier,
                     deadline=remaining, _trace=work.trace)
             except QueueFull:
                 self.metrics.incr("rerouted_full")
@@ -664,6 +675,27 @@ class ServiceRouter:
             self._warm_specs.append(_WarmSpec(
                 route, tuple(batch_sizes) if batch_sizes else None,
                 observables, shots, reference))
+
+    def optimize(self, problem, optimizer="adam", *,
+                 max_iters: int = 100, tol: float = 1e-6,
+                 learning_rate: Optional[float] = None,
+                 checkpoint_path: Optional[str] = None,
+                 resume: bool = True, max_restarts: int = 3):
+        """Optimizer-in-the-loop over the REPLICATED front end: same
+        contract as :meth:`SimulationService.optimize`, with each
+        iterate's gradient submission routed/failed-over like any
+        other request — a replica death mid-optimization costs at most
+        one re-executed iterate (the handle's restart budget), and
+        with ``checkpoint_path`` a router-wide outage resumes from the
+        last good iterate. The problem's circuit should be a RECORDED
+        :class:`~quest_tpu.circuits.Circuit` (the router routes by it;
+        each replica compiles its own gradient executable)."""
+        from .optimize import run_optimization
+        return run_optimization(
+            self, problem, optimizer, max_iters=max_iters, tol=tol,
+            learning_rate=learning_rate,
+            checkpoint_path=checkpoint_path, resume=resume,
+            max_restarts=max_restarts)
 
     def _probe(self, svc: SimulationService) -> bool:
         """Half-open readmission probe: a batch of zero-parameter

@@ -51,7 +51,7 @@ FAST_MODULES = {
     "test_essential", "test_golden", "test_golden_ref", "test_exchange",
     "test_validation_taxonomy", "test_comm_trace", "test_serve_trace",
     "test_chaos_trace", "test_trace_io", "test_obs_console",
-    "test_traj_trace", "test_mxu_saturation",
+    "test_traj_trace", "test_mxu_saturation", "test_grad_trace",
 }
 
 

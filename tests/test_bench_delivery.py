@@ -118,6 +118,57 @@ def test_ensemble_sweep_rows_required():
     assert "bench_ensemble_sweep" in src
 
 
+def test_gradient_rows_required():
+    """The bench must deliver the ISSUE-15 gradient rows: the
+    parameter-shift client loop, the one-executable grad_sweep, and
+    the served/coalesced gradient trace, all in grads/sec with the
+    shift-oracle parity and the collapsed-transfer accounting. Run
+    tiny (5 qubits, batch 4) so the delivery contract is tested, not
+    the measurement."""
+    env_overrides = {
+        "QUEST_BENCH_GRAD_QUBITS": "5",
+        "QUEST_BENCH_GRAD_BATCH": "4",
+        "QUEST_BENCH_GRAD_TERMS": "3",
+        "QUEST_BENCH_GRAD_LAYERS": "1",
+        "QUEST_BENCH_TRIALS": "5",
+    }
+    old = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        import quest_tpu as qt
+        env = qt.createQuESTEnv(num_devices=1, seed=[2026])
+        rows = bench.bench_gradients(qt, env, "cpu")
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    assert len(rows) == 3
+    shift, on, served = rows
+    assert "parameter-shift" in shift["metric"]
+    assert "one-executable" in on["metric"]
+    assert "serving coalesced" in served["metric"]
+    P = 2 * 5    # one ry+rz layer
+    for row in rows:
+        assert row["unit"] == "grads/sec"
+        assert row["value"] > 0.0
+        assert "hardware-efficient-ansatz-5" in row["metric"]
+        assert f"P={P}" in row["metric"]
+    # the shift loop pays B*(2P+1) transfers; the engine pays one
+    assert shift["host_syncs"] == 4 * (2 * P + 1)
+    assert on["host_syncs"] == 1
+    assert on["host_syncs_avoided"] == 4 * (2 * P + 1) - 1
+    assert on["speedup_vs_shift"] > 0.0
+    # gradient parity vs the shift oracle (exact for rotation gates)
+    assert on["grad_parity"] < 1e-9
+    assert served["grad_parity"] < 1e-9
+    assert served["gradient_dispatches"] >= 1
+    assert served["batch_occupancy"] > 1.0     # the requests coalesced
+    # bench_sharded_mesh must carry the rows too (the acceptance mesh)
+    import inspect
+    src = inspect.getsource(bench.bench_sharded_mesh)
+    assert "bench_gradients" in src
+
+
 def test_trajectory_rows_required():
     """The bench must deliver the ISSUE-10 trajectory rows: the exact
     density path, the per-trajectory engine-off loop, the wave-loop

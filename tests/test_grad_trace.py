@@ -1,0 +1,94 @@
+"""tools/grad_trace.py smoke (fast tier): the planned gradient
+schedule must agree with the coalescer's batch bucket, the gradient
+sharding policy (mem_factor=2), and the trajectory wave planner; the
+modeled optimizer schedule must place its convergence decision point
+deterministically; and the CLI must produce parseable, schema-tagged
+output end-to-end."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import grad_trace  # noqa: E402
+
+
+def test_schedule_matches_coalescer_and_policy():
+    from quest_tpu.serve.coalesce import batch_bucket
+    doc = json.loads(json.dumps(grad_trace.trace_schedule(
+        10, 6, 5, 1, 8)))
+    assert doc["batch_bucket"] == batch_bucket(5) == 8
+    assert doc["padded_rows"] == 3
+    # the single (B, P+1) transfer block and the collapsed
+    # parameter-shift dispatches
+    assert doc["transfer_block"] == [8, 7]
+    assert doc["host_syncs_avoided"] == 8 * (2 * 6 + 1) - 1
+    assert doc["sharding"]["mode"] == "none"
+    assert doc["sharding"]["mem_factor"] == 2.0
+
+
+def test_gradient_memory_wall_arrives_earlier():
+    """The reverse pass prices at 2x the forward working set: there is
+    a batch size where the FORWARD sweep still batch-shards but the
+    gradient sweep has already crossed to amplitude sharding."""
+    from quest_tpu.parallel.layout import choose_batch_sharding
+    kw = dict(num_devices=8, itemsize=8, num_relayouts=4,
+              mem_limit_bytes=400_000)
+    n, B = 12, 16
+    fwd = choose_batch_sharding(n, B, mem_factor=1.0, **kw)
+    grad = choose_batch_sharding(n, B, mem_factor=2.0, **kw)
+    assert fwd["mode"] == "batch"
+    assert grad["mode"] == "amp"
+
+
+def test_optimizer_decision_point_is_deterministic():
+    doc = grad_trace.trace_schedule(8, 4, 2, 1, 8, max_iters=50,
+                                    tol=1e-3, rate=0.7)
+    opt = doc["optimizer"]
+    # |delta_k| = 0.3 * 0.7^(k-1) <= 1e-3 first at k = 17
+    assert opt["decision_iteration"] == 17
+    assert opt["projected_iterations"] == 18
+    assert opt["events"][-1]["converged"] is True
+    deltas = [e["modeled_delta"] for e in opt["events"][1:]]
+    assert deltas == sorted(deltas, reverse=True)
+
+
+def test_trajectory_gradient_waves():
+    from quest_tpu.ops.trajectories import plan_waves
+    doc = grad_trace.trace_schedule(10, 3, 2, 1, 8, trajectories=100,
+                                    wave_size=32, sampling_budget=0.2,
+                                    sigma=1.0)
+    tg = doc["trajectory_grad"]
+    waves, bucket = plan_waves(100, 32, 1)
+    assert tg["wave_bucket"] == bucket
+    assert len(tg["waves"]) == len(waves)
+    assert tg["components"] == 3 + 1
+    # n* = (1.0/0.2)^2 = 25 -> inside wave 0 (cum 32)
+    assert tg["projected_stop_after"] == 25
+    assert tg["early_stop_wave"] == 0
+
+
+def test_cli_end_to_end(tmp_path):
+    tool = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "grad_trace.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}
+    out_file = tmp_path / "grad.json"
+    proc = subprocess.run(
+        [sys.executable, tool, "--qubits", "12", "--params", "8",
+         "--batch", "10", "--devices", "8", "--max-iters", "20",
+         "--tol", "1e-2", "--rate", "0.5", "--out", str(out_file)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    doc = json.loads(out_file.read_text())
+    # shared versioned dump header (tools/_trace_io.py, ISSUE 9)
+    assert doc["schema"] == "quest_tpu.trace/1"
+    assert doc["kind"] == "grad"
+    assert doc["num_params"] == 8
+    # 10 requests pad to the 16-bucket (floored at the 8-device mesh)
+    assert doc["batch_bucket"] == 16
+    assert doc["optimizer"]["decision_iteration"] is not None

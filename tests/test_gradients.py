@@ -1,0 +1,536 @@
+"""One-executable gradient sweeps (ISSUE 15): value_and_grad through
+the batched engine, differentiable trajectory waves, and
+optimizer-in-the-loop serving.
+
+Acceptance shape: gradient parity against a parameter-shift oracle at
+the reference tolerance (single device AND the 8-device mesh,
+statevector AND density), trajectory gradients within their own
+standard error of the density-path gradient, fixed-seed determinism,
+typed rejection of every non-differentiable submission, kind="gradient"
+round-tripping through SimulationService and ServiceRouter (coalesced,
+tier-keyed, failover-safe), and optimize() streaming
+monotone-converging iterates with checkpoint/resume surviving a
+mid-run injected fault.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+from quest_tpu.resilience.faults import (FaultInjector, FaultSpec,
+                                         inject)
+
+
+def _hea(num_qubits, layers=1):
+    c = Circuit(num_qubits)
+    for layer in range(layers):
+        for q in range(num_qubits):
+            c.ry(q, c.parameter(f"y{layer}_{q}"))
+            c.rz(q, c.parameter(f"z{layer}_{q}"))
+        for q in range(num_qubits):
+            c.cnot(q, (q + 1) % num_qubits)
+    return c
+
+
+def _random_ham(rng, num_qubits, num_terms):
+    codes = rng.integers(0, 4, size=(num_terms, num_qubits))
+    coeffs = rng.normal(size=num_terms)
+    terms = [[(q, int(codes[t, q])) for q in range(num_qubits)]
+             for t in range(num_terms)]
+    return terms, coeffs
+
+
+def _shift_oracle(cc, pm, ham):
+    """Parameter-shift gradients via single-row expectation_sweep calls
+    (exact for rotation-generated Params)."""
+    pm = np.asarray(pm, dtype=np.float64)
+    B, P = pm.shape
+    out = np.zeros((B, P))
+    for p in range(P):
+        for s, sgn in ((np.pi / 2, 1.0), (-np.pi / 2, -1.0)):
+            shifted = pm.copy()
+            shifted[:, p] += s
+            out[:, p] += sgn * 0.5 * np.asarray(
+                cc.expectation_sweep(shifted, ham))
+    return out
+
+
+class TestGradSweep:
+    """value_and_grad_sweep vs the parameter-shift oracle
+    (acceptance: <= 1e-9 single device and 8-device mesh, sv + dm)."""
+
+    def test_statevector_single_device(self, env, rng):
+        c = _hea(5)
+        ham = _random_ham(rng, 5, 6)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(7, len(c.param_names)))
+        vals, grads = cc.value_and_grad_sweep(pm, ham)
+        assert np.asarray(vals).shape == (7,)
+        assert np.asarray(grads).shape == (7, len(c.param_names))
+        # the energies are the expectation_sweep energies
+        en = np.asarray(cc.expectation_sweep(pm, ham))
+        assert np.max(np.abs(np.asarray(vals) - en)) <= 1e-12
+        assert np.max(np.abs(np.asarray(grads)
+                             - _shift_oracle(cc, pm, ham))) <= 1e-9
+
+    def test_statevector_mesh(self, env, mesh_env, rng):
+        c = _hea(5)
+        ham = _random_ham(rng, 5, 4)
+        ccm = c.compile(mesh_env)
+        cc1 = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(16, len(c.param_names)))
+        _, gm = ccm.value_and_grad_sweep(pm, ham)
+        assert np.max(np.abs(np.asarray(gm)
+                             - _shift_oracle(cc1, pm, ham))) <= 1e-9
+
+    def test_density_with_param_channel(self, env, rng):
+        """Density-path gradients THROUGH a Param-bound channel rate
+        (noise-model fitting by gradient): rotation columns check
+        against the shift oracle, the rate column against a central
+        difference."""
+        c = _hea(3)
+        r = c.parameter("rate")
+        c.dephase(0, r)
+        ham = _random_ham(rng, 3, 4)
+        cc = c.compile(env, density=True)
+        P = len(c.param_names)
+        pm = np.concatenate(
+            [rng.uniform(0, 2 * np.pi, size=(4, P - 1)),
+             rng.uniform(0.05, 0.3, size=(4, 1))], axis=1)
+        vals, grads = cc.value_and_grad_sweep(pm, ham)
+        grads = np.asarray(grads)
+        # rotation angles: shift rule stays exact on the density path
+        assert np.max(np.abs(grads[:, :-1]
+                             - _shift_oracle(cc, pm, ham)[:, :-1])) \
+            <= 1e-9
+        eps = 1e-6
+        up, dn = pm.copy(), pm.copy()
+        up[:, -1] += eps
+        dn[:, -1] -= eps
+        fd = (np.asarray(cc.expectation_sweep(up, ham))
+              - np.asarray(cc.expectation_sweep(dn, ham))) / (2 * eps)
+        assert np.max(np.abs(grads[:, -1] - fd)) <= 1e-8
+
+    def test_density_mesh(self, env, mesh_env, rng):
+        c = _hea(3)
+        ham = _random_ham(rng, 3, 3)
+        ccm = c.compile(mesh_env, density=True)
+        cc1 = c.compile(env, density=True)
+        pm = rng.uniform(0, 2 * np.pi, size=(8, len(c.param_names)))
+        _, gm = ccm.value_and_grad_sweep(pm, ham)
+        assert np.max(np.abs(np.asarray(gm)
+                             - _shift_oracle(cc1, pm, ham))) <= 1e-9
+
+    def test_gradient_executable_is_fully_keyed(self, env, rng):
+        """QL002 shape: the gradient executable lands in the batched
+        cache under the full (form, mode, dtype, tier) key."""
+        c = _hea(4)
+        ham = _random_ham(rng, 4, 3)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(3, len(c.param_names)))
+        cc.value_and_grad_sweep(pm, ham)
+        keys = [k for k in cc._batched_cache
+                if k and k[0] == "grad"]
+        assert len(keys) == 1
+        form, mode, dtype, tier_tok = keys[0]
+        assert mode in ("none", "batch", "amp")
+        assert dtype == str(np.dtype(env.precision.real_dtype))
+        assert tier_tok == "env"
+        # a tiered dispatch compiles its OWN executable
+        cc.value_and_grad_sweep(pm, ham, tier="double")
+        keys = [k for k in cc._batched_cache
+                if k and k[0] == "grad"]
+        assert len(keys) == 2
+
+    def test_grad_sweep_returns_gradient_block(self, env, rng):
+        c = _hea(4)
+        ham = _random_ham(rng, 4, 3)
+        cc = c.compile(env)
+        pm = rng.uniform(0, 2 * np.pi, size=(3, len(c.param_names)))
+        g = np.asarray(cc.grad_sweep(pm, ham))
+        _, g2 = cc.value_and_grad_sweep(pm, ham)
+        assert np.array_equal(g, np.asarray(g2))
+
+    def test_quad_tier_rejected_typed(self, env, rng):
+        c = _hea(4)
+        ham = _random_ham(rng, 4, 3)
+        cc = c.compile(env)
+        pm = np.zeros((2, len(c.param_names)))
+        with pytest.raises(ValueError, match="QUAD"):
+            cc.value_and_grad_sweep(pm, ham, tier="quad")
+
+    def test_paramless_circuit_rejected_typed(self, env):
+        c = Circuit(3)
+        c.h(0)
+        cc = c.compile(env)
+        with pytest.raises(ValueError, match="nothing to "
+                                             "differentiate"):
+            cc.value_and_grad_sweep(np.zeros((1, 0)),
+                                    ([[(0, 3)]], [1.0]))
+
+
+class TestTrajectoryGradients:
+    """The differentiable wave loop: score-corrected trajectory
+    gradients converge to the density-path gradient."""
+
+    def _noisy(self):
+        c = Circuit(3)
+        for q in range(3):
+            c.ry(q, c.parameter(f"a{q}"))
+        c.cnot(0, 1)
+        c.cnot(1, 2)
+        for q in range(3):
+            c.rz(q, c.parameter(f"b{q}"))
+        return c.with_noise(p1=0.05, damping=0.02)
+
+    HAM = ([[(0, 3)], [(1, 1), (2, 1)], [(0, 2), (1, 3)]],
+           [0.7, -0.4, 0.25])
+
+    def test_parity_within_stderr_of_density_gradient(self, env, rng):
+        import jax
+        noisy = self._noisy()
+        P = len(noisy.param_names)
+        params = {nm: float(v) for nm, v in
+                  zip(noisy.param_names, rng.uniform(0, 2 * np.pi, P))}
+        pm = np.asarray([[params[nm] for nm in noisy.param_names]])
+        ccd = noisy.compile(env, density=True)
+        _, gd = ccd.value_and_grad_sweep(pm, self.HAM)
+        gd = np.asarray(gd)[0]
+        tp = noisy.compile_trajectories(env)
+        val, grad, err = tp.expectation_grad(
+            self.HAM[0], self.HAM[1], num_trajectories=2400,
+            params=params, key=jax.random.PRNGKey(11), wave_size=600)
+        dev = np.abs(np.asarray(grad) - gd)
+        # every component within 5 standard errors of the exact
+        # density gradient (the score-function correction is what
+        # makes this hold; the pathwise-only estimator is biased)
+        assert np.all(dev <= 5.0 * np.maximum(err[1:], 1e-12))
+        # fixed-seed determinism, free of a second compile: the SAME
+        # shapes replay through the cached gradient wave executable
+        val2, grad2, err2 = tp.expectation_grad(
+            self.HAM[0], self.HAM[1], num_trajectories=2400,
+            params=params, key=jax.random.PRNGKey(11), wave_size=600)
+        assert val == val2
+        assert np.array_equal(np.asarray(grad), np.asarray(grad2))
+        assert np.array_equal(err, err2)
+
+    def test_early_stop_against_budget_and_determinism(self, env):
+        import jax
+        # deliberately light circuit: the (B, T) machinery under test
+        # is circuit-independent, and the grad-wave trace cost scales
+        # with the channel count
+        c = Circuit(2)
+        c.ry(0, c.parameter("a"))
+        c.cnot(0, 1)
+        c.ry(1, c.parameter("b"))
+        noisy = c.with_noise(p1=0.08)
+        ham = ([[(0, 3)], [(1, 1)]], [1.0, -0.5])
+        pm = np.full((2, len(noisy.param_names)), 0.3)
+        tp = noisy.compile_trajectories(env)
+        key = jax.random.PRNGKey(3)
+        vals, grads, errs, info = tp.expectation_grad_batch(
+            pm, ham, 2000, key=key, sampling_budget=0.25,
+            wave_size=150)
+        assert info["kind"] == "gradient"
+        assert info["early_stopped"]
+        assert info["trajectories_run"] < 2000
+        # the stop decision covered EVERY component of every live row
+        assert np.all(errs <= 0.25)
+        assert np.asarray(grads).shape == (2, len(noisy.param_names))
+        # identical replay under the same key, executable cache warm
+        vals2, grads2, errs2, info2 = tp.expectation_grad_batch(
+            pm, ham, 2000, key=key, sampling_budget=0.25,
+            wave_size=150)
+        assert info2["trajectories_run"] == info["trajectories_run"]
+        assert np.array_equal(np.asarray(vals), np.asarray(vals2))
+        assert np.array_equal(np.asarray(grads), np.asarray(grads2))
+
+    def test_paramless_rejected_typed(self, env):
+        c = Circuit(2)
+        c.h(0)
+        c = c.with_noise(p1=0.05)
+        tp = c.compile_trajectories(env)
+        with pytest.raises(ValueError, match="nothing to "
+                                             "differentiate"):
+            tp.expectation_grad([[(0, 3)]], [1.0],
+                                num_trajectories=16)
+
+
+class TestGradientServing:
+    """kind="gradient" through SimulationService and ServiceRouter."""
+
+    HAM = ([[(0, 3)], [(1, 1), (2, 1)], [(3, 3), (0, 1)]],
+           [0.6, -0.3, 0.2])
+
+    def _circuit(self):
+        c = Circuit(4)
+        for q in range(4):
+            c.ry(q, c.parameter(f"a{q}"))
+        for q in range(3):
+            c.cnot(q, q + 1)
+        return c
+
+    def test_coalesced_round_trip_with_parity(self, env, rng):
+        c = self._circuit()
+        cc = c.compile(env)
+        P = len(c.param_names)
+        pm = rng.uniform(0, 2 * np.pi, size=(8, P))
+        oracle = _shift_oracle(cc, pm, self.HAM)
+        svc = qt.createSimulationService(env, max_batch=8,
+                                         max_wait_s=5e-3)
+        try:
+            futs = [svc.submit(cc, pm[b], observables=self.HAM,
+                               gradient=True) for b in range(8)]
+            res = [f.result(timeout=120) for f in futs]
+            for b, (val, grad) in enumerate(res):
+                assert np.max(np.abs(grad - oracle[b])) <= 1e-9
+            snap = svc.dispatch_stats()["service"]
+            assert snap["gradient_dispatches"] >= 1
+            assert snap["gradients_returned"] == 8
+            assert snap["batch_occupancy"] > 1.0   # they coalesced
+        finally:
+            svc.close()
+
+    def test_tier_is_a_coalescing_dimension(self, env, rng):
+        """Gradient requests at different tiers never share an
+        executable batch: the coalesce key carries the tier token."""
+        from quest_tpu.serve.coalesce import coalesce_key, KIND_GRADIENT
+        c = self._circuit()
+        cc = c.compile(env)
+        k_env = coalesce_key(cc, KIND_GRADIENT, ("obs",), 0, None)
+        from quest_tpu.config import tier_by_name
+        k_dbl = coalesce_key(cc, KIND_GRADIENT, ("obs",), 0,
+                             tier_by_name("double"))
+        assert k_env != k_dbl
+
+    def test_typed_rejections(self, env):
+        c = self._circuit()
+        cc = c.compile(env)
+        P = len(c.param_names)
+        svc = qt.createSimulationService(env)
+        try:
+            with pytest.raises(ValueError, match="no gradient"):
+                svc.submit(cc, np.zeros(P), shots=8, gradient=True)
+            with pytest.raises(ValueError, match="observables"):
+                svc.submit(cc, np.zeros(P), gradient=True)
+            c0 = Circuit(2)
+            c0.h(0)
+            cc0 = c0.compile(env)
+            with pytest.raises(ValueError, match="declares none"):
+                svc.submit(cc0, None, observables=([[(0, 3)]], [1.0]),
+                           gradient=True)
+            with pytest.raises(ValueError, match="QUAD"):
+                svc.submit(cc, np.zeros(P), observables=self.HAM,
+                           gradient=True, tier="quad")
+        finally:
+            svc.close()
+
+    def test_trajectory_gradient_round_trip(self, env):
+        c = self._circuit().with_noise(p1=0.02)
+        svc = qt.createSimulationService(env, max_batch=4,
+                                         max_wait_s=5e-3)
+        try:
+            params = {nm: 0.4 for nm in c.param_names}
+            f = svc.submit(c, params, observables=self.HAM,
+                           gradient=True, trajectories=200,
+                           sampling_budget=0.1)
+            val, grad, err = f.result(timeout=300)
+            assert np.isfinite(val)
+            assert grad.shape == (len(c.param_names),)
+            assert err.shape == (len(c.param_names) + 1,)
+            snap = svc.dispatch_stats()["service"]
+            assert snap["gradient_dispatches"] == 1
+            assert snap["trajectory_dispatches"] == 1
+        finally:
+            svc.close()
+
+    def test_router_round_trip_with_failover(self, rng):
+        """kind="gradient" through the replicated front end: requests
+        complete with oracle parity, and a replica crash mid-traffic
+        fails gradient work over instead of dropping it."""
+        c = self._circuit()
+        P = len(c.param_names)
+        router = qt.createServiceRouter(
+            num_replicas=2, devices_per_replica=1, max_batch=8,
+            max_wait_s=5e-3)
+        try:
+            env1 = router._replicas[0].service.env
+            cc = c.compile(env1)
+            pm = rng.uniform(0, 2 * np.pi, size=(10, P))
+            oracle = _shift_oracle(cc, pm, self.HAM)
+            futs = [router.submit(c, pm[b], observables=self.HAM,
+                                  gradient=True) for b in range(4)]
+            for b, f in enumerate(futs):
+                _, grad = f.result(timeout=120)
+                assert np.max(np.abs(grad - oracle[b])) <= 1e-9
+            # per-request tier forwards through the router (the
+            # replica resolves and keys it — tier-keyed end to end)
+            _, gt = router.submit(c, pm[8], observables=self.HAM,
+                                  gradient=True,
+                                  tier="double").result(timeout=120)
+            assert np.max(np.abs(gt - oracle[8])) <= 1e-9
+            # kill one replica, keep submitting: failover must serve
+            router._replicas[0].service._debug_crash()
+            futs = [router.submit(c, pm[4 + b], observables=self.HAM,
+                                  gradient=True) for b in range(4)]
+            for b, f in enumerate(futs):
+                _, grad = f.result(timeout=120)
+                assert np.max(np.abs(grad - oracle[4 + b])) <= 1e-9
+        finally:
+            router.close()
+
+    def test_warm_compiles_the_gradient_wave_executable(self, env):
+        """warm(gradient=True, trajectories=) must build the GRADIENT
+        wave executable, not the value wave — or the first served
+        trajectory-gradient request pays the reverse-pass compile."""
+        c = Circuit(2)
+        c.ry(0, c.parameter("a"))
+        c.cnot(0, 1)
+        noisy = c.with_noise(p1=0.05)
+        svc = qt.createSimulationService(env, max_wait_s=1e-3)
+        try:
+            ham = ([[(0, 3)]], [1.0])
+            tp = svc.warm(noisy, observables=ham, trajectories=16,
+                          gradient=True)
+            assert any(k and k[0] == "tgradwave" for k in tp._cache)
+        finally:
+            svc.close()
+
+
+class TestOptimizeInTheLoop:
+    """service.optimize(): streaming iterates, convergence, and
+    checkpointed resume through an injected mid-run fault."""
+
+    HAM = ([[(0, 3)], [(1, 3)]], [1.0, 0.5])
+
+    def _circuit(self):
+        c = Circuit(2)
+        c.ry(0, c.parameter("t0"))
+        c.ry(1, c.parameter("t1"))
+        return c
+
+    def test_streams_monotone_converging_iterates(self, env):
+        """GD on the separable two-qubit objective: the streamed values
+        decrease monotonically to the -1.5 floor and the handle
+        reports convergence."""
+        svc = qt.createSimulationService(env, max_wait_s=1e-3)
+        try:
+            prob = qt.VariationalProblem(
+                self._circuit(), self.HAM, {"t0": 2.0, "t1": 2.0})
+            h = svc.optimize(prob, optimizer="gd", learning_rate=0.4,
+                             max_iters=200, tol=1e-10)
+            vals = [it["value"] for it in h.iterates()]
+            final = h.result(timeout=120)
+            # a second consumption returns immediately instead of
+            # blocking forever on the drained queue (the terminator is
+            # re-posted)
+            assert list(h.iterates()) == []
+            assert len(vals) >= 3
+            assert all(b <= a + 1e-12
+                       for a, b in zip(vals, vals[1:]))
+            assert final["converged"]
+            assert final["value"] == pytest.approx(-1.5, abs=1e-3)
+            snap = svc.dispatch_stats()["service"]
+            assert snap["optimizer_runs"] == 1
+            assert snap["optimizer_converged"] == 1
+            assert snap["optimizer_iterations"] == len(vals)
+        finally:
+            svc.close()
+
+    def test_adam_converges(self, env):
+        svc = qt.createSimulationService(env, max_wait_s=1e-3)
+        try:
+            prob = qt.VariationalProblem(
+                self._circuit(), self.HAM, {"t0": 1.0, "t1": 2.5})
+            h = svc.optimize(prob, optimizer="adam",
+                             learning_rate=0.2, max_iters=300,
+                             tol=1e-9)
+            final = h.result(timeout=240)
+            assert final["value"] == pytest.approx(-1.5, abs=1e-2)
+        finally:
+            svc.close()
+
+    def test_checkpoint_resume_survives_midrun_fault(self, env,
+                                                     tmp_path):
+        """A transient fault storm past the handle's restart budget
+        kills the run mid-way; a fresh optimize() over the same
+        checkpoint resumes from the last good iterate (never iterate
+        0) and completes."""
+        ckpt = str(tmp_path / "opt.npz")
+        prob_args = (self._circuit(), self.HAM,
+                     {"t0": 2.0, "t1": 2.0})
+        svc = qt.createSimulationService(env, max_wait_s=1e-3,
+                                         max_retries=0)
+        try:
+            # every serve.optimize step from call 6 on faults: the
+            # handle burns its restart budget and dies mid-run
+            inj = FaultInjector(
+                [FaultSpec("transient", site="serve.optimize",
+                           at_calls=tuple(range(6, 40)))])
+            with inject(inj):
+                h = svc.optimize(qt.VariationalProblem(*prob_args),
+                                 optimizer="gd", learning_rate=0.4,
+                                 max_iters=60, tol=1e-10,
+                                 checkpoint_path=ckpt,
+                                 max_restarts=2)
+                its = list(h.iterates())
+                with pytest.raises(Exception):
+                    h.result(timeout=120)
+            assert 1 <= len(its) <= 6
+            assert os.path.exists(ckpt)
+
+            # resume: continues AFTER the last checkpointed iterate
+            h2 = svc.optimize(qt.VariationalProblem(*prob_args),
+                              optimizer="gd", learning_rate=0.4,
+                              max_iters=200, tol=1e-10,
+                              checkpoint_path=ckpt, resume=True)
+            its2 = list(h2.iterates())
+            final = h2.result(timeout=240)
+            assert its2[0]["iteration"] == its[-1]["iteration"] + 1
+            assert final["resumed_from"] == its[-1]["iteration"]
+            assert final["converged"]
+            assert final["value"] == pytest.approx(-1.5, abs=1e-3)
+            snap = svc.dispatch_stats()["service"]
+            assert snap["optimizer_resumes"] == 1
+        finally:
+            svc.close()
+
+    def test_checkpoint_digest_guard(self, env, tmp_path):
+        """A checkpoint from a DIFFERENT problem is ignored, not
+        silently continued."""
+        ckpt = str(tmp_path / "opt.npz")
+        svc = qt.createSimulationService(env, max_wait_s=1e-3)
+        try:
+            h = svc.optimize(
+                qt.VariationalProblem(self._circuit(), self.HAM,
+                                      {"t0": 2.0, "t1": 2.0}),
+                optimizer="gd", learning_rate=0.4, max_iters=3,
+                tol=0.0, checkpoint_path=ckpt)
+            list(h.iterates())
+            h.result(timeout=120)
+            # different observables -> different digest -> fresh start
+            other = ([[(0, 1)]], [1.0])
+            h2 = svc.optimize(
+                qt.VariationalProblem(self._circuit(), other,
+                                      {"t0": 2.0, "t1": 2.0}),
+                optimizer="gd", learning_rate=0.4, max_iters=2,
+                tol=0.0, checkpoint_path=str(tmp_path / "opt.npz"),
+                resume=True)
+            its = list(h2.iterates())
+            h2.result(timeout=120)
+            assert its[0]["iteration"] == 0
+        finally:
+            svc.close()
+
+    def test_fatal_problem_fails_typed(self, env):
+        svc = qt.createSimulationService(env, max_wait_s=1e-3)
+        try:
+            with pytest.raises(ValueError, match="nothing to "
+                                                 "optimize"):
+                svc.optimize(qt.VariationalProblem(
+                    Circuit(2).h(0), self.HAM, {}))
+        finally:
+            svc.close()

@@ -56,6 +56,13 @@ class TestQL001HostSync:
                       self.SNIPPET)
         assert rules.rule_ql001_host_sync([f], ROOT) == []
 
+    def test_optimizer_loop_is_exempt_by_construction(self, tmp_path):
+        # serve/optimize.py consumes resolved Future results on the
+        # host; the device dispatch lives one layer down (in scope)
+        f = make_file(tmp_path, "quest_tpu/serve/optimize.py",
+                      self.SNIPPET)
+        assert rules.rule_ql001_host_sync([f], ROOT) == []
+
     def test_float_of_literal_is_not_a_sync(self, tmp_path):
         f = make_file(tmp_path, "quest_tpu/serve/hot.py",
                       "x = float(1.5)\n")
@@ -194,6 +201,48 @@ class TestQL002CacheKeys:
         vs = rules.rule_ql002_cache_keys([f], ROOT)
         assert codes(vs) == ["QL002"]
         assert "tier" in vs[0].message
+
+    # -- the ISSUE-15 gradient-executable key shapes ------------------------
+
+    def test_gradient_key_complete_passes(self, tmp_path):
+        """The value-and-grad executable (_grad_fn) keys on form +
+        mode + dtype + tier like every other batched form — a FAST
+        gradient program must never serve a DOUBLE dispatch."""
+        f = make_file(tmp_path, "quest_tpu/circuits2.py", """
+            class C:
+                def _grad_fn(self, mode, tier):
+                    key = ("grad", mode,
+                           str(np.dtype(self.env.precision.real_dtype)),
+                           self._tier_token(tier))
+                    self._batched_cache[key] = 1
+        """)
+        assert rules.rule_ql002_cache_keys([f], ROOT) == []
+
+    def test_gradient_key_missing_tier_flags(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/circuits2.py", """
+            class C:
+                def _grad_fn(self, mode):
+                    key = ("grad", mode, self._dt_token())
+                    self._batched_cache[key] = 1
+        """)
+        vs = rules.rule_ql002_cache_keys([f], ROOT)
+        assert codes(vs) == ["QL002"]
+        assert "tier" in vs[0].message
+
+    def test_trajectory_grad_wave_key_pins_kernel_path(self, tmp_path):
+        """The gradient wave executable (tier-exempt engine) carries
+        form + mode + dtype + the PINNED 'xla' kernel-path token —
+        jax.grad has no rule for a compiled pallas_call, so the
+        gradient form must never collide with a pallas-path value
+        wave."""
+        f = make_file(tmp_path, "quest_tpu/ops/trajectories.py", """
+            class T:
+                def _grad_wave_fn(self, mode):
+                    return self._cached(
+                        ("tgradwave", mode, self._dt_token(), "xla"),
+                        lambda: 1)
+        """)
+        assert rules.rule_ql002_cache_keys([f], ROOT) == []
 
 
 # -- QL003 ------------------------------------------------------------------
@@ -348,6 +397,90 @@ class TestQL004DispatchBoundaries:
         vs = rules.rule_ql004_dispatch_boundaries([faults, eng], ROOT)
         assert codes(vs) == ["QL004"]
         assert "circuits.run" in vs[0].message
+
+
+# the ISSUE-15 boundaries: the gradient executable dispatch and the
+# optimizer-in-the-loop iterate step carry the same trio contract
+FAKE_FAULTS_GRAD = """
+    SITES = (
+        "circuits.grad_sweep",
+        "serve.optimize",
+    )
+"""
+
+
+class TestQL004GradientBoundaries:
+    def test_grad_sweep_trio_passes(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_GRAD)
+        circ = make_file(tmp_path, "quest_tpu/circuits.py", """
+            def value_and_grad_sweep(self, pm, ham):
+                sp = _profile.profile_dispatch("circuits.grad_sweep")
+                poison = _faults.fire("circuits.grad_sweep")
+                with dispatch_annotation("quest_tpu.grad_sweep"):
+                    out = fn(pm)
+                return out
+            def _keeps_site_alive():
+                sp = profile_dispatch("serve.optimize")
+                _faults.fire("serve.optimize")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        assert rules.rule_ql004_dispatch_boundaries(
+            [faults, circ], ROOT) == []
+
+    def test_grad_sweep_without_profiler_flags(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_GRAD)
+        circ = make_file(tmp_path, "quest_tpu/circuits.py", """
+            def value_and_grad_sweep(self, pm, ham):
+                poison = _faults.fire("circuits.grad_sweep")
+                with dispatch_annotation("quest_tpu.grad_sweep"):
+                    return fn(pm)
+            def _keeps_site_alive():
+                sp = profile_dispatch("serve.optimize")
+                _faults.fire("serve.optimize")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, circ], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "profile_dispatch" in vs[0].message
+
+    def test_optimizer_step_without_annotation_flags(self, tmp_path):
+        """serve/optimize.py is a NEW file under the serve/ tree: the
+        whole-tree scope puts its iterate step under the trio contract
+        from day one."""
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_GRAD)
+        opt = make_file(tmp_path, "quest_tpu/serve/optimize.py", """
+            def _step(self, k, x):
+                sp = _profile.profile_dispatch("serve.optimize")
+                poison = _faults.fire("serve.optimize")
+                return self._submit(x)
+            def _keeps_site_alive():
+                sp = profile_dispatch("circuits.grad_sweep")
+                _faults.fire("circuits.grad_sweep")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, opt], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "annotation" in vs[0].message
+
+    def test_deleted_optimize_hook_is_a_coverage_loss(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_GRAD)
+        circ = make_file(tmp_path, "quest_tpu/circuits.py", """
+            def value_and_grad_sweep(self, pm, ham):
+                sp = profile_dispatch("circuits.grad_sweep")
+                poison = _faults.fire("circuits.grad_sweep")
+                with dispatch_annotation("g"):
+                    return fn(pm)
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, circ], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "serve.optimize" in vs[0].message
 
 
 # -- QL005 ------------------------------------------------------------------

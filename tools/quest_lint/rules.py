@@ -88,8 +88,12 @@ HOT_PATH_FILES = ("quest_tpu/circuits.py", "quest_tpu/parallel/pergate.py")
 # ops/doubledouble.py is exempt by construction: its float()/np.asarray
 # calls are host-scalar double-double constant splitting that runs at
 # trace time (a float() on a tracer would throw inside jit), never a
-# device sync
-QL001_EXEMPT = ("quest_tpu/ops/doubledouble.py",)
+# device sync. serve/optimize.py is exempt the same way: the optimizer
+# loop is HOST-side by design — it consumes already-resolved Future
+# results and steps numpy optimizer state; the device dispatch happens
+# one layer down in submit()/value_and_grad_sweep, which stay in scope
+QL001_EXEMPT = ("quest_tpu/ops/doubledouble.py",
+                "quest_tpu/serve/optimize.py")
 
 _SYNC_ATTRS = ("item", "block_until_ready")
 
