@@ -56,13 +56,14 @@ from .ops.trajectories import (TrajectoryProgram,
 from .qasm_import import ParsedQASM, parse_qasm, load_qasm_file
 from .serve import (SimulationService, CoalescePolicy, ServeError,
                     QueueFull, DeadlineExceeded, ServiceClosed,
-                    CircuitBreakerOpen, ServiceRouter,
+                    CircuitBreakerOpen, QuotaExceeded, ServiceRouter,
                     AllReplicasUnavailable, WarmCache,
                     VariationalProblem, OptimizationHandle,
-                    GradientDescent, Adam)
+                    GradientDescent, Adam,
+                    TenantPolicy, WFQScheduler)
 from .resilience import (FaultInjector, FaultSpec, HealthConfig,
                          NumericalFault, ResiliencePolicy,
-                         SupervisorPolicy)
+                         SupervisorPolicy, AutoscalePolicy)
 from .telemetry import (DispatchProfiler, PerfLedger, Tracer,
                         TraceContext, metrics_registry, profiler,
                         prometheus_text, start_http_exporter)
@@ -87,12 +88,12 @@ __all__ = (
         "ParsedQASM", "parse_qasm", "load_qasm_file",
         "SimulationService", "CoalescePolicy", "ServeError",
         "QueueFull", "DeadlineExceeded", "ServiceClosed",
-        "CircuitBreakerOpen", "ServiceRouter", "AllReplicasUnavailable",
-        "WarmCache",
+        "CircuitBreakerOpen", "QuotaExceeded", "ServiceRouter",
+        "AllReplicasUnavailable", "WarmCache",
         "VariationalProblem", "OptimizationHandle", "GradientDescent",
-        "Adam",
+        "Adam", "TenantPolicy", "WFQScheduler",
         "FaultInjector", "FaultSpec", "HealthConfig", "NumericalFault",
-        "ResiliencePolicy", "SupervisorPolicy",
+        "ResiliencePolicy", "SupervisorPolicy", "AutoscalePolicy",
         "Tracer", "TraceContext", "metrics_registry",
         "prometheus_text", "start_http_exporter",
         "DispatchProfiler", "PerfLedger", "profiler",

@@ -27,8 +27,9 @@ from .faults import (FaultInjector, FaultSpec, InjectedFault, SimulatedOOM,
                      install, uninstall)
 from .health import (HealthConfig, NumericalFault, check_planes, configure,
                      get_config, guarded, health_stats, reset_stats)
-from .recovery import (FATAL, POISON, TRANSIENT, CircuitBreaker,
-                       ResiliencePolicy, SupervisorPolicy, classify)
+from .recovery import (FATAL, POISON, TRANSIENT, AutoscalePolicy,
+                       CircuitBreaker, ResiliencePolicy,
+                       SupervisorPolicy, classify)
 
 __all__ = [
     # faults
@@ -39,7 +40,8 @@ __all__ = [
     "HealthConfig", "NumericalFault", "check_planes", "configure",
     "get_config", "guarded", "health_stats", "reset_stats",
     # recovery
-    "ResiliencePolicy", "SupervisorPolicy", "CircuitBreaker", "classify",
+    "ResiliencePolicy", "SupervisorPolicy", "AutoscalePolicy",
+    "CircuitBreaker", "classify",
     "TRANSIENT", "POISON", "FATAL",
     # segments (lazy — they import circuits/checkpoint)
     "split_circuit", "checkpointed_run", "checkpointed_sweep",

@@ -70,6 +70,8 @@ SITES = (
     "pergate.relayout",            # imperative relayout exchange
     "serve.execute",               # serving dispatcher batch execution
     "serve.optimize",              # optimizer-in-the-loop iterate step
+    "serve.preempt",               # checkpointed-run mesh yield boundary
+    "serve.scale",                 # autoscaler replica-pool resize
     "router.route",                # ServiceRouter placement decision
 )
 

@@ -33,7 +33,8 @@ from .faults import InjectedFault, SimulatedOOM
 from .health import NumericalFault
 
 __all__ = ["TRANSIENT", "POISON", "FATAL", "PRECISION", "classify",
-           "ResiliencePolicy", "SupervisorPolicy", "CircuitBreaker"]
+           "ResiliencePolicy", "SupervisorPolicy", "AutoscalePolicy",
+           "CircuitBreaker"]
 
 TRANSIENT = "transient"
 POISON = "poison"
@@ -168,6 +169,61 @@ class SupervisorPolicy:
     def restart_delay(self, attempt: int) -> float:
         """Backoff before restart ``attempt`` (1-based)."""
         return self.restart_backoff_s * (2.0 ** max(0, attempt - 1))
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """When the router's replica pool grows and shrinks.
+
+    The decision is priced from the perf ledger: the backlog is
+    converted to a drain-time estimate ``backlog * mean_request_s /
+    replicas`` (``mean_request_s`` comes from
+    :meth:`~quest_tpu.telemetry.PerfLedger.mean_request_s` — measured
+    per-program cost history, not a guess), and the pool grows by
+    ``step`` whenever that estimate exceeds ``scale_up_drain_s``. It
+    shrinks only after the pool has been fully idle (no backlog, no
+    in-flight work) for ``scale_down_idle_s``. ``cooldown_s`` spaces
+    consecutive decisions so a scale-up's own warm-up latency can't
+    trigger a second one. :meth:`decide` is pure — the router and the
+    ``tools/sched_trace.py`` replay drive the SAME function, so the
+    dumped schedule is the schedule the live pool would follow."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_up_drain_s: float = 0.5
+    scale_down_idle_s: float = 5.0
+    cooldown_s: float = 2.0
+    step: int = 1
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.step < 1:
+            raise ValueError("step must be >= 1")
+        if self.scale_up_drain_s <= 0:
+            raise ValueError("scale_up_drain_s must be > 0")
+
+    def decide(self, *, now: float, replicas: int, backlog: int,
+               inflight: int, mean_request_s: float,
+               last_scale_t: float, idle_since) -> int:
+        """Replica-count delta for the current instant: positive to
+        grow, negative to shrink, 0 to hold. ``idle_since`` is the
+        monotonic time the pool last became fully idle (None while any
+        work is queued or in flight)."""
+        if now - last_scale_t < self.cooldown_s:
+            return 0
+        n = max(1, int(replicas))
+        est = mean_request_s if mean_request_s > 0 else 0.0
+        drain_s = backlog * est / n
+        if drain_s > self.scale_up_drain_s and n < self.max_replicas:
+            return min(self.step, self.max_replicas - n)
+        if (backlog == 0 and inflight == 0 and idle_since is not None
+                and now - idle_since >= self.scale_down_idle_s
+                and n > self.min_replicas):
+            return -min(self.step, n - self.min_replicas)
+        return 0
 
 
 class CircuitBreaker:

@@ -29,7 +29,8 @@ import hashlib
 import os
 import shutil
 import tempfile
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -189,7 +190,9 @@ def opt_progress_load(path: str, digest: str) -> Optional[dict]:
 def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,
                        ckpt_path: Optional[str] = None,
                        max_restarts: int = 3, resume: bool = True,
-                       keep_checkpoint: bool = False):
+                       keep_checkpoint: bool = False,
+                       yield_to: Optional[Callable[[], bool]] = None,
+                       yield_hold_s: float = 5.0):
     """A :meth:`CompiledCircuit.sweep` that survives faults and process
     restarts: the ``(B, P)`` parameter matrix executes in row segments
     of ``segment_rows``, each completed segment's planes are written to
@@ -200,8 +203,16 @@ def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,
     existing progress file whose parameter digest matches continues
     where it stopped.
 
+    ``yield_to`` enables cooperative preemption at the segment
+    boundary (the checkpoint boundary, so a preempted sweep that dies
+    mid-hold still resumes bit-exactly): a zero-argument callable —
+    e.g. a :class:`~quest_tpu.serve.SimulationService`'s
+    ``interactive_pressure`` — polled before each segment; while it
+    returns truthy the sweep holds the mesh for the interactive burst,
+    at most ``yield_hold_s`` seconds per preemption.
+
     Returns ``(planes, stats)``: the full ``(B, 2, 2^n)`` result and
-    ``{"segments", "restarts", "resumed_rows"}``."""
+    ``{"segments", "restarts", "resumed_rows", "preemptions"}``."""
     from .. import checkpoint as ckpt
     pm = np.asarray(param_matrix, dtype=np.float64)
     if pm.ndim != 2:
@@ -258,8 +269,17 @@ def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,
     resumed = done
     restarts = 0
     segments = 0
+    preemptions = 0
     try:
         while done < B:
+            if yield_to is not None and yield_to():
+                # segment boundary == checkpoint boundary: the hold
+                # can't corrupt progress, only delay it
+                preemptions += 1
+                t0 = time.monotonic()
+                while (time.monotonic() - t0 < yield_hold_s
+                       and yield_to()):
+                    time.sleep(2e-3)
             hi = min(B, done + segment_rows)
             try:
                 planes = np.asarray(cc.sweep(pm[done:hi]))
@@ -295,4 +315,4 @@ def checkpointed_sweep(cc, param_matrix, *, segment_rows: int = 64,
     if not own_path and not keep_checkpoint:
         _cleanup(n_saved)
     return out, {"segments": segments, "restarts": restarts,
-                 "resumed_rows": resumed}
+                 "resumed_rows": resumed, "preemptions": preemptions}

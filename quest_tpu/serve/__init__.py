@@ -18,20 +18,27 @@ model.
 from .coalesce import (CoalescePolicy, batch_bucket, coalesce_key,
                        plan_schedule, split_ready)
 from .engine import (CircuitBreakerOpen, DeadlineExceeded, QueueFull,
-                     ServeError, ServiceClosed, SimulationService)
+                     QuotaExceeded, ServeError, ServiceClosed,
+                     SimulationService)
 from .metrics import RouterMetrics, ServiceMetrics
 from .optimize import (Adam, GradientDescent, OptimizationHandle,
-                       VariationalProblem, resolve_optimizer)
+                       VariationalProblem, resolve_optimizer,
+                       run_optimization)
 from .router import AllReplicasUnavailable, ServiceRouter, replica_envs
+from .sched import (DEFAULT_TENANT, TenantPolicy, WFQScheduler,
+                    plan_wfq_schedule)
 from .warmcache import WARM_CACHE_ENV, WarmCache
 
 __all__ = [
     "SimulationService", "ServeError", "QueueFull", "DeadlineExceeded",
-    "ServiceClosed", "CircuitBreakerOpen", "CoalescePolicy",
+    "ServiceClosed", "CircuitBreakerOpen", "QuotaExceeded",
+    "CoalescePolicy",
     "ServiceMetrics", "batch_bucket", "coalesce_key", "plan_schedule",
     "split_ready",
+    "DEFAULT_TENANT", "TenantPolicy", "WFQScheduler",
+    "plan_wfq_schedule",
     "ServiceRouter", "AllReplicasUnavailable", "replica_envs",
     "RouterMetrics", "WarmCache", "WARM_CACHE_ENV",
     "VariationalProblem", "OptimizationHandle", "GradientDescent",
-    "Adam", "resolve_optimizer",
+    "Adam", "resolve_optimizer", "run_optimization",
 ]

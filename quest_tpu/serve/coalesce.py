@@ -72,7 +72,7 @@ def batch_bucket(n: int, floor: int = 1) -> int:
 
 
 def coalesce_key(compiled, kind: str, obs_key=(), shots: int = 0,
-                 tier=None) -> tuple:
+                 tier=None, tenant: str = "default") -> tuple:
     """The compatibility class of one request: requests sharing this key
     dispatch through one executable. ``obs_key`` is the canonical
     hashable Hamiltonian form (terms + coeffs); shots enter via their
@@ -80,7 +80,11 @@ def coalesce_key(compiled, kind: str, obs_key=(), shots: int = 0,
     precision tier (:class:`~quest_tpu.config.PrecisionTier` or None) —
     a FAST sweep must never pad into (or share an executable with) a
     batch compiled at another tier, so the tier is a full coalescing
-    dimension, not a dispatch-time detail."""
+    dimension, not a dispatch-time detail. ``tenant`` is the submitting
+    tenant (:mod:`quest_tpu.serve.sched`): batches stay
+    single-tenant so the WFQ scheduler can order and account whole
+    batches per tenant — two tenants running the same executable form
+    still dispatch separately."""
     import numpy as np
     from ..circuits import CompiledCircuit
     return (id(compiled), kind, obs_key,
@@ -89,7 +93,8 @@ def coalesce_key(compiled, kind: str, obs_key=(), shots: int = 0,
             # the SAME token that keys the executable/warm caches — one
             # definition, so coalescing and executable isolation can
             # never disagree about what counts as "the same tier"
-            CompiledCircuit._tier_token(tier))
+            CompiledCircuit._tier_token(tier),
+            str(tenant))
 
 
 @dataclasses.dataclass(frozen=True)

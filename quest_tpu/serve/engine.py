@@ -52,6 +52,7 @@ traffic opens).
 from __future__ import annotations
 
 import collections
+import queue
 import threading
 import time
 import weakref
@@ -76,9 +77,38 @@ from .coalesce import (KIND_EXPECTATION, KIND_GRADIENT, KIND_SAMPLE,
                        KIND_STATE, KIND_TRAJECTORY, CoalescePolicy,
                        coalesce_key, split_ready)
 from .metrics import ServiceMetrics
+from .sched import DEFAULT_TENANT, TenantPolicy, WFQScheduler
 
 __all__ = ["ServeError", "QueueFull", "DeadlineExceeded", "ServiceClosed",
-           "CircuitBreakerOpen", "SimulationService"]
+           "CircuitBreakerOpen", "QuotaExceeded", "SimulationService"]
+
+# completion-queue shutdown sentinel (pipelined dispatch)
+_PIPE_STOP = object()
+
+
+class _Inflight:
+    """One issued-but-unresolved batch (pipelined dispatch): the raw
+    device handles plus everything the completion thread needs to
+    materialize, screen, and fan the batch out."""
+
+    __slots__ = ("batch", "pkey", "cc", "tier", "B", "padded", "kind",
+                 "t_dispatch", "traced", "poison", "guard", "sp", "raw")
+
+    def __init__(self, batch, cc, tier, B, padded, kind, t_dispatch,
+                 traced, poison, guard, sp, raw):
+        self.batch = batch
+        self.pkey = ""
+        self.cc = cc
+        self.tier = tier
+        self.B = B
+        self.padded = padded
+        self.kind = kind
+        self.t_dispatch = t_dispatch
+        self.traced = traced
+        self.poison = poison
+        self.guard = guard
+        self.sp = sp
+        self.raw = raw
 
 
 class ServeError(RuntimeError):
@@ -105,6 +135,13 @@ class CircuitBreakerOpen(ServeError):
     executor/retry budget, until the cooldown half-opens the breaker."""
 
 
+class QuotaExceeded(ServeError):
+    """The submitting tenant is at its per-tenant quota
+    (:class:`~quest_tpu.serve.sched.TenantPolicy` ``max_queued``):
+    tenant-scoped backpressure — other tenants keep admitting. Raised
+    by :meth:`SimulationService.submit`."""
+
+
 class _Request:
     """One queued submission (internal)."""
 
@@ -112,12 +149,13 @@ class _Request:
                  "submit_t", "deadline", "future", "retries_left", "key",
                  "not_before", "attempts", "tier", "escalations",
                  "obs_key", "trace", "trace_owned", "qspan", "dspan",
-                 "trajectories", "sampling_budget")
+                 "trajectories", "sampling_budget", "tenant", "priority")
 
     def __init__(self, compiled, param_vec, kind, observables, shots,
                  submit_t, deadline, future, retries_left, key,
                  tier=None, obs_key=(), trajectories=0,
-                 sampling_budget=None):
+                 sampling_budget=None, tenant=DEFAULT_TENANT,
+                 priority=1):
         self.compiled = compiled
         self.param_vec = param_vec
         self.kind = kind
@@ -139,6 +177,8 @@ class _Request:
         self.dspan = None        # open "dispatch" span
         self.trajectories = trajectories      # max_T (trajectory kind)
         self.sampling_budget = sampling_budget  # target stderr (or None)
+        self.tenant = tenant     # WFQ accounting + quota dimension
+        self.priority = priority  # strict class (0 = interactive)
 
 
 def _canonical_observables(compiled, observables) -> tuple:
@@ -225,6 +265,29 @@ class SimulationService:
         :class:`~quest_tpu.serve.router.ServiceRouter` built over the
         same ledger warm-starts its placement EMA from the recorded
         means instead of cold-starting at zero.
+    tenants : dict[str, TenantPolicy] | None
+        Per-tenant scheduling contracts (:class:`~quest_tpu.serve.sched.
+        TenantPolicy`): WFQ weight, strict priority class, and
+        inflight/queued quotas. Tenants absent from the dict run under
+        the default contract; :meth:`set_tenant` installs or replaces
+        one live.
+    scheduler : str
+        ``"wfq"`` (default) orders each dispatch cycle's ready batches
+        by virtual-time weighted fair queueing over projected mesh
+        seconds (per-program cost from the live EMA, seeded by the
+        perf ledger); ``"fifo"`` keeps the legacy drain order (the
+        measurement baseline — ``bench.py bench_multitenant`` grades
+        the difference).
+    pipeline_depth : int
+        How many issued engine dispatches may be in flight at once.
+        1 (default) is the classic synchronous dispatcher. Above 1 the
+        dispatcher only ISSUES each batch (JAX asynchronous dispatch
+        returns before the device finishes) and hands the in-flight
+        handle to a completion thread that blocks, screens, and fans
+        out IN ISSUE ORDER — host-side coalescing/fan-out overlaps
+        device compute, per-program completion order is preserved, and
+        the resilience machinery (breaker, bisection quarantine,
+        per-row screens) runs per in-flight batch.
     """
 
     def __init__(self, env, *, max_queue: int = 1024, max_batch: int = 64,
@@ -236,13 +299,21 @@ class SimulationService:
                  perf_ledger=None,
                  trace_sample_rate: float = 0.0,
                  tracer: Optional[Tracer] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 tenants: Optional[dict] = None,
+                 scheduler: str = "wfq",
+                 pipeline_depth: int = 1):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if request_timeout_s <= 0.0:
             raise ValueError("request_timeout_s must be > 0")
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        if scheduler not in ("wfq", "fifo"):
+            raise ValueError(
+                f"scheduler must be 'wfq' or 'fifo', got {scheduler!r}")
+        if int(pipeline_depth) < 1:
+            raise ValueError("pipeline_depth must be >= 1")
         self.env = env
         self.policy = CoalescePolicy(max_batch=max_batch,
                                      max_wait_s=max_wait_s)
@@ -277,6 +348,23 @@ class SimulationService:
         # (dispatcher-thread writes; close() reads after the join)
         self._lat_by_program: dict = {}
         self._inflight = 0           # requests inside an engine dispatch
+        # multi-tenant scheduling (quest_tpu/serve/sched): the WFQ
+        # virtual-time scheduler plus per-tenant queued/inflight and
+        # per-priority-class accounting — all counters mutate under
+        # _cond, mirroring every _backlog/_inflight transition
+        self.scheduler = scheduler
+        self._sched = WFQScheduler(tenants)
+        self._tenant_queued: dict = {}    # tenant -> queued requests
+        self._tenant_inflight: dict = {}  # tenant -> in-flight requests
+        self._prio_queued: dict = {}      # priority class -> queued
+        self._cost_est: dict = {}         # digest -> est request seconds
+        # pipelined dispatch: above depth 1 the dispatcher issues and a
+        # dedicated completion thread blocks/fans out in issue order;
+        # the semaphore bounds issued-but-incomplete batches
+        self.pipeline_depth = int(pipeline_depth)
+        self._pipe: Optional[queue.Queue] = None
+        self._pipe_sem: Optional[threading.Semaphore] = None
+        self._completion: Optional[threading.Thread] = None
         # replica-fault simulation hooks (router chaos: a SIGKILLed
         # process / a wedged dispatcher that stops heartbeating)
         self._crashed = False
@@ -311,6 +399,13 @@ class SimulationService:
         self._stall_flagged = False
         self._watchdog_stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None
+        if self.pipeline_depth > 1:
+            self._pipe = queue.Queue()
+            self._pipe_sem = threading.Semaphore(self.pipeline_depth)
+            self._completion = threading.Thread(
+                target=self._completion_loop, daemon=True,
+                name=f"quest-tpu-serve-complete-{id(self):x}")
+            self._completion.start()
         self._thread = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name=f"quest-tpu-serve-{id(self):x}")
@@ -383,7 +478,8 @@ class SimulationService:
                gradient: bool = False,
                deadline: Optional[float] = None,
                error_budget: Optional[float] = None,
-               tier=None, _trace=None) -> Future:
+               tier=None, tenant: str = DEFAULT_TENANT,
+               priority: Optional[int] = None, _trace=None) -> Future:
         """Enqueue one simulation request; returns its Future.
 
         ``circuit``: a :class:`CompiledCircuit` (preferred — submissions
@@ -449,6 +545,19 @@ class SimulationService:
         whose result drifts outside its tier's tolerance ONE TIER UP
         (``tier_escalations`` in the metrics) rather than returning an
         out-of-budget answer.
+
+        ``tenant`` names the submitting tenant (default
+        ``"default"``): a full coalescing dimension (batches stay
+        single-tenant) and the WFQ scheduler's accounting unit — the
+        tenant's :class:`~quest_tpu.serve.sched.TenantPolicy` (see the
+        constructor's ``tenants=`` / :meth:`set_tenant`) sets its fair
+        share, priority class, and quotas. A tenant at its
+        ``max_queued`` quota rejects typed with
+        :class:`QuotaExceeded` — tenant-scoped backpressure that never
+        blocks other tenants' admission. ``priority`` overrides the
+        policy's class for THIS request (lower is more urgent; class 0
+        is the interactive tier that checkpointed ``optimize()`` runs
+        yield the mesh to).
         """
         if self._closed:
             raise ServiceClosed("service is closed")
@@ -568,8 +677,13 @@ class SimulationService:
                 max(compiled.circuit.depth, 1), self.env, tiers=ladder)
         else:
             req_tier = compiled.tier     # the compile-time tier, if any
+        tenant = str(tenant)
+        tpol = self._sched.policy_for(tenant)
+        prio = tpol.priority if priority is None else int(priority)
+        if prio < 0:
+            raise ValueError(f"priority must be >= 0, got {prio}")
         key = coalesce_key(compiled, kind, obs_key, int(shots or 0),
-                           req_tier)
+                           req_tier, tenant=tenant)
         fut: Future = Future()
         req = _Request(compiled, vec, kind, ham, int(shots or 0), now,
                        abs_deadline, fut, self.max_retries, key,
@@ -577,7 +691,8 @@ class SimulationService:
                        trajectories=int(trajectories or 0),
                        sampling_budget=(float(sampling_budget)
                                         if sampling_budget is not None
-                                        else None))
+                                        else None),
+                       tenant=tenant, priority=prio)
         # request-scoped tracing: a router-propagated context rides in
         # via _trace (the router owns + finishes it); otherwise the
         # service's own sampler decides, and the service finishes the
@@ -606,7 +721,17 @@ class SimulationService:
                         f"admission queue is at capacity "
                         f"({self.max_queue}); retry later or raise "
                         "max_queue")
+                if tpol.max_queued is not None and \
+                        self._tenant_queued.get(tenant, 0) \
+                        >= tpol.max_queued:
+                    self.metrics.incr("rejected_quota")
+                    self.metrics.incr_tenant(tenant, "rejected_quota")
+                    raise QuotaExceeded(
+                        f"tenant {tenant!r} is at its queued-request "
+                        f"quota ({tpol.max_queued}); shed load or "
+                        f"raise max_queued in its TenantPolicy")
                 self._backlog += 1
+                self._note_queued(req, 1)
                 self._queue.append(req)
                 self._cond.notify_all()
         except ServeError as e:
@@ -619,6 +744,7 @@ class SimulationService:
                 ctx.finish(type(e).__name__)
             raise
         self.metrics.incr("submitted")
+        self.metrics.incr_tenant(tenant, "submitted")
         return fut
 
     def warm(self, circuit, batch_sizes: Optional[Sequence[int]] = None,
@@ -735,7 +861,10 @@ class SimulationService:
                  max_iters: int = 100, tol: float = 1e-6,
                  learning_rate: Optional[float] = None,
                  checkpoint_path: Optional[str] = None,
-                 resume: bool = True, max_restarts: int = 3):
+                 resume: bool = True, max_restarts: int = 3,
+                 tenant: str = DEFAULT_TENANT,
+                 yield_to_interactive: bool = True,
+                 preempt_hold_s: float = 5.0):
         """Run a variational optimization INSIDE the serving layer and
         stream its iterates back (ROADMAP item 1's
         optimizer-in-the-loop API).
@@ -763,13 +892,20 @@ class SimulationService:
         checkpoint from a different problem/optimizer configuration is
         ignored rather than silently continued. Transient iterate
         faults re-execute within ``max_restarts``; fatal caller errors
-        fail the handle with the original exception."""
+        fail the handle with the original exception.
+
+        ``tenant`` attributes every gradient submission to a WFQ
+        tenant; ``yield_to_interactive`` yields the mesh to queued
+        priority-0 work at each iterate (= checkpoint) boundary, at
+        most ``preempt_hold_s`` seconds per preemption."""
         from .optimize import run_optimization
         return run_optimization(
             self, problem, optimizer, max_iters=max_iters, tol=tol,
             learning_rate=learning_rate,
             checkpoint_path=checkpoint_path, resume=resume,
-            max_restarts=max_restarts)
+            max_restarts=max_restarts, tenant=tenant,
+            yield_to_interactive=yield_to_interactive,
+            preempt_hold_s=preempt_hold_s)
 
     def pause(self) -> None:
         """Hold dispatching (requests keep queueing, deadlines keep
@@ -782,6 +918,39 @@ class SimulationService:
         with self._cond:
             self._paused = False
             self._cond.notify_all()
+
+    def set_tenant(self, tenant: str, policy: TenantPolicy) -> None:
+        """Install or replace one tenant's scheduling contract
+        (:class:`~quest_tpu.serve.sched.TenantPolicy`) live. Quotas
+        apply to the next admission; the weight/priority apply to the
+        next dispatch cycle."""
+        with self._cond:
+            self._sched.set_policy(str(tenant), policy)
+            self._cond.notify_all()
+
+    def interactive_pressure(self) -> bool:
+        """True while priority-0 (interactive-class) work is queued —
+        the yield signal long checkpointed work polls at its segment
+        boundaries (:meth:`optimize` iterates,
+        :func:`~quest_tpu.resilience.segments.checkpointed_sweep`'s
+        ``yield_to=``). Reads one int under the GIL: safe from any
+        thread, never blocks."""
+        return self._prio_queued.get(0, 0) > 0
+
+    def _note_queued(self, req: "_Request", delta: int) -> None:
+        """Per-tenant and per-priority-class queued accounting; must
+        mirror every ``_backlog`` mutation. Caller holds ``_cond``."""
+        t, p = req.tenant, req.priority
+        n = self._tenant_queued.get(t, 0) + delta
+        if n > 0:
+            self._tenant_queued[t] = n
+        else:
+            self._tenant_queued.pop(t, None)
+        n = self._prio_queued.get(p, 0) + delta
+        if n > 0:
+            self._prio_queued[p] = n
+        else:
+            self._prio_queued.pop(p, None)
 
     # -- replica-lifecycle hooks (serve/router.py) -------------------------
 
@@ -876,6 +1045,12 @@ class SimulationService:
         if inj is not None:
             res["fault_injection"] = inj.snapshot()
         out = {**base, "service": self.metrics.snapshot(),
+               "scheduler": {**self._sched.snapshot(),
+                             "mode": self.scheduler,
+                             "pipeline_depth": self.pipeline_depth,
+                             "tenant_queued": dict(self._tenant_queued),
+                             "tenant_inflight":
+                                 dict(self._tenant_inflight)},
                "resilience": res,
                "telemetry": self.tracer.stats(),
                # the model-vs-measured layer: per-key device-time
@@ -915,6 +1090,13 @@ class SimulationService:
             self._cond.notify_all()
         if threading.current_thread() is not self._thread:
             self._thread.join(timeout)
+        if self._completion is not None and \
+                threading.current_thread() is not self._completion:
+            # the dispatcher no longer issues: a FIFO stop sentinel
+            # lets every already-issued batch complete and fan out
+            # before the completion thread exits
+            self._pipe.put(_PIPE_STOP)
+            self._completion.join(timeout)
         self._watchdog_stop.set()
         metrics_registry().unregister(self._registry_token)
         self._flush_perf_ledger()
@@ -960,6 +1142,35 @@ class SimulationService:
         return compiled.env.num_devices if compiled.env.mesh is not None \
             else 1
 
+    def _idle_wait(self) -> float:
+        """The longest the dispatcher may sleep with no scheduled wake
+        deadline. Precise waking (submit/pause/resume/close all notify
+        the condition, and every pending event — batch maturity, retry
+        backoff, request expiry — feeds ``next_deadline``) removed the
+        old fixed 50 ms cap; the only remaining bound is the watchdog:
+        an idle dispatcher must keep heartbeating well inside
+        ``watchdog_timeout_s`` or sleeping would read as a stall."""
+        t = self.resilience.watchdog_timeout_s
+        return max(1e-3, min(t / 4.0, 2.0)) if t and t > 0 else 2.0
+
+    def _batch_cost(self, batch: list) -> float:
+        """Projected mesh-seconds for one ready batch — the WFQ
+        scheduler's currency. Per-program measured request seconds
+        (live EMA from completed dispatches, seeded from the perf
+        ledger's recorded history — elasticity and fairness price new
+        work from what the program actually cost before), falling back
+        to 1.0/request cold so relative weights still arbitrate."""
+        digest = getattr(batch[0].compiled, "program_digest", "") or ""
+        est = self._cost_est.get(digest)
+        if est is None:
+            est = 0.0
+            if self.perf_ledger is not None and digest:
+                est = self.perf_ledger.mean_request_s(digest)
+            self._cost_est[digest] = est
+        if est <= 0.0:
+            est = 1.0
+        return len(batch) * est
+
     def _dispatch_loop(self) -> None:
         pending: dict = {}   # coalesce key -> FIFO list of _Request
         while True:
@@ -976,13 +1187,16 @@ class SimulationService:
             with self._cond:
                 if self._paused and not self._closed:
                     # held: requests stay in the admission queue
-                    # (deadlines keep counting; they expire on resume)
-                    self._cond.wait(timeout=0.005)
+                    # (deadlines keep counting; they expire on resume —
+                    # resume()/close() notify, so the wait only bounds
+                    # the heartbeat cadence)
+                    self._cond.wait(timeout=self._idle_wait())
                     continue
                 if self._closed and not self._drain_on_close:
                     for req in list(self._queue) + \
                             [r for v in pending.values() for r in v]:
                         self._backlog -= 1
+                        self._note_queued(req, -1)
                         if req.future.set_running_or_notify_cancel():
                             req.future.set_exception(ServiceClosed(
                                 "service closed before dispatch"))
@@ -994,7 +1208,10 @@ class SimulationService:
                 if not pending:
                     if self._closed:
                         return
-                    self._cond.wait(timeout=0.1)
+                    # nothing admitted anywhere: sleep until notified
+                    # (submit notifies) — no deadline can pass while
+                    # nothing is pending
+                    self._cond.wait(timeout=self._idle_wait())
                     continue
             now = time.monotonic()
             self._expire(pending, now)
@@ -1023,6 +1240,12 @@ class SimulationService:
                     pending[key] = rest
                 else:
                     del pending[key]
+                if rest:
+                    # a surviving request's expiry is a wake deadline
+                    # too: precise waking must run _expire on time, not
+                    # an arbitrary 50 ms later
+                    exp = min(r.deadline for r in rest)
+                    nd = exp if nd is None else min(nd, exp)
                 ready.extend(batches)
                 if nd is not None:
                     next_deadline = nd if next_deadline is None \
@@ -1030,12 +1253,55 @@ class SimulationService:
             if not ready:
                 with self._cond:
                     if not self._queue and not self._closed:
-                        wait = 0.05 if next_deadline is None else \
-                            max(1e-4, next_deadline - time.monotonic())
-                        self._cond.wait(timeout=min(wait, 0.05))
+                        # the precise-wake satellite: sleep exactly to
+                        # the earliest pending event (batch maturity,
+                        # backoff lapse, or expiry), bounded only by
+                        # the watchdog-safe idle cap — not the old
+                        # fixed 50 ms spin
+                        wait = self._idle_wait() if next_deadline is None \
+                            else max(1e-5, min(
+                                next_deadline - time.monotonic(),
+                                self._idle_wait()))
+                        self._cond.wait(timeout=wait)
                 continue
+            if self.scheduler == "wfq" and len(ready) > 1:
+                # weighted-fair dispatch order: strict priority class,
+                # then virtual finish tags over projected mesh seconds
+                entries = [(b[0].tenant, self._batch_cost(b), b)
+                           for b in ready]
+                ready = [b for _, _, b in self._sched.order(entries)]
+            dispatched = 0
+            deferred: list = []
             for batch in ready:
+                tenant = batch[0].tenant
+                tpol = self._sched.policy_for(tenant)
+                if tpol.max_inflight is not None and not drain:
+                    with self._cond:
+                        inflight = self._tenant_inflight.get(tenant, 0)
+                    # a batch wider than the quota still runs when the
+                    # tenant is otherwise idle (it could never run at
+                    # all otherwise); anything else defers until
+                    # _finish_inflight frees rows
+                    if inflight > 0 and \
+                            inflight + len(batch) > tpol.max_inflight:
+                        deferred.append(batch)
+                        continue
+                if self.scheduler == "wfq":
+                    self._sched.charge(tenant, self._batch_cost(batch))
                 self._execute(batch)
+                dispatched += 1
+            for batch in deferred:
+                # over-quota batches return to the FRONT of their
+                # group (oldest first) and re-form next cycle
+                self.metrics.incr("quota_deferrals", len(batch))
+                pending.setdefault(batch[0].key, [])[:0] = batch
+            if deferred and not dispatched:
+                # everything ready is quota-blocked: sleep until a
+                # completion frees inflight rows (_finish_inflight
+                # notifies) instead of spinning on mature batches
+                with self._cond:
+                    if not self._queue and not self._closed:
+                        self._cond.wait(timeout=self._idle_wait())
 
     def _expire(self, pending: dict, now: float) -> None:
         for key in list(pending):
@@ -1044,6 +1310,7 @@ class SimulationService:
                 if now > req.deadline:
                     with self._cond:
                         self._backlog -= 1
+                        self._note_queued(req, -1)
                     self.metrics.incr("timeouts")
                     if req.future.set_running_or_notify_cancel():
                         req.future.set_exception(DeadlineExceeded(
@@ -1163,17 +1430,42 @@ class SimulationService:
     def _execute(self, batch: list) -> None:
         """Run one coalesced group through the typed recovery path:
         breaker fast-fail, degraded sequential mode, then the
-        quarantining group executor."""
+        quarantining group executor (synchronous, or issued into the
+        in-flight pipe when ``pipeline_depth > 1``)."""
         with self._cond:
             self._backlog -= len(batch)
+            for req in batch:
+                self._note_queued(req, -1)
             self._inflight += len(batch)
+            tenant = batch[0].tenant
+            self._tenant_inflight[tenant] = \
+                self._tenant_inflight.get(tenant, 0) + len(batch)
+        pipelined = False
         try:
-            self._execute_guarded(batch)
+            pipelined = self._execute_guarded(batch)
         finally:
-            with self._cond:
-                self._inflight -= len(batch)
+            if not pipelined:
+                self._finish_inflight(batch)
 
-    def _execute_guarded(self, batch: list) -> None:
+    def _finish_inflight(self, batch: list) -> None:
+        """Retire one batch's in-flight accounting (dispatcher thread
+        for synchronous dispatches, completion thread for pipelined
+        ones) and wake the dispatcher — a quota-deferred batch may be
+        runnable now that rows freed up."""
+        tenant = batch[0].tenant
+        with self._cond:
+            self._inflight -= len(batch)
+            left = self._tenant_inflight.get(tenant, 0) - len(batch)
+            if left <= 0:
+                self._tenant_inflight.pop(tenant, None)
+            else:
+                self._tenant_inflight[tenant] = left
+            self._cond.notify_all()
+
+    def _execute_guarded(self, batch: list) -> bool:
+        """Returns True when the batch was handed to the in-flight pipe
+        (the completion thread owns retiring it), False when it was
+        fully resolved synchronously."""
         cc = batch[0].compiled
         pkey = self._program_key(cc)
         rp = self.resilience
@@ -1189,18 +1481,79 @@ class SimulationService:
             for req in batch:
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_exception(err)
-            return
+            return False
         if rp.degrade_after and len(batch) > 1 and \
                 time.monotonic() < self._degraded_until.get(pkey, 0.0):
             # graceful degradation: the batched path kept faulting, so
-            # serve each request alone until the cooldown lapses
+            # serve each request alone until the cooldown lapses —
+            # degraded mode is deliberately synchronous (the fault is
+            # still live; pipelining suspect work buys nothing)
             self.metrics.incr("degraded_dispatches", len(batch))
             self._event("degraded_dispatch", program=pkey,
                         requests=len(batch))
             for req in batch:
                 self._run_group([req], pkey)
-            return
+            return False
+        if self._pipe is not None:
+            return self._pipe_group(batch, pkey)
         self._run_group(batch, pkey)
+        return False
+
+    def _pipe_group(self, batch: list, pkey: str) -> bool:
+        """Pipelined issue: launch the batch's device work (JAX async
+        dispatch returns immediately) and hand the in-flight handle to
+        the completion thread, which blocks for results and fans out
+        while the dispatcher coalesces the NEXT batch. The semaphore
+        bounds the number of in-flight batches at ``pipeline_depth``;
+        acquiring it with no lock held is the pipeline's backpressure
+        point (QL006: deliberately not a ``with``-held lock)."""
+        self._heartbeat = time.monotonic()
+        self._pipe_sem.acquire()
+        try:
+            inf = self._issue_batch(batch)
+        # quest: allow-broad-except(issue-side fault barrier: a fault
+        # raised while LAUNCHING the dispatch recovers inline on the
+        # dispatcher thread through the same classified path as the
+        # synchronous mode)
+        except Exception as e:
+            self._pipe_sem.release()
+            self._recover_group(batch, pkey, 0, e)
+            return False
+        inf.pkey = pkey
+        self._pipe.put(inf)
+        self.metrics.incr("pipelined_batches")
+        return True
+
+    def _completion_loop(self) -> None:
+        """The completion pool: drains in-flight handles in issue order
+        (one FIFO queue, one thread — global completion order equals
+        issue order, so per-program in-order completion holds by
+        construction), blocks until each batch's device results are
+        ready, and runs screening + fan-out. Faults surfacing at
+        block-until-ready time (the common place device faults land
+        under async dispatch) recover here through the same classified
+        barrier, including bisection quarantine re-run synchronously."""
+        while True:
+            inf = self._pipe.get()
+            if inf is _PIPE_STOP:
+                return
+            self._heartbeat = time.monotonic()
+            try:
+                out = self._complete_batch(inf)
+            # quest: allow-broad-except(completion-side fault barrier:
+            # classify() routes the fault to typed recovery exactly as
+            # the synchronous path does)
+            except Exception as e:
+                self._heartbeat = time.monotonic()
+                self._recover_group(inf.batch, inf.pkey, 0, e)
+            else:
+                self._heartbeat = time.monotonic()
+                self._breaker.record_success(inf.pkey)
+                self._consec_faults.pop(inf.pkey, None)
+                self._fan_out(inf.batch, *out)
+            finally:
+                self._finish_inflight(inf.batch)
+                self._pipe_sem.release()
 
     def _run_group(self, batch: list, pkey: str, depth: int = 0) -> None:
         """Execute one compatible group as a single engine dispatch; on
@@ -1209,7 +1562,6 @@ class SimulationService:
         request), escalate precision-tier violations one tier up, or
         retry/fail each request per the policy."""
         self._heartbeat = time.monotonic()
-        rp = self.resilience
         try:
             results, bad_rows, viol_rows, t_dispatch, padded = \
                 self._dispatch_batch(batch)
@@ -1219,58 +1571,67 @@ class SimulationService:
         # faults with no recovery path at all)
         except Exception as e:
             self._heartbeat = time.monotonic()
-            kind = classify(e)
-            self._event("fault", program=pkey, kind=kind,
-                        error=type(e).__name__, requests=len(batch),
-                        depth=depth)
-            if kind == PRECISION:
-                # the engine-level fidelity monitor tripped on the whole
-                # dispatch: every member is out of budget at its tier —
-                # escalation, not retry/quarantine, is the recovery
-                self._breaker.release(pkey)
-                for req in batch:
-                    self._escalate_or_fail(req, e)
-                return
-            if kind == FATAL:
-                # caller error (ValueError / TypeError / validation):
-                # fail fast with the ORIGINAL exception — retrying
-                # cannot help and must not burn the retry budget. The
-                # breaker counts only runtime faults, but a half-open
-                # probe must not be left dangling (the probe was
-                # inconclusive, not healthy)
-                self._breaker.release(pkey)
-                self.metrics.incr("failed", len(batch))
-                self.metrics.incr("failed_fatal", len(batch))
-                for req in batch:
-                    if req.future.set_running_or_notify_cancel():
-                        req.future.set_exception(e)
-                return
-            self.metrics.incr("executor_faults")
-            if self._breaker.record_failure(pkey):
-                self.metrics.incr("breaker_trips")
-                self._event("breaker_open", program=pkey)
-            self._note_fault(pkey)
-            if len(batch) > 1 and rp.quarantine:
-                self.metrics.incr("quarantine_splits")
-                self._event("quarantine_split", program=pkey,
-                            requests=len(batch), depth=depth)
-                for req in batch:
-                    if req.trace is not None:
-                        req.trace.add("quarantine_split",
-                                      requests=len(batch), depth=depth,
-                                      error=type(e).__name__)
-                mid = len(batch) // 2
-                self._run_group(batch[:mid], pkey, depth + 1)
-                self._run_group(batch[mid:], pkey, depth + 1)
-                return
-            for req in batch:
-                self._fail_or_retry(req, e, kind)
+            self._recover_group(batch, pkey, depth, e)
             return
         self._heartbeat = time.monotonic()
         self._breaker.record_success(pkey)
         self._consec_faults.pop(pkey, None)
         self._fan_out(batch, results, bad_rows, viol_rows, t_dispatch,
                       padded)
+
+    def _recover_group(self, batch: list, pkey: str, depth: int,
+                       e: BaseException) -> None:
+        """The classified recovery path for one faulted group — shared
+        by the synchronous executor, the pipelined issue side, and the
+        completion thread (bisection re-runs execute synchronously on
+        whichever thread recovers)."""
+        rp = self.resilience
+        kind = classify(e)
+        self._event("fault", program=pkey, kind=kind,
+                    error=type(e).__name__, requests=len(batch),
+                    depth=depth)
+        if kind == PRECISION:
+            # the engine-level fidelity monitor tripped on the whole
+            # dispatch: every member is out of budget at its tier —
+            # escalation, not retry/quarantine, is the recovery
+            self._breaker.release(pkey)
+            for req in batch:
+                self._escalate_or_fail(req, e)
+            return
+        if kind == FATAL:
+            # caller error (ValueError / TypeError / validation):
+            # fail fast with the ORIGINAL exception — retrying
+            # cannot help and must not burn the retry budget. The
+            # breaker counts only runtime faults, but a half-open
+            # probe must not be left dangling (the probe was
+            # inconclusive, not healthy)
+            self._breaker.release(pkey)
+            self.metrics.incr("failed", len(batch))
+            self.metrics.incr("failed_fatal", len(batch))
+            for req in batch:
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(e)
+            return
+        self.metrics.incr("executor_faults")
+        if self._breaker.record_failure(pkey):
+            self.metrics.incr("breaker_trips")
+            self._event("breaker_open", program=pkey)
+        self._note_fault(pkey)
+        if len(batch) > 1 and rp.quarantine:
+            self.metrics.incr("quarantine_splits")
+            self._event("quarantine_split", program=pkey,
+                        requests=len(batch), depth=depth)
+            for req in batch:
+                if req.trace is not None:
+                    req.trace.add("quarantine_split",
+                                  requests=len(batch), depth=depth,
+                                  error=type(e).__name__)
+            mid = len(batch) // 2
+            self._run_group(batch[:mid], pkey, depth + 1)
+            self._run_group(batch[mid:], pkey, depth + 1)
+            return
+        for req in batch:
+            self._fail_or_retry(req, e, kind)
 
     def _tier_tol(self, cc: CompiledCircuit, tier) -> float:
         """The runtime fidelity tolerance for one tiered dispatch."""
@@ -1290,13 +1651,25 @@ class SimulationService:
         return None
 
     def _dispatch_batch(self, batch: list):
-        """One engine dispatch for one group. Returns ``(results,
-        bad_rows, viol_rows, t_dispatch, padded)`` where ``bad_rows``
-        indexes result rows screened out as non-finite (NaN poisoning —
-        those requests get a typed failure; their batchmates are
-        unaffected) and ``viol_rows`` indexes FINITE rows whose
-        norm/trace drifts past the batch tier's runtime tolerance (the
-        fidelity monitor — those requests escalate one tier up)."""
+        """One synchronous engine dispatch for one group (the
+        ``pipeline_depth=1`` path): issue and complete back-to-back.
+        Returns ``(results, bad_rows, viol_rows, t_dispatch, padded)``
+        where ``bad_rows`` indexes result rows screened out as
+        non-finite (NaN poisoning — those requests get a typed failure;
+        their batchmates are unaffected) and ``viol_rows`` indexes
+        FINITE rows whose norm/trace drifts past the batch tier's
+        runtime tolerance (the fidelity monitor — those requests
+        escalate one tier up)."""
+        return self._complete_batch(self._issue_batch(batch))
+
+    def _issue_batch(self, batch: list) -> _Inflight:
+        """Launch one group's device work and return the in-flight
+        handle WITHOUT waiting for results: JAX async dispatch hands
+        back device futures immediately, so pipelined mode overlaps
+        the dispatcher's coalescing of the NEXT batch with this one's
+        device compute. No host-side materialization happens here —
+        block-until-ready, screening, and span close all live in
+        :meth:`_complete_batch`."""
         cc = batch[0].compiled
         tier = batch[0].tier
         B = len(batch)
@@ -1328,216 +1701,275 @@ class SimulationService:
             req.dspan = ctx.begin("dispatch", batch=B, bucket=padded,
                                   kind=kind, tier=tier_name,
                                   service=self.name)
+        if tier is not None and tier.name == "fast":
+            self.metrics.incr("fast_tier_dispatches")
+        sp = None
+        poison = False
+        guard = self.resilience.guard_outputs
         try:
-            out = self._dispatch_batch_inner(batch, cc, tier, B, padded,
-                                             pm, kind)
+            # QL004 trio (fault hook + trace annotation + profiler):
+            # the profile span opens BEFORE the fault hook so injected
+            # stalls land inside the measured wall-to-ready time, and
+            # the whole trio sits inside the span-closing try so a
+            # raising fault (transient/oom) still closes this
+            # attempt's dispatch spans with the fault's type name
+            sp = _profile.profile_dispatch("serve.execute")
+            poison = _faults.fire("serve.execute")
+            if poison == "precision" and (tier is None
+                                          or kind in (KIND_EXPECTATION,
+                                                      KIND_GRADIENT)):
+                # a drifted result is UNDETECTABLE silent corruption
+                # wherever the fidelity monitor cannot see it —
+                # energies and gradients carry no unit-norm invariant,
+                # and UNTIERED requests have no tier tolerance (and no
+                # escalation rung) to screen against. Degrade the
+                # injected fault to the NaN form the value/plane
+                # screens catch: the request still fails typed, never
+                # wrong — the one thing chaos runs must never produce.
+                poison = "nan"
+            # the annotation name carries kind + bucket + tier, so a
+            # device profile (profiling.trace -> Perfetto) shows which
+            # serving dispatch each XLA region belongs to, aligned
+            # with the host "dispatch" spans the request traces record
+            ann = dispatch_annotation(
+                f"quest_tpu.serve.dispatch:{kind}:b{padded}:"
+                f"{tier.name if tier is not None else 'env'}")
+            if kind == KIND_TRAJECTORY:
+                # one (B, T) wave loop with convergence-based early
+                # stopping; live_rows excludes the padded bucket rows
+                # from the stop decision so a throwaway row can't stall
+                # the batch
+                with ann:
+                    means, errs, info = cc.expectation_batch(
+                        pm, batch[0].observables, batch[0].trajectories,
+                        sampling_budget=batch[0].sampling_budget,
+                        live_rows=B)
+                raw = (means, errs, info)
+            elif kind == KIND_GRADIENT and isinstance(cc,
+                                                      TrajectoryProgram):
+                # the differentiable wave loop: every row's value AND
+                # gradient advance through shared gradient waves with
+                # the same early-stopping contract as value requests
+                with ann:
+                    vals, grads, errs, info = cc.expectation_grad_batch(
+                        pm, batch[0].observables, batch[0].trajectories,
+                        sampling_budget=batch[0].sampling_budget,
+                        live_rows=B)
+                raw = (vals, grads, errs, info)
+            elif kind == KIND_GRADIENT:
+                # ONE reverse pass through the batched engine: the
+                # whole group's values + gradients arrive as a single
+                # (B, P+1) block (CompiledCircuit.value_and_grad_sweep)
+                with ann:
+                    vals, grads = cc.value_and_grad_sweep(
+                        pm, batch[0].observables, tier=tier)
+                raw = (vals, grads)
+            elif kind == KIND_EXPECTATION:
+                with ann:
+                    raw = (cc.expectation_sweep(
+                        pm, batch[0].observables, tier=tier),)
+            elif kind == KIND_SAMPLE:
+                shots = max(req.shots for req in batch)
+                with ann:
+                    idx, totals = cc.sample_sweep(pm, shots, tier=tier)
+                raw = (idx, totals)
+            else:
+                with ann:
+                    raw = (cc.sweep(pm, tier=tier),)
         # quest: allow-broad-except(close-spans-and-reraise: open
         # dispatch spans must be closed on ANY interruption -- the
         # exception always propagates to the classified barrier)
         except BaseException as e:
-            for req in traced:
-                if req.dspan is not None:
-                    req.trace.end(req.dspan, status=type(e).__name__)
-                    req.dspan = None
+            inf = _Inflight(batch, cc, tier, B, padded, kind,
+                            t_dispatch, traced, poison, guard, sp, None)
+            self._close_dspans(inf, status=type(e).__name__)
             raise
-        mode = ""
-        if traced:
-            try:
-                mode = cc.dispatch_stats().batch_sharding_mode
-            except (AttributeError, KeyError, RuntimeError):
-                mode = ""    # stats shape drift: the span just loses it
-            extra = {}
-            if kind == KIND_TRAJECTORY or (
-                    kind == KIND_GRADIENT
-                    and isinstance(cc, TrajectoryProgram)):
-                info = getattr(cc, "last_traj_stats", None) or {}
-                extra = {"trajectories_run":
-                         info.get("trajectories_run", 0),
-                         "early_stopped":
-                         info.get("early_stopped", False)}
-            for req in traced:
-                if req.dspan is not None:
-                    req.trace.end(req.dspan, sharding=mode, **extra)
-                    req.dspan = None
-        return out
+        return _Inflight(batch, cc, tier, B, padded, kind, t_dispatch,
+                         traced, poison, guard, sp, raw)
 
-    def _dispatch_batch_inner(self, batch, cc, tier, B, padded, pm,
-                              kind):
-        """The engine execution of one group, wrapped in a
-        ``jax.profiler`` annotation so a device profile captured with
-        :func:`quest_tpu.profiling.trace` lines up with the host-side
-        dispatch spans."""
-        t_dispatch = time.monotonic()
-        if tier is not None and tier.name == "fast":
-            self.metrics.incr("fast_tier_dispatches")
-        # QL004 trio (fault hook + trace annotation + profiler): the
-        # profile span opens BEFORE the fault hook so injected stalls
-        # land inside the measured wall-to-ready time
-        sp = _profile.profile_dispatch("serve.execute")
-        poison = _faults.fire("serve.execute")
-        guard = self.resilience.guard_outputs
+    def _complete_batch(self, inf: _Inflight):
+        """Materialize one issued batch (THE block-until-ready point —
+        the completion thread's whole job in pipelined mode), run the
+        per-row health screens and the fidelity monitor, price the
+        dispatch, and close its spans. Returns ``(results, bad_rows,
+        viol_rows, t_dispatch, padded)``."""
+        batch, cc, tier = inf.batch, inf.cc, inf.tier
+        B, padded, kind = inf.B, inf.padded, inf.kind
+        poison, guard, sp = inf.poison, inf.guard, inf.sp
         viol = ()
         norms = None
-        if poison == "precision" and (tier is None
-                                      or kind in (KIND_EXPECTATION,
-                                                  KIND_GRADIENT)):
-            # a drifted result is UNDETECTABLE silent corruption
-            # wherever the fidelity monitor cannot see it — energies
-            # and gradients carry no unit-norm invariant, and UNTIERED
-            # requests have no tier tolerance (and no escalation rung)
-            # to screen against. Degrade the injected fault to the NaN
-            # form the value/plane screens catch: the request still
-            # fails typed, never wrong — the one thing chaos runs must
-            # never produce.
-            poison = "nan"
-        # the annotation name carries kind + bucket + tier, so a device
-        # profile (profiling.trace -> Perfetto) shows which serving
-        # dispatch each XLA region belongs to, aligned with the host
-        # "dispatch" spans the request traces record
-        ann = dispatch_annotation(
-            f"quest_tpu.serve.dispatch:{kind}:b{padded}:"
-            f"{tier.name if tier is not None else 'env'}")
-        if kind == KIND_TRAJECTORY:
-            # one (B, T) wave loop with convergence-based early
-            # stopping; live_rows excludes the padded bucket rows from
-            # the stop decision so a throwaway row can't stall the batch
-            with ann:
-                means, errs, info = cc.expectation_batch(
-                    pm, batch[0].observables, batch[0].trajectories,
-                    sampling_budget=batch[0].sampling_budget,
-                    live_rows=B)
-            means = _faults.poison_output(poison,
-                                          np.asarray(means))[:B]
-            results = [(float(means[i]), float(errs[i]))
-                       for i in range(B)]
-            self.metrics.incr("trajectory_dispatches")
-            self.metrics.incr("trajectories_run",
-                              info["trajectories_run"])
-            self.metrics.incr("trajectories_saved",
-                              max(0, info["max_trajectories"]
-                                  - info["trajectories_run"]))
-            # a NaN trajectory poisons ITS row's running mean only:
-            # the per-row screen quarantines that request typed while
-            # its batchmates complete (per-row, never per-batch)
-            bad = _health.bad_value_rows(means) if guard else ()
-        elif kind == KIND_GRADIENT and isinstance(cc,
-                                                  TrajectoryProgram):
-            # the differentiable wave loop: every row's value AND
-            # gradient advance through shared gradient waves with the
-            # same early-stopping contract as value requests
-            with ann:
-                vals, grads, errs, info = cc.expectation_grad_batch(
-                    pm, batch[0].observables, batch[0].trajectories,
-                    sampling_budget=batch[0].sampling_budget,
-                    live_rows=B)
-            # quest: allow-host-sync(result fan-out boundary: the wave
-            # loop already synced its convergence carry per wave)
-            vals, grads = np.asarray(vals), np.asarray(grads)
-            block = np.concatenate([vals[:, None], grads], axis=1)
-            block = _faults.poison_output(poison, block)[:B]
-            # quest: allow-host-sync(fan-out of already-host values)
-            results = [(float(block[i, 0]), np.array(block[i, 1:]),
-                        np.array(errs[i])) for i in range(B)]
-            self.metrics.incr("gradient_dispatches")
-            self.metrics.incr("trajectory_dispatches")
-            self.metrics.incr("trajectories_run",
-                              info["trajectories_run"])
-            self.metrics.incr("trajectories_saved",
-                              max(0, info["max_trajectories"]
-                                  - info["trajectories_run"]))
-            # a NaN value OR gradient component poisons only ITS row
-            bad = _health.bad_plane_rows(block) if guard else ()
-        elif kind == KIND_GRADIENT:
-            # ONE reverse pass through the batched engine: the whole
-            # group's values + gradients arrive as a single (B, P+1)
-            # block (CompiledCircuit.value_and_grad_sweep)
-            with ann:
-                vals, grads = cc.value_and_grad_sweep(
-                    pm, batch[0].observables, tier=tier)
-            # quest: allow-host-sync(result fan-out boundary: ONE
-            # (B, P+1) transfer resolves the whole coalesced group)
-            vals, grads = np.asarray(vals), np.asarray(grads)
-            block = np.concatenate([vals[:, None], grads], axis=1)
-            block = _faults.poison_output(poison, block)[:B]
-            # quest: allow-host-sync(fan-out of already-host values)
-            results = [(float(block[i, 0]), np.array(block[i, 1:]))
-                       for i in range(B)]
-            self.metrics.incr("gradient_dispatches")
-            bad = _health.bad_plane_rows(block) if guard else ()
-            # gradients carry no unit-norm invariant: only the NaN
-            # screen applies (same contract as energies)
-        elif kind == KIND_EXPECTATION:
-            with ann:
-                out = _faults.poison_output(poison, np.asarray(
-                    cc.expectation_sweep(pm, batch[0].observables,
-                                         tier=tier))[:B])
-            results = [float(v) for v in out]
-            bad = _health.bad_value_rows(out) if guard else ()
-            # energies carry no unit-norm invariant: only the NaN
-            # screen applies (docs/accuracy.md "Precision tiers")
-        elif kind == KIND_SAMPLE:
-            shots = max(req.shots for req in batch)
-            with ann:
-                idx, totals = cc.sample_sweep(pm, shots, tier=tier)
-            totals = _faults.poison_output(poison,
-                                           np.asarray(totals)[:B])
-            results = [(np.asarray(idx[i, :req.shots]), float(totals[i]))
-                       for i, req in enumerate(batch)]
-            bad = _health.bad_value_rows(totals) if guard else ()
-            # the pre-sampling totals are the SQUARED 2-norm (sum of
-            # |amp|^2); the fidelity contract (|norm - 1| <= tol) is on
-            # the norm itself, same root as health.check_planes takes
-            norms = np.sqrt(np.maximum(
-                np.asarray(totals, dtype=np.float64), 0.0))
-        else:
-            with ann:
+        try:
+            if kind == KIND_TRAJECTORY:
+                means, errs, info = inf.raw
+                means = _faults.poison_output(poison,
+                                              np.asarray(means))[:B]
+                results = [(float(means[i]), float(errs[i]))
+                           for i in range(B)]
+                self.metrics.incr("trajectory_dispatches")
+                self.metrics.incr("trajectories_run",
+                                  info["trajectories_run"])
+                self.metrics.incr("trajectories_saved",
+                                  max(0, info["max_trajectories"]
+                                      - info["trajectories_run"]))
+                # a NaN trajectory poisons ITS row's running mean only:
+                # the per-row screen quarantines that request typed
+                # while its batchmates complete (per-row, never
+                # per-batch)
+                bad = _health.bad_value_rows(means) if guard else ()
+            elif kind == KIND_GRADIENT and isinstance(cc,
+                                                      TrajectoryProgram):
+                vals, grads, errs, info = inf.raw
+                # quest: allow-host-sync(result fan-out boundary: the
+                # wave loop already synced its convergence carry per
+                # wave)
+                vals, grads = np.asarray(vals), np.asarray(grads)
+                block = np.concatenate([vals[:, None], grads], axis=1)
+                block = _faults.poison_output(poison, block)[:B]
+                # quest: allow-host-sync(fan-out of already-host values)
+                results = [(float(block[i, 0]), np.array(block[i, 1:]),
+                            np.array(errs[i])) for i in range(B)]
+                self.metrics.incr("gradient_dispatches")
+                self.metrics.incr("trajectory_dispatches")
+                self.metrics.incr("trajectories_run",
+                                  info["trajectories_run"])
+                self.metrics.incr("trajectories_saved",
+                                  max(0, info["max_trajectories"]
+                                      - info["trajectories_run"]))
+                # a NaN value OR gradient component poisons only ITS row
+                bad = _health.bad_plane_rows(block) if guard else ()
+            elif kind == KIND_GRADIENT:
+                vals, grads = inf.raw
+                # quest: allow-host-sync(result fan-out boundary: ONE
+                # (B, P+1) transfer resolves the whole coalesced group)
+                vals, grads = np.asarray(vals), np.asarray(grads)
+                block = np.concatenate([vals[:, None], grads], axis=1)
+                block = _faults.poison_output(poison, block)[:B]
+                # quest: allow-host-sync(fan-out of already-host values)
+                results = [(float(block[i, 0]), np.array(block[i, 1:]))
+                           for i in range(B)]
+                self.metrics.incr("gradient_dispatches")
+                bad = _health.bad_plane_rows(block) if guard else ()
+                # gradients carry no unit-norm invariant: only the NaN
+                # screen applies (same contract as energies)
+            elif kind == KIND_EXPECTATION:
+                # quest: allow-host-sync(result fan-out boundary: one
+                # (B,) transfer resolves the whole coalesced group)
+                out = _faults.poison_output(poison,
+                                            np.asarray(inf.raw[0])[:B])
+                results = [float(v) for v in out]
+                bad = _health.bad_value_rows(out) if guard else ()
+                # energies carry no unit-norm invariant: only the NaN
+                # screen applies (docs/accuracy.md "Precision tiers")
+            elif kind == KIND_SAMPLE:
+                idx, totals = inf.raw
+                # quest: allow-host-sync(result fan-out boundary: the
+                # sampled indices + totals resolve the whole group)
+                totals = _faults.poison_output(poison,
+                                               np.asarray(totals)[:B])
+                results = [(np.asarray(idx[i, :req.shots]),
+                            float(totals[i]))
+                           for i, req in enumerate(batch)]
+                bad = _health.bad_value_rows(totals) if guard else ()
+                # the pre-sampling totals are the SQUARED 2-norm (sum
+                # of |amp|^2); the fidelity contract (|norm - 1| <=
+                # tol) is on the norm itself, same root as
+                # health.check_planes takes
+                norms = np.sqrt(np.maximum(
+                    np.asarray(totals, dtype=np.float64), 0.0))
+            else:
+                # quest: allow-host-sync(result fan-out boundary: one
+                # (B, planes) transfer resolves the whole group)
                 planes = _faults.poison_output(
-                    poison, np.asarray(cc.sweep(pm, tier=tier))[:B])
-            results = [np.array(planes[i]) for i in range(B)]
-            bad = _health.bad_plane_rows(planes) if guard else ()
-            if guard and tier is not None:
-                norms = _health.plane_norms(
-                    planes, is_density=cc.is_density,
-                    num_qubits=(cc.num_qubits // 2 if cc.is_density
-                                else cc.num_qubits))
-        if guard and tier is not None and norms is not None:
-            viol = _health.drifted_rows(norms, self._tier_tol(cc, tier))
-            arr = np.asarray(norms, dtype=np.float64)
-            arr = arr[np.isfinite(arr)]    # NaN rows are the NaN screen's
-            m = float(np.max(np.abs(arr - 1.0), initial=0.0))
-            with self._cond:
-                obs = self._tier_observed.setdefault(tier.name, 0.0)
-                self._tier_observed[tier.name] = max(obs, m)
-            if m > 0.0:
-                # the tier error model's drift feed: modeled per-run
-                # bound vs the fidelity monitor's observed norm drift
-                from ..profiling import modeled_tier_error
-                _profile.record_model(
-                    "tier_error",
-                    modeled_tier_error(tier, max(cc.circuit.depth, 1)),
-                    m)
-        if sp is not None:
-            mode = "none"
-            bpp = 0.0
-            models: dict = {}
-            try:
-                pol = cc._batch_policy(padded)
-                mode = pol["mode"]
-                bpp = cc._bytes_per_pass(
-                    padded, terms=len(batch[0].observables[0])
-                    if kind == KIND_EXPECTATION else 0)
-                models = cc._drift_models(mode, padded, pol)
-            except (AttributeError, TypeError, KeyError):
-                pass    # trajectory programs price their own sharding
-            sp.done(results, program=getattr(cc, "program_digest", ""),
-                    kind=kind, bucket=padded,
-                    tier=tier.name if tier is not None else "env",
-                    dtype=str(np.dtype(
-                        cc.env.precision.real_dtype)),
-                    sharding=mode, replica=self.name,
-                    bytes_per_pass=bpp, models=models)
+                    poison, np.asarray(inf.raw[0])[:B])
+                results = [np.array(planes[i]) for i in range(B)]
+                bad = _health.bad_plane_rows(planes) if guard else ()
+                if guard and tier is not None:
+                    norms = _health.plane_norms(
+                        planes, is_density=cc.is_density,
+                        num_qubits=(cc.num_qubits // 2 if cc.is_density
+                                    else cc.num_qubits))
+            if guard and tier is not None and norms is not None:
+                viol = _health.drifted_rows(norms,
+                                            self._tier_tol(cc, tier))
+                arr = np.asarray(norms, dtype=np.float64)
+                arr = arr[np.isfinite(arr)]  # NaN rows are the NaN
+                # screen's
+                m = float(np.max(np.abs(arr - 1.0), initial=0.0))
+                with self._cond:
+                    obs = self._tier_observed.setdefault(tier.name, 0.0)
+                    self._tier_observed[tier.name] = max(obs, m)
+                if m > 0.0:
+                    # the tier error model's drift feed: modeled
+                    # per-run bound vs the fidelity monitor's observed
+                    # norm drift
+                    from ..profiling import modeled_tier_error
+                    _profile.record_model(
+                        "tier_error",
+                        modeled_tier_error(tier,
+                                           max(cc.circuit.depth, 1)),
+                        m)
+            if sp is not None:
+                mode = "none"
+                bpp = 0.0
+                models: dict = {}
+                try:
+                    pol = cc._batch_policy(padded)
+                    mode = pol["mode"]
+                    bpp = cc._bytes_per_pass(
+                        padded, terms=len(batch[0].observables[0])
+                        if kind == KIND_EXPECTATION else 0)
+                    models = cc._drift_models(mode, padded, pol)
+                except (AttributeError, TypeError, KeyError):
+                    pass  # trajectory programs price their own sharding
+                sp.done(results,
+                        program=getattr(cc, "program_digest", ""),
+                        kind=kind, bucket=padded,
+                        tier=tier.name if tier is not None else "env",
+                        dtype=str(np.dtype(
+                            cc.env.precision.real_dtype)),
+                        sharding=mode, replica=self.name,
+                        bytes_per_pass=bpp, models=models)
+        # quest: allow-broad-except(close-spans-and-reraise: open
+        # dispatch spans must be closed on ANY interruption -- the
+        # exception always propagates to the classified barrier)
+        except BaseException as e:
+            self._close_dspans(inf, status=type(e).__name__)
+            raise
+        self._close_dspans(inf)
         return (results, {int(r) for r in bad}, {int(r) for r in viol},
-                t_dispatch, padded)
+                inf.t_dispatch, padded)
+
+    def _close_dspans(self, inf: _Inflight,
+                      status: Optional[str] = None) -> None:
+        """Close one batch's per-request dispatch spans exactly once:
+        with the fault's type name on the error path, or with the batch
+        sharding mode (plus trajectory convergence stats) on success."""
+        if status is not None:
+            for req in inf.traced:
+                if req.dspan is not None:
+                    req.trace.end(req.dspan, status=status)
+                    req.dspan = None
+            return
+        if not inf.traced:
+            return
+        cc, kind = inf.cc, inf.kind
+        try:
+            mode = cc.dispatch_stats().batch_sharding_mode
+        except (AttributeError, KeyError, RuntimeError):
+            mode = ""        # stats shape drift: the span just loses it
+        extra = {}
+        if kind == KIND_TRAJECTORY or (
+                kind == KIND_GRADIENT
+                and isinstance(cc, TrajectoryProgram)):
+            info = getattr(cc, "last_traj_stats", None) or {}
+            extra = {"trajectories_run":
+                     info.get("trajectories_run", 0),
+                     "early_stopped":
+                     info.get("early_stopped", False)}
+        for req in inf.traced:
+            if req.dspan is not None:
+                req.trace.end(req.dspan, sharding=mode, **extra)
+                req.dspan = None
 
     def _fail_or_retry(self, req: _Request, exc: BaseException,
                        kind: str) -> None:
@@ -1578,6 +2010,7 @@ class SimulationService:
                 req.qspan = req.trace.begin("queue", retry=req.attempts)
             with self._cond:
                 self._backlog += 1
+                self._note_queued(req, 1)
                 self._queue.append(req)
                 self._cond.notify_all()
             return
@@ -1611,7 +2044,7 @@ class SimulationService:
         req.tier = nxt
         req.escalations += 1
         req.key = coalesce_key(req.compiled, req.kind, req.obs_key,
-                               req.shots, nxt)
+                               req.shots, nxt, tenant=req.tenant)
         self.metrics.incr("tier_escalations")
         self._event("tier_escalation", _trace=req.trace,
                     from_tier=prev.name, to_tier=nxt.name,
@@ -1624,6 +2057,7 @@ class SimulationService:
                                         escalations=req.escalations)
         with self._cond:
             self._backlog += 1
+            self._note_queued(req, 1)
             self._queue.append(req)
             self._cond.notify_all()
 
@@ -1633,6 +2067,18 @@ class SimulationService:
         B = len(batch)
         self._last_cc = cc
         done_t = time.monotonic()
+        digest = getattr(cc, "program_digest", "")
+        if digest:
+            # live per-request cost EMA: the WFQ scheduler's pricing
+            # (seeded from ledger history) tracks what dispatches of
+            # this program actually cost right now
+            per_req = max(0.0, done_t - t_dispatch) / max(B, 1)
+            prev = self._cost_est.get(digest)
+            self._cost_est[digest] = per_req if not prev \
+                else 0.8 * prev + 0.2 * per_req
+        tenant = batch[0].tenant
+        self.metrics.record_tenant_busy(
+            tenant, max(0.0, done_t - t_dispatch))
         viol_rows = viol_rows - bad_rows   # NaN screen wins: nothing to
         # escalate in a non-finite row
         # metrics BEFORE resolving any future: a caller blocked on the
@@ -1657,6 +2103,10 @@ class SimulationService:
             self.metrics.incr("completed")
             self.metrics.record_latency(done_t - req.submit_t,
                                         t_dispatch - req.submit_t)
+            self.metrics.incr_tenant(tenant, "completed")
+            self.metrics.record_tenant_latency(
+                tenant, done_t - req.submit_t,
+                t_dispatch - req.submit_t)
         if batch[0].kind == KIND_GRADIENT:
             good = B - len(bad_rows) - len(viol_rows)
             if good > 0:
@@ -1665,7 +2115,6 @@ class SimulationService:
             # per-program measured latency + bucket mix, flushed to the
             # persistent perf ledger on close (the router's EMA
             # warm-start and warm()'s bucket seed in the NEXT process)
-            digest = getattr(cc, "program_digest", "")
             if digest:
                 ent = self._lat_by_program.setdefault(
                     digest, [0, 0.0, {}, {}])

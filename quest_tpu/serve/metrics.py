@@ -71,7 +71,17 @@ _COUNTERS = (
     "optimizer_iterations",   # optimizer steps executed (all handles)
     "optimizer_converged",    # handles that met their tolerance
     "optimizer_resumes",      # handles resumed from a checkpoint
+    # multi-tenant WFQ scheduling + pipelined dispatch (ISSUE 16):
+    "rejected_quota",         # submit() raised QuotaExceeded (queued cap)
+    "quota_deferrals",        # ready requests held back by an inflight cap
+    "pipelined_batches",      # dispatches issued through the in-flight pipe
+    "preemptions",            # checkpointed runs that yielded the mesh
 )
+
+# per-tenant counter family (a subset of the service counters that is
+# meaningful per submitting tenant; tracked by incr_tenant)
+_TENANT_COUNTERS = ("submitted", "completed", "rejected_quota",
+                    "preemptions")
 
 
 class ServiceMetrics:
@@ -99,6 +109,12 @@ class ServiceMetrics:
                    for name in _COUNTERS}
         self._max_occupancy = 0
         self.queue_depth_fn = None
+        # per-tenant accounting (ISSUE 16): created lazily on first
+        # touch so single-tenant services pay nothing new; all three
+        # maps are guarded by the same registry lock
+        self._tenant_c: dict = {}        # tenant -> {name: int}
+        self._tenant_lat: dict = {}      # tenant -> (Histogram, Histogram)
+        self._tenant_busy: dict = {}     # tenant -> mesh-busy seconds
 
     # -- recording ---------------------------------------------------------
 
@@ -130,6 +146,68 @@ class ServiceMetrics:
         self._latency.observe(total_s)
         self._queue_wait.observe(queue_wait_s)
 
+    # -- per-tenant accounting (ISSUE 16) ----------------------------------
+
+    def incr_tenant(self, tenant: str, name: str, k: int = 1) -> None:
+        """One per-tenant counter tick. Unknown names raise (same
+        typo-guard contract as :meth:`incr`)."""
+        if name not in _TENANT_COUNTERS:
+            raise KeyError(f"unknown tenant counter {name!r}")
+        with self._lock:
+            row = self._tenant_c.setdefault(
+                tenant, dict.fromkeys(_TENANT_COUNTERS, 0))
+            row[name] += k
+
+    def record_tenant_latency(self, tenant: str, total_s: float,
+                              queue_wait_s: float) -> None:
+        with self._lock:
+            pair = self._tenant_lat.get(tenant)
+            if pair is None:
+                pair = (Histogram("request_latency_s",
+                                  "submit-to-result seconds"),
+                        Histogram("queue_wait_s",
+                                  "submit-to-dispatch seconds"))
+                self._tenant_lat[tenant] = pair
+        pair[0].observe(total_s)
+        pair[1].observe(queue_wait_s)
+
+    def record_tenant_busy(self, tenant: str, seconds: float) -> None:
+        """Mesh-busy seconds attributed to one tenant's dispatches —
+        the numerator of the share-of-mesh gauge."""
+        with self._lock:
+            self._tenant_busy[tenant] = \
+                self._tenant_busy.get(tenant, 0.0) + float(seconds)  # quest: allow-host-sync(host wall-clock scalar, never a device value)
+
+    def tenant_snapshot(self) -> dict:
+        """Per-tenant view: counters, latency/queue-wait percentiles,
+        busy seconds, and share-of-mesh (this tenant's busy seconds
+        over all tenants'). Empty dict when no tenant ever recorded."""
+        with self._lock:
+            counters = {t: dict(row)
+                        for t, row in self._tenant_c.items()}
+            busy = dict(self._tenant_busy)
+            lat = dict(self._tenant_lat)
+        total_busy = sum(busy.values())
+        tenants = set(counters) | set(busy) | set(lat)
+        out = {}
+        for t in sorted(tenants):
+            pair = lat.get(t)
+            out[t] = {
+                **counters.get(t, dict.fromkeys(_TENANT_COUNTERS, 0)),
+                "busy_s": busy.get(t, 0.0),
+                "mesh_share": (busy.get(t, 0.0) / total_busy)
+                if total_busy > 0 else 0.0,
+                "p50_latency_s":
+                    pair[0].percentile(50.0) if pair else 0.0,
+                "p99_latency_s":
+                    pair[0].percentile(99.0) if pair else 0.0,
+                "p50_queue_wait_s":
+                    pair[1].percentile(50.0) if pair else 0.0,
+                "p99_queue_wait_s":
+                    pair[1].percentile(99.0) if pair else 0.0,
+            }
+        return out
+
     # -- reading -----------------------------------------------------------
 
     @staticmethod
@@ -141,7 +219,7 @@ class ServiceMetrics:
             return 0.0
         i = min(len(sorted_vals) - 1,
                 max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
-        return float(sorted_vals[i])
+        return float(sorted_vals[i])  # quest: allow-host-sync(offline percentile over host floats)
 
     def latency_histograms(self) -> dict:
         """The raw histogram snapshots (Prometheus-shaped cumulative
@@ -186,6 +264,10 @@ class ServiceMetrics:
             "p99_latency_s": self._latency.percentile(99.0),
             "p50_queue_wait_s": self._queue_wait.percentile(50.0),
             "p99_queue_wait_s": self._queue_wait.percentile(99.0),
+            # nested per-tenant block: the Prometheus exporter flattens
+            # numeric leaves, so each tenant's counters/percentiles
+            # export as tenants_<name>_<metric> series automatically
+            "tenants": self.tenant_snapshot(),
         }
 
 
@@ -209,6 +291,10 @@ _ROUTER_COUNTERS = (
     "optimizer_iterations",  # optimizer steps executed (all handles)
     "optimizer_converged",   # handles that met their tolerance
     "optimizer_resumes",     # handles resumed from a checkpoint
+    # ledger-driven elasticity (ISSUE 16):
+    "scale_ups",             # autoscaler replica-pool grow operations
+    "scale_downs",           # autoscaler replica-pool shrink operations
+    "preemptions",           # checkpointed runs that yielded the mesh
 )
 
 

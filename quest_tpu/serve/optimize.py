@@ -216,7 +216,10 @@ class OptimizationHandle:
     def __init__(self, target, problem: VariationalProblem, optimizer,
                  *, max_iters: int, tol: float,
                  checkpoint_path: Optional[str], resume: bool,
-                 max_restarts: int, step_timeout_s: float):
+                 max_restarts: int, step_timeout_s: float,
+                 tenant: str = "default",
+                 yield_to_interactive: bool = True,
+                 preempt_hold_s: float = 5.0):
         self._target = target
         self._problem = problem
         self._opt = optimizer
@@ -226,6 +229,9 @@ class OptimizationHandle:
         self._resume = bool(resume)
         self._max_restarts = int(max_restarts)
         self._step_timeout = float(step_timeout_s)
+        self._tenant = str(tenant)
+        self._yield_to_interactive = bool(yield_to_interactive)
+        self._preempt_hold = float(preempt_hold_s)
         self._digest = problem.digest(
             extra=getattr(optimizer, "config", lambda: repr(optimizer))())
         if checkpoint_path:
@@ -315,6 +321,40 @@ class OptimizationHandle:
         if ev is not None:
             ev(name, **detail)
 
+    def _maybe_yield(self, k: int) -> None:
+        """Cooperative preemption at the iterate boundary: when the
+        target reports queued interactive (priority-0) work, hold the
+        NEXT gradient dispatch until the burst drains (bounded by
+        ``preempt_hold_s``). The iterate boundary is exactly the
+        digest-guarded checkpoint boundary, so a preempted run that is
+        killed mid-hold resumes bit-exactly — yielding the mesh never
+        creates a new failure mode, only latency for the batch tier."""
+        if not self._yield_to_interactive:
+            return
+        pressure = getattr(self._target, "interactive_pressure", None)
+        if pressure is None or not pressure():
+            return
+        # QL004 trio at the preemption dispatch boundary: injected
+        # faults here land inside the restart budget like any other
+        # iterate fault, and the hold shows up in device profiles as
+        # its own annotated span
+        sp = _profile.profile_dispatch("serve.preempt")
+        _faults.fire("serve.preempt")
+        self._incr("preemptions")
+        metrics = getattr(self._target, "metrics", None)
+        if metrics is not None and hasattr(metrics, "incr_tenant"):
+            metrics.incr_tenant(self._tenant, "preemptions")
+        self._event("optimizer_preempted", iteration=k)
+        t0 = time.monotonic()
+        with dispatch_annotation(f"quest_tpu.serve.preempt:k{k}"):
+            while (time.monotonic() - t0 < self._preempt_hold
+                   and not self._cancelled and pressure()):
+                time.sleep(2e-3)
+        if sp is not None:
+            sp.done(None, program=self._digest[:16], kind="preempt",
+                    bucket=1, tier="env", dtype="float64",
+                    sharding="none")
+
     def _step(self, k: int, x: np.ndarray):
         """One optimizer iterate: ONE coalesced gradient submission
         through the serving stack, wall-to-result. Returns ``(value,
@@ -332,7 +372,9 @@ class OptimizationHandle:
                 p.circuit, x, observables=p.observables, gradient=True,
                 trajectories=p.trajectories,
                 sampling_budget=p.sampling_budget,
-                **({"tier": p.tier} if p.tier is not None else {}))
+                **({"tier": p.tier} if p.tier is not None else {}),
+                **({"tenant": self._tenant}
+                   if self._tenant != "default" else {}))
             res = fut.result(timeout=self._step_timeout)
         value = res[0]
         # quest: allow-host-sync(the gradient future already resolved
@@ -382,6 +424,7 @@ class OptimizationHandle:
             k = k0
             while k < self._max_iters and not self._cancelled:
                 try:
+                    self._maybe_yield(k)
                     value, grad, stderr = self._step(k, x)
                 # quest: allow-broad-except(classified barrier:
                 # classify() re-raises FATAL with the caller's original
@@ -449,13 +492,24 @@ def run_optimization(target, problem: VariationalProblem,
                      learning_rate: Optional[float] = None,
                      checkpoint_path: Optional[str] = None,
                      resume: bool = True, max_restarts: int = 3,
-                     step_timeout_s: Optional[float] = None
+                     step_timeout_s: Optional[float] = None,
+                     tenant: str = "default",
+                     yield_to_interactive: bool = True,
+                     preempt_hold_s: float = 5.0
                      ) -> OptimizationHandle:
     """Start the optimizer-in-the-loop run against ``target`` (a
     :class:`~quest_tpu.serve.SimulationService` or
     :class:`~quest_tpu.serve.router.ServiceRouter`) and return its
     streaming :class:`OptimizationHandle`. See
-    ``SimulationService.optimize`` for the caller-facing contract."""
+    ``SimulationService.optimize`` for the caller-facing contract.
+
+    ``tenant`` attributes every gradient submission (and preemption)
+    to a WFQ tenant. ``yield_to_interactive`` enables cooperative
+    preemption: before each iterate the loop checks the target's
+    ``interactive_pressure()`` and, when priority-0 work is queued,
+    holds the next dispatch until the burst drains (at most
+    ``preempt_hold_s`` per preemption). Because the hold sits exactly
+    on the checkpoint boundary, a preempted run resumes bit-exactly."""
     if max_iters < 1:
         raise ValueError("max_iters must be >= 1")
     if not (tol >= 0.0):
@@ -473,4 +527,6 @@ def run_optimization(target, problem: VariationalProblem,
     return OptimizationHandle(
         target, problem, opt, max_iters=max_iters, tol=tol,
         checkpoint_path=checkpoint_path, resume=resume,
-        max_restarts=max_restarts, step_timeout_s=step_timeout_s)
+        max_restarts=max_restarts, step_timeout_s=step_timeout_s,
+        tenant=tenant, yield_to_interactive=yield_to_interactive,
+        preempt_hold_s=preempt_hold_s)

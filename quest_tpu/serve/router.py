@@ -59,14 +59,17 @@ from ..circuits import Circuit, CompiledCircuit
 from ..ops.trajectories import TrajectoryProgram
 from ..resilience import faults as _faults
 from ..resilience.recovery import (FATAL, POISON, TRANSIENT,
-                                   SupervisorPolicy, classify)
+                                   AutoscalePolicy, SupervisorPolicy,
+                                   classify)
 from ..telemetry import profile as _profile
 from ..telemetry.events import make_event, read_timeline
 from ..telemetry.metrics import metrics_registry
-from ..telemetry.tracing import Tracer
+from ..telemetry.tracing import Tracer, dispatch_annotation
 from .engine import (CircuitBreakerOpen, DeadlineExceeded, QueueFull,
-                     ServeError, ServiceClosed, SimulationService)
+                     QuotaExceeded, ServeError, ServiceClosed,
+                     SimulationService)
 from .metrics import RouterMetrics
+from .sched import DEFAULT_TENANT
 
 __all__ = ["ServiceRouter", "AllReplicasUnavailable", "replica_envs"]
 
@@ -151,11 +154,13 @@ class _Work:
                  "deadline", "future", "failovers_left", "lock", "done",
                  "tried", "active", "last_route_t", "hedged",
                  "park_logged", "trace", "trajectories",
-                 "sampling_budget", "gradient", "tier")
+                 "sampling_budget", "gradient", "tier", "tenant",
+                 "priority")
 
     def __init__(self, circuit, params, observables, shots, submit_t,
                  deadline, failovers_left, trajectories=None,
-                 sampling_budget=None, gradient=False, tier=None):
+                 sampling_budget=None, gradient=False, tier=None,
+                 tenant=DEFAULT_TENANT, priority=None):
         self.circuit = circuit
         self.params = params
         self.observables = observables
@@ -164,6 +169,8 @@ class _Work:
         self.sampling_budget = sampling_budget
         self.gradient = gradient
         self.tier = tier
+        self.tenant = tenant
+        self.priority = priority
         self.submit_t = submit_t
         self.deadline = deadline        # ABSOLUTE (monotonic); immutable
         self.future: Future = Future()
@@ -223,6 +230,21 @@ class ServiceRouter:
         Opt-in tail-latency hedging: a request still unresolved this
         long after its last placement is duplicated onto one additional
         healthy replica (first result wins). None disables.
+    autoscale : AutoscalePolicy | None
+        Ledger-driven elasticity (:class:`quest_tpu.resilience.
+        AutoscalePolicy`): each supervisor poll prices the pooled
+        backlog as a drain-time estimate (``backlog * mean_request_s /
+        replicas`` — the mean comes from the shared perf ledger, else
+        the live EMAs) and grows/shrinks the replica pool through
+        :meth:`scale_to` when the policy says so. None disables (the
+        pool stays at its constructed size; :meth:`scale_to` still
+        works manually).
+    env_factory : callable | None
+        Zero-argument callable returning a fresh env for each replica
+        added ABOVE the constructed pool (scale-up). None builds
+        ``replica_envs(1, devices_per_replica)`` envs — on a small
+        device pool the new replica shares devices with the existing
+        ones (the CPU test mode).
     warm_cache : WarmCache | False | None
         One persistent warm-start cache SHARED by all replicas (same
         programs, same artifacts — replica 1's stores are replica 2's
@@ -261,6 +283,8 @@ class ServiceRouter:
                  supervisor: Optional[SupervisorPolicy] = None,
                  max_failovers: Optional[int] = None,
                  hedge_after_s: Optional[float] = None,
+                 autoscale: Optional[AutoscalePolicy] = None,
+                 env_factory=None,
                  warm_cache=None, perf_ledger=None,
                  record_events: int = 1024,
                  trace_sample_rate: float = 0.0,
@@ -288,6 +312,13 @@ class ServiceRouter:
         self.max_failovers = int(max_failovers) if max_failovers \
             is not None else len(envs)
         self.hedge_after_s = hedge_after_s
+        self.autoscale = autoscale
+        self._env_factory = env_factory
+        self._devices_per_replica = devices_per_replica
+        self._next_index = len(envs)    # monotonic: slots never reused
+        self._last_scale_t = 0.0
+        self._idle_since: Optional[float] = None
+        self._scale_thread: Optional[threading.Thread] = None
         self.metrics = RouterMetrics()
         self.events: collections.deque = collections.deque(
             maxlen=max(0, int(record_events)))
@@ -415,6 +446,8 @@ class ServiceRouter:
                trajectories: Optional[int] = None,
                sampling_budget: Optional[float] = None,
                gradient: bool = False, tier=None,
+               tenant: str = DEFAULT_TENANT,
+               priority: Optional[int] = None,
                deadline: Optional[float] = None) -> Future:
         """Enqueue one request on the healthiest replica; returns a
         router-owned Future. Semantics match
@@ -430,7 +463,14 @@ class ServiceRouter:
         replica faults fail the request over to a healthy replica under
         its ORIGINAL absolute deadline, and a window with no ready
         replica parks the request for re-placement instead of dropping
-        it (it still expires typed at its deadline)."""
+        it (it still expires typed at its deadline). ``tenant`` /
+        ``priority`` travel with the request across every hop —
+        failovers and hedges land in the serving replica's WFQ
+        scheduler under the SAME tenant accounting, and a replica's
+        typed :class:`~quest_tpu.serve.QuotaExceeded` propagates to
+        the caller (tenant backpressure is caller-facing, not a
+        replica fault to route around: every replica enforces the
+        same per-tenant contract)."""
         if self._closed:
             raise ServiceClosed("router is closed")
         route = self._route_circuit(circuit)
@@ -444,7 +484,7 @@ class ServiceRouter:
         work = _Work(route, params, observables, shots, now, abs_deadline,
                      self.max_failovers, trajectories=trajectories,
                      sampling_budget=sampling_budget, gradient=gradient,
-                     tier=tier)
+                     tier=tier, tenant=str(tenant), priority=priority)
         ctx = self.tracer.start(router=self.name)
         if ctx is not None:
             work.trace = ctx
@@ -514,7 +554,15 @@ class ServiceRouter:
                     trajectories=work.trajectories,
                     sampling_budget=work.sampling_budget,
                     gradient=work.gradient, tier=work.tier,
+                    tenant=work.tenant, priority=work.priority,
                     deadline=remaining, _trace=work.trace)
+            except QuotaExceeded as e:
+                # tenant backpressure, not a replica fault: every
+                # replica enforces the same per-tenant contract, so
+                # routing around it would just probe N replicas to
+                # deliver the same typed answer later
+                self._resolve(work, exc=e)
+                return
             except QueueFull:
                 self.metrics.incr("rerouted_full")
                 exclude = set(exclude) | {h.index}
@@ -645,6 +693,212 @@ class ServiceRouter:
                            - work.failovers_left)
             work.trace.finish(status)
 
+    # -- multi-tenancy + elasticity ----------------------------------------
+
+    def set_tenant(self, tenant: str, policy) -> None:
+        """Install or replace one tenant's scheduling contract
+        (:class:`~quest_tpu.serve.TenantPolicy`) on EVERY replica —
+        live ones immediately, future ones (restarts, scale-ups)
+        through the recorded service kwargs."""
+        with self._lock:
+            tenants = dict(self._service_kwargs.get("tenants") or {})
+            tenants[str(tenant)] = policy
+            self._service_kwargs["tenants"] = tenants
+            replicas = list(self._replicas)
+        for h in replicas:
+            if h.state != "failed":
+                h.service.set_tenant(tenant, policy)
+
+    def interactive_pressure(self) -> bool:
+        """True while any replica holds queued priority-0 (interactive)
+        work — the preemption signal checkpointed runs poll at segment
+        boundaries (:func:`~quest_tpu.serve.run_optimization`'s
+        ``yield_to_interactive``)."""
+        with self._lock:
+            replicas = list(self._replicas)
+        return any(h.state == "ready" and h.service.interactive_pressure()
+                   for h in replicas)
+
+    def scale_to(self, n: int, *, timeout: float = 30.0) -> dict:
+        """Resize the replica pool to ``n`` live replicas.
+
+        Growing stands each new replica up OFF the router lock — fresh
+        env (``env_factory`` or a :func:`replica_envs` slice), new
+        service, warm-spec replay through the shared warm cache, and
+        the same oracle-grade half-open probe a restart passes — then
+        admits it atomically; a probe failure aborts the grow (the
+        pool never admits a replica that computes wrong answers).
+        Shrinking drains the highest-index replicas first (quiesce,
+        then close) so no queued request is dropped. Returns
+        accounting: ``{"replicas", "added", "removed", "ready_s"}`` —
+        ``ready_s`` is the scale-up-to-ready latency
+        ``bench.py bench_multitenant`` reports."""
+        n = int(n)
+        if n < 1:
+            raise ValueError("the pool needs at least one replica")
+        if self._closed:
+            raise ServiceClosed("router is closed")
+        sp = _profile.profile_dispatch("serve.scale")
+        _faults.fire("serve.scale")
+        t0 = time.perf_counter()
+        added: list = []
+        removed: list = []
+        with self._lock:
+            cur = sum(1 for h in self._replicas if h.state != "failed")
+        with dispatch_annotation(
+                f"quest_tpu.serve.scale:{cur}to{n}"):
+            while True:            # grow, one replica at a time
+                with self._lock:
+                    live = sum(1 for h in self._replicas
+                               if h.state != "failed")
+                    if live >= n or self._closed:
+                        break
+                    idx = self._next_index
+                    self._next_index += 1
+                h = self._stand_up_replica(idx)
+                if h is None:
+                    break           # probe failed: never admit it
+                with self._lock:
+                    if self._closed:
+                        break
+                    self._replicas.append(h)
+                added.append(idx)
+                self.metrics.incr("scale_ups")
+                self._event("replica_scaled_up", replica=idx,
+                            ready_s=round(time.perf_counter() - t0, 4))
+            while True:            # shrink, newest replica first
+                with self._lock:
+                    ready = [h for h in self._replicas
+                             if h.state != "failed"]
+                    if len(ready) <= max(n, 1) or self._closed:
+                        break
+                    h = max(ready, key=lambda r: r.index)
+                    h.state = "draining"
+                self._event("replica_draining", replica=h.index)
+                try:
+                    h.service.quiesce(timeout=timeout)
+                    h.service.close(drain=True, timeout=timeout)
+                except (ServeError, RuntimeError, OSError):
+                    pass    # best-effort: the slot is leaving the pool
+                with self._lock:
+                    if h in self._replicas:
+                        self._replicas.remove(h)
+                removed.append(h.index)
+                self.metrics.incr("scale_downs")
+                self._event("replica_scaled_down", replica=h.index)
+        with self._lock:
+            self._last_scale_t = time.monotonic()
+            count = sum(1 for h in self._replicas if h.state != "failed")
+        ready_s = time.perf_counter() - t0
+        if sp is not None:
+            sp.done(None, program=f"pool{count}", kind="scale",
+                    bucket=max(1, count), tier="env", dtype="float64",
+                    sharding="none")
+        return {"replicas": count, "added": added, "removed": removed,
+                "ready_s": ready_s}
+
+    def _stand_up_replica(self, idx: int):
+        """Build one scale-up replica end to end (env, service, warm
+        replay, probe) with NO router lock held; returns the admitted
+        :class:`_Replica` or None when the probe fails."""
+        if self._env_factory is not None:
+            env = self._env_factory()
+        else:
+            k = self._devices_per_replica
+            if k is None:
+                # mirror the live pool's shape: a full-pool default
+                # mesh could out-shard the warmed circuits (more
+                # devices than local qubits) and fail every probe
+                with self._lock:
+                    live = [r for r in self._replicas
+                            if r.state != "failed"]
+                k = live[0].env.num_devices if live else 1
+            env = replica_envs(1, k)[0]
+        svc = self._new_service(env, index=idx)
+        with self._lock:
+            specs = list(self._warm_specs)
+        try:
+            for spec in specs:
+                svc.warm(spec.circuit, batch_sizes=spec.batch_sizes,
+                         observables=spec.observables, shots=spec.shots)
+            ok = self._probe(svc)
+        # quest: allow-broad-except(admission barrier: ANY warm/probe
+        # failure means the candidate replica is not admitted -- the
+        # typed outcome is an aborted scale-up, not an exception)
+        except Exception:
+            ok = False
+        if not ok:
+            self.metrics.incr("probe_failures")
+            self._event("scale_up_probe_failed", replica=idx)
+            try:
+                svc.close(drain=False, timeout=1.0)
+            except (ServeError, RuntimeError, OSError):
+                pass    # best-effort teardown of the failed candidate
+            return None
+        h = _Replica(idx, env, svc)
+        if self.perf_ledger is not None:
+            seed_s = self.perf_ledger.mean_request_s()
+            if seed_s > 0.0:
+                h.ema_request_s = seed_s
+        return h
+
+    def _maybe_autoscale(self, now: float) -> None:
+        """One elasticity decision per supervisor poll: pool the live
+        backlog/inflight, price the drain time with the perf ledger's
+        mean request latency (live EMA fallback), and hand the numbers
+        to :class:`~quest_tpu.resilience.AutoscalePolicy`. The actual
+        resize runs on a background thread — standing a replica up
+        warms and probes it, which must never stall quarantine/hedge
+        service for the whole pool."""
+        pol = self.autoscale
+        if pol is None or self._closed:
+            return
+        if self._scale_thread is not None \
+                and self._scale_thread.is_alive():
+            return                  # one resize in flight at a time
+        with self._lock:
+            live = [h for h in self._replicas if h.state != "failed"]
+            replicas = len(live)
+            backlog = sum(h.service._backlog for h in live)
+            inflight = sum(h.service._inflight for h in live)
+        if replicas == 0:
+            return
+        if backlog + inflight > 0:
+            self._idle_since = None
+        elif self._idle_since is None:
+            self._idle_since = now
+        est = self.perf_ledger.mean_request_s() \
+            if self.perf_ledger is not None else 0.0
+        if est <= 0.0:
+            emas = [h.ema_request_s for h in live if h.ema_request_s > 0]
+            est = sum(emas) / len(emas) if emas else 0.0
+        delta = pol.decide(now=now, replicas=replicas, backlog=backlog,
+                           inflight=inflight, mean_request_s=est,
+                           last_scale_t=self._last_scale_t,
+                           idle_since=self._idle_since)
+        if delta == 0:
+            return
+        target = max(1, replicas + delta)
+        self._event("autoscale_decision", replicas=replicas,
+                    target=target, backlog=backlog,
+                    mean_request_s=round(est, 6))
+
+        def _resize():
+            try:
+                self.scale_to(target)
+            # quest: allow-broad-except(elasticity barrier: a failed
+            # resize (injected scale fault, probe failure, close race)
+            # must not kill the scale thread unlogged -- the pool just
+            # holds and the next poll re-decides)
+            except Exception as e:
+                self.metrics.incr("supervisor_errors")
+                self._event("autoscale_error", error=type(e).__name__)
+
+        self._scale_thread = threading.Thread(
+            target=_resize, daemon=True,
+            name=f"quest-tpu-router-scale-{id(self):x}")
+        self._scale_thread.start()
+
     # -- warm + probe ------------------------------------------------------
 
     def warm(self, circuit, batch_sizes: Optional[Sequence[int]] = None,
@@ -680,7 +934,10 @@ class ServiceRouter:
                  max_iters: int = 100, tol: float = 1e-6,
                  learning_rate: Optional[float] = None,
                  checkpoint_path: Optional[str] = None,
-                 resume: bool = True, max_restarts: int = 3):
+                 resume: bool = True, max_restarts: int = 3,
+                 tenant: str = DEFAULT_TENANT,
+                 yield_to_interactive: bool = True,
+                 preempt_hold_s: float = 5.0):
         """Optimizer-in-the-loop over the REPLICATED front end: same
         contract as :meth:`SimulationService.optimize`, with each
         iterate's gradient submission routed/failed-over like any
@@ -695,7 +952,9 @@ class ServiceRouter:
             self, problem, optimizer, max_iters=max_iters, tol=tol,
             learning_rate=learning_rate,
             checkpoint_path=checkpoint_path, resume=resume,
-            max_restarts=max_restarts)
+            max_restarts=max_restarts, tenant=tenant,
+            yield_to_interactive=yield_to_interactive,
+            preempt_hold_s=preempt_hold_s)
 
     def _probe(self, svc: SimulationService) -> bool:
         """Half-open readmission probe: a batch of zero-parameter
@@ -872,6 +1131,7 @@ class ServiceRouter:
                 self._maybe_restart(h)
         self._replace_parked()
         self._maybe_hedge(now)
+        self._maybe_autoscale(now)
 
     def _replace_parked(self) -> None:
         with self._lock:
@@ -1093,6 +1353,10 @@ class ServiceRouter:
         metrics_registry().unregister(self._registry_token)
         if threading.current_thread() is not self._supervisor:
             self._supervisor.join(timeout)
+        t = self._scale_thread
+        if t is not None and t.is_alive() \
+                and threading.current_thread() is not t:
+            t.join(timeout)
         for w in parked:
             self._resolve(w, exc=ServiceClosed(
                 "router closed before the request could be placed"))

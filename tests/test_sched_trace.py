@@ -1,0 +1,156 @@
+"""Fast-tier smoke for tools/sched_trace.py and the pure multi-tenant
+scheduling replay it wraps (quest_tpu/serve/sched.plan_wfq_schedule).
+No device work anywhere in this module — it must stay cheap enough for
+the bounded fast tier."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+import sched_trace  # noqa: E402
+
+from quest_tpu.resilience.recovery import AutoscalePolicy  # noqa: E402
+from quest_tpu.serve.coalesce import CoalescePolicy  # noqa: E402
+from quest_tpu.serve.sched import (TenantPolicy,  # noqa: E402
+                                   plan_wfq_schedule)
+
+
+def _two_class():
+    return {"ui": TenantPolicy(weight=3.0, priority=0),
+            "batch": TenantPolicy(weight=1.0, priority=2)}
+
+
+def test_priority_class_preempts_fifo_order():
+    """A priority-0 batch arriving INTO a deep heavy backlog dispatches
+    ahead of every queued heavy batch on the next free replica."""
+    pol = CoalescePolicy(max_batch=4, max_wait_s=0.001)
+    arrivals = [(0.0, "batch", 0)] * 12 + [(0.002, "ui", 0)] * 4
+    doc = plan_wfq_schedule(arrivals, pol, _two_class(),
+                            request_cost_s=5e-3)
+    disp = [e for e in doc["events"] if e["type"] == "dispatch"]
+    # the first heavy batch holds the replica, but the ui batch goes
+    # next — before the two remaining queued heavy batches
+    ui_at = next(i for i, e in enumerate(disp) if e["tenant"] == "ui")
+    assert ui_at == 1
+    assert doc["tenants"]["ui"]["p99_wait_s"] \
+        < doc["tenants"]["batch"]["p99_wait_s"]
+
+
+def test_wfq_weights_split_mesh_share_within_a_class():
+    """Same priority class: mesh share converges toward the weight
+    ratio while both tenants stay backlogged."""
+    pol = CoalescePolicy(max_batch=4, max_wait_s=0.001)
+    tenants = {"a": TenantPolicy(weight=3.0, priority=1),
+               "b": TenantPolicy(weight=1.0, priority=1)}
+    arrivals = sorted([(0.0, "a", 0)] * 32 + [(0.0, "b", 0)] * 32,
+                      key=lambda x: x[0])
+    doc = plan_wfq_schedule(arrivals, pol, tenants, request_cost_s=5e-3)
+    # equal offered load: shares stay equal overall, but the weighted
+    # tenant finishes its work FIRST — its waits are strictly better
+    assert doc["tenants"]["a"]["p99_wait_s"] \
+        < doc["tenants"]["b"]["p99_wait_s"]
+    assert doc["totals"]["jain_fairness"] > 0.9
+
+
+def test_segment_preemption_yields_to_interactive():
+    """A long checkpointed batch yields its replica at the next segment
+    boundary when interactive work queues, and the remainder resumes."""
+    pol = CoalescePolicy(max_batch=8, max_wait_s=0.001)
+    # one huge heavy batch, then interactive arrivals while it runs
+    arrivals = [(0.0, "batch", 0)] * 8 + [(0.003, "ui", 0)] * 2
+    doc = plan_wfq_schedule(arrivals, pol, _two_class(),
+                            request_cost_s=0.01, segment_s=0.02)
+    assert doc["totals"]["preemptions"] >= 1
+    kinds = [e["type"] for e in doc["events"]]
+    assert "preempt" in kinds
+    resumed = [e for e in doc["events"]
+               if e["type"] == "dispatch" and e["resumed"]]
+    assert resumed, "the preempted remainder never resumed"
+    # every submitted request is still served exactly once
+    assert doc["tenants"]["batch"]["requests"] == 8
+    assert doc["tenants"]["ui"]["requests"] == 2
+
+
+def test_autoscale_grows_under_backlog_and_shrinks_idle():
+    pol = CoalescePolicy(max_batch=4, max_wait_s=0.001)
+    arrivals = [(0.0, "batch", 0)] * 64 + [(30.0, "batch", 0)]
+    auto = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                           scale_up_drain_s=0.05, scale_down_idle_s=1.0,
+                           cooldown_s=0.01)
+    doc = plan_wfq_schedule(arrivals, pol, _two_class(),
+                            request_cost_s=5e-3, num_replicas=1,
+                            autoscale=auto, scale_ready_s=0.1)
+    assert doc["totals"]["scale_ups"] >= 1
+    assert doc["totals"]["scale_downs"] >= 1
+    ups = [e for e in doc["events"] if e["type"] == "scale_up"]
+    assert all(e["ready_t"] == pytest.approx(e["t"] + 0.1) for e in ups)
+    assert doc["totals"]["final_replicas"] <= 3
+
+
+def test_simulated_trace_is_deterministic_and_shared():
+    shares = {"ui": 0.4, "batch": 0.6}
+    a = sched_trace.simulate_tenant_trace(200, 2000.0, shares, 2,
+                                          seed=7, burst=0.3)
+    b = sched_trace.simulate_tenant_trace(200, 2000.0, shares, 2,
+                                          seed=7, burst=0.3)
+    assert a == b
+    assert len(a) == 200
+    names = {t for _, t, _ in a}
+    assert names == {"ui", "batch"}
+    ts = [t for t, _, _ in a]
+    assert ts == sorted(ts)
+
+
+def test_parse_tenants_rejects_bad_specs():
+    with pytest.raises(ValueError):
+        sched_trace.parse_tenants(["ui:3:0"])      # missing share
+    with pytest.raises(ValueError):
+        sched_trace.parse_tenants(["ui:1:0:0", "batch:1:1:0"])
+    pols, shares = sched_trace.parse_tenants(["u:2:0:1", "b:1:1:3"])
+    assert shares["u"] == pytest.approx(0.25)
+    assert pols["b"] == {"weight": 1.0, "priority": 1}
+
+
+def test_cli_end_to_end(tmp_path):
+    tool = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "sched_trace.py")
+    out = tmp_path / "sched.json"
+    proc = subprocess.run(
+        [sys.executable, tool, "--requests", "96", "--rate", "2000",
+         "--segment", "0.02", "--autoscale", "--request-cost", "5e-3",
+         "--seed", "3", "--out", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == "quest_tpu.trace/1"
+    assert doc["kind"] == "sched"
+    assert doc["totals"]["requests"] == 96
+    assert set(doc["tenants"]) == {"ui", "batch"}
+    assert {e["type"] for e in doc["events"]} <= {
+        "dispatch", "preempt", "scale_up", "scale_down", "error"}
+    assert "error" not in {e["type"] for e in doc["events"]}
+    assert 0.0 < doc["totals"]["jain_fairness"] <= 1.0
+
+
+def test_cli_fifo_baseline_hurts_interactive_tail():
+    """The --fifo replay (every tenant collapsed to one contract) must
+    show a worse interactive tail than the WFQ replay of the SAME
+    trace — the offline version of the bench's fairness acceptance."""
+    tool = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "sched_trace.py")
+    base = [sys.executable, tool, "--requests", "128", "--rate", "4000",
+            "--request-cost", "5e-3", "--seed", "11", "--no-events"]
+    wfq = subprocess.run(base, capture_output=True, text=True,
+                         timeout=120)
+    fifo = subprocess.run(base + ["--fifo"], capture_output=True,
+                          text=True, timeout=120)
+    assert wfq.returncode == 0, wfq.stderr[-1500:]
+    assert fifo.returncode == 0, fifo.stderr[-1500:]
+    w = json.loads(wfq.stdout)["tenants"]["ui"]["p99_wait_s"]
+    f = json.loads(fifo.stdout)["tenants"]["ui"]["p99_wait_s"]
+    assert w <= f

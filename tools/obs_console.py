@@ -103,6 +103,43 @@ def _service_lines(svc: dict, indent: str = "  ") -> list:
     return lines
 
 
+def _tenant_lines(svc: dict, stats: dict = None,
+                  indent: str = "  ") -> list:
+    """The per-tenant table (a ``ServiceMetrics.tenant_snapshot()``
+    nested under the service snapshot) plus the WFQ scheduler state
+    when the stats document carries one."""
+    tenants = svc.get("tenants", {}) or {}
+    if not tenants:
+        return []
+    sched = (stats or {}).get("scheduler", {}) or {}
+    pols = sched.get("tenants", {}) or {}
+    lines = [
+        f"{indent}{'tenant':<12} {'w':>4} {'pri':>3} {'subm':>6} "
+        f"{'done':>6} {'quota':>5} {'pre':>4} {'share':>6} "
+        f"{'p50':>8} {'p99':>8} {'wait99':>8}"]
+    for name, t in sorted(tenants.items()):
+        pol = pols.get(name, {})
+        lines.append(
+            f"{indent}{str(name)[:12]:<12} "
+            f"{pol.get('weight', '-'):>4} "
+            f"{pol.get('priority', '-'):>3} "
+            f"{t.get('submitted', 0):>6} "
+            f"{t.get('completed', 0):>6} "
+            f"{t.get('rejected_quota', 0):>5} "
+            f"{t.get('preemptions', 0):>4} "
+            f"{t.get('mesh_share', 0.0):>6.2f} "
+            f"{_fmt_s(t.get('p50_latency_s')):>8} "
+            f"{_fmt_s(t.get('p99_latency_s')):>8} "
+            f"{_fmt_s(t.get('p99_queue_wait_s')):>8}")
+    if sched:
+        lines.append(indent + _kv((
+            ("mode", sched.get("mode")),
+            ("pipeline_depth", sched.get("pipeline_depth")),
+            ("vclock", sched.get("vclock")),
+        )))
+    return lines
+
+
 def _tier_lines(stats: dict, svc: dict, indent: str = "  ") -> list:
     res = stats.get("resilience", {}) or {}
     drift = res.get("tier_observed_drift", {}) or {}
@@ -248,10 +285,19 @@ def render(stats: dict, events: list = None, title: str = "engine",
                 lines.append(f"REPLICA {r.get('replica', '?')} SERVICE")
                 lines.extend(_service_lines(svc))
                 lines.extend(_tier_lines(r, svc))
+                tl = _tenant_lines(svc)
+                if tl:
+                    lines.append(
+                        f"REPLICA {r.get('replica', '?')} TENANTS")
+                    lines.extend(tl)
     else:                                               # service-shaped
         svc = stats.get("service", {}) or {}
         lines.append("SERVICE")
         lines.extend(_service_lines(svc))
+        tl = _tenant_lines(svc, stats)
+        if tl:
+            lines.append("TENANTS")
+            lines.extend(tl)
         lines.append("TIERS")
         lines.extend(_tier_lines(stats, svc))
         lines.append("RESILIENCE")
@@ -299,7 +345,7 @@ def _demo_service():
     the zero-to-console path, also the smoke test's fixture."""
     import numpy as np
     import quest_tpu as qt
-    from quest_tpu.serve import SimulationService
+    from quest_tpu.serve import SimulationService, TenantPolicy
     from quest_tpu.telemetry import profile as _profile
     _profile.configure(sample_rate=1.0, reset=True)
     env = qt.createQuESTEnv(num_devices=1, seed=[11])
@@ -308,11 +354,15 @@ def _demo_service():
     c.cnot(0, 1)
     cc = c.compile(env, pallas="off")
     svc = SimulationService(env, max_batch=8, max_wait_s=1e-3,
-                            trace_sample_rate=1.0)
+                            trace_sample_rate=1.0,
+                            tenants={"ui": TenantPolicy(weight=3.0,
+                                                        priority=0)})
     rng = np.random.default_rng(11)
     ham = ([[(0, 3)], [(1, 3)]], [1.0, 0.5])
     futs = [svc.submit(cc, {"a": float(rng.uniform(0, 6.28))},
-                       observables=ham) for _ in range(8)]
+                       observables=ham,
+                       tenant="ui" if i % 2 else "default")
+            for i in range(8)]
     for f in futs:
         f.result(timeout=60)
     return svc
