@@ -1598,6 +1598,231 @@ def bench_gradients_config(qt, env, platform: str) -> dict:
     return rows[1]
 
 
+def bench_dynamics(qt, env, platform: str) -> list:
+    """One-executable Trotter evolution vs the per-step dispatch loop,
+    SAME workload (ISSUE 18): an open-boundary TFIM Pauli sum evolved
+    from a prepared product state. Two rows in steps/sec (Trotter
+    steps per second, B x steps per run) plus a ground-state
+    time-to-convergence row:
+
+    - **per-step client loop** — per step, one ``evolve(steps=1)``
+      dispatch and one packed read-back, re-submitting the returned
+      planes as the next step's ``init_state`` (the strongest client
+      baseline: it already rides the batched engine's executable
+      cache);
+    - **one-executable evolve** — ``SimulationService.evolve``: the
+      whole step loop runs inside one executable behind ``lax.scan``,
+      per-step energies folded through the device-resident Welford
+      carry, ONE packed transfer per segment — with the final-energy
+      parity against the per-step loop in the row (the segment carve
+      is bit-exact; the acceptance gate is <= 1e-12) and the dense
+      ``expm`` oracle error when the register is small enough to
+      exponentiate;
+    - **ground state** — ``SimulationService.ground_state``
+      imaginary-time power iteration with the device-resident
+      convergence residual: seconds to a converged segment stream.
+    """
+    import jax as _jax
+    num_qubits = int(os.environ.get("QUEST_BENCH_DYN_QUBITS", "10"))
+    steps = int(os.environ.get("QUEST_BENCH_DYN_STEPS", "32"))
+    batch = int(os.environ.get("QUEST_BENCH_DYN_BATCH", "4"))
+    # the parity grade (per-step loop vs fused scan, <= 1e-12) needs
+    # f64 arithmetic — same convention as the gradient rows
+    devices = int(os.environ.get(
+        "QUEST_BENCH_DYN_DEVICES", str(env.num_devices)))
+    x64_was = bool(_jax.config.jax_enable_x64)
+    if not x64_was or devices != env.num_devices:
+        _jax.config.update("jax_enable_x64", True)
+        env = qt.createQuESTEnv(num_devices=devices,
+                                precision=qt.DOUBLE, seed=[2026])
+    try:
+        return _bench_dynamics_body(qt, env, platform, num_qubits,
+                                    steps, batch)
+    finally:
+        if not x64_was:
+            _jax.config.update("jax_enable_x64", False)
+
+
+def _bench_dynamics_body(qt, env, platform, num_qubits, steps,
+                         batch) -> list:
+    from quest_tpu.circuits import Circuit
+    from quest_tpu.ops import dynamics as dyn
+    from quest_tpu.serve import SimulationService
+
+    rng = np.random.default_rng(2026)
+    terms = [[(q_, 3), (q_ + 1, 3)] for q_ in range(num_qubits - 1)]
+    terms += [[(q_, 1)] for q_ in range(num_qubits)]
+    coeffs = np.array([-1.0] * (num_qubits - 1) + [-0.7] * num_qubits)
+    ham = (terms, coeffs)
+    circ = Circuit(num_qubits)
+    for q_ in range(num_qubits):
+        circ.ry(q_, circ.parameter(f"y{q_}"))
+    for q_ in range(num_qubits - 1):
+        circ.cnot(q_, q_ + 1)
+    cc = circ.compile(env, pallas="off")
+    cont = Circuit(num_qubits).compile(env, pallas="off")
+    params = {f"y{q_}": float(v) for q_, v in enumerate(
+        rng.uniform(0.0, np.pi, size=num_qubits))}
+    t_total = 0.8
+    dt = t_total / steps
+    dev_desc = (f"single {platform} chip" if env.num_devices == 1
+                else f"{env.num_devices} {platform} devices")
+    label = (f"tfim-{num_qubits} ({len(terms)} Pauli terms), "
+             f"{steps} Trotter steps x{batch} requests, {dev_desc}")
+
+    svc = SimulationService(env, max_batch=max(8, batch),
+                            max_wait_s=2e-3, request_timeout_s=600.0)
+    try:
+        # warm every executable the comparison hits (prep + identity
+        # continuation at steps=1, and the fused full-segment program)
+        # so the timed runs pay dispatch, not compile
+        one = dyn.EvolveSpec(t=dt, steps=1)
+        row = np.asarray(svc.submit(
+            cc, params, observables=ham, evolve=one).result(
+                timeout=600.0))
+        planes0 = dyn.unpack_evolve_block(
+            row[None, :], num_qubits, 1)["planes"][0]
+        svc.submit(cont, None, observables=ham, evolve=one,
+                   init_state=planes0).result(timeout=600.0)
+
+        def fused_run():
+            # B concurrent evolve handles submitted against a paused
+            # dispatcher, coalesced into ONE fused segment dispatch (B
+            # rows, the step loop folded inside the executable)
+            svc.pause()
+            handles = [svc.evolve(cc, params, hamiltonian=ham,
+                                  t=t_total, steps=steps,
+                                  segment_steps=steps)
+                       for _ in range(batch)]
+            time.sleep(0.25)      # let every handle thread enqueue
+            t0_ = time.perf_counter()
+            svc.resume()
+            res = [h.result(timeout=600.0) for h in handles]
+            return res, time.perf_counter() - t0_
+
+        fused_run()    # warm the fused executable AT the timed bucket
+
+        # per-step client loop: one dispatch + one packed read-back per
+        # step, planes re-submitted as the next step's init_state
+        t0 = time.perf_counter()
+        loop_energy = None
+        for _ in range(batch):
+            planes = None
+            for _k in range(steps):
+                fut = svc.submit(cc if planes is None else cont,
+                                 params if planes is None else None,
+                                 observables=ham, evolve=one,
+                                 init_state=planes)
+                out = dyn.unpack_evolve_block(
+                    np.asarray(fut.result(timeout=600.0))[None, :],
+                    num_qubits, 1)
+                planes = out["planes"][0]
+                loop_energy = float(out["energies"][0, -1])
+        loop_dt = time.perf_counter() - t0
+        loop_rate = batch * steps / loop_dt
+
+        before = svc.metrics.snapshot()
+        results, on_dt = fused_run()
+        after = svc.metrics.snapshot()
+        on_rate = batch * steps / on_dt
+        parity = max(abs(float(r["energy"]) - loop_energy)
+                     for r in results)
+        stats = svc.dispatch_stats()
+
+        oracle = {}
+        if num_qubits <= 12:
+            try:
+                from scipy.linalg import expm
+                pauli = {1: np.array([[0, 1], [1, 0]], complex),
+                         2: np.array([[0, -1j], [1j, 0]], complex),
+                         3: np.array([[1, 0], [0, -1]], complex)}
+                dense = np.zeros((1 << num_qubits,) * 2, complex)
+                for term, c_ in zip(terms, coeffs):
+                    codes = dict(term)
+                    op = np.array([[1.0]], complex)
+                    for q_ in range(num_qubits - 1, -1, -1):
+                        op = np.kron(op, pauli.get(
+                            codes.get(q_, 0), np.eye(2, dtype=complex)))
+                    dense = dense + c_ * op
+                prep = np.asarray(svc.submit(cc, params).result(
+                    timeout=600.0))
+                psi0 = prep[0] + 1j * prep[1]
+                psi_t = expm(-1j * t_total * dense) @ psi0
+                e_oracle = float(np.real(
+                    np.conj(psi_t) @ (dense @ psi_t)))
+                pl = results[0]["planes"]
+                psi_f = pl[0] + 1j * pl[1]
+                oracle = {
+                    "oracle_energy_err": round(
+                        abs(float(results[0]["energy"]) - e_oracle), 9),
+                    "oracle_state_err": round(float(np.max(
+                        np.abs(psi_f - psi_t))), 9),
+                }
+            except Exception as e:
+                oracle = {"oracle_error": f"{type(e).__name__}: {e}"}
+
+        # ground state: imaginary-time power iteration, device-resident
+        # residual, wall time to the converged segment stream
+        t0 = time.perf_counter()
+        gres = svc.ground_state(
+            cc, params, hamiltonian=ham, steps=8, tau=0.15, tol=1e-8,
+            max_segments=32).result(timeout=600.0)
+        ground_dt = time.perf_counter() - t0
+    finally:
+        svc.close()
+
+    seg_transfers = int(after.get("evolve_dispatches", 0)
+                        - before.get("evolve_dispatches", 0))
+    loop_row = {
+        "metric": f"trotter evolution per-step client loop (one "
+                  f"dispatch + read-back per step), {label}",
+        "value": round(loop_rate, 2),
+        "unit": "steps/sec",
+        "vs_baseline": 1.0,
+        "host_syncs": batch * steps,
+    }
+    on_row = {
+        "metric": f"trotter evolution one-executable (lax.scan step "
+                  f"loop inside the executable), {label}",
+        "value": round(on_rate, 2),
+        "unit": "steps/sec",
+        "vs_baseline": round(on_rate / max(loop_rate, 1e-9), 3),
+        "speedup_vs_loop": round(on_rate / max(loop_rate, 1e-9), 3),
+        "energy_parity_vs_loop": round(parity, 15),
+        "parity_failures": int(parity > 1e-12),
+        "segment_dispatches": seg_transfers,
+        "evolve_steps_fused": int(
+            after.get("evolve_steps_fused", 0)
+            - before.get("evolve_steps_fused", 0)),
+        "host_syncs_avoided": int(
+            stats.get("host_syncs_avoided", 0)),
+        "batch_sharding_mode": stats.get("batch_sharding_mode", ""),
+        **oracle,
+    }
+    ground_row = {
+        "metric": f"ground state time-to-convergence (imaginary-time "
+                  f"power iteration, device-resident residual), "
+                  f"{label}",
+        "value": round(ground_dt, 4),
+        "unit": "s",
+        "vs_baseline": 1.0,
+        "segments": int(gres["segments"]),
+        "converged": bool(gres["converged"]),
+        "ground_energy": round(float(gres["energy"]), 9),
+        "residual": float(gres.get("residual", 0.0)),
+    }
+    return [loop_row, on_row, ground_row]
+
+
+def bench_dynamics_config(qt, env, platform: str) -> dict:
+    """Config-list adapter: emit the loop + ground rows, return the
+    headline (one-executable) row."""
+    rows = bench_dynamics(qt, env, platform)
+    emit(rows[0])
+    emit(rows[2])
+    return rows[1]
+
+
 def _bound_hea(num_qubits: int, layers: int, values: dict):
     """build_hea_circuit with the parameters BOUND to static angles —
     the dd-compilable (QUAD-tier) form of the same workload."""
@@ -3309,6 +3534,8 @@ def main() -> None:
         ("sweep", 45, lambda: bench_ensemble_sweep_config(qt, env,
                                                           platform)),
         ("grad", 45, lambda: bench_gradients_config(qt, env, platform)),
+        ("dynamics", 45, lambda: bench_dynamics_config(qt, env,
+                                                       platform)),
         ("tiers", 45, lambda: bench_precision_tiers(qt, env, platform)),
         ("mxu", 45, lambda: bench_mxu_saturation_config(qt, env,
                                                         platform)),

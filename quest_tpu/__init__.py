@@ -53,6 +53,7 @@ from .qureg import Qureg
 from .circuits import Circuit, CompiledCircuit, Param
 from .ops.trajectories import (TrajectoryProgram,
                                DensityMaterialisationError)
+from .ops.dynamics import EvolveSpec, GroundSpec
 from .qasm_import import ParsedQASM, parse_qasm, load_qasm_file
 from .serve import (SimulationService, CoalescePolicy, ServeError,
                     QueueFull, DeadlineExceeded, ServiceClosed,
@@ -60,6 +61,7 @@ from .serve import (SimulationService, CoalescePolicy, ServeError,
                     AllReplicasUnavailable, WarmCache,
                     VariationalProblem, OptimizationHandle,
                     GradientDescent, Adam,
+                    DynamicsProblem, DynamicsHandle,
                     TenantPolicy, WFQScheduler)
 from .resilience import (FaultInjector, FaultSpec, HealthConfig,
                          NumericalFault, ResiliencePolicy,
@@ -92,6 +94,8 @@ __all__ = (
         "AllReplicasUnavailable", "WarmCache",
         "VariationalProblem", "OptimizationHandle", "GradientDescent",
         "Adam", "TenantPolicy", "WFQScheduler",
+        "EvolveSpec", "GroundSpec", "DynamicsProblem",
+        "DynamicsHandle",
         "FaultInjector", "FaultSpec", "HealthConfig", "NumericalFault",
         "ResiliencePolicy", "SupervisorPolicy", "AutoscalePolicy",
         "Tracer", "TraceContext", "metrics_registry",

@@ -2347,6 +2347,7 @@ class CompiledCircuit:
             batch_size=bs.get("batch_size", 0),
             host_syncs_avoided=bs.get("host_syncs_avoided", 0),
             batch_sharding_mode=bs.get("batch_sharding_mode", "none"),
+            evolve_steps_fused=bs.get("evolve_steps_fused", 0),
             batched_cache_size=cache_size,
             batched_cache_evictions=cache_evictions,
             precision_tier=self._tier_token(self.tier),
@@ -2743,14 +2744,19 @@ class CompiledCircuit:
         return pm, B
 
     def _record_batch_stats(self, batch: int, mode: str,
-                            host_syncs_avoided: int) -> None:
+                            host_syncs_avoided: int,
+                            evolve_steps_fused: int = 0) -> None:
         # one atomic dict swap under the stats lock: the serving
         # dispatcher records from its background thread while callers
-        # read dispatch_stats() (satellite: no torn batch accounting)
+        # read dispatch_stats() (satellite: no torn batch accounting).
+        # evolve_steps_fused: Trotter/imaginary-time steps the last
+        # dynamics dispatch iterated INSIDE the executable (batch x
+        # steps) — 0 for every non-dynamics dispatch
         with self._stats_lock:
             self._batch_stats = {"batch_size": batch,
                                  "batch_sharding_mode": mode,
-                                 "host_syncs_avoided": host_syncs_avoided}
+                                 "host_syncs_avoided": host_syncs_avoided,
+                                 "evolve_steps_fused": evolve_steps_fused}
 
     def _place_batch(self, arr, mode: str, amp_shardable: bool = False):
         """Commit a batch-leading array to the policy's input layout so
@@ -2917,6 +2923,285 @@ class CompiledCircuit:
             self._batched_cache[key] = fn
         return fn
 
+    def _evolve_fn(self, mode: str, tier=None, *, steps: int,
+                   order: int):
+        """The batched TROTTER-EVOLUTION executable for one (sharding
+        mode, tier, steps, order): run the state-prep program per row,
+        then iterate ``steps`` Trotter steps of ``exp(-i H dt)``
+        INSIDE the executable (``lax.scan`` over
+        :func:`quest_tpu.ops.dynamics.trotter_step`), reducing the
+        Pauli-sum energy after every step and folding the step energies
+        through the device-resident Welford carry. Masks, coefficients,
+        and ``dt`` are DATA — one executable serves every Hamiltonian
+        of the term bucket at every time step; only the scan length and
+        splitting order are trace constants (part of the cache key).
+        Returns ONE packed ``(B, steps + 3 + 2^{n+1})`` real block per
+        dispatch (:func:`quest_tpu.ops.dynamics.pack_evolve_block`) —
+        the whole segment leaves the device as a single transfer, where
+        a stepping client pays ``steps`` dispatches and transfers per
+        row."""
+        key = ("evolve", int(order), int(steps), mode,
+               str(np.dtype(self.env.precision.real_dtype)),
+               self._tier_token(tier))
+        with self._stats_lock:
+            fn = self._batched_cache.get(key)
+        if fn is not None:
+            return fn
+        from .ops import dynamics as dyn
+        from .ops import reductions as red
+        constrain = self._batch_constraint(mode)
+        run_batched = self._batched_runner(mode, tier)
+        env_rdt = np.dtype(self.env.precision.real_dtype)
+        tier_cdt = self._tier_dtypes(tier, self.env)[1]
+        comp = tier is not None and tier.compensated
+        S = int(steps)
+
+        def evolve(state_f_, pm_, xm_, ym_, zm_, cf_, dt_):
+            z = unpack(state_f_)
+            if z.dtype != tier_cdt:
+                z = z.astype(tier_cdt)
+            states = jnp.broadcast_to(z, (pm_.shape[0],) + z.shape)
+            states = constrain(states)
+            states = run_batched(states, pm_)
+            states = constrain(states)
+
+            def row(zrow):
+                def step(zc, _):
+                    zc = dyn.trotter_step(zc, xm_, ym_, zm_, cf_, dt_,
+                                          order=order)
+                    e = red.pauli_sum_total_sv(zc, xm_, ym_, zm_, cf_,
+                                               compensated=comp)
+                    return zc, e
+                zf, es = jax.lax.scan(step, zrow, None, length=S)
+                es = es.astype(env_rdt)
+                wn, wm, ws = red.welford_wave(
+                    es, jnp.ones((S,), dtype=env_rdt))
+                planes = jnp.stack([jnp.real(zf), jnp.imag(zf)]
+                                   ).astype(env_rdt)
+                return dyn.pack_evolve_block(
+                    es, jnp.stack([wn, wm, ws]), planes)
+
+            return jax.vmap(row)(states)
+
+        from jax.sharding import PartitionSpec as P
+        from .env import AMP_AXIS
+        evolve = self._wrap_batch_spmd(
+            evolve, mode,
+            in_specs=(P(), P(AMP_AXIS, None), P(), P(), P(), P(), P()),
+            out_specs=P(AMP_AXIS, None))
+        fn = jax.jit(evolve)
+        with self._stats_lock:
+            self._batched_cache[key] = fn
+        return fn
+
+    def _ground_fn(self, mode: str, tier=None, *, steps: int,
+                   method: str):
+        """The batched GROUND-STATE executable for one (sharding mode,
+        tier, steps, method). ``method="power"``: ``steps``
+        imaginary-time Trotter iterations
+        (:func:`quest_tpu.ops.dynamics.imag_time_step` — on-device
+        renormalisation every step) with the per-iteration energy
+        recorded and the convergence residual ``|e_S - e_{S-1}|``
+        computed device-side. ``method="lanczos"``: one fixed-``steps``
+        Krylov recursion (:func:`quest_tpu.ops.dynamics.
+        lanczos_ground`) whose residual is the Ritz bound
+        ``beta_m |y_m|``. Either way the dispatch returns ONE packed
+        ``(B, steps + 4 + 2^{n+1})`` real block (energies, residual,
+        Welford carry, final planes) — the serving handle reads the
+        residual from the SAME single transfer that carries the
+        checkpoint planes."""
+        key = ("ground", str(method), int(steps), mode,
+               str(np.dtype(self.env.precision.real_dtype)),
+               self._tier_token(tier))
+        with self._stats_lock:
+            fn = self._batched_cache.get(key)
+        if fn is not None:
+            return fn
+        from .ops import dynamics as dyn
+        from .ops import reductions as red
+        constrain = self._batch_constraint(mode)
+        run_batched = self._batched_runner(mode, tier)
+        env_rdt = np.dtype(self.env.precision.real_dtype)
+        tier_cdt = self._tier_dtypes(tier, self.env)[1]
+        comp = tier is not None and tier.compensated
+        S = int(steps)
+        lanczos = method == "lanczos"
+
+        def ground(state_f_, pm_, xm_, ym_, zm_, cf_, tau_):
+            z = unpack(state_f_)
+            if z.dtype != tier_cdt:
+                z = z.astype(tier_cdt)
+            states = jnp.broadcast_to(z, (pm_.shape[0],) + z.shape)
+            states = constrain(states)
+            states = run_batched(states, pm_)
+            states = constrain(states)
+
+            def row(zrow):
+                if lanczos:
+                    ritz, energy, residual = dyn.lanczos_ground(
+                        zrow, xm_, ym_, zm_, cf_, num_vectors=S)
+                    es = jnp.full((S,), energy).astype(env_rdt)
+                    zf = ritz
+                else:
+                    e0 = red.pauli_sum_total_sv(
+                        zrow, xm_, ym_, zm_, cf_, compensated=comp)
+
+                    def step(zc, _):
+                        zc = dyn.imag_time_step(zc, xm_, ym_, zm_,
+                                                cf_, tau_)
+                        e = red.pauli_sum_total_sv(
+                            zc, xm_, ym_, zm_, cf_, compensated=comp)
+                        return zc, e
+                    zf, es = jax.lax.scan(step, zrow, None, length=S)
+                    es = es.astype(env_rdt)
+                    prev = es[-2] if S >= 2 else e0.astype(env_rdt)
+                    residual = jnp.abs(es[-1] - prev)
+                wn, wm, ws = red.welford_wave(
+                    es, jnp.ones((S,), dtype=env_rdt))
+                planes = jnp.stack([jnp.real(zf), jnp.imag(zf)]
+                                   ).astype(env_rdt)
+                return dyn.pack_ground_block(
+                    es, residual.astype(env_rdt),
+                    jnp.stack([wn, wm, ws]), planes)
+
+            return jax.vmap(row)(states)
+
+        from jax.sharding import PartitionSpec as P
+        from .env import AMP_AXIS
+        ground = self._wrap_batch_spmd(
+            ground, mode,
+            in_specs=(P(), P(AMP_AXIS, None), P(), P(), P(), P(), P()),
+            out_specs=P(AMP_AXIS, None))
+        fn = jax.jit(ground)
+        with self._stats_lock:
+            self._batched_cache[key] = fn
+        return fn
+
+    def _dynamics_dispatch(self, kind: str, param_matrix, hamiltonian,
+                           spec, state_f, tier):
+        """The shared evolve/ground dispatch body: validate, choose the
+        batch policy, build or fetch the keyed executable, run, record
+        the fused-step accounting. Statevector programs only — Trotter
+        rotations act on ket amplitudes; density evolution belongs to
+        the channel machinery."""
+        from .ops import dynamics as dyn
+        if self.is_density:
+            raise ValueError(
+                f"{kind}_sweep runs on statevector-compiled programs "
+                "(Trotter rotations act on ket amplitudes); evolve "
+                "density registers through their channel circuits")
+        tier = self._effective_tier(tier)
+        if tier is not None and tier.name == "quad":
+            raise ValueError(
+                f"{kind}_sweep cannot run at the QUAD tier: the "
+                "double-double walk has no scan-resident Trotter "
+                "form; use tier='double' for the highest rung")
+        nq, T, xm, ym, zm, coeffs = self._pauli_operands(hamiltonian)
+        n = self.num_qubits
+        pm = self._validated_param_matrix(param_matrix)
+        # fault injection for dynamics dispatches happens at the
+        # serving boundary ("serve.evolve" in faults.SITES) — the
+        # circuits layer contributes the profiling span and trace
+        # annotation only
+        sp = _profile.profile_dispatch(f"circuits.{kind}_sweep")
+        B = pm.shape[0]
+        pol = self._batch_policy(B)
+        mode = pol["mode"]
+        pm_run, B = self._padded_params(pm, mode)
+        pm_run = self._place_batch(pm_run, mode)
+        if state_f is None:
+            state_f = jnp.zeros((2, 1 << n),
+                                dtype=self.env.precision.real_dtype
+                                ).at[0, 0].set(1.0)
+        elif getattr(state_f, "shape", None) != (2, 1 << n):
+            raise ValueError(
+                f"{kind}_sweep state_f must be shared (2, {1 << n}) "
+                f"planes; got {getattr(state_f, 'shape', None)}")
+        else:
+            state_f = jnp.asarray(
+                state_f, dtype=self.env.precision.real_dtype)
+        if kind == "evolve":
+            S = int(spec.steps)
+            fn = self._evolve_fn(mode, tier, steps=S,
+                                 order=int(spec.order))
+            knob = jnp.asarray(spec.dt,
+                               dtype=self.env.precision.real_dtype)
+        else:
+            S = int(spec.steps)
+            fn = self._ground_fn(mode, tier, steps=S,
+                                 method=str(spec.method))
+            knob = jnp.asarray(spec.tau,
+                               dtype=self.env.precision.real_dtype)
+        args = (state_f, pm_run, jnp.asarray(xm), jnp.asarray(ym),
+                jnp.asarray(zm),
+                jnp.asarray(coeffs,
+                            dtype=self.env.precision.real_dtype), knob)
+        ann_name = (f"quest_tpu.circuits.{kind}_sweep:"
+                    f"b{pm_run.shape[0]}:t{T}:s{S}:"
+                    f"{tier.name if tier is not None else 'env'}")
+        with dispatch_annotation(ann_name):
+            out = fn(*args)
+        # the stepping client pays one dispatch + one transfer per
+        # step per row; the fused loop returns the segment as ONE
+        # block — S*B transfers collapse to 1
+        self._record_batch_stats(B, mode, B * S - 1,
+                                 evolve_steps_fused=B * S)
+        if sp is not None:
+            sp.done(out, program=self.program_digest, kind=kind,
+                    bucket=pm_run.shape[0],
+                    tier=self._tier_token(tier),
+                    dtype=str(np.dtype(self.env.precision.real_dtype)),
+                    sharding=mode,
+                    # every Trotter step re-streams the planes once per
+                    # term sweep (order 2 sweeps twice), plus the prep
+                    # program's own passes
+                    bytes_per_pass=self._bytes_per_pass(
+                        pm_run.shape[0], terms=T * S),
+                    models=self._drift_models(mode, pm_run.shape[0],
+                                              pol))
+        return out[:B] if out.shape[0] != B else out
+
+    def evolve_sweep(self, param_matrix, hamiltonian, spec,
+                     state_f=None, tier=None):
+        """Trotterised ``exp(-i H t)`` for a whole parameter batch from
+        ONE executable and ONE device->host transfer.
+
+        Each row runs the compiled program from ``state_f`` (default
+        |0..0>; the state-prep circuit), then ``spec.steps`` Trotter
+        steps of order ``spec.order`` iterate INSIDE the executable
+        (``lax.scan`` — no per-step dispatch), with the Pauli-sum
+        energy reduced after every step. ``hamiltonian``:
+        ``(pauli_terms, coeffs)`` exactly as :meth:`expectation_sweep`;
+        ``spec``: an :class:`~quest_tpu.ops.dynamics.EvolveSpec`.
+
+        Returns the packed ``(B, steps + 3 + 2^{n+1})`` real block —
+        per-step energies, the folded Welford carry, and the final
+        state planes; decode with :func:`quest_tpu.ops.dynamics.
+        unpack_evolve_block` (the serving layer materialises the block
+        with ONE transfer per checkpointed segment)."""
+        from .ops.dynamics import EvolveSpec
+        if not isinstance(spec, EvolveSpec):
+            raise TypeError("spec must be an EvolveSpec")
+        return self._dynamics_dispatch("evolve", param_matrix,
+                                       hamiltonian, spec, state_f, tier)
+
+    def ground_sweep(self, param_matrix, hamiltonian, spec,
+                     state_f=None, tier=None):
+        """One imaginary-time (or Lanczos) ground-state SEGMENT for a
+        whole parameter batch: ``spec.steps`` on-device iterations with
+        per-iteration energies and a device-resident convergence
+        residual, as one packed ``(B, steps + 4 + 2^{n+1})`` block
+        (:func:`quest_tpu.ops.dynamics.unpack_ground_block`). ``spec``:
+        a :class:`~quest_tpu.ops.dynamics.GroundSpec`. The serving
+        layer (``SimulationService.ground_state``) chains segments —
+        each segment's output planes seed the next via ``state_f`` —
+        and stops when the residual crosses ``spec.tol``."""
+        from .ops.dynamics import GroundSpec
+        if not isinstance(spec, GroundSpec):
+            raise TypeError("spec must be a GroundSpec")
+        return self._dynamics_dispatch("ground", param_matrix,
+                                       hamiltonian, spec, state_f, tier)
+
     # -- warm-start AOT hooks (serve/warmcache.py) -------------------------
 
     def _warm_form_key(self, kind: str, mode: str, tier=None) -> tuple:
@@ -2936,6 +3221,8 @@ class CompiledCircuit:
             return ("sweep", True, False, mode, dtstr, tok)
         if kind == "energy":
             return ("energy", mode, dtstr, tok)
+        if kind == "grad":
+            return ("grad", mode, dtstr, tok)
         raise ValueError(f"unknown warm form kind {kind!r}")
 
     @staticmethod
@@ -2968,8 +3255,10 @@ class CompiledCircuit:
                       lower: bool = True, tier=None):
         """Lower (no compile, no execution) the batched executable one
         warm form would run: ``kind`` is ``"sweep"`` (broadcast start
-        state — the serving dispatcher's state/sample form) or
-        ``"energy"``. Returns ``(form, args_shapes, lowered)`` ready for
+        state — the serving dispatcher's state/sample form),
+        ``"energy"``, or ``"grad"`` (the value-and-grad block — so
+        gradient-heavy tenants restart warm too). Returns
+        ``(form, args_shapes, lowered)`` ready for
         ``lowered.compile()`` + :meth:`install_batched_aot` — the warm
         cache serializes the compiled artifact so a restarted replica
         LOADS it instead of recompiling. ``lower=False`` computes only
@@ -3007,6 +3296,24 @@ class CompiledCircuit:
                     jax.ShapeDtypeStruct(zm.shape, zm.dtype),
                     jax.ShapeDtypeStruct(cf.shape, cf.dtype))
             fn_builder = lambda: self._energy_fn(mode, tier)
+        elif kind == "grad":
+            if hamiltonian is None:
+                raise ValueError("kind='grad' needs hamiltonian=")
+            if not self.param_names:
+                raise ValueError(
+                    "kind='grad' needs a parameterised circuit (no "
+                    "Param placeholders declared)")
+            tier = self._grad_tier(tier)
+            _, _, xm, ym, zm, coeffs = self._pauli_operands(hamiltonian)
+            xm, ym, zm = jnp.asarray(xm), jnp.asarray(ym), jnp.asarray(zm)
+            cf = jnp.asarray(coeffs, dtype=dt)
+            form = self._warm_form_key("grad", mode, tier)
+            args = (state, pm,
+                    jax.ShapeDtypeStruct(xm.shape, xm.dtype),
+                    jax.ShapeDtypeStruct(ym.shape, ym.dtype),
+                    jax.ShapeDtypeStruct(zm.shape, zm.dtype),
+                    jax.ShapeDtypeStruct(cf.shape, cf.dtype))
+            fn_builder = lambda: self._grad_fn(mode, tier)
         else:
             raise ValueError(f"unknown warm form kind {kind!r}")
         shapes = tuple(a.shape for a in args)
@@ -3281,8 +3588,18 @@ class CompiledCircuit:
         ann_name = (f"quest_tpu.circuits.grad_sweep:"
                     f"b{pm_run.shape[0]}:t{T}:"
                     f"{tier.name if tier is not None else 'env'}")
-        with dispatch_annotation(ann_name):
-            out = fn(*args)
+        aot = self._aot_lookup(self._warm_form_key("grad", mode, tier),
+                               args)
+        out = None
+        if aot is not None:
+            try:
+                with dispatch_annotation(ann_name):
+                    out = aot(*args)
+            except (TypeError, ValueError):
+                out = None     # layout/placement drift: retrace via jit
+        if out is None:
+            with dispatch_annotation(ann_name):
+                out = fn(*args)
         # the parameter-shift client pays (2P+1) energy dispatches per
         # row, each >= 1 transfer; the engine's whole (B, P) gradient
         # sweep is one (B, P+1) block

@@ -1,0 +1,339 @@
+"""Device-resident Hamiltonian dynamics kernels (ISSUE 18).
+
+Trotterised real-time evolution and imaginary-time / Lanczos
+ground-state search as PURE traceable step kernels over the Pauli-sum
+bit-mask machinery (:mod:`quest_tpu.ops.reductions`):
+
+- a Pauli string is three integer masks; ``exp(-i theta P)`` is the
+  exact two-term rotation ``cos(theta) z - i sin(theta) (P z)``
+  (``P^2 = I``), one xor-gather pass per term
+  (:func:`~quest_tpu.ops.reductions.pauli_apply_sv`);
+- a first-order Trotter step is one ascending ``lax.scan`` over the
+  term masks; a second-order (Strang) step is a half-angle forward
+  sweep followed by a half-angle REVERSE sweep
+  (``lax.scan(..., reverse=True)``) — the mirror symmetry that buys
+  the O(dt^2) -> O(dt^3) local error;
+- imaginary time replaces the rotation with the exact hyperbolic form
+  ``cosh(tau c) z - sinh(tau c) (P z)`` plus on-device
+  renormalisation — power iteration toward the ground state;
+- :func:`lanczos_ground` is the Krylov option: a fixed-m on-device
+  Lanczos recursion (H·v through
+  :func:`~quest_tpu.ops.reductions.pauli_sum_apply_sv`), an ``(m, m)``
+  tridiagonal ``jnp.linalg.eigh``, and the Ritz vector — with the
+  residual bound ``beta_m |y_m|`` as a device-resident convergence
+  signal.
+
+Masks and coefficients are DATA (traced arguments), never trace
+constants: one compiled executable serves every Hamiltonian of a given
+term bucket, exactly like the energy executables. Zero-coefficient
+identity padding terms (:func:`~quest_tpu.ops.reductions.
+pauli_term_bucket`) are EXACT no-ops in every kernel here
+(``cos(0) = cosh(0) = 1``, ``sin(0) = sinh(0) = 0``).
+
+The batched, serving-facing executables live in
+:meth:`quest_tpu.circuits.CompiledCircuit.evolve_sweep` /
+``ground_sweep`` — they run these kernels inside ``lax.scan`` step
+loops and return ONE packed real block per request batch (energies +
+Welford carry + final planes), so a whole checkpointed segment costs a
+single device->host transfer. The pack/unpack layout helpers are
+defined HERE, one definition for the engine and the serving fan-out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from . import reductions as red
+
+__all__ = ["EvolveSpec", "GroundSpec", "trotter_sweep", "trotter_step",
+           "imag_time_step", "lanczos_ground", "evolve_block_width",
+           "ground_block_width", "pack_evolve_block",
+           "unpack_evolve_block", "pack_ground_block",
+           "unpack_ground_block"]
+
+
+# ---------------------------------------------------------------------------
+# request contracts (the serving layer's coalescing / digest payloads)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EvolveSpec:
+    """One real-time evolution contract: evolve by ``exp(-i H t)`` in
+    ``steps`` Trotter steps of order ``order`` (1 or 2), recording the
+    Pauli-sum energy after every step. ``dt = t / steps`` is the data
+    the executable sees; ``(steps, order)`` are static (part of the
+    executable cache key — the scan length is a trace constant)."""
+
+    t: float
+    steps: int
+    order: int = 2
+
+    def __post_init__(self):
+        if self.steps < 1:
+            raise ValueError("steps must be >= 1")
+        if self.order not in (1, 2):
+            raise ValueError("Trotter order must be 1 or 2")
+        if not np.isfinite(self.t):
+            raise ValueError("evolution time must be finite")
+
+    @property
+    def dt(self) -> float:
+        # quest: allow-host-sync(spec fields are plain Python floats —
+        # dataclass arithmetic, never a device value)
+        return float(self.t) / float(self.steps)
+
+    def contract(self) -> tuple:
+        """The hashable convergence-contract tail of a coalesce key:
+        requests sharing a compiled program AND this contract batch
+        into one fused step loop."""
+        # quest: allow-host-sync(hashable key from plain Python
+        # dataclass fields, never a device value)
+        return (float(self.t), int(self.steps), int(self.order))
+
+
+@dataclasses.dataclass(frozen=True)
+class GroundSpec:
+    """One ground-state search contract. ``method`` is ``"power"``
+    (imaginary-time Trotter power iteration, ``steps`` iterations per
+    segment at time-step ``tau``) or ``"lanczos"`` (a fixed-``steps``
+    Krylov recursion — ``tau`` unused). ``tol`` is the convergence
+    residual the serving handle stops at: per-segment energy drift for
+    power iteration, the ``beta_m |y_m|`` Ritz bound for Lanczos."""
+
+    steps: int = 16
+    tau: float = 0.1
+    method: str = "power"
+    tol: float = 1e-9
+
+    def __post_init__(self):
+        if self.steps < 1:
+            raise ValueError("steps must be >= 1")
+        if self.method not in ("power", "lanczos"):
+            raise ValueError("method must be 'power' or 'lanczos'")
+        if not (self.tau > 0.0 and np.isfinite(self.tau)):
+            raise ValueError("tau must be finite and > 0")
+        if not (self.tol >= 0.0):
+            raise ValueError("tol must be >= 0")
+
+    def contract(self) -> tuple:
+        # quest: allow-host-sync(hashable key from plain Python
+        # dataclass fields, never a device value)
+        tau, tol = float(self.tau), float(self.tol)
+        return (int(self.steps), tau, str(self.method), tol)
+
+
+# ---------------------------------------------------------------------------
+# step kernels (traceable; masks/coefficients/angles are data)
+# ---------------------------------------------------------------------------
+
+
+def trotter_sweep(z, xmask, ymask, zmask, coeffs, theta, reverse=False):
+    """One ordered product sweep ``prod_t exp(-i theta c_t P_t) |z>``
+    (ascending term order; ``reverse=True`` descends — the mirror half
+    of a Strang step). Each term is the exact Pauli rotation
+    ``cos(a) z - i sin(a) (P z)`` with ``a = theta * c_t`` (``P^2 = I``
+    makes the two-term form exact, not an approximation): one
+    xor-gather pass, no per-qubit gate loop. Zero-coefficient padding
+    terms are exact identities."""
+    rdt = jnp.real(z).dtype
+
+    def body(state, operands):
+        xm, ym, zm, c = operands
+        a = (jnp.asarray(theta, rdt) * c.astype(rdt))
+        pz = red.pauli_apply_sv(state, xm, ym, zm)
+        ca, sa = jnp.cos(a), jnp.sin(a)
+        return state * ca.astype(state.dtype) \
+            + pz * lax.complex(jnp.zeros_like(sa), -sa).astype(state.dtype), \
+            None
+
+    z, _ = lax.scan(body, z,
+                    (jnp.asarray(xmask), jnp.asarray(ymask),
+                     jnp.asarray(zmask), jnp.asarray(coeffs)),
+                    reverse=bool(reverse))
+    return z
+
+
+def trotter_step(z, xmask, ymask, zmask, coeffs, dt, order: int = 2):
+    """One Trotter step of ``exp(-i H dt)``. ``order=1`` is the plain
+    ascending sweep at full ``dt`` (local error O(dt^2)); ``order=2``
+    is the Strang splitting — a half-``dt`` forward sweep mirrored by a
+    half-``dt`` reverse sweep (local error O(dt^3), global O(t dt^2)).
+    ``order`` is static; ``dt`` is data."""
+    if order == 1:
+        return trotter_sweep(z, xmask, ymask, zmask, coeffs, dt)
+    if order != 2:
+        raise ValueError("Trotter order must be 1 or 2")
+    half = jnp.asarray(dt) * 0.5
+    z = trotter_sweep(z, xmask, ymask, zmask, coeffs, half)
+    return trotter_sweep(z, xmask, ymask, zmask, coeffs, half,
+                         reverse=True)
+
+
+def imag_time_step(z, xmask, ymask, zmask, coeffs, tau):
+    """One imaginary-time Trotter step ``~ exp(-tau H) |z>``, followed
+    by on-device renormalisation: per term the exact hyperbolic form
+    ``cosh(a) z - sinh(a) (P z)`` with ``a = tau * c_t`` (again
+    ``P^2 = I``). Repeated application is power iteration toward the
+    dominant eigenvector of ``exp(-tau H)`` — the ground state of
+    ``H`` — with the norm renormalised every step so the iterate never
+    under/overflows."""
+    rdt = jnp.real(z).dtype
+
+    def body(state, operands):
+        xm, ym, zm, c = operands
+        a = (jnp.asarray(tau, rdt) * c.astype(rdt))
+        pz = red.pauli_apply_sv(state, xm, ym, zm)
+        return state * jnp.cosh(a).astype(state.dtype) \
+            - pz * jnp.sinh(a).astype(state.dtype), None
+
+    z, _ = lax.scan(body, z,
+                    (jnp.asarray(xmask), jnp.asarray(ymask),
+                     jnp.asarray(zmask), jnp.asarray(coeffs)))
+    norm = jnp.sqrt(jnp.sum(jnp.real(z) ** 2 + jnp.imag(z) ** 2))
+    return z / jnp.maximum(norm, jnp.asarray(1e-300, rdt)).astype(z.dtype)
+
+
+def lanczos_ground(z, xmask, ymask, zmask, coeffs, num_vectors: int = 24):
+    """Fixed-``num_vectors`` Lanczos recursion toward the ground state,
+    entirely on device: Krylov basis by the three-term recurrence
+    (``H v`` through :func:`~quest_tpu.ops.reductions.
+    pauli_sum_apply_sv`), an ``(m, m)`` tridiagonal ``jnp.linalg.eigh``
+    (a tiny host-free dense solve), and the Ritz vector of the lowest
+    Ritz value. Returns ``(ritz_vector, energy, residual)`` with
+    ``residual = |beta_m * y_m|`` — the classical Lanczos bound on
+    ``||H x - E x||``, a device-resident convergence signal the serving
+    handle reads WITHOUT materialising the state.
+
+    An exhausted Krylov space (breakdown: ``beta ~ 0`` — e.g. the start
+    vector already an eigenvector) zeroes the remaining basis vectors
+    and pins their diagonal entries far ABOVE the spectrum, so the
+    spurious decoupled block can never pose as the minimum Ritz
+    value."""
+    if num_vectors < 2:
+        raise ValueError("lanczos needs num_vectors >= 2")
+    rdt = jnp.real(z).dtype
+    cutoff = jnp.asarray(1e-12, rdt)
+    xm, ym, zm = (jnp.asarray(m) for m in (xmask, ymask, zmask))
+    cf = jnp.asarray(coeffs)
+
+    def _norm(v):
+        return jnp.sqrt(jnp.sum(jnp.real(v) ** 2 + jnp.imag(v) ** 2))
+
+    n0 = _norm(z)
+    v0 = z / jnp.maximum(n0, jnp.asarray(1e-300, rdt)).astype(z.dtype)
+
+    def body(carry, _):
+        v_prev, v_cur, beta_prev, alive = carry
+        w = red.pauli_sum_apply_sv(v_cur, xm, ym, zm, cf)
+        w = w - beta_prev.astype(z.dtype) * v_prev
+        alpha = jnp.sum(jnp.real(jnp.conj(v_cur) * w))
+        w = w - alpha.astype(z.dtype) * v_cur
+        beta = _norm(w)
+        ok = alive & (beta > cutoff)
+        v_next = jnp.where(
+            ok, w / jnp.maximum(beta, cutoff).astype(z.dtype),
+            jnp.zeros_like(w))
+        beta_out = jnp.where(ok, beta, jnp.zeros_like(beta))
+        return (v_cur, v_next, beta_out, ok), \
+            (v_cur, alpha, beta_out, alive)
+
+    init = (jnp.zeros_like(v0), v0, jnp.zeros((), rdt),
+            jnp.asarray(True))
+    _, (basis, alphas, betas, alive) = lax.scan(
+        body, init, None, length=int(num_vectors))
+    # dead steps sit far above any physical coefficient scale: the
+    # eigensolver's minimum can only come from the live block
+    shift = (jnp.sum(jnp.abs(cf)).astype(rdt) + 1.0) * 1e6
+    diag = jnp.where(alive, alphas, shift)
+    tri = jnp.diag(diag) + jnp.diag(betas[:-1], 1) + jnp.diag(betas[:-1], -1)
+    evals, evecs = jnp.linalg.eigh(tri)
+    y = evecs[:, 0]
+    ritz = jnp.sum(y.astype(z.dtype)[:, None] * basis, axis=0)
+    rn = _norm(ritz)
+    ritz = ritz / jnp.maximum(rn, jnp.asarray(1e-300, rdt)).astype(z.dtype)
+    residual = jnp.abs(betas[-1] * y[-1])
+    return ritz, evals[0], residual
+
+
+# ---------------------------------------------------------------------------
+# packed segment blocks (the one-transfer-per-segment contract)
+# ---------------------------------------------------------------------------
+#
+# An evolve/ground executable returns its WHOLE segment as one flat real
+# row per request: the per-step energies, the device-folded Welford
+# (count, mean, M2) carry over those energies, [ground only: the
+# convergence residual,] and the final packed state planes. ONE layout
+# definition here keeps the engine's pack and the serving layer's
+# unpack from desynchronising — a drifted offset would hand callers
+# amplitudes as energies.
+
+
+def evolve_block_width(num_qubits: int, steps: int) -> int:
+    """Flat row width of one packed evolve segment: ``steps`` energies
+    + 3 Welford components + ``2 * 2^n`` plane entries."""
+    return int(steps) + 3 + (1 << (int(num_qubits) + 1))
+
+
+def ground_block_width(num_qubits: int, steps: int) -> int:
+    """Evolve width + 1 (the convergence residual column)."""
+    return evolve_block_width(num_qubits, steps) + 1
+
+
+def pack_evolve_block(energies, welford, planes):
+    """``(S,)`` energies + ``(3,)`` Welford + ``(2, 2^n)`` planes ->
+    one flat real row (traceable; the executable's return value)."""
+    rdt = planes.dtype
+    return jnp.concatenate([energies.astype(rdt), welford.astype(rdt),
+                            planes.reshape(-1)])
+
+
+def unpack_evolve_block(block, num_qubits: int, steps: int):
+    """Inverse of :func:`pack_evolve_block` over a leading batch axis:
+    ``(B, W)`` -> dict of ``energies (B, S)``, ``welford (B, 3)``,
+    ``planes (B, 2, 2^n)`` (host numpy in, host numpy out)."""
+    # quest: allow-host-sync(host-side unpack by contract: the caller
+    # already paid the segment's ONE device->host transfer)
+    block = np.asarray(block)
+    S = int(steps)
+    if block.ndim != 2 or block.shape[1] != evolve_block_width(
+            num_qubits, S):
+        raise ValueError(
+            f"packed evolve block must be (B, "
+            f"{evolve_block_width(num_qubits, S)}); got {block.shape}")
+    return {"energies": block[:, :S],
+            "welford": block[:, S:S + 3],
+            "planes": block[:, S + 3:].reshape(
+                block.shape[0], 2, 1 << int(num_qubits))}
+
+
+def pack_ground_block(energies, residual, welford, planes):
+    """Ground variant: the residual column sits between the energies
+    and the Welford carry."""
+    rdt = planes.dtype
+    return jnp.concatenate([energies.astype(rdt),
+                            jnp.reshape(residual, (1,)).astype(rdt),
+                            welford.astype(rdt), planes.reshape(-1)])
+
+
+def unpack_ground_block(block, num_qubits: int, steps: int):
+    """``(B, W)`` -> dict of ``energies (B, S)``, ``residual (B,)``,
+    ``welford (B, 3)``, ``planes (B, 2, 2^n)``."""
+    # quest: allow-host-sync(host-side unpack by contract: the caller
+    # already paid the segment's ONE device->host transfer)
+    block = np.asarray(block)
+    S = int(steps)
+    if block.ndim != 2 or block.shape[1] != ground_block_width(
+            num_qubits, S):
+        raise ValueError(
+            f"packed ground block must be (B, "
+            f"{ground_block_width(num_qubits, S)}); got {block.shape}")
+    return {"energies": block[:, :S],
+            "residual": block[:, S],
+            "welford": block[:, S + 1:S + 4],
+            "planes": block[:, S + 4:].reshape(
+                block.shape[0], 2, 1 << int(num_qubits))}

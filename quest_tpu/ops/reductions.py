@@ -40,7 +40,8 @@ __all__ = ["sum_compensated", "sum_pair", "dot_pair", "vdot_pair",
            "vdot_compensated", "pauli_masks", "pauli_term_bucket",
            "pauli_sum_operands", "pauli_sum_expvals_sv",
            "pauli_sum_expvals_dm", "pauli_sum_total_sv",
-           "pauli_sum_total_dm", "welford_wave", "welford_merge",
+           "pauli_sum_total_dm", "pauli_apply_sv", "pauli_sum_apply_sv",
+           "welford_wave", "welford_merge",
            "welford_stderr", "score_surrogate"]
 
 
@@ -261,6 +262,50 @@ def pauli_sum_expvals_dm(flat, num_qubits: int, xmask, ymask, zmask,
     return lax.map(one, (xmask, ymask, zmask))
 
 
+def pauli_apply_sv(z, xmask, ymask, zmask):
+    """``P|z>`` for ONE Pauli string given as scalar bit masks: the same
+    xor-gather + sign + ``i^|y|`` convention as
+    :func:`pauli_sum_expvals_sv` (one definition of the mask action —
+    the expectation of the applied state reproduces the reduction's
+    value bit for bit), but returning the full transformed statevector
+    instead of the scalar. One gather pass, no per-qubit gate loop —
+    the Trotter-step kernel (:mod:`quest_tpu.ops.dynamics`) composes
+    ``exp(-i theta P)`` from this plus the identity. Masks are DATA
+    (traced scalars), so one compiled step serves every Hamiltonian of
+    a given term bucket."""
+    idx = jnp.arange(z.shape[0])
+    rdtype = jnp.real(z).dtype
+    xm, ym, zm = (jnp.asarray(m).astype(idx.dtype)
+                  for m in (xmask, ymask, zmask))
+    j = idx ^ (xm | ym)
+    # (P z)[k] = i^|y| (-1)^{popcount(j & (y|z))} z[j] with
+    # j = k ^ (x|y) — the source basis state carries the Z/Y parity,
+    # the same ``j``-side popcount the expvals kernel takes, so
+    # <z|pauli_apply_sv(z)> == pauli_sum_expvals_sv bit for bit
+    sign = (1 - 2 * (lax.population_count(j & (ym | zm)) & 1)
+            ).astype(rdtype)
+    wr, wi = _phase_weight(ym, rdtype)
+    return z[j] * sign * lax.complex(wr, wi).astype(z.dtype)
+
+
+def pauli_sum_apply_sv(z, xmask, ymask, zmask, coeffs):
+    """``H|z> = sum_t coeffs[t] * P_t|z>`` — one xor-gather pass per
+    term through a ``lax.scan`` accumulator (sequential, compile time
+    O(1) in the term count; masks are data). The Lanczos ground-state
+    kernel's matrix-vector product."""
+
+    def body(acc, operands):
+        xm, ym, zm, c = operands
+        return acc + c.astype(jnp.real(z).dtype) * pauli_apply_sv(
+            z, xm, ym, zm), None
+
+    init = jnp.zeros_like(z)
+    acc, _ = lax.scan(body, init,
+                      (jnp.asarray(xmask), jnp.asarray(ymask),
+                       jnp.asarray(zmask), jnp.asarray(coeffs)))
+    return acc
+
+
 def pauli_sum_total_sv(z, xmask, ymask, zmask, coeffs,
                        compensated: bool = False):
     """sum_t coeffs[t] * <z|P_t|z> (real scalar, device-resident)."""
@@ -319,9 +364,10 @@ def welford_merge(a, b):
     return n, mean, m2
 
 
-def score_surrogate(value, logq):
+def score_surrogate(value, logq, baseline=0.0):
     """The differentiation surrogate for a stochastic-trajectory
-    estimator: ``value + stop_grad(value) * (logq - stop_grad(logq))``.
+    estimator: ``value + (stop_grad(value) - stop_grad(baseline)) *
+    (logq - stop_grad(logq))``.
 
     A trajectory's value ``v_j(theta)`` is drawn with a
     parameter-dependent measure ``p_j(theta)`` (the Kraus draw
@@ -334,9 +380,18 @@ def score_surrogate(value, logq):
     total derivative, so the trajectory-gradient mean converges to the
     density-path gradient at the usual O(1/sqrt(T)). ``logq`` is the
     accumulated log-probability of every channel draw the trajectory
-    took (normalised per channel)."""
+    took (normalised per channel).
+
+    ``baseline`` is the standard REINFORCE variance-reduction control
+    variate: any value independent of THIS draw (the gradient wave
+    loop passes the running mean of earlier waves) leaves the
+    expectation of the score term unchanged — ``E[b * dlogp] = b *
+    d(sum_j p_j) = 0`` — while centring the ``v_j`` weights, which
+    shrinks the score term's variance roughly by ``Var[v - b] /
+    Var[v]``. Always wrapped in ``stop_gradient``: the baseline must
+    never contribute a pathwise derivative of its own."""
     sg = lax.stop_gradient
-    return value + sg(value) * (logq - sg(logq))
+    return value + (sg(value) - sg(baseline)) * (logq - sg(logq))
 
 
 def welford_stderr(n, m2):

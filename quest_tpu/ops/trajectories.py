@@ -711,21 +711,31 @@ class TrajectoryProgram:
                 B = pm.shape[0]
                 W = flat_keys.shape[0] // B
                 flat_pv = jnp.repeat(pm, W, axis=0)
+                # REINFORCE baseline: the running mean VALUE of each
+                # row's earlier waves (carry mean column 0; zero on the
+                # first wave, where count is 0 and the mean row is the
+                # init zeros). Independent of this wave's draws, so the
+                # score term stays unbiased while its v-weights centre
+                # — the variance-reduction satellite of ISSUE 18.
+                flat_bl = jnp.repeat(
+                    jax.lax.stop_gradient(carry[1][:, 0]), W)
 
-                def one(k, vec):
+                def one(k, vec, bl):
                     def surrogate(v):
                         psi, logq = self._apply_core_lp(state_f, k, v)
                         val = red.pauli_sum_total_sv(psi, xm, ym, zm,
                                                      cf)
                         return red.score_surrogate(
-                            val, logq.astype(val.dtype)), val
+                            val, logq.astype(val.dtype),
+                            baseline=bl.astype(val.dtype)), val
 
                     (_, val), g = jax.value_and_grad(
                         surrogate, has_aux=True)(vec)
                     return jnp.concatenate(
                         [jnp.reshape(val, (1,)).astype(g.dtype), g])
 
-                vals = jax.vmap(one)(flat_keys, flat_pv)  # (B*W, P+1)
+                vals = jax.vmap(one)(flat_keys, flat_pv,
+                                     flat_bl)  # (B*W, P+1)
                 vals = constrain(vals)
                 C = vals.shape[1]
                 vals = vals.reshape(B, W, C).transpose(0, 2, 1)

@@ -650,6 +650,10 @@ class DispatchStats:
     batch_size: int = 0              # points in the last batched run
     host_syncs_avoided: int = 0      # device->host transfers vs per-point
     batch_sharding_mode: str = "none"  # "none" | "batch" | "amp"
+    # device-resident dynamics accounting (evolve_sweep/ground_sweep):
+    # Trotter/imaginary-time steps the last dynamics dispatch iterated
+    # inside ONE executable (batch x steps; 0 for non-dynamics runs)
+    evolve_steps_fused: int = 0
     # keyed executable cache accounting (serving workloads cycle
     # (form, donation, mode, dtype, tier) keys; the cache is LRU-bounded
     # — QUEST_TPU_BATCH_CACHE — so long-lived services can't pin one
@@ -697,6 +701,7 @@ class DispatchStats:
                 "batch_size": self.batch_size,
                 "host_syncs_avoided": self.host_syncs_avoided,
                 "batch_sharding_mode": self.batch_sharding_mode,
+                "evolve_steps_fused": self.evolve_steps_fused,
                 "batched_cache_size": self.batched_cache_size,
                 "batched_cache_evictions": self.batched_cache_evictions,
                 "precision_tier": self.precision_tier,

@@ -70,6 +70,7 @@ SITES = (
     "pergate.relayout",            # imperative relayout exchange
     "serve.execute",               # serving dispatcher batch execution
     "serve.optimize",              # optimizer-in-the-loop iterate step
+    "serve.evolve",                # Hamiltonian-dynamics segment dispatch
     "serve.preempt",               # checkpointed-run mesh yield boundary
     "serve.scale",                 # autoscaler replica-pool resize
     "router.route",                # ServiceRouter placement decision

@@ -38,7 +38,8 @@ from .health import HealthConfig, check_planes, bad_plane_rows, NumericalFault
 from .recovery import classify, FATAL
 
 __all__ = ["split_circuit", "checkpointed_run", "checkpointed_sweep",
-           "opt_progress_save", "opt_progress_load"]
+           "opt_progress_save", "opt_progress_load",
+           "dyn_progress_save", "dyn_progress_load"]
 
 
 def split_circuit(circuit, num_segments: int) -> list:
@@ -180,6 +181,59 @@ def opt_progress_load(path: str, digest: str) -> Optional[dict]:
                    "opt_state": {k[len("opt_"):]: np.asarray(f[k])
                                  for k in f.files
                                  if k.startswith("opt_")}}
+        return out
+    # quest: allow-broad-except(torn-archive boundary: a corrupt
+    # progress file must mean "start clean", never a crash)
+    except Exception:
+        return None
+
+
+def dyn_progress_save(path: str, *, digest: str, segment: int,
+                      planes: np.ndarray, energies: np.ndarray,
+                      welford: np.ndarray,
+                      residual: Optional[float] = None) -> None:
+    """Atomically persist one completed Hamiltonian-dynamics SEGMENT
+    (an ``evolve``/``ground_state`` run's checkpoint boundary): the
+    segment index, the packed ``(2, 2^n)`` state planes the next
+    segment seeds from, the per-step energies accumulated so far, the
+    pooled Welford ``(count, mean, M2)`` carry, and (ground runs) the
+    last device-computed convergence residual. The planes ARE the
+    resume state — a run killed mid-segment restarts bit-exactly from
+    here, because segment boundaries are the only host-visible points
+    of the whole evolution."""
+    from .. import checkpoint as ckpt
+    arrays = {"digest": np.asarray(digest),
+              "segment": np.asarray(int(segment)),
+              "planes": np.ascontiguousarray(planes, dtype=np.float64),
+              "energies": np.ascontiguousarray(energies,
+                                               dtype=np.float64),
+              "welford": np.ascontiguousarray(welford,
+                                              dtype=np.float64)}
+    if residual is not None:
+        arrays["residual"] = np.asarray(float(residual))
+    ckpt.atomic_savez(path, **arrays)
+
+
+def dyn_progress_load(path: str, digest: str) -> Optional[dict]:
+    """Read a saved dynamics segment back, or None when the file is
+    missing, torn, or belongs to a different run (digest mismatch — a
+    different Hamiltonian, spec contract, start state, or tier must
+    start clean, never continue someone else's trajectory). Returns
+    ``{"segment", "planes", "energies", "welford", "residual"}``."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as f:
+            if str(f["digest"]) != digest:
+                return None
+            out = {"segment": int(f["segment"]),
+                   "planes": np.asarray(f["planes"], dtype=np.float64),
+                   "energies": np.asarray(f["energies"],
+                                          dtype=np.float64),
+                   "welford": np.asarray(f["welford"],
+                                         dtype=np.float64),
+                   "residual": (float(f["residual"])
+                                if "residual" in f.files else None)}
         return out
     # quest: allow-broad-except(torn-archive boundary: a corrupt
     # progress file must mean "start clean", never a crash)

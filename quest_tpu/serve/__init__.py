@@ -17,6 +17,7 @@ model.
 
 from .coalesce import (CoalescePolicy, batch_bucket, coalesce_key,
                        plan_schedule, split_ready)
+from .dynamics import DynamicsHandle, DynamicsProblem, run_dynamics
 from .engine import (CircuitBreakerOpen, DeadlineExceeded, QueueFull,
                      QuotaExceeded, ServeError, ServiceClosed,
                      SimulationService)
@@ -41,4 +42,5 @@ __all__ = [
     "RouterMetrics", "WarmCache", "WARM_CACHE_ENV",
     "VariationalProblem", "OptimizationHandle", "GradientDescent",
     "Adam", "resolve_optimizer", "run_optimization",
+    "DynamicsProblem", "DynamicsHandle", "run_dynamics",
 ]

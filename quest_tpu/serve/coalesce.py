@@ -36,7 +36,8 @@ from typing import Optional, Sequence
 from ..parallel.sampling import shot_bucket
 
 __all__ = ["KIND_STATE", "KIND_EXPECTATION", "KIND_SAMPLE",
-           "KIND_TRAJECTORY", "KIND_GRADIENT", "batch_bucket",
+           "KIND_TRAJECTORY", "KIND_GRADIENT", "KIND_EVOLVE",
+           "KIND_GROUND", "batch_bucket",
            "coalesce_key", "CoalescePolicy", "split_ready",
            "plan_schedule"]
 
@@ -56,6 +57,15 @@ KIND_TRAJECTORY = "trajectory"
 # gradients additionally carry the (max_T, budget) convergence
 # contract and run one gradient wave loop
 KIND_GRADIENT = "gradient"
+# device-resident Hamiltonian dynamics (``submit(..., evolve=spec)`` /
+# ``submit(..., ground_state=spec)``): the observable key carries the
+# Hamiltonian's Pauli masks PLUS the spec contract — (t, steps, order)
+# for Trotter evolution, (steps, tau, method, tol) for the ground-state
+# segment — and the start-state digest, so a coalesced group agrees on
+# the WHOLE evolution (one keyed executable, the step loop inside it,
+# ONE packed (B, W) transfer per segment)
+KIND_EVOLVE = "evolve"
+KIND_GROUND = "ground_state"
 
 
 def batch_bucket(n: int, floor: int = 1) -> int:

@@ -73,8 +73,9 @@ from ..telemetry import profile as _profile
 from ..telemetry.events import make_event, read_timeline
 from ..telemetry.metrics import metrics_registry
 from ..telemetry.tracing import Tracer, dispatch_annotation
-from .coalesce import (KIND_EXPECTATION, KIND_GRADIENT, KIND_SAMPLE,
-                       KIND_STATE, KIND_TRAJECTORY, CoalescePolicy,
+from .coalesce import (KIND_EVOLVE, KIND_EXPECTATION, KIND_GRADIENT,
+                       KIND_GROUND, KIND_SAMPLE, KIND_STATE,
+                       KIND_TRAJECTORY, CoalescePolicy,
                        coalesce_key, split_ready)
 from .metrics import ServiceMetrics
 from .sched import DEFAULT_TENANT, TenantPolicy, WFQScheduler
@@ -149,13 +150,14 @@ class _Request:
                  "submit_t", "deadline", "future", "retries_left", "key",
                  "not_before", "attempts", "tier", "escalations",
                  "obs_key", "trace", "trace_owned", "qspan", "dspan",
-                 "trajectories", "sampling_budget", "tenant", "priority")
+                 "trajectories", "sampling_budget", "tenant", "priority",
+                 "dynamics")
 
     def __init__(self, compiled, param_vec, kind, observables, shots,
                  submit_t, deadline, future, retries_left, key,
                  tier=None, obs_key=(), trajectories=0,
                  sampling_budget=None, tenant=DEFAULT_TENANT,
-                 priority=1):
+                 priority=1, dynamics=None):
         self.compiled = compiled
         self.param_vec = param_vec
         self.kind = kind
@@ -179,6 +181,7 @@ class _Request:
         self.sampling_budget = sampling_budget  # target stderr (or None)
         self.tenant = tenant     # WFQ accounting + quota dimension
         self.priority = priority  # strict class (0 = interactive)
+        self.dynamics = dynamics  # (spec, state_f) for evolve/ground
 
 
 def _canonical_observables(compiled, observables) -> tuple:
@@ -476,6 +479,7 @@ class SimulationService:
                trajectories: Optional[int] = None,
                sampling_budget: Optional[float] = None,
                gradient: bool = False,
+               evolve=None, ground_state=None, init_state=None,
                deadline: Optional[float] = None,
                error_budget: Optional[float] = None,
                tier=None, tenant: str = DEFAULT_TENANT,
@@ -534,6 +538,30 @@ class SimulationService:
         reject typed at this boundary: ``shots=`` (samples have no
         gradient), a circuit with no declared parameters, and the
         QUAD tier (the dd walk has no transpose rules).
+
+        ``evolve=EvolveSpec(t, steps, order)`` makes this a
+        HAMILTONIAN-DYNAMICS request (``kind="evolve"``): the circuit
+        prepares the start state (from |0..0> or ``init_state=``
+        packed ``(2, 2^n)`` planes), then the request applies the
+        Trotterised ``exp(-i H t)`` of the required ``observables=``
+        Pauli sum with the WHOLE step loop iterating inside ONE
+        executable (:meth:`~quest_tpu.circuits.CompiledCircuit.
+        evolve_sweep` — no per-step dispatch). The result is the
+        packed per-row block — per-step energies ``<H>``, the folded
+        Welford carry, and the final state planes; decode with
+        :func:`quest_tpu.ops.dynamics.unpack_evolve_block` (or use
+        :meth:`evolve`, which streams decoded segments).
+        ``ground_state=GroundSpec(...)`` is the imaginary-time /
+        Lanczos analogue (``kind="ground_state"``): one fixed-step
+        segment with on-device renormalisation and a device-resident
+        convergence residual in the same single packed transfer
+        (:meth:`ground_state` chains segments to convergence).
+        Requests coalesce only when they agree on the Hamiltonian, the
+        FULL spec contract, and the start-state digest — a group
+        shares one keyed executable and one ``(B, W)`` transfer.
+        Statevector programs only; the QUAD tier rejects typed (the
+        scan-resident Trotter walk has no double-double form); not
+        combinable with ``shots``/``trajectories``/``gradient``.
 
         ``error_budget`` states the max amplitude error this request
         may carry; the service picks the cheapest
@@ -597,6 +625,37 @@ class SimulationService:
         if sampling_budget is not None and float(sampling_budget) <= 0.0:
             raise ValueError("sampling_budget is a target standard "
                              "error and must be > 0")
+        dyn_spec = None
+        if evolve is not None and ground_state is not None:
+            raise ValueError(
+                "a request returns ONE result: pass evolve= for time "
+                "evolution or ground_state= for the ground-state "
+                "segment, not both")
+        if evolve is not None or ground_state is not None:
+            from ..ops.dynamics import EvolveSpec, GroundSpec
+            if evolve is not None:
+                if not isinstance(evolve, EvolveSpec):
+                    raise TypeError(
+                        "evolve= takes a quest_tpu.ops.dynamics."
+                        "EvolveSpec")
+                dyn_spec = evolve
+            else:
+                if not isinstance(ground_state, GroundSpec):
+                    raise TypeError(
+                        "ground_state= takes a quest_tpu.ops.dynamics."
+                        "GroundSpec")
+                dyn_spec = ground_state
+            if shots is not None or trajectories is not None or gradient:
+                raise ValueError(
+                    "dynamics requests apply exp(-iHt) / imaginary "
+                    "time to the prepared state; they do not combine "
+                    "with shots=, trajectories=, or gradient=")
+            if observables is None:
+                raise ValueError(
+                    "dynamics requests need the Hamiltonian: pass "
+                    "observables=(pauli_terms, coeffs)")
+        elif init_state is not None:
+            raise ValueError("init_state= needs evolve= or ground_state=")
         compiled = self._resolve(circuit,
                                  trajectories=trajectories is not None)
         if isinstance(compiled, TrajectoryProgram) \
@@ -643,6 +702,35 @@ class SimulationService:
             # obs masks + the gradient width P: a group must agree on
             # both to share one (B, P) reverse pass
             obs_key = obs_key + (len(compiled.param_names),)
+        elif dyn_spec is not None:
+            kind = KIND_EVOLVE if evolve is not None else KIND_GROUND
+            if compiled.is_density:
+                raise ValueError(
+                    "dynamics requests run on statevector-compiled "
+                    "programs (Trotter rotations act on ket "
+                    "amplitudes); evolve density registers through "
+                    "their channel circuits")
+            ham, obs_key = _canonical_observables(compiled, observables)
+            dyn_state = None
+            sd = "zero"
+            if init_state is not None:
+                nq_c = compiled.num_qubits
+                # quest: allow-host-sync(caller-provided host start
+                # state: admission-time validation, never a device
+                # value)
+                dyn_state = np.asarray(init_state, dtype=np.float64)
+                if dyn_state.shape != (2, 1 << nq_c):
+                    raise ValueError(
+                        f"init_state must be packed (2, {1 << nq_c}) "
+                        f"planes; got {dyn_state.shape}")
+                import hashlib
+                sd = hashlib.sha256(dyn_state.tobytes()).hexdigest()[:16]
+            # the spec contract + start-state digest are coalescing
+            # dimensions: a group must agree on the WHOLE evolution
+            # (dt, steps, order / tau, method, tol AND the seed
+            # planes) to share one keyed executable and one packed
+            # (B, W) transfer per segment
+            obs_key = obs_key + dyn_spec.contract() + (sd,)
         elif shots is not None:
             if int(shots) < 1:
                 raise ValueError("shots must be >= 1")
@@ -677,6 +765,12 @@ class SimulationService:
                 max(compiled.circuit.depth, 1), self.env, tiers=ladder)
         else:
             req_tier = compiled.tier     # the compile-time tier, if any
+        if dyn_spec is not None and req_tier is not None \
+                and req_tier.name == "quad":
+            raise ValueError(
+                "dynamics requests cannot run at the QUAD tier: the "
+                "double-double walk has no scan-resident Trotter form; "
+                "use tier='double' for the highest rung")
         tenant = str(tenant)
         tpol = self._sched.policy_for(tenant)
         prio = tpol.priority if priority is None else int(priority)
@@ -692,7 +786,9 @@ class SimulationService:
                        sampling_budget=(float(sampling_budget)
                                         if sampling_budget is not None
                                         else None),
-                       tenant=tenant, priority=prio)
+                       tenant=tenant, priority=prio,
+                       dynamics=((dyn_spec, dyn_state)
+                                 if dyn_spec is not None else None))
         # request-scoped tracing: a router-propagated context rides in
         # via _trace (the router owns + finishes it); otherwise the
         # service's own sampler decides, and the service finishes the
@@ -831,8 +927,13 @@ class SimulationService:
             # rides the request axis through a trajectory program);
             # compiled-circuit gradients pad like energies
             padded = self.policy.bucket_size(int(bs), mult)
-            if self.warm_cache is not None and not gradient:
-                kind = "energy" if observables is not None else "sweep"
+            if self.warm_cache is not None:
+                # gradient forms persist too ("grad" — the (B, P+1)
+                # value-and-grad block), so gradient-heavy tenants
+                # restart warm instead of paying the reverse-pass
+                # compile on their first optimize() iterate
+                kind = "grad" if gradient else (
+                    "energy" if observables is not None else "sweep")
                 status = self.warm_cache.warm_form(
                     compiled, kind, padded, hamiltonian=ham, tier=tier)
                 if status == "hit":
@@ -902,6 +1003,96 @@ class SimulationService:
         return run_optimization(
             self, problem, optimizer, max_iters=max_iters, tol=tol,
             learning_rate=learning_rate,
+            checkpoint_path=checkpoint_path, resume=resume,
+            max_restarts=max_restarts, tenant=tenant,
+            yield_to_interactive=yield_to_interactive,
+            preempt_hold_s=preempt_hold_s)
+
+    def evolve(self, circuit, params=None, *, hamiltonian, t: float,
+               steps: int, order: int = 2, init_state=None, tier=None,
+               segment_steps: int = 64,
+               checkpoint_path: Optional[str] = None,
+               resume: bool = True, max_restarts: int = 3,
+               tenant: str = DEFAULT_TENANT,
+               yield_to_interactive: bool = True,
+               preempt_hold_s: float = 5.0):
+        """Run real-time Hamiltonian evolution INSIDE the serving
+        layer and stream its segments back.
+
+        ``circuit`` prepares the start state (with ``params`` bound;
+        an empty circuit evolves ``init_state`` / |0...0> directly),
+        then the state evolves by ``exp(-i * hamiltonian * t)`` in
+        ``steps`` Trotter steps of ``order`` (1 or 2), recording the
+        Pauli-sum energy after EVERY step. The step loop runs inside
+        ONE keyed executable per segment (``segment_steps`` steps
+        each), so a whole segment costs one coalesced
+        ``kind="evolve"`` dispatch and ONE device->host transfer — the
+        packed per-step energies, the device-folded Welford carry, and
+        the exit-state planes the next segment seeds from. The
+        returned :class:`~quest_tpu.serve.dynamics.DynamicsHandle`
+        yields one dict per segment from ``iterates()`` and resolves
+        ``{"energy", "energies", "planes", "welford", ...}`` via
+        ``result()``.
+
+        ``checkpoint_path`` checkpoints every completed segment
+        atomically (:func:`quest_tpu.resilience.segments.
+        dyn_progress_save`, digest-guarded); with ``resume=True`` a
+        killed run continues BIT-EXACTLY from its last good segment.
+        Transient segment faults re-execute within ``max_restarts``;
+        ``tenant`` / ``yield_to_interactive`` / ``preempt_hold_s``
+        attribute and preempt exactly like :meth:`optimize`."""
+        from ..ops.dynamics import EvolveSpec
+        from .dynamics import DynamicsProblem, run_dynamics
+        # quest: allow-host-sync(plain Python request knobs, never
+        # device values)
+        spec = EvolveSpec(t=float(t), steps=int(steps),
+                          order=int(order))
+        problem = DynamicsProblem(
+            circuit=circuit, hamiltonian=hamiltonian, spec=spec,
+            params=params, init_state=init_state, tier=tier)
+        return run_dynamics(
+            self, problem, segment_steps=segment_steps,
+            checkpoint_path=checkpoint_path, resume=resume,
+            max_restarts=max_restarts, tenant=tenant,
+            yield_to_interactive=yield_to_interactive,
+            preempt_hold_s=preempt_hold_s)
+
+    def ground_state(self, circuit, params=None, *, hamiltonian,
+                     steps: int = 16, tau: float = 0.1,
+                     method: str = "power", tol: float = 1e-9,
+                     max_segments: int = 64, init_state=None,
+                     tier=None, checkpoint_path: Optional[str] = None,
+                     resume: bool = True, max_restarts: int = 3,
+                     tenant: str = DEFAULT_TENANT,
+                     yield_to_interactive: bool = True,
+                     preempt_hold_s: float = 5.0):
+        """Run an imaginary-time ground-state search INSIDE the
+        serving layer and stream its segments back.
+
+        Each segment is ONE coalesced ``kind="ground_state"``
+        dispatch: ``steps`` iterations of imaginary-time power
+        iteration at time-step ``tau`` (``method="power"``) or a
+        ``steps``-vector Lanczos recursion (``method="lanczos"``) with
+        on-device renormalization, returning per-iteration energies,
+        the device-computed convergence residual, the Welford carry,
+        and the exit-state planes in one packed transfer. The loop
+        stops when the residual crosses ``tol`` (bounded by
+        ``max_segments`` segments) and the handle resolves
+        ``{"energy", "residual", "converged", ...}``. Checkpointing,
+        resume, restart, tenancy, and preemption behave exactly like
+        :meth:`evolve`."""
+        from ..ops.dynamics import GroundSpec
+        from .dynamics import DynamicsProblem, run_dynamics
+        # quest: allow-host-sync(plain Python request knobs, never
+        # device values)
+        tau, tol = float(tau), float(tol)
+        spec = GroundSpec(steps=int(steps), tau=tau,
+                          method=str(method), tol=tol)
+        problem = DynamicsProblem(
+            circuit=circuit, hamiltonian=hamiltonian, spec=spec,
+            params=params, init_state=init_state, tier=tier)
+        return run_dynamics(
+            self, problem, max_segments=max_segments,
             checkpoint_path=checkpoint_path, resume=resume,
             max_restarts=max_restarts, tenant=tenant,
             yield_to_interactive=yield_to_interactive,
@@ -1717,7 +1908,9 @@ class SimulationService:
             poison = _faults.fire("serve.execute")
             if poison == "precision" and (tier is None
                                           or kind in (KIND_EXPECTATION,
-                                                      KIND_GRADIENT)):
+                                                      KIND_GRADIENT,
+                                                      KIND_EVOLVE,
+                                                      KIND_GROUND)):
                 # a drifted result is UNDETECTABLE silent corruption
                 # wherever the fidelity monitor cannot see it —
                 # energies and gradients carry no unit-norm invariant,
@@ -1768,6 +1961,22 @@ class SimulationService:
                 with ann:
                     raw = (cc.expectation_sweep(
                         pm, batch[0].observables, tier=tier),)
+            elif kind in (KIND_EVOLVE, KIND_GROUND):
+                # the whole segment iterates INSIDE one executable
+                # (the keyed evolve/ground form): the group's step
+                # loops never touch the host, and the packed (B, W)
+                # block is the segment's ONE device->host transfer
+                # (materialised in _complete_batch)
+                spec, dyn_state = batch[0].dynamics
+                with ann:
+                    if kind == KIND_EVOLVE:
+                        raw = (cc.evolve_sweep(
+                            pm, batch[0].observables, spec,
+                            state_f=dyn_state, tier=tier),)
+                    else:
+                        raw = (cc.ground_sweep(
+                            pm, batch[0].observables, spec,
+                            state_f=dyn_state, tier=tier),)
             elif kind == KIND_SAMPLE:
                 shots = max(req.shots for req in batch)
                 with ann:
@@ -1860,6 +2069,22 @@ class SimulationService:
                 bad = _health.bad_value_rows(out) if guard else ()
                 # energies carry no unit-norm invariant: only the NaN
                 # screen applies (docs/accuracy.md "Precision tiers")
+            elif kind in (KIND_EVOLVE, KIND_GROUND):
+                spec, _ = batch[0].dynamics
+                # quest: allow-host-sync(result fan-out boundary: ONE
+                # packed (B, W) block resolves the whole coalesced
+                # segment — the step loop already ran device-side)
+                block = np.asarray(inf.raw[0])
+                block = _faults.poison_output(poison, block)[:B]
+                results = [np.array(block[i]) for i in range(B)]
+                self.metrics.incr("evolve_dispatches"
+                                  if kind == KIND_EVOLVE
+                                  else "ground_dispatches")
+                self.metrics.incr("evolve_steps_fused",
+                                  B * int(spec.steps))
+                # a NaN anywhere in a row's packed block (energies,
+                # Welford carry, or planes) quarantines THAT row only
+                bad = _health.bad_plane_rows(block) if guard else ()
             elif kind == KIND_SAMPLE:
                 idx, totals = inf.raw
                 # quest: allow-host-sync(result fan-out boundary: the

@@ -76,6 +76,13 @@ _COUNTERS = (
     "quota_deferrals",        # ready requests held back by an inflight cap
     "pipelined_batches",      # dispatches issued through the in-flight pipe
     "preemptions",            # checkpointed runs that yielded the mesh
+    # device-resident Hamiltonian dynamics (ops/dynamics.py; ISSUE 18):
+    "evolve_dispatches",      # coalesced Trotter-evolution segments run
+    "evolve_steps_fused",     # Trotter steps iterated inside executables
+    "ground_dispatches",      # coalesced ground-state segments run
+    "dynamics_runs",          # evolve()/ground_state() handles started
+    "dynamics_resumes",       # handles resumed from a dynamics checkpoint
+    "ground_converged",       # ground handles that met their residual tol
 )
 
 # per-tenant counter family (a subset of the service counters that is

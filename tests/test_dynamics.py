@@ -1,0 +1,427 @@
+"""Device-resident Hamiltonian dynamics (ISSUE 18).
+
+Acceptance shape: ``evolve`` matches the dense ``expm(-iHt)`` oracle
+within the Trotter order's error bound (measured convergence slopes ~1
+for order 1 and ~2 for order 2), runs bit-deterministically, and agrees
+between the single device and the 8-device mesh at <= 1e-12;
+``ground_state`` lands on ``numpy.linalg.eigh``'s ground energy
+(Lanczos to solver precision, imaginary-time power iteration within its
+O(tau^2) Trotter bias); the serving layer streams segments with exactly
+ONE host transfer per segment (``host_syncs_avoided`` accounted), and —
+the chaos acceptance — a checkpointed ``ground_state`` run that takes
+an injected transient fault AND a priority-0 preemption resumes
+bit-exactly on both meshes.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+from quest_tpu.ops.dynamics import EvolveSpec, GroundSpec
+from quest_tpu.resilience.faults import (FaultInjector, FaultSpec,
+                                         inject)
+from quest_tpu.serve import SimulationService
+from quest_tpu.serve.dynamics import DynamicsProblem, run_dynamics
+
+# -- oracle helpers ---------------------------------------------------------
+
+_I = np.eye(2, dtype=complex)
+_PAULI = {1: np.array([[0, 1], [1, 0]], dtype=complex),
+          2: np.array([[0, -1j], [1j, 0]], dtype=complex),
+          3: np.diag([1.0, -1.0]).astype(complex)}
+
+
+def dense_hamiltonian(num_qubits, terms, coeffs):
+    """The full 2^n x 2^n matrix of a Pauli sum (qubit 0 = least
+    significant bit, matching the amplitude-index convention)."""
+    dim = 1 << num_qubits
+    H = np.zeros((dim, dim), dtype=complex)
+    for term, c in zip(terms, coeffs):
+        ops = [_I] * num_qubits
+        for (q, p) in term:
+            ops[q] = _PAULI[p]
+        M = np.array([[1.0]], dtype=complex)
+        for q in range(num_qubits - 1, -1, -1):
+            M = np.kron(M, ops[q])
+        H += float(c) * M
+    return H
+
+
+def tfim(num_qubits, h=0.7):
+    """Open-boundary transverse-field Ising: sum ZZ + h * sum X."""
+    terms = [[(q, 3), (q + 1, 3)] for q in range(num_qubits - 1)]
+    terms += [[(q, 1)] for q in range(num_qubits)]
+    coeffs = [1.0] * (num_qubits - 1) + [h] * num_qubits
+    return terms, coeffs
+
+
+def prep_circuit(num_qubits):
+    c = Circuit(num_qubits)
+    for q in range(num_qubits):
+        c.ry(q, c.parameter(f"y{q}"))
+    for q in range(num_qubits - 1):
+        c.cnot(q, q + 1)
+    return c
+
+
+def prep_params(num_qubits, scale=0.3):
+    rng = np.random.default_rng(20260807)
+    return rng.normal(size=(num_qubits,)) * scale
+
+
+def as_complex(planes):
+    planes = np.asarray(planes)
+    return planes[0] + 1j * planes[1]
+
+
+def evolved_oracle(cc, x, ham, t):
+    """expm(-iHt) applied to the prepared state — the dense reference
+    the Trotter synthesis converges to."""
+    import scipy.linalg as sla
+    psi0 = as_complex(np.asarray(cc.sweep(np.asarray(x)[None, :]))[0])
+    H = dense_hamiltonian(cc.num_qubits, *ham)
+    return sla.expm(-1j * H * t) @ psi0
+
+
+def evolve_planes(cc, x, ham, spec):
+    from quest_tpu.ops.dynamics import unpack_evolve_block
+    blk = np.asarray(cc.evolve_sweep(np.asarray(x)[None, :], ham, spec))
+    return unpack_evolve_block(blk, cc.num_qubits, spec.steps)
+
+
+# -- Trotter synthesis vs the dense oracle ----------------------------------
+
+class TestTrotterOracle:
+
+    def test_evolve_matches_dense_expm(self, env):
+        n = 5
+        cc = prep_circuit(n).compile(env, pallas=False)
+        x = prep_params(n)
+        ham = tfim(n)
+        t = 0.6
+        out = evolve_planes(cc, x, ham, EvolveSpec(t=t, steps=40,
+                                                   order=2))
+        psi = as_complex(out["planes"][0])
+        ref = evolved_oracle(cc, x, ham, t)
+        assert np.abs(psi - ref).max() < 5e-4
+        # the evolved state stays normalized (Trotter steps are exact
+        # exponentials of Hermitian terms — unitary by construction)
+        assert abs(np.vdot(psi, psi).real - 1.0) < 1e-12
+
+    @pytest.mark.parametrize("order,lo,hi", [(1, 0.8, 1.25),
+                                             (2, 1.7, 2.4)])
+    def test_trotter_order_error_slopes(self, env, order, lo, hi):
+        """Halving dt must cut the oracle error by ~2^order — the
+        measured convergence slope certifies the synthesis rule, not
+        just one lucky operating point."""
+        n = 4
+        cc = prep_circuit(n).compile(env, pallas=False)
+        x = prep_params(n)
+        ham = tfim(n)
+        t = 0.8
+        ref = evolved_oracle(cc, x, ham, t)
+        errs = []
+        for steps in (8, 16):
+            out = evolve_planes(cc, x, ham,
+                                EvolveSpec(t=t, steps=steps,
+                                           order=order))
+            errs.append(np.abs(as_complex(out["planes"][0])
+                               - ref).max())
+        slope = np.log2(errs[0] / errs[1])
+        assert lo < slope < hi, (errs, slope)
+
+    def test_energy_stream_and_welford(self, env):
+        """Per-step energies come back device-folded: S values plus a
+        Welford (count, mean, M2) carry that matches the host moments
+        of the streamed energies."""
+        n = 4
+        cc = prep_circuit(n).compile(env, pallas=False)
+        x = prep_params(n)
+        ham = tfim(n)
+        S = 12
+        out = evolve_planes(cc, x, ham, EvolveSpec(t=0.5, steps=S))
+        es = out["energies"][0]
+        cnt, mean, m2 = out["welford"][0]
+        assert es.shape == (S,)
+        assert cnt == S
+        np.testing.assert_allclose(mean, es.mean(), rtol=0, atol=1e-12)
+        np.testing.assert_allclose(m2, ((es - es.mean()) ** 2).sum(),
+                                   rtol=1e-10, atol=1e-12)
+        # energy under real-time evolution drifts only by the Trotter
+        # error, never secularly
+        H = dense_hamiltonian(n, *ham)
+        psi0 = as_complex(np.asarray(cc.sweep(x[None, :]))[0])
+        e0 = float(np.vdot(psi0, H @ psi0).real)
+        assert np.abs(es - e0).max() < 5e-2
+
+    def test_evolve_is_deterministic(self, env):
+        n = 4
+        cc = prep_circuit(n).compile(env, pallas=False)
+        x = prep_params(n)
+        ham = tfim(n)
+        spec = EvolveSpec(t=0.4, steps=10)
+        a = np.asarray(cc.evolve_sweep(x[None, :], ham, spec))
+        b = np.asarray(cc.evolve_sweep(x[None, :], ham, spec))
+        np.testing.assert_array_equal(a, b)
+
+    def test_mesh_amplitude_parity(self, env, mesh_env):
+        """The sharded 8-device evolve agrees with the single device
+        at <= 1e-12 — the fused step loop runs under the same
+        constrained sharding as every other dispatch."""
+        n = 5
+        x = prep_params(n)
+        ham = tfim(n)
+        spec = EvolveSpec(t=0.5, steps=12, order=2)
+        cc1 = prep_circuit(n).compile(env, pallas=False)
+        cc8 = prep_circuit(n).compile(mesh_env, pallas=False)
+        out1 = evolve_planes(cc1, x, ham, spec)
+        out8 = evolve_planes(cc8, x, ham, spec)
+        assert np.abs(out1["planes"] - out8["planes"]).max() <= 1e-12
+        assert np.abs(out1["energies"] - out8["energies"]).max() <= 1e-12
+
+    def test_one_transfer_per_segment_accounting(self, env):
+        """A B-row, S-step segment folds B*S per-step observable reads
+        into ONE packed transfer: dispatch_stats() must account the
+        B*S - 1 avoided syncs and the B*S fused steps."""
+        n = 4
+        cc = prep_circuit(n).compile(env, pallas=False)
+        pm = np.stack([prep_params(n), prep_params(n) * 0.5])
+        ham = tfim(n)
+        cc.evolve_sweep(pm, ham, EvolveSpec(t=0.4, steps=10))
+        st = cc.dispatch_stats()
+        assert st.host_syncs_avoided >= 2 * 10 - 1
+        assert st.evolve_steps_fused == 2 * 10
+
+
+# -- ground-state search vs numpy.linalg.eigh -------------------------------
+
+class TestGroundStateOracle:
+
+    def test_lanczos_matches_eigh(self, env):
+        n = 5
+        ham = tfim(n)
+        w = np.linalg.eigh(dense_hamiltonian(n, *ham))[0]
+        with SimulationService(env, max_wait_s=1e-3) as svc:
+            h = svc.ground_state(prep_circuit(n), prep_params(n),
+                                 hamiltonian=ham, steps=24,
+                                 method="lanczos", tol=1e-8,
+                                 max_segments=6)
+            fin = h.result(timeout=600)
+        assert fin["converged"]
+        assert abs(fin["energy"] - w[0]) < 1e-8
+
+    def test_power_iteration_descends_to_ground(self, env):
+        """Imaginary-time power iteration: energies descend to the
+        ground energy within the O(tau^2) per-step Trotter bias, and
+        the device-computed residual drives convergence."""
+        n = 4
+        ham = tfim(n)
+        w = np.linalg.eigh(dense_hamiltonian(n, *ham))[0]
+        with SimulationService(env, max_wait_s=1e-3) as svc:
+            h = svc.ground_state(prep_circuit(n), prep_params(n),
+                                 hamiltonian=ham, steps=16, tau=0.1,
+                                 tol=1e-8, max_segments=24)
+            segs = list(h.iterates())
+            fin = h.result(timeout=600)
+        assert fin["converged"]
+        assert fin["residual"] <= 1e-8
+        assert abs(fin["energy"] - w[0]) < 5e-2
+        # descent: each segment's closing energy is no higher than the
+        # previous segment's (monotone up to solver noise)
+        closes = [s["energy"] for s in segs]
+        assert all(b <= a + 1e-9 for a, b in zip(closes, closes[1:]))
+
+
+# -- the serving layer ------------------------------------------------------
+
+class TestServeDynamics:
+
+    def test_evolve_streams_segments_and_matches_oracle(self, env):
+        n = 5
+        circ = prep_circuit(n)
+        x = prep_params(n)
+        ham = tfim(n)
+        with SimulationService(env, max_wait_s=1e-3) as svc:
+            h = svc.evolve(circ, x, hamiltonian=ham, t=0.6, steps=36,
+                           order=2, segment_steps=12)
+            segs = list(h.iterates())
+            fin = h.result(timeout=600)
+            m = svc.metrics.snapshot()
+        assert [s["segment"] for s in segs] == [0, 1, 2]
+        assert fin["segments"] == 3 and fin["steps"] == 36
+        assert len(fin["energies"]) == 36
+        cc = circ.compile(env, pallas=False)
+        ref = evolved_oracle(cc, x, ham, 0.6)
+        assert np.abs(as_complex(fin["planes"]) - ref).max() < 5e-4
+        # pooled Welford across segments = host moments of the stream
+        cnt, mean, _ = fin["welford"]
+        assert cnt == 36
+        np.testing.assert_allclose(mean, fin["energies"].mean(),
+                                   rtol=0, atol=1e-12)
+        assert m["evolve_dispatches"] == 3
+        assert m["evolve_steps_fused"] == 36
+        assert m["dynamics_runs"] == 1
+
+    def test_segmented_equals_unsegmented(self, env):
+        """Slicing the Trotter schedule into segments (same dt) is
+        physics-neutral: one 24-step segment and three 8-step segments
+        land on the same state bit-for-bit."""
+        n = 4
+        circ = prep_circuit(n)
+        x = prep_params(n)
+        ham = tfim(n)
+        with SimulationService(env, max_wait_s=1e-3) as svc:
+            one = svc.evolve(circ, x, hamiltonian=ham, t=0.6, steps=24,
+                             segment_steps=24).result(timeout=600)
+            three = svc.evolve(circ, x, hamiltonian=ham, t=0.6,
+                               steps=24,
+                               segment_steps=8).result(timeout=600)
+        assert one["segments"] == 1 and three["segments"] == 3
+        np.testing.assert_array_equal(one["planes"], three["planes"])
+        np.testing.assert_array_equal(one["energies"],
+                                      three["energies"])
+
+    def test_coalesced_evolve_requests_share_one_dispatch(self, env):
+        """Two submissions agreeing on program + Hamiltonian + spec
+        contract + start state coalesce into ONE evolve dispatch."""
+        n = 4
+        cc = prep_circuit(n).compile(env, pallas=False)
+        ham = tfim(n)
+        spec = EvolveSpec(t=0.4, steps=8)
+        x = dict(zip(cc.param_names, prep_params(n)))
+        with SimulationService(env, max_wait_s=0.2,
+                               max_batch=8) as svc:
+            svc.pause()
+            f1 = svc.submit(cc, x, observables=ham, evolve=spec)
+            f2 = svc.submit(cc, x, observables=ham, evolve=spec)
+            svc.resume()
+            r1 = f1.result(timeout=600)
+            r2 = f2.result(timeout=600)
+            m = svc.metrics.snapshot()
+        assert m["evolve_dispatches"] == 1
+        assert m["evolve_steps_fused"] == 2 * 8
+        np.testing.assert_array_equal(r1, r2)
+
+    def test_submit_validation(self, env):
+        cc = prep_circuit(3).compile(env, pallas=False)
+        ham = tfim(3)
+        x = dict(zip(cc.param_names, prep_params(3)))
+        spec = EvolveSpec(t=0.1, steps=2)
+        with SimulationService(env, max_wait_s=1e-3) as svc:
+            with pytest.raises(ValueError):
+                svc.submit(cc, x, observables=ham, evolve=spec,
+                           ground_state=GroundSpec())
+            with pytest.raises(ValueError):
+                svc.submit(cc, x, observables=ham, evolve=spec,
+                           gradient=True)
+            with pytest.raises(ValueError):
+                svc.submit(cc, x, evolve=spec)     # no observables
+            with pytest.raises(TypeError):
+                svc.submit(cc, x, observables=ham, evolve=0.5)
+            with pytest.raises(ValueError):
+                svc.submit(cc, x, observables=ham, evolve=spec,
+                           init_state=np.zeros((3, 4)))
+            with pytest.raises(ValueError):
+                svc.submit(cc, x, observables=ham,
+                           init_state=np.zeros((2, 8)))
+
+    def test_problem_digest_separates_runs(self):
+        circ = prep_circuit(3)
+        x = prep_params(3)
+        ham = tfim(3)
+        a = DynamicsProblem(circ, ham, EvolveSpec(t=0.5, steps=8),
+                            params=x)
+        b = DynamicsProblem(circ, ham, EvolveSpec(t=0.5, steps=8),
+                            params=x)
+        assert a.digest() == b.digest()
+        c = DynamicsProblem(circ, ham, EvolveSpec(t=0.5, steps=16),
+                            params=x)
+        d = DynamicsProblem(circ, ham, GroundSpec(steps=8), params=x)
+        assert len({a.digest(), c.digest(), d.digest()}) == 3
+        with pytest.raises(TypeError):
+            DynamicsProblem(circ, ham, 3.0)
+
+
+# -- chaos acceptance: fault + preemption + bit-exact resume ----------------
+
+class _PreemptibleTarget:
+    """A SimulationService with a standing interactive-pressure signal,
+    so the preemption boundary fires deterministically."""
+
+    def __init__(self, svc):
+        self._svc = svc
+        self.pressure = True
+
+    def interactive_pressure(self):
+        return self.pressure
+
+    def __getattr__(self, name):
+        return getattr(self._svc, name)
+
+
+@pytest.mark.chaos
+class TestDynamicsChaos:
+    """The ISSUE 18 chaos acceptance: a checkpointed ``ground_state``
+    run that survives an injected mid-run transient fault PLUS a
+    priority-0 preemption resumes bit-exactly, on the single device
+    and on the 8-device mesh."""
+
+    @pytest.mark.parametrize("which", ["env", "mesh_env"])
+    def test_faulted_preempted_ground_resume_is_bit_exact(
+            self, which, request, tmp_path):
+        envx = request.getfixturevalue(which)
+        n = 5 if which == "mesh_env" else 3
+        circ = prep_circuit(n)
+        x = prep_params(n)
+        ham = tfim(n)
+        kw = dict(hamiltonian=ham, steps=6, tau=0.15, tol=0.0)
+        ckpt = str(tmp_path / "dyn.npz")
+        with SimulationService(envx, max_wait_s=1e-3) as svc:
+            # reference: six uninterrupted segments
+            hA = svc.ground_state(circ, x, max_segments=6,
+                                  yield_to_interactive=False, **kw)
+            ref = list(hA.iterates())
+            hA.result(timeout=600)
+            assert len(ref) == 6
+
+            # phase 1: three segments under standing interactive
+            # pressure (every boundary preempts, bounded by the hold)
+            # with a transient fault injected into segment 1's dispatch
+            target = _PreemptibleTarget(svc)
+            inj = FaultInjector(
+                [FaultSpec("transient", site="serve.evolve",
+                           at_calls=(1,))])
+            with inject(inj):
+                h1 = run_dynamics(
+                    target,
+                    DynamicsProblem(circ, ham,
+                                    GroundSpec(steps=6, tau=0.15,
+                                               tol=0.0), params=x),
+                    max_segments=3, checkpoint_path=ckpt,
+                    max_restarts=3, preempt_hold_s=0.05)
+                its1 = list(h1.iterates())
+                r1 = h1.result(timeout=600)
+            assert len(its1) == 3
+            assert r1["restarts"] >= 1
+            assert svc.dispatch_stats()["service"]["preemptions"] >= 3
+
+            # phase 2: a fresh handle resumes from the checkpoint and
+            # finishes the remaining three segments
+            h2 = svc.ground_state(circ, x, max_segments=6,
+                                  checkpoint_path=ckpt, resume=True,
+                                  yield_to_interactive=False, **kw)
+            its2 = list(h2.iterates())
+            r2 = h2.result(timeout=600)
+            assert r2["resumed_from"] == 2
+            assert svc.metrics.snapshot()["dynamics_resumes"] == 1
+
+        combined = its1 + its2
+        assert [it["segment"] for it in combined] == list(range(6))
+        for want, got in zip(ref, combined):
+            # bit-exact, not approximately equal: the preemption hold
+            # and the re-executed faulted segment must be invisible
+            assert want["energy"] == got["energy"]
+            np.testing.assert_array_equal(want["energies"],
+                                          got["energies"])
+            assert want["residual"] == got["residual"]

@@ -1,0 +1,85 @@
+"""tools/evolve_trace.py smoke (fast tier): the planned dynamics
+schedule must agree with the coalescer's batch bucket and the dynamics
+sharding policy (mem_factor=1), the segment carve must reuse one
+executable across equal-length slices, the step-fusion ledger must
+price exactly one packed transfer per segment, the modeled ground-state
+residual must place its decision point deterministically, and the CLI
+must produce parseable, schema-tagged output end-to-end."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import evolve_trace  # noqa: E402
+
+
+def test_schedule_matches_coalescer_and_carve():
+    from quest_tpu.serve.coalesce import batch_bucket
+    doc = json.loads(json.dumps(evolve_trace.trace_schedule(
+        4, 19, 100, 2, 32, 5, 8)))
+    assert doc["batch_bucket"] == batch_bucket(5, floor=8) == 8
+    assert doc["padded_rows"] == 3
+    # 100 steps carve into 32/32/32/4 at constant dt; the three
+    # full-size slices replay ONE executable, the remainder compiles
+    # the second
+    assert [s["steps"] for s in doc["segments"]] == [32, 32, 32, 4]
+    assert [s["reuses_executable"] for s in doc["segments"]] == [
+        False, True, True, False]
+    assert doc["executables_compiled"] == 2
+    # one packed (B, S + 3 + 2^(n+1)) transfer per segment
+    assert doc["segments"][0]["transfer_block"] == [8, 32 + 3 + 32]
+    assert doc["evolve_steps_fused"] == 8 * 100
+    assert doc["host_syncs_avoided"] == sum(
+        8 * s - 1 for s in (32, 32, 32, 4))
+    assert doc["sharding"]["mem_factor"] == 1.0
+
+
+def test_trotter_order_prices_the_strang_sweep():
+    d1 = evolve_trace.trace_schedule(4, 7, 10, 1, 10, 1, 1)
+    d2 = evolve_trace.trace_schedule(4, 7, 10, 2, 10, 1, 1)
+    assert d1["segments"][0]["rotations"] == 10 * 7
+    assert d2["segments"][0]["rotations"] == 10 * 2 * 7
+
+
+def test_ground_decision_point_is_deterministic():
+    doc = evolve_trace.trace_schedule(
+        4, 7, 4, 2, 64, 1, 1, ground=True, max_segments=10,
+        tol=1e-3, rate=0.5, r0=1.0)
+    # residual after segment k is 0.5^(4(k+1)): 6.25e-2, 3.9e-3,
+    # 2.44e-4 <= 1e-3 first at segment 2
+    g = doc["ground"]
+    assert g["decision_segment"] == 2
+    assert g["projected_segments"] == 3
+    assert doc["segments"][-1]["converged"] is True
+    assert doc["mode"] == "ground"
+    # ground rows carry the residual column
+    assert doc["segments"][0]["transfer_block"] == [1, 4 + 3 + 32 + 1]
+    residuals = [s["modeled_residual"] for s in doc["segments"]]
+    assert residuals == sorted(residuals, reverse=True)
+
+
+def test_cli_end_to_end(tmp_path):
+    tool = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "evolve_trace.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}
+    out_file = tmp_path / "evolve.json"
+    proc = subprocess.run(
+        [sys.executable, tool, "--qubits", "12", "--terms", "23",
+         "--steps", "48", "--segment", "16", "--batch", "6",
+         "--devices", "8", "--out", str(out_file)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    doc = json.loads(out_file.read_text())
+    # shared versioned dump header (tools/_trace_io.py, ISSUE 9)
+    assert doc["schema"] == "quest_tpu.trace/1"
+    assert doc["kind"] == "evolve"
+    assert doc["total_steps"] == 48
+    assert doc["batch_bucket"] == 8
+    assert doc["executables_compiled"] == 1
+    assert doc["sharding"]["mode"] in ("none", "batch", "amp")

@@ -258,6 +258,58 @@ class TestTrajectoryGradients:
             tp.expectation_grad([[(0, 3)]], [1.0],
                                 num_trajectories=16)
 
+    def test_running_mean_baseline_reduces_stderr(self, env):
+        """The REINFORCE control variate (ISSUE 18): the gradient wave
+        loop passes each row's running-mean value as the score-term
+        baseline. On a deep noisy circuit whose objective carries a
+        constant offset — the worst case for an uncentred score term —
+        the reported gradient stderr must be strictly smaller than a
+        baseline-free control run over the SAME draws, with the primal
+        value bit-identical (the surrogate's added term is zero)."""
+        import jax
+        import quest_tpu.ops.reductions as red
+        c = Circuit(3)
+        for layer in range(3):
+            for q in range(3):
+                c.ry(q, c.parameter(f"a{layer}_{q}"))
+            for q in range(2):
+                c.cnot(q, q + 1)
+            for q in range(3):
+                c.rz(q, c.parameter(f"b{layer}_{q}"))
+        noisy = c.with_noise(p1=0.05, damping=0.02)
+        # the empty term is the identity: a +4 offset every trajectory
+        # value carries, which only the baseline can centre away
+        ham = ([[], [(0, 3)], [(1, 1), (2, 1)], [(0, 2), (1, 3)]],
+               [4.0, 0.7, -0.4, 0.25])
+        rng = np.random.default_rng(20260729)
+        params = {nm: float(v) for nm, v in
+                  zip(noisy.param_names,
+                      rng.uniform(0, 2 * np.pi,
+                                  len(noisy.param_names)))}
+        key = jax.random.PRNGKey(5)
+        kw = dict(num_trajectories=1200, params=params, key=key,
+                  wave_size=150)
+        tp = noisy.compile_trajectories(env)
+        val, _, err = tp.expectation_grad(ham[0], ham[1], **kw)
+        # control: the identical wave loop with the baseline forced to
+        # zero (a fresh program so the patched surrogate is traced in)
+        orig = red.score_surrogate
+        try:
+            red.score_surrogate = \
+                lambda value, logq, baseline=0.0: orig(value, logq)
+            tp0 = noisy.compile_trajectories(env)
+            val0, _, err0 = tp0.expectation_grad(ham[0], ham[1], **kw)
+        finally:
+            red.score_surrogate = orig
+        assert val == val0
+        err, err0 = np.asarray(err), np.asarray(err0)
+        # the value stderr is baseline-independent (primal unchanged);
+        # the gradient stderr must shrink — strictly overall and for
+        # every component on this offset-dominated objective
+        assert err[0] == err0[0]
+        assert err[1:].sum() < 0.75 * err0[1:].sum()
+        assert np.all(err[1:] <= err0[1:])
+
 
 class TestGradientServing:
     """kind="gradient" through SimulationService and ServiceRouter."""
@@ -381,6 +433,82 @@ class TestGradientServing:
                 assert np.max(np.abs(grad - oracle[4 + b])) <= 1e-9
         finally:
             router.close()
+
+    def test_grad_form_warm_restart_round_trip(self, env, tmp_path,
+                                               rng):
+        """``("grad", ...)`` executable forms persist through the warm
+        cache (ISSUE 18 satellite): a restarted process LOADS the
+        value-and-grad executable (hit, no reverse-pass recompile) and
+        the loaded executable answers at oracle parity."""
+        from quest_tpu.serve.warmcache import WarmCache
+        c = self._circuit()
+        pm = rng.uniform(0, 2 * np.pi, size=(4, len(c.param_names)))
+        oracle = _shift_oracle(c.compile(env), pm, self.HAM)
+        cache = WarmCache(str(tmp_path / "warm"))
+        with qt.SimulationService(env, max_batch=4, max_wait_s=2e-3,
+                                  warm_cache=cache) as svc:
+            svc.warm(c, batch_sizes=(4,), observables=self.HAM,
+                     gradient=True)
+            cold = svc.dispatch_stats()["service"]
+        assert cold["warm_cache_misses"] == 1
+        assert cold["warm_cache_hits"] == 0
+
+        # "process restart": fresh service + cache object, same dir
+        cache2 = WarmCache(str(tmp_path / "warm"))
+        env2 = qt.createQuESTEnv(num_devices=1, seed=[12345])
+        with qt.SimulationService(env2, max_batch=4, max_wait_s=2e-3,
+                                  warm_cache=cache2) as svc:
+            svc.warm(c, batch_sizes=(4,), observables=self.HAM,
+                     gradient=True)
+            futs = [svc.submit(c, dict(zip(c.param_names, row)),
+                               observables=self.HAM, gradient=True)
+                    for row in pm]
+            res = [f.result(timeout=120) for f in futs]
+            warm = svc.dispatch_stats()["service"]
+        assert warm["warm_cache_hits"] == 1
+        assert warm["warm_cache_misses"] == 0
+        for b, (_, grad) in enumerate(res):
+            assert np.max(np.abs(grad - oracle[b])) <= 1e-9
+
+    def test_torn_grad_artifact_falls_back_to_compile(self, env,
+                                                      tmp_path, rng):
+        """A truncated ``("grad", ...)`` artifact never crashes or
+        mis-answers: the load counts an error, the reverse pass
+        recompiles, and the answers stay at oracle parity."""
+        from quest_tpu.serve.warmcache import WarmCache
+        c = self._circuit()
+        cache = WarmCache(str(tmp_path / "warm"))
+        with qt.SimulationService(env, max_batch=4,
+                                  warm_cache=cache) as svc:
+            svc.warm(c, batch_sizes=(4,), observables=self.HAM,
+                     gradient=True)
+        paths = []
+        for dirpath, _, names in os.walk(str(tmp_path / "warm")):
+            for nm in names:
+                if nm.endswith(".exe.pkl"):
+                    paths.append(os.path.join(dirpath, nm))
+        assert paths
+        for p in paths:
+            blob = open(p, "rb").read()
+            with open(p, "wb") as f:
+                f.write(blob[:len(blob) // 2])
+        cache2 = WarmCache(str(tmp_path / "warm"))
+        env2 = qt.createQuESTEnv(num_devices=1, seed=[12345])
+        pm = rng.uniform(0, 2 * np.pi, size=(4, len(c.param_names)))
+        oracle = _shift_oracle(c.compile(env2), pm, self.HAM)
+        with qt.SimulationService(env2, max_batch=4,
+                                  warm_cache=cache2) as svc:
+            svc.warm(c, batch_sizes=(4,), observables=self.HAM,
+                     gradient=True)
+            futs = [svc.submit(c, dict(zip(c.param_names, row)),
+                               observables=self.HAM, gradient=True)
+                    for row in pm]
+            res = [f.result(timeout=120) for f in futs]
+        st = cache2.stats()
+        assert st["errors"] >= 1          # the torn load was counted
+        assert st["misses"] >= 1          # and recompiled
+        for b, (_, grad) in enumerate(res):
+            assert np.max(np.abs(grad - oracle[b])) <= 1e-9
 
     def test_warm_compiles_the_gradient_wave_executable(self, env):
         """warm(gradient=True, trajectories=) must build the GRADIENT

@@ -245,6 +245,65 @@ class TestQL002CacheKeys:
         assert rules.rule_ql002_cache_keys([f], ROOT) == []
 
 
+    # -- the ISSUE-18 dynamics-executable key shapes -------------------------
+
+    def test_evolve_key_complete_passes(self, tmp_path):
+        """The Trotter-segment executable (_dynamics_dispatch "evolve")
+        keys on order + scan length + mode + dtype + tier: masks,
+        coefficients and dt are DATA, but the scan length and splitting
+        order are trace constants."""
+        f = make_file(tmp_path, "quest_tpu/circuits2.py", """
+            class C:
+                def _evolve_fn(self, order, steps, mode, tier):
+                    key = ("evolve", int(order), int(steps), mode,
+                           str(np.dtype(self.env.precision.real_dtype)),
+                           self._tier_token(tier))
+                    self._batched_cache[key] = 1
+        """)
+        assert rules.rule_ql002_cache_keys([f], ROOT) == []
+
+    def test_evolve_key_missing_tier_flags(self, tmp_path):
+        """A fused segment executable keyed without the tier would
+        serve a FAST-tier step loop to a DOUBLE dispatch — and the
+        error compounds once per fused step."""
+        f = make_file(tmp_path, "quest_tpu/circuits2.py", """
+            class C:
+                def _evolve_fn(self, order, steps, mode):
+                    key = ("evolve", int(order), int(steps), mode,
+                           self._dt_token())
+                    self._batched_cache[key] = 1
+        """)
+        vs = rules.rule_ql002_cache_keys([f], ROOT)
+        assert codes(vs) == ["QL002"]
+        assert "tier" in vs[0].message
+
+    def test_ground_key_complete_passes(self, tmp_path):
+        """The imaginary-time executable keys on method + scan length +
+        mode + dtype + tier: power iteration and Lanczos trace
+        different recursions under one "ground" family."""
+        f = make_file(tmp_path, "quest_tpu/circuits2.py", """
+            class C:
+                def _ground_fn(self, method, steps, mode, tier):
+                    key = ("ground", str(method), int(steps), mode,
+                           str(np.dtype(self.env.precision.real_dtype)),
+                           self._tier_token(tier))
+                    self._batched_cache[key] = 1
+        """)
+        assert rules.rule_ql002_cache_keys([f], ROOT) == []
+
+    def test_ground_key_missing_dtype_flags(self, tmp_path):
+        f = make_file(tmp_path, "quest_tpu/circuits2.py", """
+            class C:
+                def _ground_fn(self, method, steps, mode, tier):
+                    key = ("ground", str(method), int(steps), mode,
+                           self._tier_token(tier))
+                    self._batched_cache[key] = 1
+        """)
+        vs = rules.rule_ql002_cache_keys([f], ROOT)
+        assert codes(vs) == ["QL002"]
+        assert "dtype" in vs[0].message
+
+
 # -- QL003 ------------------------------------------------------------------
 
 class TestQL003UntypedExcept:
@@ -481,6 +540,89 @@ class TestQL004GradientBoundaries:
         vs = rules.rule_ql004_dispatch_boundaries([faults, circ], ROOT)
         assert codes(vs) == ["QL004"]
         assert "serve.optimize" in vs[0].message
+
+
+# the ISSUE-18 boundaries: the dynamics segment dispatch and the
+# preemption yield point carry the same trio contract
+FAKE_FAULTS_DYN = """
+    SITES = (
+        "serve.evolve",
+        "serve.preempt",
+    )
+"""
+
+
+class TestQL004DynamicsBoundaries:
+    def test_evolve_segment_trio_passes(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_DYN)
+        dyn = make_file(tmp_path, "quest_tpu/serve/dynamics.py", """
+            def _segment(self, k, planes, spec, steps):
+                sp = _profile.profile_dispatch("serve.evolve")
+                poison = _faults.fire("serve.evolve")
+                with dispatch_annotation("quest_tpu.serve.evolve:k0"):
+                    return self._target.submit(spec)
+            def _keeps_site_alive():
+                sp = profile_dispatch("serve.preempt")
+                _faults.fire("serve.preempt")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        assert rules.rule_ql004_dispatch_boundaries(
+            [faults, dyn], ROOT) == []
+
+    def test_evolve_segment_without_profiler_flags(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_DYN)
+        dyn = make_file(tmp_path, "quest_tpu/serve/dynamics.py", """
+            def _segment(self, k, planes, spec, steps):
+                poison = _faults.fire("serve.evolve")
+                with dispatch_annotation("quest_tpu.serve.evolve:k0"):
+                    return self._target.submit(spec)
+            def _keeps_site_alive():
+                sp = profile_dispatch("serve.preempt")
+                _faults.fire("serve.preempt")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, dyn], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "profile_dispatch" in vs[0].message
+
+    def test_evolve_segment_without_annotation_flags(self, tmp_path):
+        """serve/dynamics.py is a NEW file under the serve/ tree: the
+        whole-tree scope puts its segment dispatch under the trio
+        contract from day one."""
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_DYN)
+        dyn = make_file(tmp_path, "quest_tpu/serve/dynamics.py", """
+            def _segment(self, k, planes, spec, steps):
+                sp = _profile.profile_dispatch("serve.evolve")
+                poison = _faults.fire("serve.evolve")
+                return self._target.submit(spec)
+            def _keeps_site_alive():
+                sp = profile_dispatch("serve.preempt")
+                _faults.fire("serve.preempt")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, dyn], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "annotation" in vs[0].message
+
+    def test_deleted_evolve_hook_is_a_coverage_loss(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_DYN)
+        dyn = make_file(tmp_path, "quest_tpu/serve/dynamics.py", """
+            def _maybe_yield(self, k):
+                sp = profile_dispatch("serve.preempt")
+                _faults.fire("serve.preempt")
+                with dispatch_annotation("y"):
+                    pass
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, dyn], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "serve.evolve" in vs[0].message
 
 
 # -- QL005 ------------------------------------------------------------------

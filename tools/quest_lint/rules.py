@@ -93,7 +93,8 @@ HOT_PATH_FILES = ("quest_tpu/circuits.py", "quest_tpu/parallel/pergate.py")
 # results and steps numpy optimizer state; the device dispatch happens
 # one layer down in submit()/value_and_grad_sweep, which stay in scope
 QL001_EXEMPT = ("quest_tpu/ops/doubledouble.py",
-                "quest_tpu/serve/optimize.py")
+                "quest_tpu/serve/optimize.py",
+                "quest_tpu/serve/dynamics.py")
 
 _SYNC_ATTRS = ("item", "block_until_ready")
 
