@@ -3208,6 +3208,162 @@ def bench_multitenant_config(qt, platform: str) -> dict:
     return rows[-1]
 
 
+def bench_netserve(qt, env, platform: str) -> list:
+    # the rows' contract is the PRODUCTION wire cost; the test-tier
+    # lock-order validator would be measured instead — suspend it
+    from quest_tpu.testing import lockcheck as _lockcheck
+    with _lockcheck.suspended():
+        return _bench_netserve(qt, env, platform)
+
+
+def _bench_netserve(qt, env, platform: str) -> list:
+    """The network front door vs the in-process service (ISSUE 19):
+    the SAME mixed expectation/sweep trace submitted once directly to a
+    ``SimulationService`` and once through the loopback HTTP wire
+    (``NetServer`` + the stdlib socket client). Emits requests/sec and
+    p50/p99 for both paths, the wire's serialization cost per request
+    (server-side parse + serialize spans, traced at ``sample_rate=1.0``)
+    as a fraction of total request handling, bytes on the wire, and the
+    parity count (graded: zero expectation mismatches > 1e-12 — the
+    wire must add exactly no numerical error)."""
+    num_qubits = int(os.environ.get("QUEST_BENCH_NET_QUBITS", "10"))
+    n_req = int(os.environ.get(
+        "QUEST_BENCH_NET_REQUESTS", "256" if _remaining() > 120 else "64"))
+    num_terms = int(os.environ.get("QUEST_BENCH_NET_TERMS", "8"))
+    layers = int(os.environ.get("QUEST_BENCH_NET_LAYERS", "1"))
+    max_batch = int(os.environ.get("QUEST_BENCH_NET_BATCH", "32"))
+    workers = int(os.environ.get("QUEST_BENCH_NET_WORKERS", "32"))
+    rng = np.random.default_rng(2026)
+    circ, n_gates, names = build_hea_circuit(num_qubits, layers)
+    codes = rng.integers(0, 4, size=(num_terms, num_qubits))
+    coeffs = rng.normal(size=num_terms)
+    ham = ([[(q_, int(codes[t, q_])) for q_ in range(num_qubits)]
+            for t in range(num_terms)], coeffs)
+    pm = rng.uniform(0.0, 2.0 * np.pi, size=(n_req, len(names)))
+    # every 4th request asks for the full (2, 2^n) planes — the
+    # payload-heavy class that stresses the serializer; the rest ask
+    # for the scalar Pauli-sum energy
+    is_sweep = (np.arange(n_req) % 4) == 3
+    dev_desc = (f"single {platform} chip" if env.num_devices == 1
+                else f"{env.num_devices} {platform} devices")
+    label = (f"hardware-efficient-ansatz-{num_qubits}, {n_req} requests "
+             f"({int(is_sweep.sum())} sweep / "
+             f"{int((~is_sweep).sum())} expectation), "
+             f"{num_terms}-term Pauli sum, {dev_desc}")
+
+    from quest_tpu.serve import SimulationService
+    from quest_tpu.netserve import NetClient, NetServer
+
+    def kwargs(i):
+        return {} if is_sweep[i] else {"observables": ham}
+
+    svc = SimulationService(env, max_batch=max_batch, max_wait_s=5e-3,
+                            max_queue=n_req + max_batch,
+                            request_timeout_s=600.0)
+    try:
+        for count, kw in ((int((~is_sweep).sum()),
+                           {"observables": ham}),
+                          (int(is_sweep.sum()), {})):
+            sizes = {min(max_batch, count)} | \
+                ({count % max_batch} if count % max_batch else set())
+            svc.warm(circ, batch_sizes=sorted(sizes - {0}), **kw)
+
+        # pass 1: in-process — the ceiling the wire is graded against
+        t0 = time.perf_counter()
+        futs = [svc.submit(circ, dict(zip(names, pm[i])), **kwargs(i))
+                for i in range(n_req)]
+        res_in = [f.result(timeout=600) for f in futs]
+        in_dt = time.perf_counter() - t0
+        snap_in = svc.dispatch_stats()["service"]
+
+        # pass 2: the same trace through the loopback socket
+        with NetServer(svc, trace_sample_rate=1.0) as srv:
+            with NetClient(srv.host, srv.port, max_workers=workers) as cl:
+                # register the program (and its session) outside the
+                # timed window: steady-state requests ride circuit_ref
+                cl.submit(circ, dict(zip(names, pm[0])),
+                          observables=ham).result(timeout=600)
+                t0 = time.perf_counter()
+                futs = [cl.submit(circ, dict(zip(names, pm[i])),
+                                  **kwargs(i)) for i in range(n_req)]
+                res_net = [f.result(timeout=600) for f in futs]
+                net_dt = time.perf_counter() - t0
+            wm = srv.metrics.snapshot()
+            spans = {"parse": 0.0, "queue": 0.0, "dispatch": 0.0,
+                     "serialize": 0.0}
+            for ctx in srv.tracer.finished():
+                for sp in ctx.to_dict()["spans"]:
+                    if sp["name"] in spans and sp["duration_s"]:
+                        spans[sp["name"]] += sp["duration_s"]
+    finally:
+        svc.close()
+
+    parity_failures = 0
+    max_dev = 0.0
+    for i in range(n_req):
+        if is_sweep[i]:
+            d = float(np.max(np.abs(np.asarray(res_net[i])
+                                    - np.asarray(res_in[i]))))
+        else:
+            d = abs(float(res_net[i]) - float(res_in[i]))
+        max_dev = max(max_dev, d)
+        if d > 1e-12:
+            parity_failures += 1
+
+    ser_s = spans["parse"] + spans["serialize"]
+    total_span_s = sum(spans.values())
+    overhead_pct = 100.0 * ser_s / max(total_span_s, 1e-12)
+    in_rate = n_req / in_dt
+    net_rate = n_req / net_dt
+
+    in_row = {
+        "metric": f"netserve in-process baseline (direct "
+                  f"SimulationService), {label}",
+        "value": round(in_rate, 2),
+        "unit": "requests/sec",
+        "vs_baseline": 0.0,
+        "p50_latency_s": round(snap_in["p50_latency_s"], 6),
+        "p99_latency_s": round(snap_in["p99_latency_s"], 6),
+    }
+    ser_row = {
+        "metric": f"netserve wire serialization cost per request, "
+                  f"{label}",
+        "value": round(ser_s / max(n_req, 1), 6),
+        "unit": "s",
+        "vs_baseline": 0.0,
+        "parse_s_per_req": round(spans["parse"] / max(n_req, 1), 6),
+        "serialize_s_per_req": round(
+            spans["serialize"] / max(n_req, 1), 6),
+        "overhead_pct_of_request": round(overhead_pct, 3),
+    }
+    net_row = {
+        "metric": f"netserve socket (loopback HTTP front door), {label}",
+        "value": round(net_rate, 2),
+        "unit": "requests/sec",
+        "vs_baseline": 0.0,
+        "socket_vs_inprocess": round(net_rate / max(in_rate, 1e-9), 4),
+        "p50_request_s": round(wm["p50_request_s"], 6),
+        "p99_request_s": round(wm["p99_request_s"], 6),
+        "serialization_overhead_pct": round(overhead_pct, 3),
+        "bytes_in": wm["bytes_in"],
+        "bytes_out": wm["bytes_out"],
+        "program_hits": wm["program_hits"],
+        "program_misses": wm["program_misses"],
+        "parity_failures": parity_failures,
+        "max_deviation": max_dev,
+    }
+    return [in_row, ser_row, net_row]
+
+
+def bench_netserve_config(qt, env, platform: str) -> dict:
+    """Config-list adapter: emit the in-process and serialization rows,
+    return the socket headline."""
+    rows = bench_netserve(qt, env, platform)
+    for row in rows[:-1]:
+        emit(row)
+    return rows[-1]
+
+
 def bench_density_noise(qt, env, platform: str) -> dict:
     """Density register with dephasing/damping channels (the BASELINE.json
     config-4 workload, width-reduced to 12 qubits everywhere — see the
@@ -3548,6 +3704,8 @@ def main() -> None:
         ("router", 45, lambda: bench_replicated_serving(qt, platform)),
         ("multitenant", 45, lambda: bench_multitenant_config(
             qt, platform)),
+        ("netserve", 45, lambda: bench_netserve_config(qt, env,
+                                                       platform)),
     ]
     if accel:
         # heavyweight compiles last on the tunnel (the heartbeat keeps a

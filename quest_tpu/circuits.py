@@ -108,6 +108,26 @@ def _rot_matrix(angle, axis) -> jnp.ndarray:
         + jnp.array([[0.0, 0.0], [0.0, 1.0]]) * jnp.conj(alpha)
 
 
+def _wire_angle(a: Angle):
+    """JSON-able wire form of one builder angle/rate argument: a Param
+    placeholder travels by name, a static value by exact float."""
+    if isinstance(a, Param):
+        return {"param": a.name}
+    # quest: allow-host-sync(builder-time journal entry — `a` is the
+    # caller's static Python angle, recorded before any device work)
+    return float(a)
+
+
+def _wire_cmat(arr) -> dict:
+    """JSON-able wire form of one complex tensor. ``json.dumps`` emits
+    ``repr(float)`` so the round trip is bit-exact — the decoded matrix
+    hashes to the same ``warmcache.circuit_digest`` bytes."""
+    # quest: allow-host-sync(builder-time journal entry — `arr` is the
+    # caller's host matrix, recorded before any device work)
+    a = np.asarray(arr, dtype=np.complex128)
+    return {"re": a.real.tolist(), "im": a.imag.tolist()}
+
+
 def _phase_diag(angle) -> jnp.ndarray:
     return jnp.stack([jnp.ones_like(angle) + 0j, jnp.exp(1j * angle)])
 
@@ -126,6 +146,13 @@ class Circuit:
         self.num_qubits = num_qubits
         self.ops: list[_Op] = []
         self._params: list[str] = []
+        # wire journal: one JSON-able row per recorded op describing the
+        # builder call that produced it (None = not wire-serializable).
+        # quest_tpu.netserve.wire replays rows through these same
+        # builders, so a decoded circuit reproduces the exact op stream
+        # — closures included — and with it warmcache.circuit_digest.
+        self._wire: list = []
+        self._wire_depth = 0
 
     # -- parameters --------------------------------------------------------
 
@@ -154,6 +181,33 @@ class Circuit:
             return self.parameter(a.name)
         return a
 
+    def _journal(self, entry, fn):
+        """Run a builder body with ``entry`` as its wire-journal row:
+        the HIGH-LEVEL call (not the primitive it delegates to) is what
+        the wire form replays, so parameterized closures decode to the
+        same code objects they were recorded from."""
+        base = len(self.ops)
+        self._wire_depth += 1
+        try:
+            out = fn()
+        finally:
+            self._wire_depth -= 1
+        if self._wire_depth == 0:
+            added = len(self.ops) - base
+            # guarded builders append exactly one op; anything else has
+            # no 1:1 row and journals opaque rather than guessing
+            self._wire.extend([entry] if added == 1 else [None] * added)
+        return out
+
+    def _wire_rows(self) -> list:
+        """The journal, validated against the op stream (consumed by
+        ``quest_tpu.netserve.wire``). A mutation path that bypassed the
+        journal (``inverse``, direct ``ops`` edits) misaligns it — every
+        row then reads opaque, never a wrong replay."""
+        if len(self._wire) != len(self.ops):
+            return [None] * len(self.ops)
+        return list(self._wire)
+
     def gate(self, u, targets: Sequence[int], controls: Sequence[int] = (),
              control_states: Optional[Sequence[int]] = None) -> "Circuit":
         """Record an arbitrary k-qubit (controlled) unitary.
@@ -176,13 +230,19 @@ class Circuit:
                     flip |= 1 << c
         if callable(u):
             op = _Op("u", targets, bitmask(controls), flip, mat_fn=u)
+            row = None      # a bare callable payload has no wire form
         else:
             u = np.asarray(u, dtype=np.complex128)
             dim = 1 << len(targets)
             if u.shape != (dim, dim):
                 raise ValueError(f"matrix shape {u.shape} != {(dim, dim)}")
             op = _Op("u", targets, bitmask(controls), flip, mat=u)
+            row = ["gate", _wire_cmat(u), list(targets), list(controls),
+                   [int(s) for s in control_states]
+                   if control_states is not None else None]
         self.ops.append(op)
+        if self._wire_depth == 0:
+            self._wire.append(row)
         return self
 
     def diagonal(self, factors, qubits: Sequence[int]) -> "Circuit":
@@ -199,13 +259,19 @@ class Circuit:
             fn = factors if identity else \
                 (lambda p, f=factors, a=axes: jnp.transpose(f(p), a))
             op = _Op("diag", desc, diag_fn=fn)
+            row = None
         else:
             t = np.asarray(factors, dtype=np.complex128)
             if t.shape != (2,) * len(qubits):
                 raise ValueError(f"diagonal tensor shape {t.shape} != "
                                  f"{(2,) * len(qubits)}")
             op = _Op("diag", desc, diag=t if identity else t.transpose(axes))
+            # journal the CALLER's axis order: replay re-derives the
+            # engine layout through this same method
+            row = ["diagonal", _wire_cmat(t), list(qubits)]
         self.ops.append(op)
+        if self._wire_depth == 0:
+            self._wire.append(row)
         return self
 
     # -- named gates (reference API surface) -------------------------------
@@ -231,14 +297,23 @@ class Circuit:
     def phase(self, q: int, angle: Angle) -> "Circuit":
         angle = self._register_angle(angle)
         if isinstance(angle, Param):
-            return self.diagonal(lambda p, a=angle: _phase_diag(_angle(p, a)), (q,))
+            return self._journal(
+                ["phase", int(q), _wire_angle(angle)],
+                lambda: self.diagonal(
+                    lambda p, a=angle: _phase_diag(_angle(p, a)), (q,)))
         return self.diagonal(np.array([1.0, np.exp(1j * angle)]), (q,))
 
     def _rot(self, q: int, angle: Angle, axis, controls=()) -> "Circuit":
         angle = self._register_angle(angle)
         if isinstance(angle, Param):
-            return self.gate(lambda p, a=angle: _rot_matrix(_angle(p, a), axis),
-                             (q,), controls)
+            return self._journal(
+                ["rot", int(q), _wire_angle(angle),
+                 # quest: allow-host-sync(builder-time journal entry —
+                 # `axis` is the caller's static host tuple)
+                 [float(x) for x in axis], [int(c) for c in controls]],
+                lambda: self.gate(
+                    lambda p, a=angle: _rot_matrix(_angle(p, a), axis),
+                    (q,), controls))
         return self.gate(mats.rotation(float(angle), axis), (q,), controls)
 
     def rx(self, q: int, angle: Angle) -> "Circuit":
@@ -254,7 +329,8 @@ class Circuit:
             def f(p, a=angle):
                 half = _angle(p, a) / 2.0
                 return jnp.stack([jnp.exp(-1j * half), jnp.exp(1j * half)])
-            return self.diagonal(f, (q,))
+            return self._journal(["rz", int(q), _wire_angle(angle)],
+                                 lambda: self.diagonal(f, (q,)))
         half = float(angle) / 2.0
         return self.diagonal(np.array([np.exp(-1j * half), np.exp(1j * half)]),
                              (q,))
@@ -279,7 +355,9 @@ class Circuit:
                 ph = jnp.exp(1j * _angle(p, a))
                 return jnp.stack([jnp.ones((2,), ph.dtype),
                                   jnp.stack([jnp.ones((), ph.dtype), ph])])
-            return self.diagonal(f, (control, target))
+            return self._journal(
+                ["cphase", int(control), int(target), _wire_angle(angle)],
+                lambda: self.diagonal(f, (control, target)))
         d = np.ones((2, 2), dtype=np.complex128)
         d[1, 1] = np.exp(1j * angle)
         return self.diagonal(d, (control, target))
@@ -291,7 +369,9 @@ class Circuit:
                 half = _angle(p, a) / 2.0
                 lo, hi = jnp.exp(-1j * half), jnp.exp(1j * half)
                 return jnp.stack([jnp.ones((2,), lo.dtype), jnp.stack([lo, hi])])
-            return self.diagonal(f, (control, target))
+            return self._journal(
+                ["crz", int(control), int(target), _wire_angle(angle)],
+                lambda: self.diagonal(f, (control, target)))
         half = float(angle) / 2.0
         d = np.ones((2, 2), dtype=np.complex128)
         d[1, 0], d[1, 1] = np.exp(-1j * half), np.exp(1j * half)
@@ -314,7 +394,10 @@ class Circuit:
             def f(p, a=angle, parity=idx):
                 half = _angle(p, a) / 2.0
                 return jnp.exp(1j * half * (2.0 * parity - 1.0))
-            return self.diagonal(f, qubits)
+            return self._journal(
+                ["multi_rotate_z", [int(q) for q in qubits],
+                 _wire_angle(angle)],
+                lambda: self.diagonal(f, qubits))
         half = float(angle) / 2.0
         return self.diagonal(np.exp(-1j * half * (1.0 - 2.0 * idx)), qubits)
 
@@ -352,9 +435,14 @@ class Circuit:
         self._check(targets)
         if callable(ops):
             self.ops.append(_Op("kraus", targets, kraus=ops))
+            if self._wire_depth == 0:
+                self._wire.append(None)
             return self
         mats_l = [np.asarray(m, dtype=np.complex128) for m in ops]
         self.ops.append(_Op("kraus", targets, kraus=mats_l))
+        if self._wire_depth == 0:
+            self._wire.append(
+                ["kraus", [_wire_cmat(m) for m in mats_l], list(targets)])
         return self
 
     def dephase(self, q: int, prob: Angle) -> "Circuit":
@@ -372,9 +460,11 @@ class Circuit:
         if isinstance(prob, Param):
             from .ops import channels as chan
             nm = self._register_angle(prob).name
-            return self.kraus(
-                lambda p, nm=nm: chan.dephasing_kraus_traceable(p[nm]),
-                (q,))
+            return self._journal(
+                ["dephase", int(q), {"param": nm}],
+                lambda: self.kraus(
+                    lambda p, nm=nm: chan.dephasing_kraus_traceable(p[nm]),
+                    (q,)))
         from . import validation as val
         val.validate_prob(prob, "Circuit.dephase", 0.5,
                           code=val.ErrorCode.E_INVALID_ONE_QUBIT_DEPHASE_PROB)
@@ -391,9 +481,11 @@ class Circuit:
         if isinstance(prob, Param):
             from .ops import channels as chan
             nm = self._register_angle(prob).name
-            return self.kraus(
-                lambda p, nm=nm: chan.depolarising_kraus_traceable(p[nm]),
-                (q,))
+            return self._journal(
+                ["depolarise", int(q), {"param": nm}],
+                lambda: self.kraus(
+                    lambda p, nm=nm: chan.depolarising_kraus_traceable(
+                        p[nm]), (q,)))
         from . import validation as val
         from .ops import channels as chan
         val.validate_prob(prob, "Circuit.depolarise", 0.75,
@@ -409,9 +501,11 @@ class Circuit:
         if isinstance(prob, Param):
             from .ops import channels as chan
             nm = self._register_angle(prob).name
-            return self.kraus(
-                lambda p, nm=nm: chan.damping_kraus_traceable(p[nm]),
-                (q,))
+            return self._journal(
+                ["damp", int(q), {"param": nm}],
+                lambda: self.kraus(
+                    lambda p, nm=nm: chan.damping_kraus_traceable(p[nm]),
+                    (q,)))
         from . import validation as val
         from .ops import channels as chan
         val.validate_prob(prob, "Circuit.damp", 1.0)
@@ -448,9 +542,12 @@ class Circuit:
                     vals.append(lambda pd, nm=nm: pd[nm])
                 else:
                     vals.append(lambda pd, v=float(p): v)
-            return self.kraus(
-                lambda pd, vs=tuple(vals): chan.pauli_kraus_traceable(
-                    vs[0](pd), vs[1](pd), vs[2](pd)), (q,))
+            return self._journal(
+                ["pauli_channel", int(q), _wire_angle(prob_x),
+                 _wire_angle(prob_y), _wire_angle(prob_z)],
+                lambda: self.kraus(
+                    lambda pd, vs=tuple(vals): chan.pauli_kraus_traceable(
+                        vs[0](pd), vs[1](pd), vs[2](pd)), (q,)))
         val.validate_one_qubit_pauli_probs(prob_x, prob_y, prob_z,
                                            "Circuit.pauli_channel")
         return self.kraus(chan.pauli_kraus(prob_x, prob_y, prob_z), (q,))
@@ -527,8 +624,10 @@ class Circuit:
         def on(p):
             return isinstance(p, Param) or p > 0.0
 
-        for op in self.ops:
+        base_rows = self._wire_rows()
+        for i, op in enumerate(self.ops):
             out.ops.append(op)
+            out._wire.append(base_rows[i])
             if op.kind == "kraus":
                 continue
             touched = sorted(
@@ -724,6 +823,7 @@ class Circuit:
     def extend(self, other: "Circuit") -> "Circuit":
         if other.num_qubits != self.num_qubits:
             raise ValueError("qubit count mismatch")
+        self._wire = self._wire_rows() + other._wire_rows()
         self.ops.extend(other.ops)
         for n in other._params:
             if n not in self._params:

@@ -1,0 +1,47 @@
+"""Network front door for the serving stack (ROADMAP item 1).
+
+An asyncio HTTP/1.1 JSON façade in front of
+:class:`~quest_tpu.serve.router.ServiceRouter` /
+:class:`~quest_tpu.serve.engine.SimulationService` — stdlib-only on the
+server side, like the telemetry loopback exporter it shares endpoint
+plumbing with:
+
+- :mod:`quest_tpu.netserve.wire` — the versioned ``quest_tpu.wire/1``
+  form: recorded circuits (builder-call journal replay), Param
+  bindings, observables-as-Pauli-terms, every request kind, canonical
+  JSON, and a content digest that matches
+  :func:`quest_tpu.serve.warmcache.circuit_digest`;
+- :mod:`quest_tpu.netserve.session` — authn tokens -> tenants through a
+  pluggable :class:`AuthHook` (quota/priority ride the WFQ
+  :class:`~quest_tpu.serve.sched.TenantPolicy` contract) and the
+  digest-keyed program registry that pins a session's compiled
+  programs to warm replicas;
+- :mod:`quest_tpu.netserve.server` — the server: request/stream/
+  observability endpoints, chunked-transfer streaming of optimizer
+  iterates, dynamics segments, and trajectory wave progress;
+- :mod:`quest_tpu.netserve.client` — the stdlib sync client with the
+  same ``submit() -> Future`` shape as the in-process service.
+"""
+
+from .errors import (WireError, WireFormatError, DigestMismatch,
+                     UnknownProgram, AuthError, StreamUnsupported,
+                     http_status, error_body)
+from .wire import (WIRE_SCHEMA, REQUEST_KINDS, canonical_json,
+                   encode_circuit, decode_circuit, encode_request,
+                   decode_request, encode_result, parse_result,
+                   WireRequest)
+from .session import (AuthHook, StaticTokenAuth, OpenAuth, SessionGrant,
+                      Session, SessionManager, ProgramRegistry)
+from .server import NetServer
+from .client import NetClient
+
+__all__ = [
+    "WIRE_SCHEMA", "REQUEST_KINDS", "canonical_json",
+    "encode_circuit", "decode_circuit", "encode_request",
+    "decode_request", "encode_result", "parse_result", "WireRequest",
+    "WireError", "WireFormatError", "DigestMismatch", "UnknownProgram",
+    "AuthError", "StreamUnsupported", "http_status", "error_body",
+    "AuthHook", "StaticTokenAuth", "OpenAuth", "SessionGrant",
+    "Session", "SessionManager", "ProgramRegistry",
+    "NetServer", "NetClient",
+]

@@ -1,0 +1,276 @@
+"""The stdlib sync client: ``submit() -> Future`` over a socket.
+
+:class:`NetClient` mirrors the in-process
+:meth:`~quest_tpu.serve.engine.SimulationService.submit` shape — pass a
+recorded circuit plus the kind's knobs, get a
+:class:`concurrent.futures.Future` resolving with the SAME value shape
+the in-process future resolves with (planes array, ``(mean, stderr)``,
+``(value, grad)``, …). Server errors re-raise as the SAME typed
+exception family (``except QueueFull`` works identically over the
+socket, :func:`~quest_tpu.netserve.errors.raise_typed`).
+
+The client is content-address aware: the first submission of a circuit
+ships the full wire form; repeats ship only its digest
+(``circuit_ref``), falling back to a one-shot full resend when the
+server answers 404 ``UnknownProgram`` (evicted or restarted). Deadlines
+are RELATIVE (``timeout_s``) by protocol — there is no way to send an
+absolute timestamp, so a skewed client clock cannot extend one.
+
+:meth:`NetClient.stream` yields the server's ndjson events (optimizer
+iterates, dynamics segments, trajectory waves) as plain dicts; closing
+the generator closes the socket, which cancels the server-side handle.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+from . import wire
+from ._pool import WorkerPool
+from .errors import UnknownProgram, raise_typed
+from .server import SESSION_HEADER
+
+__all__ = ["NetClient"]
+
+
+def _infer_kind(observables, shots, trajectories, gradient, evolve,
+                ground) -> str:
+    if evolve is not None:
+        return "evolve"
+    if ground is not None:
+        return "ground"
+    if gradient:
+        return "gradient"
+    if shots is not None:
+        return "shots"
+    if trajectories is not None:
+        return "trajectory"
+    if observables is not None:
+        return "expectation"
+    return "sweep"
+
+
+class NetClient:
+    """One server endpoint, many concurrent requests.
+
+    Each request rides its own ``http.client.HTTPConnection`` on a
+    small thread pool — the stdlib connection is not thread-safe, and
+    per-request connections keep the client dependency-free while the
+    server side multiplexes fine.
+    """
+
+    def __init__(self, host: str, port: int, *,
+                 token: Optional[str] = None, timeout: float = 300.0,
+                 max_workers: int = 8):
+        self.host = host
+        self.port = int(port)
+        self._token = token
+        self._timeout = float(timeout)
+        self._lock = threading.Lock()
+        self._session_lock = threading.Lock()
+        self._session: Optional[str] = None
+        self.tenant: Optional[str] = None
+        self._programs: dict = {}      # digest -> full circuit doc
+        self._confirmed: set = set()   # digests the server acked
+        self._pool = WorkerPool(int(max_workers), "quest-netclient")
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: bytes = None,
+                 headers: dict = None,
+                 timeout: Optional[float] = None):
+        conn = http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=self._timeout if timeout is None else timeout)
+        try:
+            hdrs = {"Content-Type": "application/json"}
+            if headers:
+                hdrs.update(headers)
+            conn.request(method, path, body=body, headers=hdrs)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _payload(status: int, data: bytes) -> dict:
+        try:
+            return json.loads(data.decode("utf-8"))
+        except ValueError:
+            return {"error": {"type": "WireError",
+                              "message": f"non-JSON body (HTTP "
+                                         f"{status}): {data[:200]!r}"}}
+
+    # -- sessions ----------------------------------------------------------
+
+    def open_session(self) -> str:
+        """Open (or return) this client's session; called lazily by the
+        first submit."""
+        # one session per client: serialize creation so concurrent
+        # first submits don't each open their own
+        with self._session_lock:
+            if self._session is not None:
+                return self._session
+            doc = {} if self._token is None else {"token": self._token}
+            status, data = self._request(
+                "POST", "/v1/session", json.dumps(doc).encode())
+            payload = self._payload(status, data)
+            if status != 200:
+                raise_typed(status, payload)
+            self._session = str(payload["session"])
+            self.tenant = payload.get("tenant")
+            return self._session
+
+    @property
+    def session(self) -> Optional[str]:
+        return self._session
+
+    # -- submit ------------------------------------------------------------
+
+    def submit(self, circuit=None, params=None, *, kind=None,
+               circuit_ref=None, qasm=None, observables=None,
+               shots=None, trajectories=None, sampling_budget=None,
+               gradient: bool = False, evolve=None, ground=None,
+               ground_state=None, init_state=None, tier=None,
+               priority=None, timeout_s=None) -> Future:
+        """Submit one request; returns a Future resolving with the same
+        value shape the in-process API resolves with."""
+        ground = ground if ground is not None else ground_state
+        wk = kind or _infer_kind(observables, shots, trajectories,
+                                 gradient, evolve, ground)
+        cdoc = None
+        if circuit is not None:
+            cdoc = circuit if isinstance(circuit, dict) \
+                else wire.encode_circuit(circuit)
+            digest = cdoc.get("digest")
+            with self._lock:
+                # ref only digests the server ACKED (a 200 with this
+                # program): switching on first SEND would race our own
+                # in-flight full submission to the server
+                known = digest in self._confirmed
+                if digest is not None:
+                    self._programs[digest] = cdoc
+            if known:
+                circuit_ref, cdoc_sent = digest, None
+            else:
+                cdoc_sent = cdoc
+        else:
+            cdoc_sent = None
+        doc = wire.encode_request(
+            wk, circuit=cdoc_sent, circuit_ref=circuit_ref, qasm=qasm,
+            params=params, observables=observables, shots=shots,
+            trajectories=trajectories, sampling_budget=sampling_budget,
+            tier=tier, priority=priority, timeout_s=timeout_s,
+            evolve=evolve, ground=ground, init_state=init_state)
+        return self._pool.submit(self._roundtrip, wk, doc)
+
+    def submit_wire(self, doc: dict) -> Future:
+        """Submit a raw wire document verbatim (tests, tooling)."""
+        kind = doc.get("kind")
+        return self._pool.submit(self._roundtrip, kind, dict(doc))
+
+    def _roundtrip(self, kind: str, doc: dict):
+        sid = self.open_session()
+        body = wire.canonical_json(doc).encode()
+        status, data = self._request(
+            "POST", "/v1/submit", body, headers={SESSION_HEADER: sid})
+        payload = self._payload(status, data)
+        if status == 200:
+            program = payload.get("program")
+            if program is not None:
+                with self._lock:
+                    self._confirmed.add(program)
+            self.last_program = program
+            return wire.parse_result(kind, payload["result"])
+        ref = doc.get("circuit_ref")
+        if status == 404 and ref is not None:
+            # evicted/restarted server forgot the program: one full
+            # resend re-registers it
+            with self._lock:
+                self._confirmed.discard(ref)
+                full = self._programs.get(ref)
+            if full is not None:
+                retry = {k: v for k, v in doc.items()
+                         if k != "circuit_ref"}
+                retry["circuit"] = full
+                status2, data2 = self._request(
+                    "POST", "/v1/submit", wire.canonical_json(
+                        retry).encode(),
+                    headers={SESSION_HEADER: sid})
+                payload2 = self._payload(status2, data2)
+                if status2 == 200:
+                    program = payload2.get("program")
+                    if program is not None:
+                        with self._lock:
+                            self._confirmed.add(program)
+                    self.last_program = program
+                    return wire.parse_result(kind, payload2["result"])
+                raise_typed(status2, payload2)
+            raise UnknownProgram(
+                f"server forgot program {ref!r} and this client holds "
+                "no full wire form for it")
+        raise_typed(status, payload)
+
+    # -- streaming ---------------------------------------------------------
+
+    def stream(self, circuit=None, params=None, *, kind=None,
+               circuit_ref=None, qasm=None, observables=None,
+               trajectories=None, sampling_budget=None, evolve=None,
+               ground=None, ground_state=None, init_state=None,
+               tier=None, optimizer=None, timeout_s=None,
+               timeout: Optional[float] = None):
+        """Stream one run's events as dicts (``event`` in
+        ``{"stream.open", "iterate", "segment", "wave", "result",
+        "error"}``). Closing the generator closes the socket, which
+        cancels the server-side handle."""
+        ground = ground if ground is not None else ground_state
+        if kind is None:
+            if optimizer is not None:
+                kind = "gradient"
+            else:
+                kind = _infer_kind(observables, None, trajectories,
+                                   False, evolve, ground)
+        if circuit is not None and not isinstance(circuit, dict):
+            circuit = wire.encode_circuit(circuit)
+        doc = wire.encode_request(
+            kind, circuit=circuit, circuit_ref=circuit_ref, qasm=qasm,
+            params=params, observables=observables,
+            trajectories=trajectories, sampling_budget=sampling_budget,
+            tier=tier, timeout_s=timeout_s, evolve=evolve,
+            ground=ground, init_state=init_state, optimizer=optimizer)
+        sid = self.open_session()
+        body = wire.canonical_json(doc).encode()
+        conn = http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=self._timeout if timeout is None else timeout)
+        try:
+            conn.request("POST", "/v1/stream", body=body,
+                         headers={"Content-Type": "application/json",
+                                  SESSION_HEADER: sid})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise_typed(resp.status,
+                            self._payload(resp.status, resp.read()))
+            while True:
+                line = resp.readline()
+                if not line:
+                    return
+                yield json.loads(line)
+        finally:
+            conn.close()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "NetClient":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -1,0 +1,149 @@
+"""Typed wire errors and their HTTP mapping.
+
+Every error crossing the wire carries three things: an HTTP status, the
+exception TYPE name (clients dispatch on it the way in-process callers
+``except QueueFull``), and the resilience classification
+(:func:`quest_tpu.resilience.recovery.classify` — ``transient`` errors
+are retryable, ``fatal`` ones are caller bugs). The mapping table is
+the contract documented in ``docs/tpu.md``:
+
+===============================  ======  ==============
+exception                        status  classification
+===============================  ======  ==============
+``WireFormatError`` (bad form)   400     fatal
+``AuthError``                    401     fatal
+``UnknownProgram``               404     transient
+``DigestMismatch``               409     fatal
+``QueueFull`` / ``QuotaExceeded``  429   transient
+``NumericalFault`` (poison)      500     poison
+``StreamUnsupported``            501     fatal
+``CircuitBreakerOpen`` etc.      503     transient
+``DeadlineExceeded``             504     transient
+===============================  ======  ==============
+"""
+
+from __future__ import annotations
+
+__all__ = ["WireError", "WireFormatError", "DigestMismatch",
+           "UnknownProgram", "AuthError", "StreamUnsupported",
+           "http_status", "error_body", "raise_typed"]
+
+
+class WireError(Exception):
+    """Base class for wire-protocol errors; ``status`` is the HTTP
+    code the server answers with."""
+
+    status = 400
+    classification = "fatal"     # a malformed submission never retries
+
+    def __init__(self, message: str, detail: dict = None):
+        super().__init__(message)
+        self.detail = dict(detail or {})
+
+
+class WireFormatError(WireError):
+    """The request body is not a valid ``quest_tpu.wire/1`` document
+    (unknown schema/kind, malformed circuit row, absolute deadline,
+    un-serializable op)."""
+
+    status = 400
+
+
+class AuthError(WireError):
+    """Unknown token or session — the authn hook rejected it."""
+
+    status = 401
+
+
+class UnknownProgram(WireError):
+    """A ``circuit_ref`` digest the server has no registered program
+    for (evicted or never sent): re-submit the full circuit."""
+
+    status = 404
+    classification = "transient"   # the full-circuit retry resolves it
+
+
+class DigestMismatch(WireError):
+    """The decoded circuit's content digest does not match the digest
+    the submission claimed — a corrupted or mis-assembled wire form is
+    rejected, never silently served."""
+
+    status = 409
+
+
+class StreamUnsupported(WireError):
+    """The backend behind this server cannot stream the requested
+    kind (e.g. a bare router with no ``evolve()``)."""
+
+    status = 501
+
+
+def http_status(exc: BaseException) -> int:
+    """HTTP status for ANY exception crossing the wire boundary."""
+    if isinstance(exc, WireError):
+        return exc.status
+    from ..serve.engine import (QueueFull, QuotaExceeded,
+                                DeadlineExceeded, ServeError)
+    if isinstance(exc, (QueueFull, QuotaExceeded)):
+        return 429
+    if isinstance(exc, DeadlineExceeded):
+        return 504
+    if isinstance(exc, ServeError):
+        # ServiceClosed, CircuitBreakerOpen, AllReplicasUnavailable, …
+        return 503
+    if isinstance(exc, (ValueError, TypeError, KeyError)):
+        return 400       # caller errors reject typed at admission
+    return 500
+
+
+def error_body(exc: BaseException) -> dict:
+    """The JSON error envelope: type name + message + resilience
+    classification (+ any typed detail)."""
+    from ..resilience.recovery import classify
+    body = {"error": {
+        "type": type(exc).__name__,
+        "message": str(exc),
+        "classification": getattr(exc, "classification", None)
+        or classify(exc),
+    }}
+    detail = getattr(exc, "detail", None)
+    if detail:
+        body["error"]["detail"] = dict(detail)
+    return body
+
+
+_CLIENT_TYPES = None
+
+
+def raise_typed(status: int, err: dict) -> None:
+    """Client side of the mapping: re-raise the server's error envelope
+    as the SAME typed exception family the in-process API raises, so
+    ``except QueueFull`` works identically over the socket."""
+    global _CLIENT_TYPES
+    if _CLIENT_TYPES is None:
+        from ..serve.engine import (QueueFull, QuotaExceeded,
+                                    DeadlineExceeded, ServiceClosed,
+                                    CircuitBreakerOpen)
+        _CLIENT_TYPES = {
+            "QueueFull": QueueFull,
+            "QuotaExceeded": QuotaExceeded,
+            "DeadlineExceeded": DeadlineExceeded,
+            "ServiceClosed": ServiceClosed,
+            "CircuitBreakerOpen": CircuitBreakerOpen,
+            "WireFormatError": WireFormatError,
+            "DigestMismatch": DigestMismatch,
+            "UnknownProgram": UnknownProgram,
+            "AuthError": AuthError,
+            "StreamUnsupported": StreamUnsupported,
+            "ValueError": ValueError,
+            "TypeError": TypeError,
+        }
+    info = dict(err.get("error", {}))
+    name = str(info.get("type", "WireError"))
+    msg = str(info.get("message", f"HTTP {status}"))
+    exc_type = _CLIENT_TYPES.get(name)
+    if exc_type is None:
+        e = WireError(f"{name}: {msg} (HTTP {status})")
+        e.status = status
+        raise e
+    raise exc_type(msg)

@@ -1,0 +1,682 @@
+"""The netserve front door: a stdlib asyncio HTTP/1.1 JSON server.
+
+One daemon-thread event loop accepts connections and parses requests;
+every blocking step (decode, backend ``submit``, ``future.result()``,
+encode) runs on the server's own worker pool
+(:class:`~quest_tpu.netserve._pool.WorkerPool` — NOT the loop's
+default ``ThreadPoolExecutor``; see ``_pool.py``) so slow dispatches
+never stall the acceptor. Routes:
+
+- ``POST /v1/session`` — open a session: ``{"token": ...}`` through the
+  :class:`~quest_tpu.netserve.session.AuthHook` to a tenant (401 on
+  rejection); the grant's WFQ policy is installed on the backend.
+- ``POST /v1/submit`` — one wire request
+  (:mod:`quest_tpu.netserve.wire`), one JSON result. The program is
+  resolved through the digest-keyed registry (first submission warms
+  the backend; repeats skip decode entirely), the session's tenant
+  rides into the SAME WFQ admission as in-process callers, and the
+  relative ``timeout_s`` is converted to an absolute deadline at
+  SERVER receipt — client clocks never extend a deadline.
+- ``POST /v1/stream`` — chunked-transfer ndjson events
+  (:data:`~quest_tpu.telemetry.events.EVENT_SCHEMA` shape): optimizer
+  iterates (``kind="gradient"`` + ``optimizer``), dynamics segments
+  (``evolve``/``ground``), trajectory wave progress (``trajectory``).
+  Client disconnect cancels the underlying handle.
+- ``GET /metrics``, ``/metrics.json``, ``/healthz`` — the shared
+  observability resolver (:class:`~quest_tpu.telemetry.endpoints.
+  ObservabilityEndpoints`), identical to the telemetry exporter's; and
+  ``GET /v1/sessions`` — per-session program-registry hit rates (the
+  ``tools/wire_trace.py`` signal).
+
+Request handling is traced (``quest_tpu.trace/1``) when
+``trace_sample_rate`` samples it: ``parse`` -> ``queue`` ->
+``dispatch`` -> ``serialize`` spans per request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from typing import Optional
+
+from ..telemetry.endpoints import ObservabilityEndpoints
+from ..telemetry.events import make_event
+from ..telemetry.metrics import metrics_registry
+from ..telemetry.tracing import Tracer
+from . import wire
+from ._pool import WorkerPool
+from .errors import (AuthError, StreamUnsupported, WireFormatError,
+                     error_body, http_status)
+from .session import ProgramRegistry, SessionManager
+
+__all__ = ["NetServer"]
+
+_SERVER_NAME = "quest-tpu-netserve"
+SESSION_HEADER = "x-quest-session"
+
+_REASONS = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
+            404: "Not Found", 409: "Conflict",
+            429: "Too Many Requests", 500: "Internal Server Error",
+            501: "Not Implemented", 503: "Service Unavailable",
+            504: "Gateway Timeout"}
+
+_NOT_FOUND = (b'{"error": {"type": "NotFound", "message": '
+              b'"unknown route", "classification": "fatal"}}')
+
+
+def _response(status: int, body: bytes,
+              ctype: str = "application/json",
+              keep_alive: bool = True) -> bytes:
+    reason = _REASONS.get(status, "Error")
+    conn = "keep-alive" if keep_alive else "close"
+    head = (f"HTTP/1.1 {status} {reason}\r\n"
+            f"Server: {_SERVER_NAME}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {conn}\r\n\r\n")
+    return head.encode("latin-1") + body
+
+
+class NetServer:
+    """The network front door over one backend (a
+    :class:`~quest_tpu.serve.router.ServiceRouter` or a bare
+    :class:`~quest_tpu.serve.engine.SimulationService`).
+
+    ``port=0`` (the default) binds a free loopback port — read it back
+    from ``server.port``. The server is a context manager; ``close()``
+    cancels live stream handles, stops the loop, and unregisters the
+    wire metrics provider.
+    """
+
+    def __init__(self, backend, *, auth=None, allow_anonymous: bool = True,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_body: int = 16 << 20, max_programs: int = 256,
+                 registry=None, trace_sample_rate: float = 0.0,
+                 warm_on_register: bool = True, max_workers: int = 16):
+        from ..serve.metrics import WireMetrics
+        self.backend = backend
+        # NOT the loop's default executor (a ThreadPoolExecutor): see
+        # netserve/_pool.py. Every blocking step — session open, wire
+        # decode, backend submit + future.result(), stream pump — runs
+        # here; each in-flight request occupies one worker for its
+        # whole dispatch, so max_workers bounds server-side concurrency
+        self._pool = WorkerPool(int(max_workers), "quest-netserve")
+        self.metrics = WireMetrics()
+        self.sessions = SessionManager(auth, backend,
+                                       allow_anonymous=allow_anonymous)
+        self.programs = ProgramRegistry(max_programs=max_programs)
+        self.tracer = Tracer(sample_rate=trace_sample_rate,
+                             name="netserve")
+        self._max_body = int(max_body)
+        self._warm_on_register = bool(warm_on_register)
+        self._registry = registry if registry is not None \
+            else metrics_registry()
+        self._endpoints = ObservabilityEndpoints(
+            self._registry,
+            backend if hasattr(backend, "dispatch_stats") else None)
+        self._metrics_name = self._registry.unique_name("netserve")
+        self._registry.register(self._metrics_name, self.metrics.snapshot,
+                                kind="netserve", owner=self)
+        self._handles_lock = threading.Lock()
+        self._handles: set = set()
+        self._debug_last_handle = None      # tests poke at this
+        self._closed = False
+        self._server = None
+        self._start_exc: Optional[BaseException] = None
+        self._started = threading.Event()
+        self._loop = asyncio.new_event_loop()
+        self.host = host
+        self.port = int(port)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"quest-tpu-netserve-{host}")
+        self._thread.start()
+        self._started.wait(30.0)
+        if self._start_exc is not None:
+            exc, self._start_exc = self._start_exc, None
+            self._registry.unregister(self._metrics_name)
+            raise exc
+        if not self._started.is_set():
+            raise RuntimeError("netserve event loop failed to start")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._server = self._loop.run_until_complete(
+                asyncio.start_server(self._handle_conn, self.host,
+                                     self.port))
+            sockname = self._server.sockets[0].getsockname()
+            self.host, self.port = sockname[0], int(sockname[1])
+        # quest: allow-broad-except(boot failure propagates to the
+        # constructor through _start_exc, whatever the bind raised)
+        except Exception as e:
+            self._start_exc = e
+            self._started.set()
+            return
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            try:
+                self._server.close()
+                self._loop.run_until_complete(
+                    self._server.wait_closed())
+                self._loop.run_until_complete(
+                    self._loop.shutdown_asyncgens())
+            # quest: allow-broad-except(shutdown best-effort: the
+            # daemon loop thread must exit cleanly regardless)
+            except Exception:
+                pass
+            self._loop.close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with self._handles_lock:
+            handles = list(self._handles)
+            self._handles.clear()
+        for h in handles:
+            self._cancel_handle(h)
+        if self._started.is_set() and self._start_exc is None:
+            try:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            except RuntimeError:
+                pass                      # loop already gone
+            self._thread.join(10.0)
+        self._pool.shutdown(wait=False)
+        self._registry.unregister(self._metrics_name)
+
+    def __enter__(self) -> "NetServer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    @staticmethod
+    def _cancel_handle(handle) -> None:
+        try:
+            handle.cancel()
+        # quest: allow-broad-except(cancel is best-effort teardown; a
+        # handle mid-completion may legally refuse)
+        except Exception:
+            pass
+
+    def _track(self, handle) -> None:
+        self._debug_last_handle = handle
+        with self._handles_lock:
+            self._handles.add(handle)
+
+    def _untrack(self, handle) -> None:
+        with self._handles_lock:
+            self._handles.discard(handle)
+
+    # -- connection handling -----------------------------------------------
+
+    async def _read_request(self, reader):
+        line = await reader.readline()
+        if not line or line in (b"\r\n", b"\n"):
+            return None
+        parts = line.decode("latin-1").strip().split()
+        if len(parts) != 3:
+            raise WireFormatError(f"malformed request line {line!r}")
+        method, path, _version = parts
+        headers = {}
+        while True:
+            hline = await reader.readline()
+            if hline in (b"\r\n", b"\n", b""):
+                break
+            name, sep, value = hline.decode("latin-1").partition(":")
+            if not sep:
+                raise WireFormatError(f"malformed header {hline!r}")
+            headers[name.strip().lower()] = value.strip()
+        body = b""
+        length = int(headers.get("content-length", "0") or "0")
+        if length:
+            if length > self._max_body:
+                raise WireFormatError(
+                    f"request body of {length} bytes exceeds the "
+                    f"server's max_body of {self._max_body}")
+            body = await reader.readexactly(length)
+        return method, path, headers, body
+
+    async def _handle_conn(self, reader, writer) -> None:
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                except WireFormatError as e:
+                    writer.write(_response(
+                        400, wire.canonical_json(error_body(e)).encode(),
+                        keep_alive=False))
+                    await writer.drain()
+                    break
+                if req is None:
+                    break
+                method, path, headers, body = req
+                keep = headers.get("connection", "").lower() != "close"
+                if method == "GET":
+                    resolved = await asyncio.wrap_future(
+                        self._pool.submit(self._get_blocking, path))
+                    status, ctype, payload = resolved
+                    writer.write(_response(status, payload, ctype,
+                                           keep_alive=keep))
+                    await writer.drain()
+                elif method == "POST" and path.startswith("/v1/session"):
+                    status, payload = await asyncio.wrap_future(
+                        self._pool.submit(self._open_session_blocking,
+                                          body))
+                    writer.write(_response(status, payload,
+                                           keep_alive=keep))
+                    await writer.drain()
+                elif method == "POST" and path.startswith("/v1/submit"):
+                    status, payload = await asyncio.wrap_future(
+                        self._pool.submit(self._submit_blocking,
+                                          headers, body))
+                    writer.write(_response(status, payload,
+                                           keep_alive=keep))
+                    await writer.drain()
+                elif method == "POST" and path.startswith("/v1/stream"):
+                    await self._handle_stream(headers, body, reader,
+                                              writer)
+                    break             # streams own (and end) the socket
+                else:
+                    writer.write(_response(404, _NOT_FOUND,
+                                           keep_alive=keep))
+                    await writer.drain()
+                if not keep:
+                    break
+        # quest: allow-broad-except(connection boundary: one sick
+        # socket must never take down the acceptor loop)
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            # quest: allow-broad-except(double-close on a reset socket
+            # is not an event)
+            except Exception:
+                pass
+
+    # -- GET ---------------------------------------------------------------
+
+    def _get_blocking(self, path: str):
+        try:
+            if path.startswith("/v1/sessions"):
+                body = wire.canonical_json(
+                    {"sessions": self.sessions.snapshot(),
+                     "programs": len(self.programs)}).encode()
+                return 200, "application/json", body
+            resolved = self._endpoints.resolve(path)
+            if resolved is None:
+                return 404, "application/json", _NOT_FOUND
+            return resolved
+        # quest: allow-broad-except(observability boundary: a failing
+        # provider answers 500, it must not kill the connection loop)
+        except Exception as e:
+            return (500, "application/json",
+                    json.dumps(error_body(e)).encode())
+
+    # -- sessions ----------------------------------------------------------
+
+    def _open_session_blocking(self, body: bytes):
+        try:
+            doc = json.loads(body.decode("utf-8")) if body else {}
+            token = doc.get("token")
+            sess = self.sessions.open(
+                str(token) if token is not None else None)
+            self.metrics.incr("sessions_opened")
+            payload = wire.canonical_json(
+                {"session": sess.id, "tenant": sess.tenant}).encode()
+            return 200, payload
+        # quest: allow-broad-except(wire boundary: every failure
+        # answers typed — AuthError 401, bad JSON 400)
+        except Exception as e:
+            self.metrics.incr("errors_total")
+            if isinstance(e, AuthError):
+                self.metrics.incr("auth_rejections")
+            return http_status(e), json.dumps(error_body(e)).encode()
+
+    # -- submit ------------------------------------------------------------
+
+    def _submit_blocking(self, headers: dict, body: bytes):
+        t0 = time.perf_counter()
+        ctx = self.tracer.start(endpoint="submit")
+        self.metrics.incr("bytes_in", len(body))
+        try:
+            sess = self.sessions.resolve(headers.get(SESSION_HEADER))
+            sess.requests += 1
+            sp = ctx.begin("parse") if ctx else None
+            p0 = time.perf_counter()
+            wr = wire.decode_request(json.loads(body.decode("utf-8")))
+            circuit, digest = self._resolve_program(sess, wr, ctx)
+            self.metrics.record_parse(time.perf_counter() - p0)
+            if ctx:
+                ctx.end(sp, kind=wr.kind, program=digest,
+                        session=sess.id)
+            kw = wr.submit_kwargs()
+            kw["tenant"] = sess.tenant
+            if wr.timeout_s is not None:
+                # RELATIVE budget: the backend anchors it to ITS clock
+                # at receipt (min with the service policy's own cap)
+                kw["deadline"] = wr.timeout_s
+            sp = ctx.begin("queue") if ctx else None
+            fut = self.backend.submit(circuit, **kw)
+            if ctx:
+                ctx.end(sp)
+            sp = ctx.begin("dispatch") if ctx else None
+            value = fut.result()
+            if ctx:
+                ctx.end(sp)
+            sp = ctx.begin("serialize") if ctx else None
+            s0 = time.perf_counter()
+            payload = wire.canonical_json(
+                {"schema": wire.WIRE_SCHEMA, "kind": wr.kind,
+                 "program": digest,
+                 "result": wire.encode_result(wr.kind, value)}).encode()
+            self.metrics.record_serialize(time.perf_counter() - s0)
+            if ctx:
+                ctx.end(sp)
+                ctx.finish("ok")
+            self.metrics.incr("requests_total")
+            self.metrics.incr("requests_" + wr.kind)
+            self.metrics.incr("bytes_out", len(payload))
+            self.metrics.record_request(time.perf_counter() - t0)
+            return 200, payload
+        # quest: allow-broad-except(wire boundary: EVERY failure maps
+        # to a typed JSON error envelope + HTTP status — the socket
+        # never sees a traceback)
+        except Exception as e:
+            self.metrics.incr("errors_total")
+            if isinstance(e, AuthError):
+                self.metrics.incr("auth_rejections")
+            if ctx:
+                ctx.add("error", type=type(e).__name__)
+                ctx.finish("error")
+            return http_status(e), json.dumps(error_body(e)).encode()
+
+    def _resolve_program(self, sess, wr, ctx):
+        """``circuit_ref``/``circuit``/``qasm`` -> (Circuit, digest),
+        with per-session hit accounting. First sight of a digest
+        registers AND warms; repeats skip decode entirely."""
+        if wr.circuit_ref is not None:
+            c = self.programs.lookup(str(wr.circuit_ref))
+            sess.hits += 1
+            self.metrics.incr("program_hits")
+            return c, str(wr.circuit_ref)
+        if wr.qasm is not None:
+            from ..qasm_import import parse_qasm
+            from ..serve.warmcache import circuit_digest
+            self.metrics.incr("qasm_submissions")
+            c = parse_qasm(wr.qasm, dialect="quest").circuit
+            digest = circuit_digest(c)
+            existing = self.programs.get(digest)
+            if existing is not None:
+                sess.hits += 1
+                self.metrics.incr("program_hits")
+                return existing, digest
+            self._register_and_warm(digest, c, wr, ctx)
+            sess.misses += 1
+            self.metrics.incr("program_misses")
+            return c, digest
+        doc = wr.circuit_doc
+        claimed = doc.get("digest") if isinstance(doc, dict) else None
+        if claimed is not None:
+            existing = self.programs.get(claimed)
+            if existing is not None:
+                # a full resend of a known program: the digest IS the
+                # content address, so skip the replay entirely
+                sess.hits += 1
+                self.metrics.incr("program_hits")
+                return existing, claimed
+        c = wire.decode_circuit(doc)          # verifies the digest claim
+        if claimed is None:
+            from ..serve.warmcache import circuit_digest
+            claimed = circuit_digest(c)
+        self._register_and_warm(claimed, c, wr, ctx)
+        sess.misses += 1
+        self.metrics.incr("program_misses")
+        return c, claimed
+
+    def _register_and_warm(self, digest, circuit, wr, ctx=None) -> None:
+        if not self.programs.register(digest, circuit):
+            return
+        self.metrics.incr("programs_registered")
+        if not self._warm_on_register:
+            return
+        warm = getattr(self.backend, "warm", None)
+        if warm is None:
+            return
+        if ctx:
+            ctx.add("warm", program=digest, kind=wr.kind)
+        obs = wr.observables
+        try:
+            if wr.kind == "expectation" and obs is not None:
+                warm(circuit, observables=obs)
+            elif wr.kind == "shots" and wr.shots is not None:
+                warm(circuit, shots=wr.shots)
+            elif wr.kind == "gradient" and obs is not None \
+                    and wr.trajectories is None:
+                try:
+                    warm(circuit, observables=obs, gradient=True)
+                except TypeError:
+                    # routers warm observables only; the gradient
+                    # executable compiles on first dispatch
+                    warm(circuit, observables=obs)
+            elif wr.kind == "trajectory" and obs is not None:
+                try:
+                    warm(circuit, observables=obs,
+                         trajectories=wr.trajectories or 1)
+                except TypeError:
+                    pass   # no trajectory warm surface on this backend
+            elif wr.kind == "sweep":
+                warm(circuit)
+            # evolve/ground compile per-segment executables — no
+            # submit-shaped warm form exists for them
+        # quest: allow-broad-except(warming is an optimization: a warm
+        # failure must never fail the request that triggered it)
+        except Exception:
+            pass
+
+    # -- streaming ---------------------------------------------------------
+
+    async def _handle_stream(self, headers, body, reader, writer) -> None:
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+        t0 = time.monotonic()
+        done = object()
+        self.metrics.incr("bytes_in", len(body))
+
+        def emit(name: str, **detail) -> None:
+            ev = make_event(name, t0, **wire.jsonable(detail))
+            try:
+                loop.call_soon_threadsafe(queue.put_nowait, ev)
+            except RuntimeError:
+                pass                        # loop closed mid-stream
+
+        setup = await asyncio.wrap_future(
+            self._pool.submit(self._stream_setup_blocking, headers,
+                              body, emit))
+        status, err_payload, mode, handle, digest, kind = setup
+        if err_payload is not None:
+            writer.write(_response(status, err_payload,
+                                   keep_alive=False))
+            await writer.drain()
+            return
+        writer.write((f"HTTP/1.1 200 OK\r\n"
+                      f"Server: {_SERVER_NAME}\r\n"
+                      "Content-Type: application/x-ndjson\r\n"
+                      "Transfer-Encoding: chunked\r\n"
+                      "Connection: close\r\n\r\n").encode("latin-1"))
+        await writer.drain()
+        self.metrics.incr("streams_opened")
+        emit("stream.open", kind=kind, program=digest)
+
+        def pump() -> None:
+            try:
+                if mode == "handle":
+                    name = "segment" if kind in ("evolve", "ground") \
+                        else "iterate"
+                    for it in handle.iterates():
+                        emit(name, **it)
+                    emit("result", kind=kind, result=handle.result())
+                else:
+                    # a trajectory future: wave events already ride the
+                    # _progress callback; just resolve the value
+                    value = handle.result()
+                    emit("result", kind=kind,
+                         result=wire.encode_result(kind, value))
+            # quest: allow-broad-except(stream boundary: a failing run
+            # becomes a terminal "error" event, never a half-closed
+            # socket with no explanation)
+            except Exception as e:
+                emit("error", **error_body(e)["error"])
+            finally:
+                self._untrack(handle)
+                try:
+                    loop.call_soon_threadsafe(queue.put_nowait, done)
+                except RuntimeError:
+                    pass
+
+        pump_fut = asyncio.wrap_future(self._pool.submit(pump))
+
+        disconnected = asyncio.Event()
+
+        async def watch_disconnect() -> None:
+            # the client sends nothing after the request: the next
+            # read resolving (EOF or reset) means the peer went away
+            try:
+                await reader.read(1)
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+            disconnected.set()
+            if not pump_fut.done():
+                self._cancel_handle(handle)
+                self.metrics.incr("stream_cancels")
+
+        watcher = asyncio.ensure_future(watch_disconnect())
+        try:
+            while True:
+                ev = await queue.get()
+                if ev is done:
+                    break
+                line = (json.dumps(ev, sort_keys=True, default=str)
+                        + "\n").encode("utf-8")
+                chunk = (f"{len(line):x}\r\n".encode("latin-1") + line
+                         + b"\r\n")
+                try:
+                    writer.write(chunk)
+                    await writer.drain()
+                except (ConnectionError, ConnectionResetError):
+                    if not disconnected.is_set():
+                        disconnected.set()
+                        self._cancel_handle(handle)
+                        self.metrics.incr("stream_cancels")
+                    break
+                self.metrics.incr("stream_events")
+                self.metrics.incr("bytes_out", len(chunk))
+            if not disconnected.is_set():
+                try:
+                    writer.write(b"0\r\n\r\n")
+                    await writer.drain()
+                except (ConnectionError, ConnectionResetError):
+                    pass
+        finally:
+            watcher.cancel()
+            try:
+                await pump_fut
+            # quest: allow-broad-except(the pump already reported its
+            # failure as an "error" event)
+            except Exception:
+                pass
+
+    def _stream_setup_blocking(self, headers, body, emit):
+        """Resolve the request into a streamable handle BEFORE any bytes
+        go out, so typed failures still answer as plain HTTP errors."""
+        try:
+            sess = self.sessions.resolve(headers.get(SESSION_HEADER))
+            sess.requests += 1
+            wr = wire.decode_request(json.loads(body.decode("utf-8")))
+            circuit, digest = self._resolve_program(sess, wr, None)
+            kind = wr.kind
+            if kind == "gradient" and wr.optimizer is not None:
+                from ..serve.optimize import VariationalProblem
+                opt = dict(wr.optimizer)
+                problem = VariationalProblem(
+                    circuit=circuit, observables=wr.observables,
+                    x0=wr.params if wr.params is not None else {},
+                    trajectories=wr.trajectories,
+                    sampling_budget=wr.sampling_budget, tier=wr.tier)
+                handle = self.backend.optimize(
+                    problem, opt.get("name", "adam"),
+                    max_iters=int(opt.get("max_iters", 100)),
+                    tol=opt.get("tol", 1e-6),
+                    learning_rate=opt.get("learning_rate"),
+                    tenant=sess.tenant)
+                mode = "handle"
+            elif kind in ("evolve", "ground"):
+                fn = getattr(self.backend,
+                             "evolve" if kind == "evolve"
+                             else "ground_state", None)
+                if fn is None:
+                    raise StreamUnsupported(
+                        f"this backend has no streaming {kind!r} "
+                        "surface — POST /v1/submit runs it as one "
+                        "request instead")
+                if wr.observables is None:
+                    raise WireFormatError(
+                        f"{kind} requests carry the Hamiltonian as "
+                        "observables={'terms': ..., 'coeffs': ...}")
+                if kind == "evolve":
+                    handle = fn(circuit, wr.params,
+                                hamiltonian=wr.observables,
+                                t=wr.evolve.t, steps=wr.evolve.steps,
+                                order=wr.evolve.order,
+                                init_state=wr.init_state, tier=wr.tier,
+                                tenant=sess.tenant)
+                else:
+                    handle = fn(circuit, wr.params,
+                                hamiltonian=wr.observables,
+                                steps=wr.ground.steps,
+                                tau=wr.ground.tau,
+                                method=wr.ground.method,
+                                tol=wr.ground.tol,
+                                init_state=wr.init_state, tier=wr.tier,
+                                tenant=sess.tenant)
+                mode = "handle"
+            elif kind == "trajectory":
+                kw = wr.submit_kwargs()
+                kw["tenant"] = sess.tenant
+                if wr.timeout_s is not None:
+                    kw["deadline"] = wr.timeout_s
+                handle = self.backend.submit(
+                    circuit,
+                    _progress=lambda info: emit("wave", **info), **kw)
+                mode = "future"
+            else:
+                raise StreamUnsupported(
+                    f"kind {kind!r} has no streaming form — "
+                    "POST /v1/submit")
+            self._track(handle)
+            self.metrics.incr("requests_total")
+            self.metrics.incr("requests_" + kind)
+            return 200, None, mode, handle, digest, kind
+        # quest: allow-broad-except(wire boundary: setup failures
+        # answer as typed plain-HTTP errors BEFORE streaming starts)
+        except Exception as e:
+            self.metrics.incr("errors_total")
+            if isinstance(e, AuthError):
+                self.metrics.incr("auth_rejections")
+            return (http_status(e), json.dumps(error_body(e)).encode(),
+                    None, None, None, None)

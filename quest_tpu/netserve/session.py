@@ -1,0 +1,218 @@
+"""Sessions: authn tokens -> tenants, and the digest-keyed program
+registry that pins a session's compiled programs to warm replicas.
+
+The authn surface is a single pluggable hook: :class:`AuthHook`
+``.authenticate(token)`` returns a :class:`SessionGrant` (tenant name
+plus an optional WFQ :class:`~quest_tpu.serve.sched.TenantPolicy`) or
+``None`` to reject. The server installs the grant's policy on the
+backend via ``set_tenant`` when the session opens, so quota/priority
+admission (429 ``QuotaExceeded``/``QueueFull``) is enforced by the SAME
+WFQ layer that guards in-process callers — the wire adds no second
+quota system.
+
+Programs are content-addressed: the first submission of a circuit
+registers it under its :func:`~quest_tpu.serve.warmcache.circuit_digest`
+and warms the backend's replicas; later submissions send only the
+digest (``circuit_ref``) and skip re-serialization, re-decode, and
+re-compile entirely. Hit rates are tracked per session — they are the
+signal ``tools/wire_trace.py`` reports.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional
+
+from .errors import AuthError, UnknownProgram
+
+__all__ = ["SessionGrant", "AuthHook", "OpenAuth", "StaticTokenAuth",
+           "Session", "SessionManager", "ProgramRegistry"]
+
+DEFAULT_TENANT = "default"
+
+
+class SessionGrant:
+    """What an authn hook vouches for: the tenant this token serves
+    under, optionally the WFQ policy to install for it."""
+
+    __slots__ = ("tenant", "policy", "meta")
+
+    def __init__(self, tenant: str, policy=None, meta: dict = None):
+        self.tenant = str(tenant)
+        self.policy = policy
+        self.meta = dict(meta or {})
+
+
+class AuthHook:
+    """Pluggable authn: map a bearer token to a :class:`SessionGrant`
+    (or ``None`` to reject). Subclass and hand an instance to
+    :class:`~quest_tpu.netserve.server.NetServer`."""
+
+    def authenticate(self, token: Optional[str]) -> Optional[SessionGrant]:
+        raise NotImplementedError
+
+
+class OpenAuth(AuthHook):
+    """Accept everything; every caller lands on one tenant. The default
+    for loopback/dev servers, mirroring the telemetry exporter."""
+
+    def __init__(self, tenant: str = DEFAULT_TENANT):
+        self._tenant = tenant
+
+    def authenticate(self, token):
+        return SessionGrant(self._tenant)
+
+
+class StaticTokenAuth(AuthHook):
+    """A fixed token table: ``{token: SessionGrant | tenant_name}``.
+    Unknown tokens reject (401)."""
+
+    def __init__(self, tokens: dict):
+        self._tokens = {}
+        for token, grant in dict(tokens).items():
+            if not isinstance(grant, SessionGrant):
+                grant = SessionGrant(str(grant))
+            self._tokens[str(token)] = grant
+
+    def authenticate(self, token):
+        return self._tokens.get(token)
+
+
+class Session:
+    """One authenticated wire session: identity plus per-session
+    program-registry hit accounting."""
+
+    __slots__ = ("id", "tenant", "grant", "hits", "misses", "requests")
+
+    def __init__(self, sid: str, grant: SessionGrant):
+        self.id = sid
+        self.tenant = grant.tenant
+        self.grant = grant
+        self.hits = 0
+        self.misses = 0
+        self.requests = 0
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def snapshot(self) -> dict:
+        return {"session": self.id, "tenant": self.tenant,
+                "requests": self.requests, "program_hits": self.hits,
+                "program_misses": self.misses,
+                "program_hit_rate": round(self.hit_rate(), 4)}
+
+
+class ProgramRegistry:
+    """Digest-keyed store of decoded circuits. ``lookup`` raises typed
+    :class:`UnknownProgram` (404 — transient: re-sending the full
+    circuit resolves it) for digests this server never saw or evicted."""
+
+    def __init__(self, max_programs: int = 256):
+        self._lock = threading.Lock()
+        self._programs: dict = {}       # digest -> Circuit (insertion order)
+        self._max = int(max_programs)
+
+    def register(self, digest: str, circuit) -> bool:
+        """Store a decoded program; returns True when it was new (the
+        caller then warms replicas exactly once per digest)."""
+        with self._lock:
+            if digest in self._programs:
+                return False
+            while len(self._programs) >= self._max:
+                self._programs.pop(next(iter(self._programs)))
+            self._programs[digest] = circuit
+            return True
+
+    def get(self, digest: str):
+        with self._lock:
+            return self._programs.get(digest)
+
+    def lookup(self, digest: str):
+        c = self.get(digest)
+        if c is None:
+            raise UnknownProgram(
+                f"no program registered under digest {digest!r} "
+                "(never sent, or evicted) — re-submit the full circuit",
+                detail={"digest": str(digest)})
+        return c
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._programs)
+
+
+class SessionManager:
+    """Open/resolve sessions against an :class:`AuthHook` and install
+    each grant's tenant policy on the backend (once per tenant)."""
+
+    def __init__(self, auth: Optional[AuthHook] = None, backend=None,
+                 allow_anonymous: bool = True):
+        self._auth = auth
+        self._backend = backend
+        self._allow_anonymous = bool(allow_anonymous)
+        self._lock = threading.Lock()
+        self._sessions: dict = {}
+        self._ids = itertools.count(1)
+        self._policies_installed: set = set()
+        self._anon: Optional[Session] = None
+
+    def open(self, token: Optional[str]) -> Session:
+        if self._auth is not None:
+            grant = self._auth.authenticate(token)
+            if grant is None:
+                raise AuthError("unknown token: the authn hook rejected "
+                                "this credential")
+        elif token is not None or self._allow_anonymous:
+            grant = SessionGrant(DEFAULT_TENANT)
+        else:
+            raise AuthError("this server requires a token")
+        with self._lock:
+            sid = f"s{next(self._ids):06d}"
+            sess = Session(sid, grant)
+            self._sessions[sid] = sess
+        self._install_policy(grant)
+        return sess
+
+    def _install_policy(self, grant: SessionGrant) -> None:
+        if grant.policy is None or self._backend is None:
+            return
+        set_tenant = getattr(self._backend, "set_tenant", None)
+        if set_tenant is None:
+            return
+        with self._lock:
+            if grant.tenant in self._policies_installed:
+                return
+            self._policies_installed.add(grant.tenant)
+        set_tenant(grant.tenant, grant.policy)
+
+    def resolve(self, sid: Optional[str]) -> Session:
+        """Session id -> Session; unknown ids reject 401. A missing id
+        opens an implicit anonymous session when allowed."""
+        if sid is None:
+            if self._auth is None and self._allow_anonymous:
+                # ONE shared implicit session, not one per request: the
+                # hit-rate accounting stays meaningful for sessionless
+                # callers
+                with self._lock:
+                    anon = self._anon
+                if anon is not None:
+                    return anon
+                sess = self.open(None)
+                with self._lock:
+                    if self._anon is None:
+                        self._anon = sess
+                    sess = self._anon
+                return sess
+            raise AuthError("no session: POST /v1/session first")
+        with self._lock:
+            sess = self._sessions.get(sid)
+        if sess is None:
+            raise AuthError(f"unknown session {sid!r}: it was never "
+                            "opened here, or the server restarted")
+        return sess
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return [s.snapshot() for s in self._sessions.values()]

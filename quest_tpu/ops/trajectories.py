@@ -892,7 +892,7 @@ class TrajectoryProgram:
                           sampling_budget: Optional[float] = None,
                           wave_size: Optional[int] = None,
                           live_rows: Optional[int] = None,
-                          state_f=None):
+                          state_f=None, progress=None):
         """The ``(B, T)`` form: one noisy-VQE ensemble per parameter
         row, all rows advancing through shared waves of one executable
         (the serving runtime's ``kind="trajectory"`` dispatch). Early
@@ -918,7 +918,7 @@ class TrajectoryProgram:
             pm, terms, [float(c) for c in coeffs], state_f,
             int(num_trajectories), key,
             sampling_budget=sampling_budget, wave_size=wave_size,
-            live_rows=live_rows)
+            live_rows=live_rows, progress=progress)
         return means, errs, info
 
     def expectation_grad(self, pauli_terms, coeffs, state_f=None,
@@ -971,7 +971,7 @@ class TrajectoryProgram:
                                sampling_budget: Optional[float] = None,
                                wave_size: Optional[int] = None,
                                live_rows: Optional[int] = None,
-                               state_f=None):
+                               state_f=None, progress=None):
         """The ``(B, T)`` gradient form — one noisy-VQE ensemble per
         parameter row, every row's value AND gradient advancing through
         shared gradient waves of one executable (the serving runtime's
@@ -1003,13 +1003,13 @@ class TrajectoryProgram:
         means, errs, info = self._converge(
             pm, terms, coeffs, state_f, int(num_trajectories), key,
             sampling_budget=sampling_budget, wave_size=wave_size,
-            live_rows=live_rows, grad=True)
+            live_rows=live_rows, grad=True, progress=progress)
         return means[:, 0], means[:, 1:], errs, info
 
     def _converge(self, pm, terms, coeffs, state_f, max_trajectories,
                   key, sampling_budget=None, wave_size=None,
                   live_rows=None, shard_trajectories=None,
-                  grad: bool = False):
+                  grad: bool = False, progress=None):
         """The shared convergence loop. ``pm``: ``(B, P)``; per row the
         keys are an up-front ``split`` of one fold of the base key, so
         wave boundaries never change any draw. ``grad=True`` runs the
@@ -1068,6 +1068,23 @@ class TrajectoryProgram:
             waves_run += 1
             snap = np.asarray(carry)           # the wave's ONE transfer
             stderr = red.welford_stderr(snap[0], snap[2])
+            if progress is not None:
+                # the per-wave signal (netserve streaming, notebooks):
+                # reuses the wave's existing host snapshot — no extra
+                # transfer, no extra sync
+                try:
+                    progress({"wave": int(waves_run),
+                              "trajectories_run": int(run),
+                              "max_trajectories": int(T),
+                              # quest: allow-host-sync(stderr is the
+                              # wave's existing host snapshot — no new
+                              # device transfer)
+                              "max_stderr": float(np.max(stderr[:live]))})
+                # quest: allow-broad-except(progress listeners are
+                # caller code; a sick listener must never kill the
+                # wave loop)
+                except Exception:
+                    pass
             if sampling_budget is not None and \
                     np.all(snap[0][:live] >= 2.0) and \
                     np.all(stderr[:live] <= float(sampling_budget)):

@@ -151,13 +151,13 @@ class _Request:
                  "not_before", "attempts", "tier", "escalations",
                  "obs_key", "trace", "trace_owned", "qspan", "dspan",
                  "trajectories", "sampling_budget", "tenant", "priority",
-                 "dynamics")
+                 "dynamics", "progress")
 
     def __init__(self, compiled, param_vec, kind, observables, shots,
                  submit_t, deadline, future, retries_left, key,
                  tier=None, obs_key=(), trajectories=0,
                  sampling_budget=None, tenant=DEFAULT_TENANT,
-                 priority=1, dynamics=None):
+                 priority=1, dynamics=None, progress=None):
         self.compiled = compiled
         self.param_vec = param_vec
         self.kind = kind
@@ -182,6 +182,7 @@ class _Request:
         self.tenant = tenant     # WFQ accounting + quota dimension
         self.priority = priority  # strict class (0 = interactive)
         self.dynamics = dynamics  # (spec, state_f) for evolve/ground
+        self.progress = progress  # per-wave listener (trajectory kinds)
 
 
 def _canonical_observables(compiled, observables) -> tuple:
@@ -483,7 +484,8 @@ class SimulationService:
                deadline: Optional[float] = None,
                error_budget: Optional[float] = None,
                tier=None, tenant: str = DEFAULT_TENANT,
-               priority: Optional[int] = None, _trace=None) -> Future:
+               priority: Optional[int] = None, _trace=None,
+               _progress=None) -> Future:
         """Enqueue one simulation request; returns its Future.
 
         ``circuit``: a :class:`CompiledCircuit` (preferred — submissions
@@ -788,7 +790,8 @@ class SimulationService:
                                         else None),
                        tenant=tenant, priority=prio,
                        dynamics=((dyn_spec, dyn_state)
-                                 if dyn_spec is not None else None))
+                                 if dyn_spec is not None else None),
+                       progress=_progress)
         # request-scoped tracing: a router-propagated context rides in
         # via _trace (the router owns + finishes it); otherwise the
         # service's own sampler decides, and the service finishes the
@@ -1841,6 +1844,27 @@ class SimulationService:
                 return t
         return None
 
+    @staticmethod
+    def _merged_progress(batch: list):
+        """One per-wave listener for a coalesced trajectory group: each
+        request's ``_progress`` callback (netserve streaming, notebooks)
+        hears every wave. None when nobody is listening — the common
+        case stays a no-callback wave loop."""
+        cbs = [r.progress for r in batch if r.progress is not None]
+        if not cbs:
+            return None
+
+        def fanout(info: dict) -> None:
+            for cb in cbs:
+                try:
+                    cb(dict(info))
+                # quest: allow-broad-except(progress listeners are
+                # caller code; a sick listener must never kill the
+                # dispatcher or its batchmates' waves)
+                except Exception:
+                    pass
+        return fanout
+
     def _dispatch_batch(self, batch: list):
         """One synchronous engine dispatch for one group (the
         ``pipeline_depth=1`` path): issue and complete back-to-back.
@@ -1936,7 +1960,8 @@ class SimulationService:
                     means, errs, info = cc.expectation_batch(
                         pm, batch[0].observables, batch[0].trajectories,
                         sampling_budget=batch[0].sampling_budget,
-                        live_rows=B)
+                        live_rows=B,
+                        progress=self._merged_progress(batch))
                 raw = (means, errs, info)
             elif kind == KIND_GRADIENT and isinstance(cc,
                                                       TrajectoryProgram):
@@ -1947,7 +1972,8 @@ class SimulationService:
                     vals, grads, errs, info = cc.expectation_grad_batch(
                         pm, batch[0].observables, batch[0].trajectories,
                         sampling_budget=batch[0].sampling_budget,
-                        live_rows=B)
+                        live_rows=B,
+                        progress=self._merged_progress(batch))
                 raw = (vals, grads, errs, info)
             elif kind == KIND_GRADIENT:
                 # ONE reverse pass through the batched engine: the

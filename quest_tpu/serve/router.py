@@ -155,12 +155,14 @@ class _Work:
                  "tried", "active", "last_route_t", "hedged",
                  "park_logged", "trace", "trajectories",
                  "sampling_budget", "gradient", "tier", "tenant",
-                 "priority")
+                 "priority", "evolve", "ground_state", "init_state",
+                 "progress")
 
     def __init__(self, circuit, params, observables, shots, submit_t,
                  deadline, failovers_left, trajectories=None,
                  sampling_budget=None, gradient=False, tier=None,
-                 tenant=DEFAULT_TENANT, priority=None):
+                 tenant=DEFAULT_TENANT, priority=None, evolve=None,
+                 ground_state=None, init_state=None, progress=None):
         self.circuit = circuit
         self.params = params
         self.observables = observables
@@ -171,6 +173,10 @@ class _Work:
         self.tier = tier
         self.tenant = tenant
         self.priority = priority
+        self.evolve = evolve
+        self.ground_state = ground_state
+        self.init_state = init_state
+        self.progress = progress
         self.submit_t = submit_t
         self.deadline = deadline        # ABSOLUTE (monotonic); immutable
         self.future: Future = Future()
@@ -446,9 +452,11 @@ class ServiceRouter:
                trajectories: Optional[int] = None,
                sampling_budget: Optional[float] = None,
                gradient: bool = False, tier=None,
+               evolve=None, ground_state=None, init_state=None,
                tenant: str = DEFAULT_TENANT,
                priority: Optional[int] = None,
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               _progress=None) -> Future:
         """Enqueue one request on the healthiest replica; returns a
         router-owned Future. Semantics match
         :meth:`SimulationService.submit` — including trajectory
@@ -484,7 +492,9 @@ class ServiceRouter:
         work = _Work(route, params, observables, shots, now, abs_deadline,
                      self.max_failovers, trajectories=trajectories,
                      sampling_budget=sampling_budget, gradient=gradient,
-                     tier=tier, tenant=str(tenant), priority=priority)
+                     tier=tier, tenant=str(tenant), priority=priority,
+                     evolve=evolve, ground_state=ground_state,
+                     init_state=init_state, progress=_progress)
         ctx = self.tracer.start(router=self.name)
         if ctx is not None:
             work.trace = ctx
@@ -554,8 +564,11 @@ class ServiceRouter:
                     trajectories=work.trajectories,
                     sampling_budget=work.sampling_budget,
                     gradient=work.gradient, tier=work.tier,
+                    evolve=work.evolve, ground_state=work.ground_state,
+                    init_state=work.init_state,
                     tenant=work.tenant, priority=work.priority,
-                    deadline=remaining, _trace=work.trace)
+                    deadline=remaining, _trace=work.trace,
+                    _progress=work.progress)
             except QuotaExceeded as e:
                 # tenant backpressure, not a replica fault: every
                 # replica enforces the same per-tenant contract, so

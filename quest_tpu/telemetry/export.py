@@ -153,30 +153,31 @@ class MetricsServer:
     """
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 health_source=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from .endpoints import ObservabilityEndpoints
         reg = registry or metrics_registry()
+        # the shared observability resolver: the netserve front door
+        # mounts this same object, so both ports serve identical
+        # /metrics, /metrics.json, and (with a health source) /healthz
+        endpoints = ObservabilityEndpoints(reg, health_source)
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):                      # noqa: N802 (stdlib API)
                 try:
-                    if self.path.startswith("/metrics.json"):
-                        body = json.dumps(json_snapshot(reg),
-                                          default=str).encode()
-                        ctype = "application/json"
-                    elif self.path.startswith("/metrics"):
-                        body = prometheus_text(reg).encode()
-                        ctype = "text/plain; version=0.0.4"
-                    else:
+                    resolved = endpoints.resolve(self.path)
+                    if resolved is None:
                         self.send_error(404)
                         return
+                    status, ctype, body = resolved
                 # quest: allow-broad-except(exporter boundary: one
                 # sick provider answers 500; it must never kill the
                 # metrics server)
                 except Exception as e:
                     self.send_error(500, str(e))
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -52,7 +52,8 @@ FAST_MODULES = {
     "test_validation_taxonomy", "test_comm_trace", "test_serve_trace",
     "test_chaos_trace", "test_trace_io", "test_obs_console",
     "test_traj_trace", "test_mxu_saturation", "test_grad_trace",
-    "test_sched_trace", "test_evolve_trace",
+    "test_sched_trace", "test_evolve_trace", "test_netserve_wire",
+    "test_wire_trace",
 }
 
 

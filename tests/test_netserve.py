@@ -1,0 +1,498 @@
+"""The network front door (ISSUE 19): every wire request kind answered
+over a real loopback socket must match the in-process
+``SimulationService`` answer to <= 1e-12 (the same service backs both
+paths, so most comparisons are exact), server failures must come back
+as the SAME typed exception family the in-process API raises
+(``except QueueFull`` works identically over the socket), streaming
+must deliver optimizer iterates / dynamics segments / trajectory waves
+as ndjson events with disconnect-cancel semantics, and the acceptance
+trace (256 mixed-kind requests plus one streamed optimize run) must
+hold parity end to end.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuits import Circuit
+from quest_tpu.ops.dynamics import EvolveSpec, GroundSpec
+from quest_tpu.serve import (DeadlineExceeded, QueueFull,
+                             SimulationService)
+from quest_tpu.serve.optimize import VariationalProblem
+from quest_tpu.netserve import (AuthError, DigestMismatch, NetClient,
+                                NetServer, SessionGrant,
+                                StaticTokenAuth, UnknownProgram,
+                                WireFormatError, wire)
+
+ATOL = 1e-12
+
+
+def _hea(num_qubits, layers=1, tag=0.0):
+    """Hardware-efficient ansatz; ``tag`` bakes a distinct static angle
+    in so tests that assert on registry hit/miss accounting can mint a
+    program no other test has registered."""
+    c = Circuit(num_qubits)
+    for layer in range(layers):
+        for q in range(num_qubits):
+            c.ry(q, c.parameter(f"y{layer}_{q}"))
+            c.rz(q, c.parameter(f"z{layer}_{q}"))
+        for q in range(num_qubits):
+            c.cnot(q, (q + 1) % num_qubits)
+    if tag:
+        c.rz(0, tag)
+    return c
+
+
+def _noisy(num_qubits, p=0.02):
+    c = Circuit(num_qubits)
+    for q in range(num_qubits):
+        c.ry(q, c.parameter(f"t{q}"))
+        c.dephase(q, p)
+    for q in range(num_qubits - 1):
+        c.cnot(q, q + 1)
+    return c
+
+
+def _ham(num_qubits):
+    terms = [[(q, 3)] for q in range(num_qubits)]
+    terms.append([(0, 1), (1, 1)])
+    return terms, [1.0] * num_qubits + [0.5]
+
+
+def _params(circuit, i):
+    return {nm: 0.1 + 0.01 * i + 0.003 * j
+            for j, nm in enumerate(circuit.param_names)}
+
+
+@pytest.fixture(scope="module")
+def net():
+    """One service, one loopback server, one client for the module —
+    boot cost is paid once; tests needing special servers (auth,
+    admission bounds) build their own on top of ``net.svc`` or a
+    dedicated service."""
+
+    class _Net:
+        pass
+
+    n = _Net()
+    n.env = qt.createQuESTEnv(num_devices=1, seed=[12345])
+    with SimulationService(n.env, max_batch=8, max_wait_s=2e-3) as svc:
+        n.svc = svc
+        with NetServer(svc) as srv:
+            n.srv = srv
+            with NetClient(srv.host, srv.port) as client:
+                n.client = client
+                yield n
+
+
+class TestKindParity:
+    """Socket answer == in-process answer, per request kind."""
+
+    def test_sweep(self, net):
+        c = _hea(3)
+        p = _params(c, 0)
+        want = net.svc.submit(c, p).result(timeout=120)
+        got = net.client.submit(c, p).result(timeout=120)
+        np.testing.assert_allclose(got, want, atol=ATOL, rtol=0)
+
+    def test_expectation(self, net):
+        c = _hea(3)
+        p = _params(c, 1)
+        ham = _ham(3)
+        want = net.svc.submit(c, p, observables=ham).result(timeout=120)
+        got = net.client.submit(c, p,
+                                observables=ham).result(timeout=120)
+        assert abs(got - want) <= ATOL
+
+    def test_shots(self, net):
+        c = _hea(3)
+        p = _params(c, 2)
+        # sampling draws from the env's stateful key stream: register
+        # (and server-warm) the program first, then pin the stream so
+        # both paths consume the SAME key for their one dispatch
+        net.client.submit(c, p, shots=4).result(timeout=120)
+        net.env.key = jax.random.PRNGKey(71)
+        w_out, w_norm = net.svc.submit(c, p, shots=32).result(timeout=120)
+        net.env.key = jax.random.PRNGKey(71)
+        g_out, g_norm = net.client.submit(c, p,
+                                          shots=32).result(timeout=120)
+        np.testing.assert_array_equal(g_out, w_out)
+        assert g_out.dtype == np.int64
+        assert abs(g_norm - w_norm) <= ATOL
+
+    def test_trajectory(self, net):
+        c = _noisy(2)
+        p = _params(c, 3)
+        ham = _ham(2)
+        # same key-stream pinning as shots: Monte-Carlo draws must
+        # come from the same key for bitwise socket/in-process parity
+        net.client.submit(c, p, observables=ham,
+                          trajectories=4).result(timeout=240)
+        net.env.key = jax.random.PRNGKey(72)
+        want = net.svc.submit(c, p, observables=ham,
+                              trajectories=16).result(timeout=240)
+        net.env.key = jax.random.PRNGKey(72)
+        got = net.client.submit(c, p, observables=ham,
+                                trajectories=16).result(timeout=240)
+        np.testing.assert_allclose(got, want, atol=ATOL, rtol=0)
+
+    def test_gradient(self, net):
+        c = _hea(3)
+        p = _params(c, 4)
+        ham = _ham(3)
+        wv, wg = net.svc.submit(c, p, observables=ham,
+                                gradient=True).result(timeout=240)
+        gv, gg = net.client.submit(c, p, observables=ham,
+                                   gradient=True).result(timeout=240)
+        assert abs(gv - wv) <= ATOL
+        np.testing.assert_allclose(gg, wg, atol=ATOL, rtol=0)
+
+    def test_evolve(self, net):
+        c = _hea(2)
+        p = _params(c, 5)
+        ham = _ham(2)
+        spec = dict(t=0.4, steps=6, order=2)
+        want = net.svc.submit(c, p, observables=ham,
+                              evolve=EvolveSpec(**spec)).result(
+                                  timeout=240)
+        got = net.client.submit(c, p, observables=ham,
+                                evolve=spec).result(timeout=240)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=ATOL, rtol=0)
+
+    def test_ground(self, net):
+        c = _hea(2)
+        p = _params(c, 6)
+        ham = _ham(2)
+        spec = dict(steps=4, tau=0.1, method="power", tol=1e-9)
+        want = net.svc.submit(c, p, observables=ham,
+                              ground_state=GroundSpec(**spec)).result(
+                                  timeout=240)
+        got = net.client.submit(c, p, observables=ham,
+                                ground=spec).result(timeout=240)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=ATOL, rtol=0)
+
+    def test_qasm(self, net):
+        text = ("OPENQASM 2.0;\nqreg q[2];\nh q[0];\n"
+                "cx q[0],q[1];\nrz(0.25) q[1];\nry(0.5) q[0];\n")
+        want = net.svc.submit(qt.parse_qasm(text).circuit).result(
+            timeout=120)
+        got = net.client.submit(qasm=text, kind="sweep").result(
+            timeout=120)
+        np.testing.assert_allclose(got, want, atol=ATOL, rtol=0)
+
+
+class TestSessionsAndRegistry:
+    def test_repeat_submissions_hit_the_registry(self, net):
+        c = _hea(2, tag=0.731)                     # program unique to
+        ham = _ham(2)                              # this test
+        with NetClient(net.srv.host, net.srv.port) as cl:
+            first = cl.submit(c, _params(c, 0),
+                              observables=ham).result(timeout=120)
+            for i in (1, 2):
+                cl.submit(c, _params(c, i),
+                          observables=ham).result(timeout=120)
+            snap = {s["session"]: s
+                    for s in net.srv.sessions.snapshot()}
+            sess = snap[cl.session]
+        # the tag makes the program unique to this test, so the one
+        # registration happens HERE: first submit misses, repeats hit
+        assert sess["requests"] == 3
+        assert sess["program_misses"] == 1
+        assert sess["program_hits"] == 2
+        assert isinstance(first, float)
+
+    def test_client_refetches_after_server_eviction(self, net):
+        c = _hea(2, tag=0.877)
+        with NetClient(net.srv.host, net.srv.port) as cl:
+            want = cl.submit(c, _params(c, 0)).result(timeout=120)
+            # the server forgets everything (restart / eviction) …
+            net.srv.programs._programs.clear()
+            # … and the client's next ref-only submission self-heals
+            # with a one-shot full resend
+            got = cl.submit(c, _params(c, 0)).result(timeout=120)
+        np.testing.assert_allclose(got, want, atol=ATOL, rtol=0)
+
+    def test_unknown_ref_is_typed_404(self, net):
+        doc = wire.encode_request("sweep", circuit_ref="0" * 64)
+        with pytest.raises(UnknownProgram):
+            net.client.submit_wire(doc).result(timeout=120)
+
+    def test_digest_mismatch_is_typed_409(self, net):
+        doc = wire.encode_request("sweep", circuit=_hea(2))
+        doc["circuit"] = dict(doc["circuit"], digest="0" * 64)
+        with pytest.raises(DigestMismatch):
+            net.client.submit_wire(doc).result(timeout=120)
+
+    def test_malformed_request_is_typed_400(self, net):
+        doc = wire.encode_request("sweep", circuit=_hea(2))
+        doc["deadline_epoch"] = time.time() + 3600   # skewed-clock try
+        with pytest.raises(WireFormatError, match="RELATIVE"):
+            net.client.submit_wire(doc).result(timeout=120)
+
+
+class TestAuth:
+    def test_anonymous_rejected_and_token_resolves_tenant(self, net):
+        auth = StaticTokenAuth({
+            "sekrit": SessionGrant(tenant="acme",
+                                   policy=qt.TenantPolicy(weight=2.0)),
+        })
+        with NetServer(net.svc, auth=auth,
+                       allow_anonymous=False) as srv:
+            with NetClient(srv.host, srv.port) as anon:
+                with pytest.raises(AuthError):
+                    anon.submit(_hea(2), _params(_hea(2), 0)).result(
+                        timeout=60)
+            with NetClient(srv.host, srv.port, token="sekrit") as cl:
+                c = _hea(2)
+                got = cl.submit(c, _params(c, 0)).result(timeout=120)
+                assert cl.tenant == "acme"
+                assert got.shape == (2, 4)
+            assert srv.metrics.snapshot()["auth_rejections"] >= 1
+
+
+class TestBackpressureAndDeadlines:
+    def test_queue_full_is_typed_429(self, net):
+        with SimulationService(net.env, max_queue=3, max_batch=8,
+                               max_wait_s=5e-3) as svc:
+            with NetServer(svc) as srv:
+                with NetClient(srv.host, srv.port) as cl:
+                    c = _hea(2)
+                    svc.pause()
+                    futs = [cl.submit(c, _params(c, i))
+                            for i in range(3)]
+                    deadline = time.monotonic() + 30
+                    while (svc.dispatch_stats()["service"]["submitted"]
+                           < 3):
+                        assert time.monotonic() < deadline, \
+                            "backlog never reached the bound"
+                        time.sleep(0.01)
+                    with pytest.raises(QueueFull, match="capacity"):
+                        cl.submit(c, _params(c, 3)).result(timeout=60)
+                    svc.resume()
+                    for f in futs:
+                        assert f.result(timeout=120).shape == (2, 4)
+
+    def test_expired_relative_deadline_is_typed_504(self, net):
+        with SimulationService(net.env, max_batch=8,
+                               max_wait_s=5e-3) as svc:
+            with NetServer(svc) as srv:
+                with NetClient(srv.host, srv.port) as cl:
+                    c = _hea(2)
+                    # hold dispatch until the 50 ms budget has lapsed,
+                    # then resume: the dispatcher must expire the
+                    # request typed instead of running it stale
+                    svc.pause()
+                    fut = cl.submit(c, _params(c, 0), timeout_s=0.05)
+                    deadline = time.monotonic() + 30
+                    while (svc.dispatch_stats()["service"]["submitted"]
+                           < 1):
+                        assert time.monotonic() < deadline
+                        time.sleep(0.01)
+                    time.sleep(0.2)
+                    svc.resume()
+                    with pytest.raises(DeadlineExceeded):
+                        fut.result(timeout=60)
+
+
+class TestStreaming:
+    HAM2 = ([[(0, 3)], [(1, 3)]], [1.0, 0.5])
+
+    def _vqe_circuit(self):
+        c = Circuit(2)
+        c.ry(0, c.parameter("t0"))
+        c.ry(1, c.parameter("t1"))
+        return c
+
+    def test_optimize_stream_matches_in_process(self, net):
+        x0 = {"t0": 2.0, "t1": 2.0}
+        h = net.svc.optimize(
+            VariationalProblem(self._vqe_circuit(), self.HAM2, x0),
+            optimizer="gd", learning_rate=0.4, max_iters=40, tol=1e-10)
+        want_vals = [it["value"] for it in h.iterates()]
+        want = h.result(timeout=240)
+
+        events = list(net.client.stream(
+            self._vqe_circuit(), x0, observables=self.HAM2,
+            optimizer={"name": "gd", "learning_rate": 0.4,
+                       "max_iters": 40, "tol": 1e-10}))
+        assert events[0]["event"] == "stream.open"
+        iters = [e for e in events if e["event"] == "iterate"]
+        (res,) = [e for e in events if e["event"] == "result"]
+        got_vals = [e["value"] for e in iters]
+        np.testing.assert_allclose(got_vals, want_vals, atol=ATOL,
+                                   rtol=0)
+        assert res["result"]["converged"] == want["converged"]
+        assert abs(res["result"]["value"] - want["value"]) <= ATOL
+
+    def test_trajectory_stream_waves_then_result(self, net):
+        c = _noisy(2)
+        p = _params(c, 7)
+        ham = _ham(2)
+        # pin the key stream (see TestKindParity.test_trajectory)
+        net.client.submit(c, p, observables=ham,
+                          trajectories=4).result(timeout=240)
+        net.env.key = jax.random.PRNGKey(73)
+        want = net.svc.submit(c, p, observables=ham,
+                              trajectories=16).result(timeout=240)
+        net.env.key = jax.random.PRNGKey(73)
+        events = list(net.client.stream(c, p, observables=ham,
+                                        trajectories=16))
+        assert [e["event"] for e in events][0] == "stream.open"
+        assert any(e["event"] == "wave" for e in events)
+        (res,) = [e for e in events if e["event"] == "result"]
+        got = wire.parse_result("trajectory", res["result"])
+        np.testing.assert_allclose(got, want, atol=ATOL, rtol=0)
+
+    def test_evolve_stream_segments(self, net):
+        c = _hea(2)
+        events = list(net.client.stream(
+            c, _params(c, 8), observables=_ham(2),
+            evolve={"t": 0.4, "steps": 4, "order": 2}))
+        assert any(e["event"] == "segment" for e in events)
+        assert events[-1]["event"] in ("result", "error")
+        assert events[-1]["event"] == "result"
+
+    def test_disconnect_cancels_server_handle(self, net):
+        x0 = {"t0": 2.0, "t1": 2.0}
+        before = net.srv.metrics.snapshot()["stream_cancels"]
+        gen = net.client.stream(
+            self._vqe_circuit(), x0, observables=self.HAM2,
+            optimizer={"name": "adam", "learning_rate": 1e-3,
+                       "max_iters": 5000, "tol": 0.0})
+        seen = 0
+        for ev in gen:
+            if ev["event"] == "iterate":
+                seen += 1
+            if seen >= 2:
+                break
+        gen.close()                      # drops the socket mid-stream
+        handle = net.srv._debug_last_handle
+        deadline = time.monotonic() + 60
+        while not handle.done:
+            assert time.monotonic() < deadline, \
+                "server handle kept optimizing after disconnect"
+            time.sleep(0.02)
+        assert len(handle.history) < 5000
+        deadline = time.monotonic() + 10
+        while net.srv.metrics.snapshot()["stream_cancels"] == before:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+
+
+class TestEndpoints:
+    def _get(self, net, path):
+        with urllib.request.urlopen(
+                f"http://{net.srv.host}:{net.srv.port}{path}",
+                timeout=30) as r:
+            return r.status, r.read()
+
+    def test_healthz_metrics_sessions(self, net):
+        status, _ = self._get(net, "/healthz")
+        assert status == 200
+        status, body = self._get(net, "/metrics")
+        assert status == 200
+        text = body.decode()
+        assert "netserve" in text
+        status, body = self._get(net, "/v1/sessions")
+        assert status == 200
+        doc = json.loads(body)
+        assert isinstance(doc["sessions"], list)
+        assert doc["programs"] >= 1
+
+    def test_unknown_path_404(self, net):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._get(net, "/no/such/path")
+        assert ei.value.code == 404
+
+
+class TestAcceptanceTrace:
+    """The ISSUE-19 acceptance gate: a 256-request mixed-kind trace
+    (sweep + expectation + gradient + trajectory) through the socket
+    client, with one streamed optimize run riding along, every answer
+    within 1e-12 of the in-process path."""
+
+    N = 256
+
+    N_DET = 192                         # sweep + expectation + gradient
+    N_TRAJ = 64                         # Monte-Carlo, key-pinned
+
+    def test_mixed_trace_parity(self, net):
+        c = _hea(3)
+        nz = _noisy(2)
+        ham3, ham2 = _ham(3), _ham(2)
+
+        def det(i):
+            p = _params(c, i)
+            which = i % 3
+            if which == 0:
+                return dict(circuit=c, params=p)
+            if which == 1:
+                return dict(circuit=c, params=p, observables=ham3)
+            return dict(circuit=c, params=p, observables=ham3,
+                        gradient=True)
+
+        def traj(i):
+            return dict(circuit=nz, params=_params(nz, i),
+                        observables=ham2, trajectories=8)
+
+        # phase 1: the 192 deterministic requests, fully concurrent,
+        # with the streamed optimize run riding alongside
+        want = [net.svc.submit(**det(i)) for i in range(self.N_DET)]
+        want = [f.result(timeout=600) for f in want]
+
+        x0 = {"t0": 2.0, "t1": 2.0}
+        vqe = Circuit(2)
+        vqe.ry(0, vqe.parameter("t0"))
+        vqe.ry(1, vqe.parameter("t1"))
+        stream = net.client.stream(
+            vqe, x0, observables=([[(0, 3)], [(1, 3)]], [1.0, 0.5]),
+            optimizer={"name": "gd", "learning_rate": 0.4,
+                       "max_iters": 30, "tol": 1e-10})
+
+        got = [net.client.submit(**det(i)) for i in range(self.N_DET)]
+        events = list(stream)            # drains while futures resolve
+        got = [f.result(timeout=600) for f in got]
+
+        # phase 2: the 64 trajectory requests. Monte-Carlo draws come
+        # from the env's stateful key stream folded with the batch row
+        # index, so bitwise parity needs identical consumption: the
+        # program is registered up front (server-side warm draws keys
+        # too), the stream is pinned before each pass, and requests run
+        # one at a time so both passes dispatch the same (B=1) batches
+        # in the same order
+        net.client.submit(**traj(0)).result(timeout=240)
+        net.env.key = jax.random.PRNGKey(74)
+        for i in range(self.N_TRAJ):
+            want.append(net.svc.submit(**traj(i)).result(timeout=240))
+        net.env.key = jax.random.PRNGKey(74)
+        for i in range(self.N_TRAJ):
+            got.append(net.client.submit(**traj(i)).result(timeout=240))
+
+        assert len(got) == len(want) == self.N_DET + self.N_TRAJ == 256
+        for i, (g, w) in enumerate(zip(got, want)):
+            if isinstance(w, tuple):
+                for gp, wp in zip(g, w):
+                    np.testing.assert_allclose(
+                        np.asarray(gp), np.asarray(wp), atol=ATOL,
+                        rtol=0, err_msg=f"request {i}")
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(w), atol=ATOL, rtol=0,
+                    err_msg=f"request {i}")
+
+        assert events[0]["event"] == "stream.open"
+        assert [e["event"] for e in events].count("iterate") >= 2
+        assert events[-1]["event"] == "result"
+
+        snap = net.srv.metrics.snapshot()
+        assert snap["requests_total"] >= 256
+        assert snap["streams_opened"] >= 1
+        assert snap["p99_request_s"] > 0.0

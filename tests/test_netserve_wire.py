@@ -1,0 +1,286 @@
+"""Fast-tier tests for the ``quest_tpu.wire/1`` form: circuit journal
+round-trips must land on the SAME content digest
+(:func:`~quest_tpu.serve.warmcache.circuit_digest`) as the original,
+un-journalable circuits must reject typed instead of serializing
+wrongly, and the strict-v1 request validation (unknown keys, absolute
+deadline names, program-source arity) must reject at the boundary. No
+device work anywhere in this module — it must stay cheap enough for
+the bounded fast tier."""
+
+import json
+
+import numpy as np
+import pytest
+
+from quest_tpu.circuits import Circuit
+from quest_tpu.netserve import (DigestMismatch, WireFormatError, wire)
+from quest_tpu.serve.warmcache import circuit_digest
+
+
+def _roundtrip(circuit):
+    """Encode -> canonical JSON text -> parse -> decode, the actual
+    wire path."""
+    doc = json.loads(wire.canonical_json(wire.encode_circuit(circuit)))
+    return wire.decode_circuit(doc), doc
+
+
+def _param_circuit():
+    c = Circuit(3)
+    t0 = c.parameter("t0")
+    t1 = c.parameter("t1")
+    c.h(0)
+    c.cnot(0, 1)
+    c.rx(1, t0)
+    c.ry(2, 0.3)
+    c.rz(0, t1)
+    c.cphase(0, 2, 0.25)
+    c.crz(1, 2, t0)
+    c.multi_rotate_z([0, 2], t1)
+    c.phase(1, 0.5)
+    c.x(2)
+    c.s(0)
+    c.t(1)
+    return c
+
+
+class TestCircuitRoundTrip:
+    def test_param_circuit_digest_stable(self):
+        c = _param_circuit()
+        c2, doc = _roundtrip(c)
+        assert doc["digest"] == circuit_digest(c)
+        assert circuit_digest(c2) == circuit_digest(c)
+        assert c2.param_names == c.param_names
+        assert len(c2.ops) == len(c.ops)
+
+    def test_channel_circuit_digest_stable(self):
+        d = Circuit(2)
+        g = d.parameter("g")
+        d.h(0)
+        d.dephase(0, g)
+        d.depolarise(1, 0.05)
+        d.damp(0, g)
+        d.pauli_channel(1, 0.01, g, 0.02)
+        d.kraus([np.eye(2), np.zeros((2, 2))], [0])
+        d2, _ = _roundtrip(d)
+        assert circuit_digest(d2) == circuit_digest(d)
+
+    def test_gate_and_diagonal_digest_stable(self):
+        e = Circuit(2)
+        e.gate(np.array([[1, 0], [0, 1j]]), [1], [0])
+        e.diagonal(np.array([1, 1j, -1, -1j]).reshape(2, 2), (0, 1))
+        e2, _ = _roundtrip(e)
+        assert circuit_digest(e2) == circuit_digest(e)
+
+    def test_signed_zero_matrix_entries_survive(self):
+        """The digest hashes exact BYTES: a matrix containing -0.0
+        must round-trip bit-for-bit (the classic `re + 1j*im`
+        reconstruction flips zero signs)."""
+        e = Circuit(1)
+        e.gate(np.array([[1.0, -0.0], [0.0, -1.0]], dtype=complex), [0])
+        e2, _ = _roundtrip(e)
+        assert circuit_digest(e2) == circuit_digest(e)
+
+    def test_inverse_is_opaque(self):
+        s = Circuit(2)
+        s.h(0)
+        s.cnot(0, 1)
+        s.t(1)
+        with pytest.raises(WireFormatError, match="not wire-serializ"):
+            wire.encode_circuit(s.inverse())
+
+    def test_callable_payload_is_opaque(self):
+        f = Circuit(1)
+        f.parameter("a")
+        f.gate(lambda a: np.eye(2), [0])
+        with pytest.raises(WireFormatError, match="not wire-serializ"):
+            wire.encode_circuit(f)
+
+    def test_digest_mismatch_rejects(self):
+        c = _param_circuit()
+        doc = wire.encode_circuit(c)
+        doc["digest"] = "0" * 64
+        with pytest.raises(DigestMismatch) as ei:
+            wire.decode_circuit(doc)
+        assert ei.value.detail["claimed"] == "0" * 64
+        assert ei.value.detail["computed"] == circuit_digest(c)
+        assert ei.value.status == 409
+
+    def test_unknown_op_rejects_with_index(self):
+        doc = wire.encode_circuit(_param_circuit())
+        doc["ops"][2] = ["frobnicate", 0]
+        with pytest.raises(WireFormatError, match="op 2"):
+            wire.decode_circuit(doc, verify_digest=False)
+
+
+class TestRequestValidation:
+    def _req(self, **kw):
+        kw.setdefault("circuit", _param_circuit())
+        kw.setdefault("params", {"t0": 0.1, "t1": 0.2})
+        return wire.encode_request(
+            kw.pop("kind", "expectation"),
+            observables=kw.pop("observables",
+                               ([[(0, 3)], [(1, 1)]], [1.0, 0.5])),
+            **kw)
+
+    def test_roundtrip_all_kinds(self):
+        c = _param_circuit()
+        obs = ([[(0, 3)]], [1.0])
+        docs = [
+            wire.encode_request("sweep", circuit=c, params={"t0": 0.1,
+                                                            "t1": 0.2}),
+            wire.encode_request("expectation", circuit=c,
+                                observables=obs),
+            wire.encode_request("shots", circuit=c, shots=16),
+            wire.encode_request("trajectory", circuit=c,
+                                observables=obs, trajectories=32,
+                                sampling_budget=1e-2),
+            wire.encode_request("gradient", circuit=c,
+                                observables=obs),
+            wire.encode_request("evolve", circuit=c, observables=obs,
+                                evolve={"t": 0.5, "steps": 8,
+                                        "order": 2}),
+            wire.encode_request("ground", circuit=c, observables=obs,
+                                ground={"steps": 4, "tau": 0.1,
+                                        "method": "power",
+                                        "tol": 1e-9}),
+        ]
+        for doc in docs:
+            wr = wire.decode_request(json.loads(wire.canonical_json(
+                doc)))
+            assert wr.kind == doc["kind"]
+            if wr.kind == "shots":
+                assert wr.submit_kwargs()["shots"] == 16
+            if wr.kind == "trajectory":
+                kw = wr.submit_kwargs()
+                assert kw["trajectories"] == 32
+                assert kw["sampling_budget"] == pytest.approx(1e-2)
+            if wr.kind == "gradient":
+                assert wr.submit_kwargs()["gradient"] is True
+            if wr.kind == "evolve":
+                assert wr.evolve.steps == 8
+                assert "evolve" in wr.submit_kwargs()
+            if wr.kind == "ground":
+                assert wr.ground.tau == pytest.approx(0.1)
+                assert "ground_state" in wr.submit_kwargs()
+
+    def test_absolute_deadline_keys_reject_by_name(self):
+        """The skewed-clock regression: no absolute client timestamp
+        is representable in v1, so a client clock cannot extend (or
+        shrink) a server-side deadline."""
+        base = self._req(timeout_s=5.0)
+        for key in ("deadline", "deadline_s", "deadline_epoch",
+                    "expires_at", "deadline_wall"):
+            doc = dict(base)
+            doc[key] = 4102444800.0          # far-future epoch
+            with pytest.raises(WireFormatError, match="RELATIVE"):
+                wire.decode_request(doc)
+
+    def test_unknown_top_level_key_rejects(self):
+        doc = self._req()
+        doc["shotz"] = 4
+        with pytest.raises(WireFormatError, match="shotz"):
+            wire.decode_request(doc)
+
+    def test_unknown_schema_rejects(self):
+        doc = self._req()
+        doc["schema"] = "quest_tpu.wire/99"
+        with pytest.raises(WireFormatError, match="schema"):
+            wire.decode_request(doc)
+
+    def test_unknown_kind_rejects(self):
+        with pytest.raises(WireFormatError, match="kind"):
+            wire.encode_request("teleport", circuit=_param_circuit())
+
+    def test_program_source_arity(self):
+        c = _param_circuit()
+        with pytest.raises(WireFormatError, match="exactly ONE"):
+            wire.encode_request("sweep", circuit=c, qasm="OPENQASM...")
+        with pytest.raises(WireFormatError, match="ONE program"):
+            wire.decode_request({"schema": wire.WIRE_SCHEMA,
+                                 "kind": "sweep"})
+
+    def test_bad_timeout_rejects(self):
+        for bad in (0.0, -1.0):
+            doc = self._req()
+            doc["timeout_s"] = bad
+            with pytest.raises(WireFormatError, match="timeout_s"):
+                wire.decode_request(doc)
+
+    def test_params_roundtrip_exact(self):
+        doc = self._req(params={"t0": 0.123456789012345,
+                                "t1": -2.5})
+        wr = wire.decode_request(json.loads(wire.canonical_json(doc)))
+        assert wr.params == {"t0": 0.123456789012345, "t1": -2.5}
+
+    def test_observables_shape_errors(self):
+        doc = self._req()
+        doc["observables"] = {"terms": "nope"}
+        with pytest.raises(WireFormatError, match="observables"):
+            wire.decode_request(doc)
+
+
+class TestResults:
+    def test_result_roundtrips(self):
+        planes = np.arange(8, dtype=np.float64).reshape(2, 4)
+        got = wire.parse_result("sweep", wire.encode_result("sweep",
+                                                            planes))
+        np.testing.assert_array_equal(got, planes)
+
+        assert wire.parse_result(
+            "expectation",
+            wire.encode_result("expectation", 0.25)) == 0.25
+
+        outcomes = np.array([0, 3, 1], dtype=np.int64)
+        o2, norm = wire.parse_result(
+            "shots", wire.encode_result("shots", (outcomes, 0.999)))
+        np.testing.assert_array_equal(o2, outcomes)
+        assert o2.dtype == np.int64
+        assert norm == pytest.approx(0.999)
+
+        mean, stderr = wire.parse_result(
+            "trajectory",
+            wire.encode_result("trajectory", (0.5, 0.01)))
+        assert (mean, stderr) == (0.5, 0.01)
+
+        v, g = wire.parse_result(
+            "gradient",
+            wire.encode_result("gradient",
+                               (1.5, np.array([0.1, -0.2]))))
+        assert v == 1.5
+        np.testing.assert_array_equal(g, [0.1, -0.2])
+
+        v, g, s = wire.parse_result(
+            "gradient",
+            wire.encode_result("gradient", (1.5, np.array([0.1]),
+                                            np.array([0.01]))))
+        np.testing.assert_array_equal(s, [0.01])
+
+        block = np.arange(6, dtype=np.float64)
+        np.testing.assert_array_equal(
+            wire.parse_result("evolve",
+                              wire.encode_result("evolve", block)),
+            block)
+
+    def test_unknown_result_kind_rejects(self):
+        with pytest.raises(WireFormatError):
+            wire.encode_result("teleport", 1.0)
+
+
+class TestCanonicalJson:
+    def test_sorted_and_compact(self):
+        assert wire.canonical_json({"b": 1, "a": [1, 2]}) \
+            == '{"a":[1,2],"b":1}'
+
+    def test_nan_rejects(self):
+        with pytest.raises(WireFormatError):
+            wire.canonical_json({"x": float("nan")})
+
+    def test_jsonable_numpy(self):
+        doc = wire.jsonable({"a": np.float64(1.5),
+                             "b": np.int32(3),
+                             "c": np.array([1.0, 2.0]),
+                             "d": np.bool_(True),
+                             "e": (1, "x", None)})
+        assert doc == {"a": 1.5, "b": 3, "c": [1.0, 2.0], "d": True,
+                       "e": [1, "x", None]}
+        json.dumps(doc)          # plain JSON types throughout

@@ -1,0 +1,51 @@
+"""Fast-tier smoke for tools/wire_trace.py: the pure span summary, and
+one tiny end-to-end run of the tool (a real loopback server + socket
+client on CPU) validating the ``quest_tpu.trace/1`` envelope, the wire
+span names, and the session hit accounting."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+import wire_trace  # noqa: E402
+
+
+def test_span_summary_stats():
+    traces = [
+        {"spans": [{"name": "parse", "duration_s": 0.001},
+                   {"name": "dispatch", "duration_s": 0.010}]},
+        {"spans": [{"name": "parse", "duration_s": 0.003},
+                   {"name": "open", "duration_s": None}]},
+    ]
+    out = wire_trace.span_summary(traces)
+    assert set(out) == {"parse", "dispatch"}    # None durations drop
+    assert out["parse"]["count"] == 2
+    assert out["parse"]["total_s"] == 0.004
+    assert out["parse"]["max_s"] == 0.003
+    assert out["dispatch"]["count"] == 1
+
+
+def test_wire_trace_end_to_end(tmp_path):
+    out = tmp_path / "wire.json"
+    rc = wire_trace.main(["--requests", "4", "--qubits", "2",
+                          "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == "quest_tpu.trace/1"
+    assert doc["kind"] == "wire"
+    assert doc["config"]["requests"] == 4
+    # every request carries the wire pipeline spans
+    spans = doc["span_summary"]
+    for name in ("parse", "queue", "dispatch", "serialize"):
+        assert spans[name]["count"] >= 4, name
+    # one implicit session; first submit registers, repeats hit
+    sessions = doc["sessions"]
+    assert len(sessions) == 1
+    (sess,) = sessions
+    assert sess["program_misses"] == 1
+    assert sess["program_hits"] == 3
+    assert sess["program_hit_rate"] == 0.75
+    assert doc["wire_metrics"]["requests_total"] == 4
+    assert doc["tracer"]["traces_retained"] >= 4

@@ -83,7 +83,8 @@ def enclosing_function_map(tree: ast.AST) -> dict:
 
 # -- QL001: host sync on a hot path ----------------------------------------
 
-HOT_PATH_PREFIXES = ("quest_tpu/serve/", "quest_tpu/ops/")
+HOT_PATH_PREFIXES = ("quest_tpu/serve/", "quest_tpu/ops/",
+                     "quest_tpu/netserve/")
 HOT_PATH_FILES = ("quest_tpu/circuits.py", "quest_tpu/parallel/pergate.py")
 # ops/doubledouble.py is exempt by construction: its float()/np.asarray
 # calls are host-scalar double-double constant splitting that runs at
@@ -94,7 +95,15 @@ HOT_PATH_FILES = ("quest_tpu/circuits.py", "quest_tpu/parallel/pergate.py")
 # one layer down in submit()/value_and_grad_sweep, which stay in scope
 QL001_EXEMPT = ("quest_tpu/ops/doubledouble.py",
                 "quest_tpu/serve/optimize.py",
-                "quest_tpu/serve/dynamics.py")
+                "quest_tpu/serve/dynamics.py",
+                # netserve's wire codec and sync client are HOST-side by
+                # design: they serialize already-resolved numpy results
+                # (np.asarray/float on concrete host arrays, never a
+                # tracer or device buffer). The server's dispatch path —
+                # which does touch the engine — lives in server.py and
+                # session.py, which stay in scope.
+                "quest_tpu/netserve/wire.py",
+                "quest_tpu/netserve/client.py")
 
 _SYNC_ATTRS = ("item", "block_until_ready")
 

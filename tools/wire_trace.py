@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Dump one netserve request trace as JSON: per-request wire spans
+plus per-session program-registry hit rates.
+
+Boots a small CPU :class:`~quest_tpu.serve.engine.SimulationService`
+behind a loopback :class:`~quest_tpu.netserve.server.NetServer` with
+tracing at ``sample_rate=1.0``, replays a mixed-kind request trace
+(sweep / expectation / shots / gradient, plus repeat submissions that
+exercise the ``circuit_ref`` fast path) through the stdlib socket
+client, and prints what the wire layer did:
+
+- per-request ``parse`` -> ``queue`` -> ``dispatch`` -> ``serialize``
+  spans (the ``quest_tpu.trace/1`` documents the server's tracer
+  retained), with a per-span duration summary;
+- per-session program-registry hit rates (the content-address win:
+  every repeat submission should be a hit);
+- the server's wire metrics snapshot (request counters, parse/
+  serialize latency percentiles, bytes in/out).
+
+Usage::
+
+    python tools/wire_trace.py --requests 24 --qubits 3
+    python tools/wire_trace.py --requests 64 --out wire.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_circuit(num_qubits: int):
+    from quest_tpu.circuits import Circuit
+    c = Circuit(num_qubits)
+    theta = c.parameter("theta")
+    phi = c.parameter("phi")
+    c.h(0)
+    for q in range(num_qubits - 1):
+        c.cnot(q, q + 1)
+    c.rx(0, theta)
+    c.ry(num_qubits - 1, phi)
+    return c
+
+
+def replay(client, circuit, ham, num_requests: int) -> list:
+    """The mixed-kind trace: one wire request per step, round-robin
+    over the kinds the submit endpoint serves, with params varied so
+    nothing short-circuits. Returns the resolved values."""
+    futs = []
+    for i in range(num_requests):
+        if i == 1:
+            # resolve the first submission before fanning out: the
+            # server now holds the program, so every later request
+            # rides the circuit_ref fast path (one registry miss,
+            # n-1 hits — deterministic for the smoke test)
+            futs[0].result(timeout=300)
+        params = {"theta": 0.1 + 0.01 * i, "phi": 0.2 + 0.005 * i}
+        which = i % 4
+        if which == 0:
+            futs.append(client.submit(circuit, params))
+        elif which == 1:
+            futs.append(client.submit(circuit, params,
+                                      observables=ham))
+        elif which == 2:
+            futs.append(client.submit(circuit, params, shots=8))
+        else:
+            futs.append(client.submit(circuit, params,
+                                      observables=ham, gradient=True))
+    return [f.result(timeout=300) for f in futs]
+
+
+def span_summary(traces: list) -> dict:
+    """Per-span-name duration stats over every retained trace."""
+    by_name: dict = {}
+    for tr in traces:
+        for sp in tr["spans"]:
+            if sp["duration_s"] is None:
+                continue
+            by_name.setdefault(sp["name"], []).append(sp["duration_s"])
+    out = {}
+    for name, durs in sorted(by_name.items()):
+        durs.sort()
+        out[name] = {
+            "count": len(durs),
+            "total_s": round(sum(durs), 6),
+            "mean_s": round(sum(durs) / len(durs), 9),
+            "p50_s": round(durs[len(durs) // 2], 9),
+            "max_s": round(durs[-1], 9),
+        }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=24,
+                    help="requests in the mixed-kind trace")
+    ap.add_argument("--qubits", type=int, default=3)
+    ap.add_argument("--max-batch", type=int, default=8)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _trace_io
+    _trace_io.add_output_argument(ap)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    import quest_tpu as qt
+    from quest_tpu.serve import SimulationService
+    from quest_tpu.netserve import NetClient, NetServer
+
+    env = qt.createQuESTEnv(num_devices=1, seed=[12345])
+    circuit = build_circuit(args.qubits)
+    ham = ([[(q, 3)] for q in range(args.qubits)],
+           [1.0] * args.qubits)
+
+    with SimulationService(env, max_batch=args.max_batch,
+                           max_wait_s=2e-3) as svc:
+        with NetServer(svc, trace_sample_rate=1.0) as srv:
+            with NetClient(srv.host, srv.port) as client:
+                replay(client, circuit, ham, args.requests)
+            traces = [ctx.to_dict() for ctx in srv.tracer.finished()]
+            sessions = srv.sessions.snapshot()
+            metrics = srv.metrics.snapshot()
+            tracer_stats = srv.tracer.stats()
+
+    doc = {
+        "config": {"requests": args.requests, "qubits": args.qubits,
+                   "max_batch": args.max_batch},
+        "tracer": tracer_stats,
+        "span_summary": span_summary(traces),
+        "sessions": sessions,
+        "wire_metrics": metrics,
+        "traces": traces,
+    }
+    _trace_io.emit(doc, kind="wire", out=args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
