@@ -3364,6 +3364,153 @@ def bench_netserve_config(qt, env, platform: str) -> dict:
     return rows[-1]
 
 
+def bench_netserve_chaos(qt, env, platform: str) -> dict:
+    # production wire cost, not the test-tier lock-order validator
+    from quest_tpu.testing import lockcheck as _lockcheck
+    with _lockcheck.suspended():
+        return _bench_netserve_chaos(qt, env, platform)
+
+
+def _bench_netserve_chaos(qt, env, platform: str) -> dict:
+    """Wire-chaos row (ISSUE 20): the SAME expectation trace through
+    the loopback socket fault-free and under seeded wire faults
+    (default 2% per request spread across every wire kind —
+    conn_reset / slow_read / torn_body / dup_delivery / stale_ref —
+    plus one guaranteed reset so the retry path always runs). Reports
+    requests/sec degradation vs the fault-free pass, the client's
+    retry/resend counters, the server's dedup replay/join accounting,
+    and two graded invariants: every completed chaos request returns
+    EXACTLY the fault-free value, and the dedup window proves zero
+    double dispatches."""
+    from quest_tpu.resilience import FaultInjector, FaultSpec, faults
+    from quest_tpu.serve import SimulationService
+    from quest_tpu.netserve import NetClient, NetServer
+
+    num_qubits = int(os.environ.get(
+        "QUEST_BENCH_NETCHAOS_QUBITS",
+        os.environ.get("QUEST_BENCH_NET_QUBITS", "10")))
+    n_req = int(os.environ.get(
+        "QUEST_BENCH_NETCHAOS_REQUESTS",
+        "256" if _remaining() > 120 else "64"))
+    num_terms = int(os.environ.get("QUEST_BENCH_NETCHAOS_TERMS", "8"))
+    layers = int(os.environ.get("QUEST_BENCH_NETCHAOS_LAYERS", "1"))
+    max_batch = int(os.environ.get("QUEST_BENCH_NETCHAOS_BATCH", "32"))
+    workers = int(os.environ.get("QUEST_BENCH_NETCHAOS_WORKERS", "32"))
+    fault_rate = float(os.environ.get("QUEST_BENCH_NETCHAOS_RATE",
+                                      "0.02"))
+    rng = np.random.default_rng(2028)
+    circ, n_gates, names = build_hea_circuit(num_qubits, layers)
+    codes = rng.integers(0, 4, size=(num_terms, num_qubits))
+    coeffs = rng.normal(size=num_terms)
+    ham = ([[(q_, int(codes[t, q_])) for q_ in range(num_qubits)]
+            for t in range(num_terms)], coeffs)
+    pm = rng.uniform(0.0, 2.0 * np.pi, size=(n_req, len(names)))
+    dev_desc = (f"single {platform} chip" if env.num_devices == 1
+                else f"{env.num_devices} {platform} devices")
+    label = (f"hardware-efficient-ansatz-{num_qubits}, {n_req} "
+             f"requests, {num_terms}-term Pauli sum, {dev_desc}")
+
+    def run_trace(injector):
+        svc = SimulationService(env, max_batch=max_batch,
+                                max_wait_s=5e-3,
+                                max_queue=n_req + max_batch,
+                                request_timeout_s=600.0)
+        try:
+            sizes = {min(max_batch, n_req)} | \
+                ({n_req % max_batch} if n_req % max_batch else set())
+            svc.warm(circ, batch_sizes=sorted(sizes - {0}),
+                     observables=ham)
+            with NetServer(svc) as srv:
+                with NetClient(srv.host, srv.port, max_workers=workers,
+                               retries=6, backoff_s=0.02,
+                               retry_seed=2028) as cl:
+                    # program registration rides outside the timed
+                    # window: steady-state requests use circuit_ref
+                    cl.submit(circ, dict(zip(names, pm[0])),
+                              observables=ham).result(timeout=600)
+                    ctx = faults.inject(injector) \
+                        if injector is not None \
+                        else contextlib.nullcontext()
+                    with ctx:
+                        t0 = time.perf_counter()
+                        futs = [cl.submit(circ, dict(zip(names, pm[i])),
+                                          observables=ham,
+                                          timeout_s=600.0)
+                                for i in range(n_req)]
+                        outcomes = []
+                        for f in futs:
+                            try:
+                                outcomes.append(
+                                    ("ok", float(f.result(timeout=600))))
+                            except Exception as e:   # typed: visible
+                                outcomes.append((type(e).__name__, None))
+                        dt = time.perf_counter() - t0
+                    stats = cl.stats
+                wm = srv.metrics.snapshot()
+                dd = srv.dedup.snapshot()
+        finally:
+            svc.close()
+        return outcomes, n_req / dt, stats, wm, dd
+
+    clean, clean_rate, _, _, _ = run_trace(None)
+    per_kind = fault_rate / len(faults.WIRE_KINDS)
+    specs = [FaultSpec(kind, site="netserve.request",
+                       probability=per_kind,
+                       at_calls=(2,) if kind == "conn_reset" else ())
+             for kind in faults.WIRE_KINDS]
+    inj = FaultInjector(specs, seed=2028, stall_s=0.01)
+    chaos, chaos_rate, stats, wm, dd = run_trace(inj)
+
+    # graded: a completed chaos request must return the fault-free value
+    incorrect = 0
+    typed_failures = 0
+    max_dev = 0.0
+    for (k1, v1), (k2, v2) in zip(clean, chaos):
+        if k2 != "ok":
+            typed_failures += 1
+            continue
+        if k1 != "ok":
+            continue
+        d = abs(v2 - v1)
+        max_dev = max(max_dev, d)
+        if d > 1e-10:
+            incorrect += 1
+
+    row = {
+        "metric": f"netserve wire chaos ({100.0 * fault_rate:.1f}% "
+                  f"injected wire faults over the loopback socket), "
+                  f"{label}",
+        "value": round(chaos_rate, 2),
+        "unit": "requests/sec",
+        "vs_baseline": 0.0,
+        "fault_free_rate": round(clean_rate, 2),
+        "degradation_pct": round(
+            100.0 * (1.0 - chaos_rate / max(clean_rate, 1e-9)), 2),
+        "injected_faults": inj.total_injected,
+        "client_retries": stats["retries"],
+        "client_resends": stats["resends"],
+        "dedup_replays": dd["replays"],
+        "dedup_joins": dd["joins"],
+        "wire_faults": wm.get("wire_faults", 0),
+        "typed_failures": typed_failures,
+        "incorrect_results": incorrect,          # graded: must be 0
+        "double_dispatches": dd["double_dispatches"],  # graded: must be 0
+        "max_energy_deviation": max_dev,
+    }
+    errors = []
+    if incorrect:
+        errors.append(f"{incorrect} chaos-run requests completed with "
+                      "values differing from the fault-free pass — "
+                      "silent corruption")
+    if dd["double_dispatches"]:
+        errors.append(f"{dd['double_dispatches']} request_ids "
+                      "dispatched more than once — the idempotency "
+                      "window leaked")
+    if errors:
+        row["errors"] = errors
+    return row
+
+
 def bench_density_noise(qt, env, platform: str) -> dict:
     """Density register with dephasing/damping channels (the BASELINE.json
     config-4 workload, width-reduced to 12 qubits everywhere — see the
@@ -3706,6 +3853,8 @@ def main() -> None:
             qt, platform)),
         ("netserve", 45, lambda: bench_netserve_config(qt, env,
                                                        platform)),
+        ("netserve_chaos", 45, lambda: bench_netserve_chaos(qt, env,
+                                                            platform)),
     ]
     if accel:
         # heavyweight compiles last on the tunnel (the heartbeat keeps a

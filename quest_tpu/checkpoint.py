@@ -23,7 +23,7 @@ import numpy as np
 from .qureg import Qureg
 
 __all__ = ["save", "load", "save_npz", "load_npz", "atomic_savez",
-           "CheckpointMismatch"]
+           "atomic_write_json", "CheckpointMismatch"]
 
 _META_NAME = "quest_meta.json"
 
@@ -42,6 +42,32 @@ def atomic_savez(path: str, **arrays) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    # quest: allow-broad-except(cleanup-and-reraise: the temp file must
+    # be unlinked on ANY interruption, including KeyboardInterrupt --
+    # the exception always propagates)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path: str, doc: dict) -> None:
+    """:func:`atomic_savez`'s crash-safe replace semantics for a JSON
+    document (same-directory temp + fsync + ``os.replace``) — the
+    persistence primitive for small host-side state tables (the
+    netserve drain snapshot). A crash mid-write leaves the previous
+    file intact; a torn half-document is never observable."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".json",
+                               prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True, separators=(",", ":"))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)

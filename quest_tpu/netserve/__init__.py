@@ -24,14 +24,18 @@ plumbing with:
 """
 
 from .errors import (WireError, WireFormatError, DigestMismatch,
-                     UnknownProgram, AuthError, StreamUnsupported,
-                     http_status, error_body)
+                     UnknownProgram, AuthError, SessionExpired,
+                     RequestTimeout, RateLimited, ServerOverloaded,
+                     UnknownStream, StreamUnsupported, http_status,
+                     error_body, retry_after_s)
 from .wire import (WIRE_SCHEMA, REQUEST_KINDS, canonical_json,
                    encode_circuit, decode_circuit, encode_request,
                    decode_request, encode_result, parse_result,
                    WireRequest)
 from .session import (AuthHook, StaticTokenAuth, OpenAuth, SessionGrant,
                       Session, SessionManager, ProgramRegistry)
+from .robust import (TokenBucket, DedupWindow, ResumableStream,
+                     backlog_estimate)
 from .server import NetServer
 from .client import NetClient
 
@@ -40,8 +44,12 @@ __all__ = [
     "encode_circuit", "decode_circuit", "encode_request",
     "decode_request", "encode_result", "parse_result", "WireRequest",
     "WireError", "WireFormatError", "DigestMismatch", "UnknownProgram",
-    "AuthError", "StreamUnsupported", "http_status", "error_body",
+    "AuthError", "SessionExpired", "RequestTimeout", "RateLimited",
+    "ServerOverloaded", "UnknownStream", "StreamUnsupported",
+    "http_status", "error_body", "retry_after_s",
     "AuthHook", "StaticTokenAuth", "OpenAuth", "SessionGrant",
     "Session", "SessionManager", "ProgramRegistry",
+    "TokenBucket", "DedupWindow", "ResumableStream",
+    "backlog_estimate",
     "NetServer", "NetClient",
 ]

@@ -12,21 +12,32 @@ exception                        status  classification
 ===============================  ======  ==============
 ``WireFormatError`` (bad form)   400     fatal
 ``AuthError``                    401     fatal
+``SessionExpired`` (TTL evict)   401     transient
 ``UnknownProgram``               404     transient
+``UnknownStream``                404     fatal
+``RequestTimeout`` (slow loris)  408     transient
 ``DigestMismatch``               409     fatal
 ``QueueFull`` / ``QuotaExceeded``  429   transient
+``RateLimited`` / ``ServerOverloaded``  429  transient
 ``NumericalFault`` (poison)      500     poison
 ``StreamUnsupported``            501     fatal
 ``CircuitBreakerOpen`` etc.      503     transient
 ``DeadlineExceeded``             504     transient
 ===============================  ======  ==============
+
+The 429 family and ``RequestTimeout`` carry ``retry_after_s`` in their
+``detail`` (and the server mirrors it into an HTTP ``Retry-After``
+header) so a well-behaved client backs off by the server's estimate of
+when capacity returns, not by a blind exponential guess.
 """
 
 from __future__ import annotations
 
 __all__ = ["WireError", "WireFormatError", "DigestMismatch",
-           "UnknownProgram", "AuthError", "StreamUnsupported",
-           "http_status", "error_body", "raise_typed"]
+           "UnknownProgram", "UnknownStream", "AuthError",
+           "SessionExpired", "RequestTimeout", "RateLimited",
+           "ServerOverloaded", "StreamUnsupported",
+           "http_status", "error_body", "retry_after_s", "raise_typed"]
 
 
 class WireError(Exception):
@@ -55,6 +66,43 @@ class AuthError(WireError):
     status = 401
 
 
+class SessionExpired(AuthError):
+    """A session the TTL sweep evicted for idleness. Transient by
+    contract: re-opening the session (POST /v1/session) and replaying
+    the request resolves it — the client's retry loop does both."""
+
+    classification = "transient"
+
+
+class RequestTimeout(WireError):
+    """The peer failed to deliver a complete request within the
+    server's read deadline (the slow-loris guard). The connection is
+    closed after this answer; a healthy client retries promptly on a
+    fresh connection."""
+
+    status = 408
+    classification = "transient"
+
+
+class RateLimited(WireError):
+    """The session's token bucket is empty — the per-session request
+    rate exceeded the server's ``rate_limit``. ``detail`` carries
+    ``retry_after_s``: when the next token lands."""
+
+    status = 429
+    classification = "transient"
+
+
+class ServerOverloaded(WireError):
+    """Priority-aware load shed: the backend queue depth crossed the
+    server's watermark and this request's priority class is sheddable.
+    ``detail`` carries ``retry_after_s``, derived from the WFQ backlog
+    estimate (queue depth x per-request service time)."""
+
+    status = 429
+    classification = "transient"
+
+
 class UnknownProgram(WireError):
     """A ``circuit_ref`` digest the server has no registered program
     for (evicted or never sent): re-submit the full circuit."""
@@ -69,6 +117,15 @@ class DigestMismatch(WireError):
     rejected, never silently served."""
 
     status = 409
+
+
+class UnknownStream(WireError):
+    """A stream-resume request named a stream id this server does not
+    hold (never opened, expired past its resume TTL, or the requested
+    cursor fell off the bounded replay buffer). Fatal for the RESUME
+    attempt: start a fresh stream instead of retrying the resume."""
+
+    status = 404
 
 
 class StreamUnsupported(WireError):
@@ -112,6 +169,17 @@ def error_body(exc: BaseException) -> dict:
     return body
 
 
+def retry_after_s(exc: BaseException):
+    """The server's backoff estimate riding a typed error (the
+    ``retry_after_s`` detail of the 429 family), or None."""
+    detail = getattr(exc, "detail", None)
+    if isinstance(detail, dict):
+        ra = detail.get("retry_after_s")
+        if isinstance(ra, (int, float)) and ra >= 0:
+            return ra
+    return None
+
+
 _CLIENT_TYPES = None
 
 
@@ -133,7 +201,12 @@ def raise_typed(status: int, err: dict) -> None:
             "WireFormatError": WireFormatError,
             "DigestMismatch": DigestMismatch,
             "UnknownProgram": UnknownProgram,
+            "UnknownStream": UnknownStream,
             "AuthError": AuthError,
+            "SessionExpired": SessionExpired,
+            "RequestTimeout": RequestTimeout,
+            "RateLimited": RateLimited,
+            "ServerOverloaded": ServerOverloaded,
             "StreamUnsupported": StreamUnsupported,
             "ValueError": ValueError,
             "TypeError": TypeError,
@@ -146,4 +219,9 @@ def raise_typed(status: int, err: dict) -> None:
         e = WireError(f"{name}: {msg} (HTTP {status})")
         e.status = status
         raise e
+    if issubclass(exc_type, WireError):
+        # typed detail survives the wire: the client retry loop reads
+        # retry_after_s off the re-raised exception exactly as an
+        # in-process caller would
+        raise exc_type(msg, detail=info.get("detail"))
     raise exc_type(msg)

@@ -57,8 +57,13 @@ _REQUEST_KEYS = frozenset({
     "schema", "kind", "circuit", "circuit_ref", "qasm", "params",
     "observables", "shots", "trajectories", "sampling_budget", "tier",
     "priority", "timeout_s", "evolve", "ground", "init_state",
-    "optimizer",
+    "optimizer", "request_id", "resumable",
 })
+
+#: client-chosen idempotency keys are opaque but bounded: the server's
+#: dedup window stores them verbatim, so a pathological id must not be
+#: able to balloon it
+_MAX_REQUEST_ID_LEN = 128
 
 
 def jsonable(obj):
@@ -218,7 +223,8 @@ class WireRequest:
     __slots__ = ("kind", "circuit_doc", "circuit_ref", "qasm", "params",
                  "observables", "shots", "trajectories",
                  "sampling_budget", "tier", "priority", "timeout_s",
-                 "evolve", "ground", "init_state", "optimizer")
+                 "evolve", "ground", "init_state", "optimizer",
+                 "request_id", "resumable")
 
     def __init__(self, **kw):
         for name in self.__slots__:
@@ -281,7 +287,8 @@ def encode_request(kind: str, *, circuit=None, circuit_ref=None,
                    qasm=None, params=None, observables=None, shots=None,
                    trajectories=None, sampling_budget=None, tier=None,
                    priority=None, timeout_s=None, evolve=None,
-                   ground=None, init_state=None, optimizer=None) -> dict:
+                   ground=None, init_state=None, optimizer=None,
+                   request_id=None, resumable=None) -> dict:
     """Build one canonical wire request document. ``circuit`` is a
     recorded Circuit (encoded inline), ``circuit_ref`` a digest the
     server already registered, ``qasm`` an OpenQASM 2.0 source string —
@@ -335,6 +342,10 @@ def encode_request(kind: str, *, circuit=None, circuit_ref=None,
         doc["init_state"] = {"planes": st.tolist()}
     if optimizer is not None:
         doc["optimizer"] = dict(optimizer)
+    if request_id is not None:
+        doc["request_id"] = str(request_id)
+    if resumable:
+        doc["resumable"] = True
     return doc
 
 
@@ -375,6 +386,18 @@ def decode_request(doc: dict) -> WireRequest:
         if not isinstance(params, dict):
             raise WireFormatError("params must be a name->angle object")
         params = {str(k): float(v) for k, v in params.items()}
+    request_id = doc.get("request_id")
+    if request_id is not None:
+        if not isinstance(request_id, str) or not request_id:
+            raise WireFormatError(
+                "request_id must be a non-empty string — it is the "
+                "idempotency key the dedup window stores verbatim")
+        if len(request_id) > _MAX_REQUEST_ID_LEN:
+            raise WireFormatError(
+                f"request_id exceeds {_MAX_REQUEST_ID_LEN} chars")
+    resumable = doc.get("resumable")
+    if resumable is not None and not isinstance(resumable, bool):
+        raise WireFormatError("resumable must be a JSON boolean")
     timeout_s = doc.get("timeout_s")
     if timeout_s is not None:
         timeout_s = float(timeout_s)
@@ -434,7 +457,8 @@ def decode_request(doc: dict) -> WireRequest:
         if doc.get("priority") is not None else None,
         timeout_s=timeout_s,
         evolve=evolve, ground=ground, init_state=init_state,
-        optimizer=doc.get("optimizer"))
+        optimizer=doc.get("optimizer"),
+        request_id=request_id, resumable=bool(resumable))
 
 
 # ---------------------------------------------------------------------------

@@ -35,6 +35,16 @@ Fault kinds:
   the replica it was about to pick, then must fail traffic over. At the
   intra-service boundaries they are no-ops — a single service cannot
   kill itself meaningfully.
+- ``"conn_reset"`` / ``"slow_read"`` / ``"torn_body"`` /
+  ``"dup_delivery"`` / ``"stale_ref"`` — WIRE-level failure domains
+  (:data:`WIRE_KINDS`): a socket reset before the response, a
+  slow-loris peer, a response truncated mid-body, the same request
+  delivered twice, and a ``circuit_ref`` whose program the server
+  evicted. These fire only at the netserve boundaries
+  (``"netserve.*"``, :func:`fire_wire`): the front door applies them to
+  the connection it is serving, and the client's idempotent retry loop
+  must absorb them. At the engine and router boundaries they are
+  no-ops — there is no socket to corrupt below the wire.
 
 Determinism: given the same specs, seed, and sequence of ``fire`` calls,
 the injected schedule is identical — ``at_calls`` schedules are exact,
@@ -56,8 +66,8 @@ import numpy as np
 
 __all__ = ["InjectedFault", "SimulatedOOM", "FaultSpec", "FaultInjector",
            "install", "uninstall", "active", "inject", "fire",
-           "fire_router", "poison_output", "SITES", "KINDS",
-           "REPLICA_KINDS", "POISON_KINDS"]
+           "fire_router", "fire_wire", "poison_output", "SITES",
+           "KINDS", "REPLICA_KINDS", "POISON_KINDS", "WIRE_KINDS"]
 
 # the dispatch boundaries that call fire() (site names are stable API —
 # tools/chaos_trace.py and the chaos tests target them by pattern)
@@ -74,10 +84,14 @@ SITES = (
     "serve.preempt",               # checkpointed-run mesh yield boundary
     "serve.scale",                 # autoscaler replica-pool resize
     "router.route",                # ServiceRouter placement decision
+    "netserve.request",            # wire front-door request dispatch
+    "netserve.stream",             # wire front-door stream setup
 )
 
 KINDS = ("transient", "oom", "nan", "precision", "stall",
-         "replica_crash", "replica_stall")
+         "replica_crash", "replica_stall",
+         "conn_reset", "slow_read", "torn_body", "dup_delivery",
+         "stale_ref")
 
 # the output-corrupting subset: fire() returns the kind for the caller
 # to apply to its dispatch RESULT via poison_output()
@@ -86,6 +100,11 @@ POISON_KINDS = ("nan", "precision")
 # the replica-scoped subset: returned by fire_router() for the router
 # to apply to its chosen replica, inert at every other boundary
 REPLICA_KINDS = ("replica_crash", "replica_stall")
+
+# the wire-scoped subset: returned by fire_wire() for the netserve
+# front door to apply to the connection it serves, inert everywhere else
+WIRE_KINDS = ("conn_reset", "slow_read", "torn_body", "dup_delivery",
+              "stale_ref")
 
 
 class InjectedFault(RuntimeError):
@@ -291,8 +310,10 @@ def fire(site: str):
     if kind == "stall":
         time.sleep(inj.stall_s)
         return False
-    if kind in REPLICA_KINDS:
-        return False    # replica faults only mean something to the router
+    if kind in REPLICA_KINDS or kind in WIRE_KINDS:
+        # replica faults only mean something to the router, wire faults
+        # only to the netserve front door
+        return False
     return kind     # "nan"/"precision": caller corrupts its output
 
 
@@ -308,9 +329,36 @@ def fire_router(site: str) -> Optional[str]:
     if inj is None:
         return None
     kind = inj.draw(site)
-    if kind is None or kind in POISON_KINDS:
+    if kind is None or kind in POISON_KINDS or kind in WIRE_KINDS:
         return None
     if kind in REPLICA_KINDS:
+        return kind
+    if kind == "transient":
+        raise InjectedFault(f"injected transient fault at {site}")
+    if kind == "oom":
+        raise SimulatedOOM(
+            f"RESOURCE_EXHAUSTED: injected simulated OOM at {site}")
+    time.sleep(inj.stall_s)     # "stall"
+    return None
+
+
+def fire_wire(site: str) -> Optional[str]:
+    """The NETSERVE-boundary hook. Wire-scoped kinds are not raised —
+    only the front door owns the socket, so :data:`WIRE_KINDS` are
+    RETURNED for the server to apply to the connection it is serving
+    (reset it, trickle it, tear the body, re-deliver the request, or
+    evict the referenced program first). Every other kind behaves
+    exactly as at the engine boundaries (transient/oom raise — they
+    surface as typed 500s the client may retry — and stall sleeps); the
+    output-corrupting and replica-scoped kinds have no wire meaning and
+    are dropped. None = a clean request."""
+    inj = _ACTIVE
+    if inj is None:
+        return None
+    kind = inj.draw(site)
+    if kind is None or kind in POISON_KINDS or kind in REPLICA_KINDS:
+        return None
+    if kind in WIRE_KINDS:
         return kind
     if kind == "transient":
         raise InjectedFault(f"injected transient fault at {site}")

@@ -363,6 +363,18 @@ _WIRE_COUNTERS = (
     "streams_opened",        # chunked-transfer streams started
     "stream_events",         # ndjson events written across all streams
     "stream_cancels",        # handles cancelled by client disconnect
+    # the hardened front door (ISSUE 20):
+    "dedup_hits",            # duplicate request_ids replayed from cache
+    "dedup_joins",           # duplicates that joined an in-flight original
+    "rate_limited",          # 429s from the per-session token bucket
+    "load_shed",             # 429s from priority-aware overload shedding
+    "read_timeouts",         # 408 slow-loris kills (read deadline)
+    "conn_rejected",         # connections refused at max_connections
+    "sessions_expired",      # sessions evicted by the idle TTL sweep
+    "streams_resumed",       # successful stream-resume attachments
+    "wire_faults",           # injected wire faults applied at this door
+    "drains",                # graceful drains completed
+    "programs_restored",     # programs readmitted from persisted state
 )
 
 

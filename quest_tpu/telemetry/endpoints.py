@@ -10,7 +10,14 @@ has exactly one answer per process:
 - ``/metrics.json`` — the versioned JSON snapshot
   (:func:`~quest_tpu.telemetry.export.json_snapshot`);
 - ``/healthz`` — a replica/breaker summary built from the health
-  source's ``dispatch_stats()`` (absent on the bare exporter: 404).
+  source's ``dispatch_stats()`` (absent on the bare exporter: 404);
+- ``/healthz/live`` — pure liveness: always ``200 {"status": "alive"}``
+  while the process answers at all. A draining or overloaded server is
+  still ALIVE — orchestrators must not kill it for shedding load;
+- ``/healthz/ready`` — readiness: 200 only when the health source is
+  healthy AND the mounting server's ``readiness`` hook (if any) reports
+  ``ready`` — a draining netserve flips this to 503 so load balancers
+  stop routing to it while in-flight work finishes.
 
 The resolver is transport-agnostic: it maps a path to a
 ``(status, content_type, body_bytes)`` triple and never touches
@@ -71,12 +78,17 @@ class ObservabilityEndpoints:
     """Path -> ``(status, content_type, body)`` for the shared
     observability surface. ``health_source`` is anything with a
     ``dispatch_stats()`` (a router or service); without one,
-    ``/healthz`` answers 404 (the bare exporter's contract)."""
+    ``/healthz`` answers 404 (the bare exporter's contract).
+    ``readiness`` is an optional zero-arg hook returning a dict with a
+    boolean ``"ready"`` (plus any detail to surface) — the mounting
+    server's own admission state (e.g. netserve draining), AND-ed into
+    ``/healthz/ready``."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 health_source=None):
+                 health_source=None, readiness=None):
         self._registry = registry
         self._health_source = health_source
+        self._readiness = readiness
 
     def resolve(self, path: str):
         """Serve one observability path; None when the path is not an
@@ -90,6 +102,11 @@ class ObservabilityEndpoints:
         if path.startswith("/metrics"):
             return (200, "text/plain; version=0.0.4",
                     prometheus_text(self._registry).encode())
+        # the subpaths MUST be checked before the bare /healthz prefix
+        if path.startswith("/healthz/live"):
+            return 200, "application/json", b'{"status": "alive"}'
+        if path.startswith("/healthz/ready"):
+            return self._ready()
         if path.startswith("/healthz"):
             if self._health_source is None:
                 return (404, "application/json",
@@ -99,3 +116,22 @@ class ObservabilityEndpoints:
             return (status, "application/json",
                     json.dumps(summary, default=str).encode())
         return None
+
+    def _ready(self):
+        """Readiness = backend health AND the server's own admission
+        state. Either signal alone can flip routing off (503) without
+        claiming the process is dead — that is /healthz/live's job."""
+        if self._health_source is None and self._readiness is None:
+            return (404, "application/json",
+                    b'{"error": "no readiness source mounted"}')
+        summary: dict = {"status": "ok"}
+        if self._health_source is not None:
+            summary = health_summary(self._health_source.dispatch_stats())
+        ready = summary.get("status") == "ok"
+        if self._readiness is not None:
+            local = self._readiness()
+            summary.update(local)
+            ready = ready and bool(local.get("ready", True))
+        summary["ready"] = ready
+        return (200 if ready else 503, "application/json",
+                json.dumps(summary, default=str).encode())

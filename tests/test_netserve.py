@@ -263,7 +263,9 @@ class TestBackpressureAndDeadlines:
         with SimulationService(net.env, max_queue=3, max_batch=8,
                                max_wait_s=5e-3) as svc:
             with NetServer(svc) as srv:
-                with NetClient(srv.host, srv.port) as cl:
+                # retries=0: this test asserts the FAIL-FAST typed 429,
+                # not the retry loop's eventual success
+                with NetClient(srv.host, srv.port, retries=0) as cl:
                     c = _hea(2)
                     svc.pause()
                     futs = [cl.submit(c, _params(c, i))

@@ -625,6 +625,89 @@ class TestQL004DynamicsBoundaries:
         assert "serve.evolve" in vs[0].message
 
 
+# the ISSUE-20 boundaries: the network front door's request dispatch
+# and stream relay carry the same trio contract, anchored by the
+# wire-scoped fire_wire() variant
+FAKE_FAULTS_WIRE = """
+    SITES = (
+        "netserve.request",
+        "netserve.stream",
+    )
+"""
+
+
+class TestQL004WireBoundaries:
+    def test_fire_wire_trio_passes(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_WIRE)
+        srv = make_file(tmp_path, "quest_tpu/netserve/server.py", """
+            def _submit_blocking(self, sess, doc):
+                sp = _profile.profile_dispatch("netserve.request")
+                poison = _faults.fire_wire("netserve.request")
+                with dispatch_annotation("quest_tpu.netserve.request"):
+                    return self._backend.submit(doc)
+            def _keeps_site_alive():
+                sp = profile_dispatch("netserve.stream")
+                _faults.fire_wire("netserve.stream")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        assert rules.rule_ql004_dispatch_boundaries(
+            [faults, srv], ROOT) == []
+
+    def test_fire_wire_without_annotation_flags(self, tmp_path):
+        """netserve/ is whole-tree scoped, and the fire_wire leaf
+        anchors the boundary the same way fire does."""
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_WIRE)
+        srv = make_file(tmp_path, "quest_tpu/netserve/server.py", """
+            def _submit_blocking(self, sess, doc):
+                sp = _profile.profile_dispatch("netserve.request")
+                poison = _faults.fire_wire("netserve.request")
+                return self._backend.submit(doc)
+            def _keeps_site_alive():
+                sp = profile_dispatch("netserve.stream")
+                _faults.fire_wire("netserve.stream")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, srv], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "annotation" in vs[0].message
+
+    def test_fire_wire_without_profiler_flags(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_WIRE)
+        srv = make_file(tmp_path, "quest_tpu/netserve/server.py", """
+            def _stream_setup_blocking(self, sess, doc):
+                poison = _faults.fire_wire("netserve.stream")
+                with dispatch_annotation("quest_tpu.netserve.stream"):
+                    return self._backend.submit(doc)
+            def _keeps_site_alive():
+                sp = profile_dispatch("netserve.request")
+                _faults.fire_wire("netserve.request")
+                with dispatch_annotation("x"):
+                    pass
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, srv], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "profile_dispatch" in vs[0].message
+
+    def test_deleted_wire_hook_is_a_coverage_loss(self, tmp_path):
+        faults = make_file(tmp_path, "quest_tpu/resilience/faults.py",
+                           FAKE_FAULTS_WIRE)
+        srv = make_file(tmp_path, "quest_tpu/netserve/server.py", """
+            def _submit_blocking(self, sess, doc):
+                sp = profile_dispatch("netserve.request")
+                _faults.fire_wire("netserve.request")
+                with dispatch_annotation("r"):
+                    pass
+        """)
+        vs = rules.rule_ql004_dispatch_boundaries([faults, srv], ROOT)
+        assert codes(vs) == ["QL004"]
+        assert "netserve.stream" in vs[0].message
+
+
 # -- QL005 ------------------------------------------------------------------
 
 class TestQL005TraceHeader:

@@ -27,9 +27,28 @@ def test_span_summary_stats():
     assert out["dispatch"]["count"] == 1
 
 
+def test_resilience_events_timeline():
+    traces = [{"spans": [
+        {"name": "dedup", "t_wall": 2.0, "trace_id": "b",
+         "attrs": {"state": "replay", "request_id": "r1"}},
+        {"name": "error", "t_wall": 1.0, "trace_id": "a",
+         "attrs": {"type": "ServerOverloaded"}},
+        {"name": "error", "t_wall": 3.0, "trace_id": "c",
+         "attrs": {"type": "DigestMismatch"}},
+        {"name": "parse", "t_wall": 0.5, "trace_id": "a",
+         "attrs": {}},
+    ]}]
+    evs = wire_trace.resilience_events(traces)
+    # typed instants only, wall-time order, mapped labels
+    assert [e["event"] for e in evs] == \
+        ["shed", "dedup.replay", "error.DigestMismatch"]
+    assert evs[1]["attrs"]["request_id"] == "r1"
+
+
 def test_wire_trace_end_to_end(tmp_path):
     out = tmp_path / "wire.json"
     rc = wire_trace.main(["--requests", "4", "--qubits", "2",
+                          "--chaos-requests", "4", "--seed", "11",
                           "--out", str(out)])
     assert rc == 0
     doc = json.loads(out.read_text())
@@ -49,3 +68,23 @@ def test_wire_trace_end_to_end(tmp_path):
     assert sess["program_hit_rate"] == 0.75
     assert doc["wire_metrics"]["requests_total"] == 4
     assert doc["tracer"]["traces_retained"] >= 4
+    # the resilience phase: both deterministic faults fired, the client
+    # retried through them (at least one landing as a dedup replay),
+    # the paused-backend burst crossed the shed watermark, and the
+    # drain persisted the session + program state
+    res = doc["resilience"]
+    assert res["faults"]["total_injected"] == 2
+    assert res["faults"]["injected_by_kind"] == {"conn_reset": 1,
+                                                 "torn_body": 1}
+    assert res["client"]["retries"] >= 1
+    assert res["server"]["load_shed"] >= 1
+    assert res["server"]["wire_faults"] == 2
+    assert res["dedup_window"]["replays"] >= 1
+    assert res["dedup_window"]["double_dispatches"] == 0
+    names = {e["event"] for e in res["events"]}
+    assert "shed" in names and "dedup.replay" in names
+    ts = [e["t_wall"] for e in res["events"]]
+    assert ts == sorted(ts)
+    assert res["drain"]["persisted"] is True
+    assert res["drain"]["sessions"] >= 1
+    assert res["drain"]["programs"] >= 1

@@ -273,9 +273,11 @@ def rule_ql003_untyped_except(files, root):
 QL004_FILES = ("quest_tpu/serve/engine.py", "quest_tpu/circuits.py",
                "quest_tpu/parallel/pergate.py")
 # ANY file under these trees is in scope for the boundary checks — a
-# NEW dispatch site added under serve/ or ops/ must carry the full trio
-# (fault hook + trace annotation + profiler hook) from day one
-QL004_TREE_PREFIXES = ("quest_tpu/serve/", "quest_tpu/ops/")
+# NEW dispatch site added under serve/, ops/, or netserve/ must carry
+# the full trio (fault hook + trace annotation + profiler hook) from
+# day one
+QL004_TREE_PREFIXES = ("quest_tpu/serve/", "quest_tpu/ops/",
+                       "quest_tpu/netserve/")
 FAULTS_PATH = "quest_tpu/resilience/faults.py"
 _ANNOTATION_NAMES = ("dispatch_annotation", "TraceAnnotation")
 _PROFILE_NAMES = ("profile_dispatch",)
@@ -350,7 +352,10 @@ def rule_ql004_dispatch_boundaries(files, root):
                     has_ann = True
                 if leaf in _PROFILE_NAMES:
                     has_prof = True
-                if (leaf == "fire" or "inject" in leaf) and any(
+                # fire() and its scoped variants (fire_wire,
+                # fire_router) all anchor a boundary
+                if (leaf == "fire" or leaf.startswith("fire_")
+                        or "inject" in leaf) and any(
                         isinstance(a, ast.Constant)
                         and a.value in dispatch_sites
                         for a in node.args):

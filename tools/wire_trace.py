@@ -15,12 +15,18 @@ client, and prints what the wire layer did:
 - per-session program-registry hit rates (the content-address win:
   every repeat submission should be a hit);
 - the server's wire metrics snapshot (request counters, parse/
-  serialize latency percentiles, bytes in/out).
+  serialize latency percentiles, bytes in/out);
+- a resilience section (``--chaos-requests > 0``): a second server run
+  under deterministic injected wire faults plus a shed burst against a
+  paused backend, rendered as a chronological retry/dedup/shed event
+  timeline, the client's retry counters, the dedup-window snapshot,
+  and the graceful-drain summary.
 
 Usage::
 
     python tools/wire_trace.py --requests 24 --qubits 3
     python tools/wire_trace.py --requests 64 --out wire.json
+    python tools/wire_trace.py --chaos-requests 8 --seed 11
 """
 
 from __future__ import annotations
@@ -28,6 +34,8 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import tempfile
+import time
 
 
 def build_circuit(num_qubits: int):
@@ -91,12 +99,112 @@ def span_summary(traces: list) -> dict:
     return out
 
 
+# trace instants -> resilience timeline labels: the server records
+# dedup outcomes and typed-error kinds as zero-duration spans; these
+# are the wire-level events worth reading in order
+_EVENT_LABELS = {
+    "ServerOverloaded": "shed",
+    "RateLimited": "rate_limited",
+    "RequestTimeout": "read_timeout",
+}
+
+
+def resilience_events(traces: list) -> list:
+    """Chronological retry/dedup/shed event timeline from the retained
+    traces: every ``dedup`` instant (replay/join) and every typed-error
+    instant, sorted by wall time."""
+    evs = []
+    for tr in traces:
+        for sp in tr["spans"]:
+            if sp["name"] == "dedup":
+                evs.append({
+                    "t_wall": sp["t_wall"], "trace_id": sp["trace_id"],
+                    "event": f"dedup.{sp['attrs'].get('state')}",
+                    "attrs": dict(sp["attrs"]),
+                })
+            elif sp["name"] == "error":
+                etype = sp["attrs"].get("type")
+                evs.append({
+                    "t_wall": sp["t_wall"], "trace_id": sp["trace_id"],
+                    "event": _EVENT_LABELS.get(etype, f"error.{etype}"),
+                    "attrs": dict(sp["attrs"]),
+                })
+    evs.sort(key=lambda e: e["t_wall"])
+    return evs
+
+
+def chaos_replay(svc, circuit, ham, num_requests: int, seed: int) -> dict:
+    """Exercise the resilience machinery on a fresh rate-limited server:
+    deterministic conn_reset/torn_body faults force client retries that
+    land as dedup replays, a burst against a paused backend crosses the
+    shed watermark, and a graceful drain closes the run."""
+    from quest_tpu.netserve import NetClient, NetServer
+    from quest_tpu.resilience import FaultInjector, FaultSpec, faults
+
+    specs = [FaultSpec("conn_reset", site="netserve.request",
+                       at_calls=(1,)),
+             FaultSpec("torn_body", site="netserve.request",
+                       at_calls=(3,))]
+    inj = FaultInjector(specs, seed=seed, stall_s=0.01)
+    with tempfile.TemporaryDirectory() as tmp:
+        with NetServer(svc, trace_sample_rate=1.0,
+                       rate_limit=(50.0, 4), shed_watermark=1,
+                       state_path=os.path.join(tmp, "netstate.json")) \
+                as srv:
+            with NetClient(srv.host, srv.port, retries=6,
+                           backoff_s=0.05, retry_seed=seed) as client:
+                with faults.inject(inj):
+                    futs = [client.submit(
+                        circuit,
+                        {"theta": 0.3 + 0.01 * i, "phi": 0.1},
+                        observables=ham, timeout_s=120.0)
+                        for i in range(num_requests)]
+                    for f in futs:
+                        f.result(timeout=300)
+                # shed burst: the paused backend holds one request in
+                # queue; the rest cross the watermark, answer 429, and
+                # the client's backoff carries them through resume()
+                svc.pause()
+                try:
+                    futs = [client.submit(
+                        circuit, {"theta": 0.7 + 0.01 * i, "phi": 0.2},
+                        observables=ham, priority=2, timeout_s=120.0)
+                        for i in range(4)]
+                    time.sleep(0.05)
+                finally:
+                    svc.resume()
+                for f in futs:
+                    f.result(timeout=300)
+                client_stats = client.stats
+            drain = srv.drain()
+            traces = [ctx.to_dict() for ctx in srv.tracer.finished()]
+            metrics = srv.metrics.snapshot()
+            dedup = srv.dedup.snapshot()
+    keys = ("dedup_hits", "dedup_joins", "rate_limited", "load_shed",
+            "read_timeouts", "conn_rejected", "wire_faults",
+            "sessions_expired", "streams_resumed", "drains")
+    return {
+        "config": {"chaos_requests": num_requests, "seed": seed},
+        "events": resilience_events(traces),
+        "client": client_stats,
+        "server": {k: metrics.get(k, 0) for k in keys},
+        "dedup_window": dedup,
+        "faults": inj.snapshot(),
+        "drain": drain,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=24,
                     help="requests in the mixed-kind trace")
     ap.add_argument("--qubits", type=int, default=3)
     ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--chaos-requests", type=int, default=6,
+                    help="requests in the injected-fault resilience "
+                         "phase (0 disables it)")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="fault-injection + retry-jitter seed")
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import _trace_io
     _trace_io.add_output_argument(ap)
@@ -125,6 +233,10 @@ def main(argv=None) -> int:
             sessions = srv.sessions.snapshot()
             metrics = srv.metrics.snapshot()
             tracer_stats = srv.tracer.stats()
+        resilience = None
+        if args.chaos_requests > 0:
+            resilience = chaos_replay(svc, circuit, ham,
+                                      args.chaos_requests, args.seed)
 
     doc = {
         "config": {"requests": args.requests, "qubits": args.qubits,
@@ -133,6 +245,7 @@ def main(argv=None) -> int:
         "span_summary": span_summary(traces),
         "sessions": sessions,
         "wire_metrics": metrics,
+        "resilience": resilience,
         "traces": traces,
     }
     _trace_io.emit(doc, kind="wire", out=args.out)
